@@ -1,0 +1,10 @@
+"""ra-tpu: a TPU-native multi-Raft consensus framework.
+
+Brand-new implementation with the capabilities of RabbitMQ Ra
+(reference at /root/reference, studied — not ported): thousands of
+co-hosted Raft clusters whose hot vote/commit arithmetic is evaluated as
+batched XLA kernels, with a pure host-side core as the oracle and the
+handler of rare/divergent transitions.
+"""
+
+__version__ = "0.1.0"

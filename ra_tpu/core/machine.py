@@ -1,0 +1,162 @@
+"""User state-machine behaviour — the ra_machine equivalent.
+
+Mirrors the callback contract of /root/reference/src/ra_machine.erl:233-287:
+mandatory ``init/1`` + ``apply/3``; optional ``state_enter/2``, ``tick/2``,
+``snapshot_installed/4``, aux handlers, ``overview/1`` and versioning
+(``version/0`` + ``which_module/1``).
+
+Two flavours exist:
+
+* :class:`Machine` — the classic host-side behaviour.  ``apply`` runs in
+  Python on the host, may return arbitrary effects, and state may be any
+  Python object.  This is always available and is the default.
+* :class:`JitMachine` — the TPU-native variant (the ``ra_machine_xla`` of the
+  north star).  Its ``apply`` must be a pure, shape-stable JAX function
+  ``(meta_array, cmd_array, state_pytree) -> (state_pytree, reply_array)``
+  so committed batches can be folded on-device with ``lax.scan`` by the lane
+  engine (see ra_tpu/ops/apply_fold.py).  A JitMachine also provides the
+  host-side protocol so the same machine works on both paths.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from .types import Effects
+
+
+@dataclass(frozen=True)
+class ApplyMeta:
+    """Metadata passed to apply/3 (ra_machine:command_meta_data())."""
+
+    index: int
+    term: int
+    system_time: float = 0.0
+    machine_version: int = 0
+    from_: Any = None
+    reply_mode: Any = None
+
+
+class Machine:
+    """Base class for host-side state machines.
+
+    Subclasses must override :meth:`init` and :meth:`apply`.  All other
+    callbacks have no-op defaults matching the optional-callback semantics of
+    the reference (ra_machine.erl:211-221).
+    """
+
+    #: bump when apply semantics change; see version gating in the core
+    #: (ra_server.erl:2671-2732)
+    version: int = 0
+
+    def init(self, config: dict) -> Any:
+        raise NotImplementedError
+
+    def apply(self, meta: ApplyMeta, command: Any, state: Any):
+        """Apply a committed user command.
+
+        Returns ``(new_state, reply)`` or ``(new_state, reply, effects)``.
+        """
+        raise NotImplementedError
+
+    # -- optional callbacks -------------------------------------------------
+
+    def state_enter(self, raft_state: str, state: Any) -> Effects:
+        return []
+
+    def tick(self, time_ms: float, state: Any) -> Effects:
+        return []
+
+    def snapshot_installed(self, meta, state, old_meta, old_state) -> Effects:
+        return []
+
+    def init_aux(self, name: str) -> Any:
+        return None
+
+    def handle_aux(self, raft_state: str, msg_type: str, msg: Any,
+                   aux_state: Any, internal) -> tuple:
+        """Returns (aux_state, effects)."""
+        return aux_state, []
+
+    def overview(self, state: Any) -> Any:
+        return state
+
+    def which_module(self, version: int) -> "Machine":
+        """Machine-version dispatch (ra_machine.erl:346-362).  Return the
+        machine implementing ``version``; default: self for all versions."""
+        return self
+
+    def snapshot_module(self):
+        """Override to customise the snapshot format (ra_machine.erl:435)."""
+        return None
+
+    def live_indexes(self, state: Any) -> list:
+        return []
+
+
+class SimpleMachine(Machine):
+    """Wraps a plain ``fun(command, state) -> state`` as a machine — the
+    ``{simple, Fun, Init}`` config variant (ra_machine_simple.erl, selected in
+    ra_server.erl:277-283).  Replies are the new state."""
+
+    def __init__(self, fn: Callable[[Any, Any], Any], initial_state: Any):
+        self._fn = fn
+        self._initial = initial_state
+
+    def init(self, config: dict) -> Any:
+        return self._initial
+
+    def apply(self, meta: ApplyMeta, command: Any, state: Any):
+        new_state = self._fn(command, state)
+        return new_state, new_state
+
+
+class JitMachine(Machine):
+    """TPU-native machine: committed commands are dense arrays folded
+    on-device.
+
+    Contract (enforced by the lane engine, not here):
+
+    * ``state`` is a JAX pytree of fixed-shape arrays (one leading lane axis
+      when used under the batched engine).
+    * :meth:`jit_apply` is pure and traceable: it is called under ``jit`` /
+      ``vmap`` / ``lax.scan`` and must not use data-dependent Python control
+      flow.
+    * :meth:`encode_command` / :meth:`decode_reply` convert between host
+      commands and the dense on-device representation.
+    """
+
+    #: shape/dtype spec of one encoded command, e.g. ("int32", (2,))
+    command_spec: tuple = ("int32", ())
+    #: shape/dtype spec of one reply
+    reply_spec: tuple = ("int32", ())
+
+    def jit_init(self, n_lanes: int) -> Any:
+        """Return the initial state pytree with a leading lane axis."""
+        raise NotImplementedError
+
+    def jit_apply(self, meta, command, state):
+        """Pure JAX apply: (meta arrays, encoded cmd, state) -> (state, reply)."""
+        raise NotImplementedError
+
+    def encode_command(self, command: Any):
+        raise NotImplementedError
+
+    def decode_reply(self, reply_array) -> Any:
+        return reply_array
+
+    # -- host-side protocol so JitMachines also run on the classic path ----
+
+    def init(self, config: dict) -> Any:
+        import numpy as np  # local import: host path only
+        import jax
+        state = self.jit_init(1)
+        return jax.tree.map(lambda x: np.asarray(x)[0], state)
+
+    def apply(self, meta: ApplyMeta, command: Any, state: Any):
+        import jax.numpy as jnp
+        import jax
+        meta_arr = {"index": jnp.int32(meta.index), "term": jnp.int32(meta.term)}
+        enc = self.encode_command(command)
+        new_state, reply = self.jit_apply(meta_arr, enc, state)
+        return new_state, self.decode_reply(reply)

@@ -1,0 +1,1498 @@
+"""Pure Raft core — the ra_server equivalent.
+
+This is the host-side *oracle* implementation of the per-cluster Raft state
+machine: every transition mirrors the semantics of
+/root/reference/src/ra_server.erl (cited per-function below) but is written
+as a Python class whose handlers are ``(event) -> effects`` with the next
+Raft state recorded in ``self.raft_state``.  Side effects are **data**
+(ra_tpu.core.types effect dataclasses) executed by the shell
+(ra_tpu.proc.ServerProcess) — the same purity contract as the reference,
+which is what lets the hot arithmetic (quorum evaluation, vote counting,
+heartbeat quorum) also be implemented as batched XLA kernels in ra_tpu.ops:
+the lane engine keeps thousands of these cores' *hot fields* in SoA arrays
+and uses this class only for rare/divergent transitions and as the
+conformance oracle for kernel tests.
+
+Design note (TPU-first): nothing in this module performs I/O or blocks.  The
+log is an injected object with memtable semantics; durability is observed
+only through WrittenEvent messages, so a leader's own fsync participates in
+the commit quorum exactly like a follower's reply (ra_server.erl:2977-2993).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Optional
+
+from .machine import ApplyMeta, Machine
+from .types import (
+    RA_PROTO_VERSION,
+    AppendEntriesReply,
+    AppendEntriesRpc,
+    AuxEffect,
+    CancelElectionTimeout,
+    Checkpoint,
+    ClusterChangeCommand,
+    ClusterDeleteCommand,
+    CommandEvent,
+    CommandsEvent,
+    CommandResult,
+    ConsistentQueryEvent,
+    ElectionTimeout,
+    Entry,
+    ErrorResult,
+    ForceElectionEvent,
+    GarbageCollection,
+    HeartbeatReply,
+    HeartbeatRpc,
+    IdxTerm,
+    InstallSnapshotResult,
+    InstallSnapshotRpc,
+    JoinCommand,
+    LeaveCommand,
+    LogReadEffect,
+    Membership,
+    Monitor,
+    NextEvent,
+    NoopCommand,
+    Notify,
+    PeerStatus,
+    PreVoteResult,
+    PreVoteRpc,
+    Priority,
+    PromoteCheckpoint,
+    RaftState,
+    RecordLeader,
+    ReleaseCursor,
+    Reply,
+    ReplyMode,
+    RequestVoteResult,
+    RequestVoteRpc,
+    SendMsg,
+    SendRpc,
+    SendSnapshot,
+    SendVoteRequests,
+    ServerConfig,
+    ServerId,
+    SnapshotMeta,
+    StartElectionTimeout,
+    TickEvent,
+    TimerEffect,
+    TransferLeadershipEvent,
+    UserCommand,
+    WrittenEvent,
+)
+
+
+@dataclass
+class Peer:
+    """Per-peer replication state (ra.hrl:62-73, new_peer ra_server.erl:3006)."""
+
+    next_index: int = 1
+    match_index: int = 0
+    commit_index_sent: int = 0
+    query_index: int = 0
+    status: PeerStatus = PeerStatus.NORMAL
+    membership: Membership = Membership.VOTER
+    promote_target: int = 0  # promotable non-voter: target index
+    snapshot_sender: Any = None  # token of in-flight snapshot send
+
+
+@dataclass
+class Condition:
+    """await_condition descriptor (ra_server.erl await_condition state)."""
+
+    predicate: Callable  # (event, server) -> bool
+    transition_to: RaftState = RaftState.FOLLOWER
+    timeout_ms: Optional[int] = None
+    timeout_effects: list = field(default_factory=list)
+
+
+class RaServer:
+    """One cluster member's pure Raft core."""
+
+    def __init__(self, config: ServerConfig, log) -> None:
+        self.cfg = config
+        self.log = log
+        self.id: ServerId = config.server_id
+        self.machine: Machine = config.machine
+
+        # persisted via the log's meta store (ra_log_meta)
+        self.current_term: int = log.fetch_meta("current_term", 0)
+        self.voted_for: Optional[ServerId] = log.fetch_meta("voted_for")
+
+        self.raft_state: RaftState = RaftState.RECOVER
+        self.leader_id: Optional[ServerId] = None
+        self.commit_index: int = 0
+        self.last_applied: int = log.fetch_meta("last_applied", 0)
+
+        # machine versioning (ra_server.erl init + noop handling :2671-2732)
+        self.machine_version: int = self.machine.version
+        self.effective_machine_version: int = 0
+        self.effective_machine: Machine = self.machine.which_module(0)
+        self.machine_versions: list = []  # [(idx, version)] newest first
+
+        self.cluster: dict[ServerId, Peer] = {}
+        self.cluster_change_permitted: bool = False
+        self.cluster_index_term: IdxTerm = IdxTerm(0, 0)
+        self.previous_cluster: Optional[tuple] = None
+        self.membership: Membership = config.membership
+
+        self.votes: int = 0
+        self.pre_vote_token: Any = None
+        self.condition: Optional[Condition] = None
+        self.condition_pending: list = []  # events buffered in await_condition
+
+        # consistent-query machinery (ra_server.erl:3032-3190)
+        self.query_index: int = 0
+        self.queries_waiting_heartbeats: list = []  # [(qidx, from, fun, ci)]
+        self.pending_consistent_queries: list = []  # [(from, fun, ci)]
+
+        self.machine_state: Any = None
+        self.aux_state: Any = self.machine.init_aux(config.uid)
+        self.commit_latency: float = 0.0
+        self._transfer_target: Optional[ServerId] = None
+        self._accepting_snapshot: Optional[tuple] = None
+
+        self._init_state()
+
+    # ------------------------------------------------------------------
+    # init / recovery (ra_server.erl:249-414)
+    # ------------------------------------------------------------------
+
+    def _init_state(self) -> None:
+        snap = self.log.recover_snapshot_state()
+        if snap is not None:
+            meta, mac_state = snap
+            self.machine_state = mac_state
+            self.last_applied = max(self.last_applied, meta.index)
+            self.commit_index = max(self.commit_index, meta.index)
+            self.effective_machine_version = meta.machine_version
+            self.effective_machine = self.machine.which_module(
+                meta.machine_version)
+            self.machine_versions = [(meta.index, meta.machine_version)]
+            self.cluster = {sid: Peer(membership=m)
+                            for sid, m in meta.cluster}
+        else:
+            self.machine_state = self.machine.init(
+                {"id": self.id, "uid": self.cfg.uid,
+                 "name": self.cfg.cluster_name})
+            self.cluster = {sid: Peer() for sid in self.cfg.initial_members}
+            self.machine_versions = [(0, 0)]
+        if self.id not in self.cluster and not self.cluster:
+            self.cluster[self.id] = Peer()
+        self.membership = self._get_membership()
+        # commit index starts at last_applied; it is re-learned from the
+        # leader / quorum (ra_server.erl:305-320)
+        self.commit_index = max(self.commit_index, self.last_applied)
+
+    def recover(self) -> list:
+        """Replay committed-but-unapplied entries with effects suppressed
+        (deduped by persisted last_applied), then scan the remainder of the
+        log for cluster changes only (ra_server.erl:376-414)."""
+        effects: list = []
+        self._apply_to(self.commit_index, effects, suppress=True)
+        # scan the un-committed tail for cluster changes (cluster_scan_fun)
+        last_idx, _ = self.log.last_index_term()
+        for entry in self.log.read_range(self.last_applied + 1, last_idx):
+            cmd = entry.command
+            if isinstance(cmd, ClusterChangeCommand):
+                self._set_cluster(dict_from_cluster_spec(cmd.cluster))
+                self.cluster_index_term = IdxTerm(entry.index, entry.term)
+        self.raft_state = RaftState.FOLLOWER
+        return []
+
+    # ------------------------------------------------------------------
+    # public dispatch
+    # ------------------------------------------------------------------
+
+    def handle(self, event: Any) -> list:
+        """Dispatch one event; NextEvent effects are resolved inline (they
+        are the core's own re-injections, ra_server_proc's next_event), so
+        callers only ever see external effects."""
+        effects = self._dispatch(event)
+        return self._resolve_next_events(effects)
+
+    def _resolve_next_events(self, effects: list) -> list:
+        out: list = []
+        for e in effects:
+            if isinstance(e, NextEvent):
+                out.extend(self.handle(e.event))
+            else:
+                out.append(e)
+        return out
+
+    def _dispatch(self, event: Any) -> list:
+        handler = {
+            RaftState.LEADER: self._handle_leader,
+            RaftState.FOLLOWER: self._handle_follower,
+            RaftState.CANDIDATE: self._handle_candidate,
+            RaftState.PRE_VOTE: self._handle_pre_vote,
+            RaftState.AWAIT_CONDITION: self._handle_await_condition,
+            RaftState.RECEIVE_SNAPSHOT: self._handle_receive_snapshot,
+        }[self.raft_state]
+        return handler(event)
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    def _update_term(self, term: int) -> None:
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self.log.store_meta(current_term=term, voted_for=None)
+
+    def _update_term_and_voted_for(self, term: int,
+                                   voted_for: Optional[ServerId]) -> None:
+        if term != self.current_term or voted_for != self.voted_for:
+            self.current_term = term
+            self.voted_for = voted_for
+            self.log.store_meta(current_term=term, voted_for=voted_for)
+
+    def last_idx_term(self) -> IdxTerm:
+        """Effective last idx/term: log tail or snapshot (last_idx_term)."""
+        lit = self.log.last_index_term()
+        snap = self.log.snapshot_index_term()
+        if snap.index > lit.index:
+            return snap
+        return lit
+
+    def _peer_ids(self) -> list:
+        return [pid for pid in self.cluster if pid != self.id]
+
+    def _voter_count(self) -> int:
+        return sum(1 for p in self.cluster.values()
+                   if p.membership == Membership.VOTER)
+
+    def required_quorum(self) -> int:
+        return self._voter_count() // 2 + 1
+
+    def _get_membership(self) -> Membership:
+        peer = self.cluster.get(self.id)
+        return peer.membership if peer is not None else Membership.UNKNOWN
+
+    def _set_cluster(self, new_cluster: dict[ServerId, Peer]) -> None:
+        # preserve replication state of peers we already track
+        for sid, peer in new_cluster.items():
+            if sid in self.cluster:
+                old = self.cluster[sid]
+                peer.next_index = old.next_index
+                peer.match_index = old.match_index
+                peer.commit_index_sent = old.commit_index_sent
+                peer.query_index = old.query_index
+                peer.status = old.status
+        self.cluster = new_cluster
+        self.membership = self._get_membership()
+
+    def is_voter(self) -> bool:
+        return self.membership == Membership.VOTER
+
+    def _aer_reply(self, term: int, success: bool) -> AppendEntriesReply:
+        """Reply uses last *written* for match info but unwritten last index
+        for next_index (ra_server.erl:2927-2939)."""
+        lw = self.log.last_written()
+        snap = self.log.snapshot_index_term()
+        if snap.index > lw.index:
+            lw = snap
+        last_idx = self.last_idx_term().index
+        return AppendEntriesReply(term=term, success=success,
+                                  next_index=last_idx + 1,
+                                  last_index=lw.index, last_term=lw.term,
+                                  from_=self.id)
+
+    def _heartbeat_reply(self) -> HeartbeatReply:
+        return HeartbeatReply(query_index=self.query_index,
+                              term=self.current_term, from_=self.id)
+
+    # ------------------------------------------------------------------
+    # elections (ra_server.erl:2211-2330)
+    # ------------------------------------------------------------------
+
+    def _call_for_election_pre_vote(self) -> list:
+        self.pre_vote_token = object()
+        last = self.last_idx_term()
+        reqs = tuple(
+            (pid, PreVoteRpc(term=self.current_term, token=self.pre_vote_token,
+                             candidate_id=self.id, version=RA_PROTO_VERSION,
+                             machine_version=self.machine_version,
+                             last_log_index=last.index,
+                             last_log_term=last.term))
+            for pid in self._peer_ids())
+        self._update_term_and_voted_for(self.current_term, self.id)
+        self.leader_id = None
+        self.votes = 0
+        self.raft_state = RaftState.PRE_VOTE
+        self_vote = PreVoteResult(term=self.current_term,
+                                  token=self.pre_vote_token,
+                                  vote_granted=True, from_=self.id)
+        return [NextEvent(self_vote), SendVoteRequests(reqs),
+                StartElectionTimeout("long")]
+
+    def _call_for_election_candidate(self) -> list:
+        new_term = self.current_term + 1
+        last = self.last_idx_term()
+        reqs = tuple(
+            (pid, RequestVoteRpc(term=new_term, candidate_id=self.id,
+                                 last_log_index=last.index,
+                                 last_log_term=last.term))
+            for pid in self._peer_ids())
+        self._update_term_and_voted_for(new_term, self.id)
+        self.leader_id = None
+        self.votes = 0
+        self.raft_state = RaftState.CANDIDATE
+        self_vote = RequestVoteResult(term=new_term, vote_granted=True,
+                                      from_=self.id)
+        return [NextEvent(self_vote), SendVoteRequests(reqs),
+                StartElectionTimeout("long")]
+
+    def _process_pre_vote(self, rpc: PreVoteRpc) -> list:
+        """Grant/deny a pre-vote without changing durable vote state
+        beyond term adoption (ra_server.erl:2260-2319)."""
+        if rpc.term < self.current_term:
+            return [SendRpc(rpc.candidate_id,
+                            PreVoteResult(term=self.current_term,
+                                          token=rpc.token, vote_granted=False,
+                                          from_=self.id))]
+        self._update_term(rpc.term)
+        last = self.last_idx_term()
+        up_to_date = _log_up_to_date(rpc.last_log_index, rpc.last_log_term,
+                                     last)
+        if up_to_date and rpc.version > RA_PROTO_VERSION:
+            granted = False
+        elif up_to_date and (
+                rpc.machine_version == self.effective_machine_version or
+                (rpc.machine_version >= self.effective_machine_version and
+                 rpc.machine_version <= self.machine_version)):
+            self.voted_for = rpc.candidate_id
+            granted = True
+        else:
+            granted = False
+        effects: list = []
+        if granted or self.raft_state != RaftState.FOLLOWER:
+            effects.append(SendRpc(rpc.candidate_id,
+                                   PreVoteResult(term=rpc.term,
+                                                 token=rpc.token,
+                                                 vote_granted=granted,
+                                                 from_=self.id)))
+        if not granted and self.raft_state == RaftState.FOLLOWER:
+            effects.append(StartElectionTimeout("medium"))
+        return effects
+
+    def _process_request_vote(self, rpc: RequestVoteRpc) -> list:
+        """Follower-side vote granting (ra_server.erl:1211-1251)."""
+        if rpc.term < self.current_term:
+            return [SendRpc(rpc.candidate_id,
+                            RequestVoteResult(term=self.current_term,
+                                              vote_granted=False,
+                                              from_=self.id))]
+        if (rpc.term == self.current_term and self.voted_for is not None
+                and self.voted_for != rpc.candidate_id):
+            return [SendRpc(rpc.candidate_id,
+                            RequestVoteResult(term=rpc.term,
+                                              vote_granted=False,
+                                              from_=self.id))]
+        self._update_term(rpc.term)
+        last = self.last_idx_term()
+        if _log_up_to_date(rpc.last_log_index, rpc.last_log_term, last):
+            self._update_term_and_voted_for(rpc.term, rpc.candidate_id)
+            return [SendRpc(rpc.candidate_id,
+                            RequestVoteResult(term=rpc.term,
+                                              vote_granted=True,
+                                              from_=self.id)),
+                    StartElectionTimeout("long")]
+        return [SendRpc(rpc.candidate_id,
+                        RequestVoteResult(term=rpc.term, vote_granted=False,
+                                          from_=self.id))]
+
+    def _become_follower(self, term: int,
+                         next_event: Any = None) -> list:
+        self._update_term(term)
+        self.leader_id = None
+        self.votes = 0
+        self.raft_state = RaftState.FOLLOWER
+        effects: list = [StartElectionTimeout("medium")]
+        if next_event is not None:
+            effects.insert(0, NextEvent(next_event))
+        return effects
+
+    def _become_leader(self) -> list:
+        """Candidate won: initialise peers, establish leadership, append the
+        noop for this term (ra_server.erl:845-859)."""
+        self.leader_id = self.id
+        self.raft_state = RaftState.LEADER
+        self.votes = 0
+        last_idx = self.last_idx_term().index
+        for pid, peer in self.cluster.items():
+            peer.next_index = last_idx + 1
+            peer.match_index = 0
+            peer.commit_index_sent = 0
+            peer.query_index = 0
+            if peer.status != PeerStatus.SENDING_SNAPSHOT:
+                peer.status = PeerStatus.NORMAL
+        self.cluster_change_permitted = False
+        effects = self._make_all_rpcs()
+        noop = NoopCommand(machine_version=self.machine_version)
+        effects.append(NextEvent(CommandEvent(noop)))
+        effects.append(RecordLeader(self.cfg.cluster_name, self.id,
+                                    tuple(self.cluster)))
+        effects.append(CancelElectionTimeout())
+        return effects
+
+    # ------------------------------------------------------------------
+    # follower (ra_server.erl:1032-1330)
+    # ------------------------------------------------------------------
+
+    def _handle_follower(self, event: Any) -> list:
+        if isinstance(event, AppendEntriesRpc):
+            return self._follower_aer(event)
+        if isinstance(event, HeartbeatRpc):
+            if event.term >= self.current_term:
+                self._update_term(event.term)
+                self.leader_id = event.leader_id
+                self.query_index = max(self.query_index, event.query_index)
+                return [SendRpc(event.leader_id, self._heartbeat_reply()),
+                        StartElectionTimeout("medium")]
+            return [SendRpc(event.leader_id, self._heartbeat_reply())]
+        if isinstance(event, WrittenEvent):
+            self.log.handle_written(event)
+            effects: list = []
+            # replicate-then-confirm: reply to the leader once our WAL
+            # confirms (ra_server.erl:1183-1192)
+            if self.leader_id is not None:
+                effects.append(SendRpc(self.leader_id,
+                                       self._aer_reply(self.current_term,
+                                                       True)))
+            # commit index may already cover these entries
+            effects.extend(self._evaluate_commit_index_follower())
+            return effects
+        if isinstance(event, PreVoteRpc):
+            if not self.is_voter():
+                return []
+            return self._process_pre_vote(event)
+        if isinstance(event, RequestVoteRpc):
+            if not self.is_voter():
+                return []
+            return self._process_request_vote(event)
+        if isinstance(event, InstallSnapshotRpc):
+            return self._follower_install_snapshot(event)
+        if isinstance(event, (AppendEntriesReply, HeartbeatReply)):
+            self._update_term(event.term)
+            return []
+        if isinstance(event, (RequestVoteResult, PreVoteResult)):
+            return []
+        if isinstance(event, ElectionTimeout):
+            if not self.is_voter():
+                return []
+            return self._call_for_election_pre_vote()
+        if isinstance(event, ForceElectionEvent):
+            return self._call_for_election_candidate()
+        if isinstance(event, TransferLeadershipEvent):
+            # try_become_leader arrives at the transfer target as this event
+            return self._call_for_election_pre_vote()
+        if isinstance(event, CommandEvent):
+            # not the leader: the shell redirects using leader_id
+            if event.from_ is not None:
+                return [Reply(event.from_,
+                              ErrorResult("not_leader", self.leader_id))]
+            return []
+        if isinstance(event, ConsistentQueryEvent):
+            if event.from_ is not None:
+                return [Reply(event.from_,
+                              ErrorResult("not_leader", self.leader_id))]
+            return []
+        if isinstance(event, TickEvent):
+            return self._tick()
+        return []
+
+    def _follower_aer(self, rpc: AppendEntriesRpc) -> list:
+        if rpc.term < self.current_term:
+            return [SendRpc(rpc.leader_id,
+                            self._aer_reply(self.current_term, False))]
+        # valid leader for this term (ra_server.erl:1032-1156)
+        effects: list = [StartElectionTimeout("medium")]
+        self._update_term(rpc.term)
+        self.leader_id = rpc.leader_id
+        self.commit_index = max(self.commit_index, rpc.leader_commit)
+        check = self._has_log_entry_or_snapshot(rpc.prev_log_index,
+                                                rpc.prev_log_term)
+        if check == "ok":
+            entries = self._drop_existing(list(rpc.entries))
+            if not entries:
+                last_idx = self.log.last_index_term().index
+                if not rpc.entries and last_idx > rpc.prev_log_index:
+                    # leader's log is shorter: reset ours to match
+                    # (ra_server.erl:1056-1066)
+                    self.log.set_last_index(rpc.prev_log_index)
+                effects.extend(self._evaluate_commit_index_follower())
+                effects.append(SendRpc(rpc.leader_id,
+                                       self._aer_reply(rpc.term, True)))
+                return effects
+            self.log.write(entries)
+            effects.extend(self._evaluate_commit_index_follower())
+            # success reply is sent when the WrittenEvent arrives
+            return effects
+        if check == "missing":
+            # gap: ask leader to resend from our next index and hold in
+            # await_condition for the entries to arrive out of order
+            # (ra_server.erl:1118-1133)
+            reply_eff = SendRpc(rpc.leader_id,
+                                self._aer_reply(rpc.term, False))
+            self.condition = Condition(
+                predicate=_follower_catchup_predicate,
+                transition_to=RaftState.FOLLOWER,
+                timeout_ms=self.cfg.await_condition_timeout_ms,
+                timeout_effects=[reply_eff])
+            self.raft_state = RaftState.AWAIT_CONDITION
+            effects.append(reply_eff)
+            return effects
+        # term mismatch: rewind to last_applied (ra_server.erl:1134-1156)
+        la = self.last_applied
+        la_term = self.log.fetch_term(la)
+        if la_term is None:
+            snap = self.log.snapshot_index_term()
+            la_term = snap.term if snap.index == la else 0
+        reply = AppendEntriesReply(term=rpc.term, success=False,
+                                   next_index=la + 1, last_index=la,
+                                   last_term=la_term or 0, from_=self.id)
+        reply_eff = SendRpc(rpc.leader_id, reply)
+        self.condition = Condition(
+            predicate=_follower_catchup_predicate,
+            transition_to=RaftState.FOLLOWER,
+            timeout_ms=self.cfg.await_condition_timeout_ms,
+            timeout_effects=[reply_eff])
+        self.raft_state = RaftState.AWAIT_CONDITION
+        effects.append(reply_eff)
+        return effects
+
+    def _has_log_entry_or_snapshot(self, idx: int, term: int) -> str:
+        if idx == 0:
+            return "ok"
+        t = self.log.fetch_term(idx)
+        if t is None:
+            snap = self.log.snapshot_index_term()
+            if snap.index == idx and snap.term == term:
+                return "ok"
+            return "missing"
+        return "ok" if t == term else "term_mismatch"
+
+    def _drop_existing(self, entries: list) -> list:
+        """Skip entries already present with the same idx+term
+        (ra_server.erl drop_existing)."""
+        i = 0
+        while i < len(entries) and self.log.exists(entries[i].index,
+                                                   entries[i].term):
+            i += 1
+        return entries[i:]
+
+    def _evaluate_commit_index_follower(self) -> list:
+        """Apply up to min(last_index, commit_index) — may apply entries not
+        yet fsynced locally; safe per the argument in
+        ra_server.erl:1780-1813."""
+        if self.leader_id is None:
+            return []
+        last_idx = self.log.last_index_term().index
+        apply_to = min(last_idx, self.commit_index)
+        effects: list = []
+        self._apply_to(apply_to, effects)
+        return _filter_follower_effects(effects)
+
+    def _follower_install_snapshot(self, rpc: InstallSnapshotRpc) -> list:
+        if rpc.term < self.current_term:
+            return [SendRpc(rpc.leader_id,
+                            InstallSnapshotResult(
+                                term=self.current_term,
+                                last_index=rpc.meta.index,
+                                last_term=rpc.meta.term, from_=self.id))]
+        if (rpc.chunk_number == 1 and rpc.meta.index > self.last_applied
+                and self.machine_version >= rpc.meta.machine_version):
+            self._update_term(rpc.term)
+            self.leader_id = rpc.leader_id
+            self._accepting_snapshot = (rpc.meta, [])
+            self.raft_state = RaftState.RECEIVE_SNAPSHOT
+            return [NextEvent(rpc), StartElectionTimeout("medium")]
+        # stale snapshot: confirm our progress so the leader moves on
+        last = self.last_idx_term()
+        return [SendRpc(rpc.leader_id,
+                        InstallSnapshotResult(term=self.current_term,
+                                              last_index=last.index,
+                                              last_term=last.term,
+                                              from_=self.id))]
+
+    # ------------------------------------------------------------------
+    # receive_snapshot state (ra_server.erl:1333-1413)
+    # ------------------------------------------------------------------
+
+    def _handle_receive_snapshot(self, event: Any) -> list:
+        if isinstance(event, InstallSnapshotRpc):
+            if event.term < self.current_term:
+                return []
+            meta, chunks = self._accepting_snapshot
+            chunks.append(event.data)
+            if event.chunk_flag == "last":
+                data = b"".join(chunks)
+                self.log.install_snapshot(meta, data)
+                recovered = self.log.recover_snapshot_state()
+                assert recovered is not None
+                old_state = self.machine_state
+                _, self.machine_state = recovered
+                self.last_applied = meta.index
+                self.commit_index = max(self.commit_index, meta.index)
+                self.effective_machine_version = meta.machine_version
+                self.effective_machine = self.machine.which_module(
+                    meta.machine_version)
+                self._set_cluster({sid: Peer(membership=m)
+                                   for sid, m in meta.cluster})
+                self._accepting_snapshot = None
+                self.raft_state = RaftState.FOLLOWER
+                effs = list(self.machine.snapshot_installed(
+                    meta, self.machine_state, None, old_state))
+                effs.append(SendRpc(event.leader_id,
+                                    InstallSnapshotResult(
+                                        term=self.current_term,
+                                        last_index=meta.index,
+                                        last_term=meta.term, from_=self.id)))
+                effs.append(StartElectionTimeout("medium"))
+                return effs
+            return [SendRpc(event.leader_id,
+                            InstallSnapshotResult(term=self.current_term,
+                                                  last_index=meta.index,
+                                                  last_term=meta.term,
+                                                  from_=self.id))]
+        if isinstance(event, AppendEntriesRpc) and \
+                event.term >= self.current_term:
+            # a leader in a newer term interrupts the transfer
+            self._accepting_snapshot = None
+            self.raft_state = RaftState.FOLLOWER
+            return [NextEvent(event)]
+        if isinstance(event, ElectionTimeout):
+            self._accepting_snapshot = None
+            self.raft_state = RaftState.FOLLOWER
+            return [StartElectionTimeout("medium")]
+        if isinstance(event, WrittenEvent):
+            self.log.handle_written(event)
+            return []
+        return []
+
+    # ------------------------------------------------------------------
+    # candidate (ra_server.erl:745-950)
+    # ------------------------------------------------------------------
+
+    def _handle_candidate(self, event: Any) -> list:
+        if isinstance(event, RequestVoteResult):
+            if event.term > self.current_term:
+                self._update_term_and_voted_for(event.term, None)
+                return self._become_follower(event.term)
+            if not event.vote_granted or event.term != self.current_term:
+                return []
+            self.votes += 1
+            if self.votes == self.required_quorum():
+                return self._become_leader()
+            return []
+        if isinstance(event, AppendEntriesRpc):
+            if event.term >= self.current_term:
+                self._update_term_and_voted_for(event.term, None)
+                return self._become_follower(event.term, next_event=event)
+            return [SendRpc(event.leader_id,
+                            self._aer_reply(self.current_term, False))]
+        if isinstance(event, HeartbeatRpc):
+            if event.term >= self.current_term:
+                self._update_term_and_voted_for(event.term, None)
+                return self._become_follower(event.term, next_event=event)
+            return [SendRpc(event.leader_id, self._heartbeat_reply())]
+        if isinstance(event, (AppendEntriesReply, HeartbeatReply)):
+            if event.term > self.current_term:
+                self._update_term_and_voted_for(event.term, None)
+                return self._become_follower(event.term)
+            return []
+        if isinstance(event, RequestVoteRpc):
+            if event.term > self.current_term:
+                self._update_term_and_voted_for(event.term, None)
+                eff = self._become_follower(event.term)
+                return [NextEvent(event)] + eff
+            return [SendRpc(event.candidate_id,
+                            RequestVoteResult(term=self.current_term,
+                                              vote_granted=False,
+                                              from_=self.id))]
+        if isinstance(event, PreVoteRpc):
+            if event.term > self.current_term:
+                self._update_term_and_voted_for(event.term, None)
+                eff = self._become_follower(event.term)
+                return [NextEvent(event)] + eff
+            # candidate cannot simply reject (rabbitmq/ra#439)
+            return self._process_pre_vote(event)
+        if isinstance(event, InstallSnapshotRpc):
+            if event.term >= self.current_term:
+                return self._become_follower(event.term, next_event=event)
+            return []
+        if isinstance(event, PreVoteResult):
+            return []
+        if isinstance(event, ElectionTimeout):
+            return self._call_for_election_candidate()
+        if isinstance(event, WrittenEvent):
+            self.log.handle_written(event)
+            return []
+        if isinstance(event, CommandEvent) and event.from_ is not None:
+            return [Reply(event.from_, ErrorResult("not_leader", None))]
+        if isinstance(event, TickEvent):
+            return self._tick()
+        return []
+
+    # ------------------------------------------------------------------
+    # pre_vote (ra_server.erl:952-1030)
+    # ------------------------------------------------------------------
+
+    def _handle_pre_vote(self, event: Any) -> list:
+        if isinstance(event, PreVoteResult):
+            if event.term > self.current_term:
+                return self._become_follower(event.term)
+            if (event.vote_granted and event.token is self.pre_vote_token
+                    and event.term == self.current_term):
+                self.votes += 1
+                if self.votes == self.required_quorum():
+                    return self._call_for_election_candidate()
+            return []
+        if isinstance(event, (AppendEntriesRpc, HeartbeatRpc)):
+            if event.term >= self.current_term:
+                self._update_term(event.term)
+                self.votes = 0
+                self.raft_state = RaftState.FOLLOWER
+                return [NextEvent(event)]
+            if isinstance(event, HeartbeatRpc):
+                return [SendRpc(event.leader_id, self._heartbeat_reply())]
+            return []
+        if isinstance(event, (AppendEntriesReply, HeartbeatReply)):
+            if event.term > self.current_term:
+                return self._become_follower(event.term)
+            return []
+        if isinstance(event, RequestVoteRpc):
+            if event.term > self.current_term:
+                eff = self._become_follower(event.term)
+                return [NextEvent(event)] + eff
+            return []
+        if isinstance(event, InstallSnapshotRpc):
+            if event.term >= self.current_term:
+                self.votes = 0
+                self.raft_state = RaftState.FOLLOWER
+                return [NextEvent(event)]
+            return []
+        if isinstance(event, PreVoteRpc):
+            return self._process_pre_vote(event)
+        if isinstance(event, RequestVoteResult):
+            return []
+        if isinstance(event, ElectionTimeout):
+            return self._call_for_election_pre_vote()
+        if isinstance(event, WrittenEvent):
+            self.log.handle_written(event)
+            return []
+        if isinstance(event, CommandEvent) and event.from_ is not None:
+            return [Reply(event.from_, ErrorResult("not_leader", None))]
+        if isinstance(event, TickEvent):
+            return self._tick()
+        return []
+
+    # ------------------------------------------------------------------
+    # leader (ra_server.erl:418-760)
+    # ------------------------------------------------------------------
+
+    def _handle_leader(self, event: Any) -> list:
+        if isinstance(event, AppendEntriesReply):
+            return self._leader_aer_reply(event)
+        if isinstance(event, CommandEvent):
+            return self._leader_command(event.command, event.from_)
+        if isinstance(event, CommandsEvent):
+            effects: list = []
+            for cmd in event.commands:
+                effects.extend(self._leader_append(cmd, None))
+            effects.extend(self._make_pipelined_rpcs())
+            return effects
+        if isinstance(event, WrittenEvent):
+            self.log.handle_written(event)
+            effects = self._evaluate_quorum()
+            effects.extend(self._process_pending_consistent_queries())
+            effects.extend(self._make_pipelined_rpcs())
+            return effects
+        if isinstance(event, InstallSnapshotResult):
+            if event.term > self.current_term:
+                self._update_term(event.term)
+                self.leader_id = None
+                return self._become_follower(event.term)
+            peer = self.cluster.get(event.from_)
+            if peer is None:
+                return []
+            peer.status = PeerStatus.NORMAL
+            peer.snapshot_sender = None
+            peer.match_index = max(peer.match_index, event.last_index)
+            peer.commit_index_sent = event.last_index
+            peer.next_index = event.last_index + 1
+            return self._make_pipelined_rpcs()
+        if isinstance(event, HeartbeatReply):
+            if event.term > self.current_term:
+                self._update_term(event.term)
+                self.leader_id = None
+                return self._become_follower(event.term)
+            if event.term < self.current_term:
+                return []
+            return self._heartbeat_rpc_quorum(event.query_index, event.from_)
+        if isinstance(event, ConsistentQueryEvent):
+            return self._leader_consistent_query(event.from_, event.query_fn)
+        if isinstance(event, RequestVoteRpc):
+            if event.term > self.current_term:
+                if event.candidate_id not in self.cluster:
+                    return []
+                self._update_term(event.term)
+                self.leader_id = None
+                return self._become_follower(event.term, next_event=event)
+            return [SendRpc(event.candidate_id,
+                            RequestVoteResult(term=self.current_term,
+                                              vote_granted=False,
+                                              from_=self.id))]
+        if isinstance(event, PreVoteRpc):
+            if event.term > self.current_term:
+                if event.candidate_id not in self.cluster:
+                    return []
+                self._update_term(event.term)
+                self.leader_id = None
+                return self._become_follower(event.term, next_event=event)
+            # enforce leadership (ra_server.erl:793-797)
+            return self._make_all_rpcs()
+        if isinstance(event, (AppendEntriesRpc, HeartbeatRpc,
+                              InstallSnapshotRpc)):
+            if event.term > self.current_term:
+                self._update_term(event.term)
+                self.leader_id = None
+                return self._become_follower(event.term, next_event=event)
+            if event.term == self.current_term:
+                raise RuntimeError(
+                    f"{self.id}: leader saw rpc in same term {event.term}")
+            reply = (self._heartbeat_reply()
+                     if isinstance(event, HeartbeatRpc)
+                     else self._aer_reply(self.current_term, False))
+            return [SendRpc(event.leader_id, reply)]
+        if isinstance(event, (RequestVoteResult, PreVoteResult)):
+            return []
+        if isinstance(event, TransferLeadershipEvent):
+            return self._leader_transfer(event)
+        if isinstance(event, ElectionTimeout):
+            return []
+        if isinstance(event, TickEvent):
+            return self._tick_leader()
+        return []
+
+    def _leader_aer_reply(self, reply: AppendEntriesReply) -> list:
+        peer = self.cluster.get(reply.from_)
+        if peer is None:
+            return []
+        if reply.term > self.current_term:
+            self._update_term(reply.term)
+            self.leader_id = None
+            return self._become_follower(reply.term)
+        if reply.success and reply.term == self.current_term:
+            peer.match_index = max(peer.match_index, reply.last_index)
+            peer.next_index = max(peer.next_index, reply.next_index)
+            effects = self._maybe_promote_peer(reply.from_)
+            effects.extend(self._evaluate_quorum())
+            effects.extend(self._process_pending_consistent_queries())
+            effects.extend(self._make_pipelined_rpcs())
+            # if we are no longer in the committed cluster, step down
+            # (ra_server.erl:440-453)
+            if (self.id not in self.cluster and
+                    self.commit_index >= self.cluster_index_term.index):
+                self.raft_state = RaftState.STOP
+            return effects
+        if reply.success:  # stale term reply
+            return []
+        # success=false: next_index repair (ra_server.erl:477-532)
+        t = self.log.fetch_term(reply.last_index)
+        if t is None:
+            peer.match_index = reply.last_index
+            peer.next_index = reply.next_index
+        elif t == reply.last_term and reply.last_index >= peer.match_index:
+            peer.match_index = reply.last_index
+            peer.next_index = reply.next_index
+        elif reply.last_index < peer.match_index:
+            peer.match_index = reply.last_index
+            peer.next_index = reply.last_index + 1
+        else:
+            peer.next_index = max(min(peer.next_index - 1, reply.last_index),
+                                  peer.match_index)
+        return self._make_pipelined_rpcs()
+
+    def _leader_command(self, cmd: Any, from_: Any) -> list:
+        effects = self._leader_append(cmd, from_)
+        effects.extend(self._make_pipelined_rpcs())
+        return effects
+
+    def _leader_append(self, cmd: Any, from_: Any) -> list:
+        """append_log_leader (ra_server.erl:2798-2915): join/leave commands
+        become '$ra_cluster_change' appends; cluster changes are refused
+        while one is in flight."""
+        effects: list = []
+        if isinstance(cmd, JoinCommand):
+            if not self.cluster_change_permitted:
+                return self._defer_or_refuse(cmd, from_, effects)
+            if cmd.server_id in self.cluster:
+                if from_ is not None:
+                    effects.append(Reply(from_, ErrorResult("already_member",
+                                                            self.id)))
+                return effects
+            new_cluster = {sid: (p.membership, p.promote_target)
+                           for sid, p in self.cluster.items()}
+            target = 0
+            if cmd.membership == Membership.PROMOTABLE:
+                target = self.log.next_index()
+            new_cluster[cmd.server_id] = (cmd.membership, target)
+            return self._append_cluster_change(new_cluster, cmd, from_,
+                                               effects)
+        if isinstance(cmd, LeaveCommand):
+            if not self.cluster_change_permitted:
+                return self._defer_or_refuse(cmd, from_, effects)
+            if cmd.server_id not in self.cluster:
+                if from_ is not None:
+                    effects.append(Reply(from_, ErrorResult("not_member",
+                                                            self.id)))
+                return effects
+            new_cluster = {sid: (p.membership, p.promote_target)
+                           for sid, p in self.cluster.items()
+                           if sid != cmd.server_id}
+            return self._append_cluster_change(new_cluster, cmd, from_,
+                                               effects)
+        # plain commands: attach from_ for the consensus reply
+        if from_ is not None and hasattr(cmd, "from_"):
+            cmd = replace(cmd, from_=from_)
+        idx = self.log.next_index()
+        entry = Entry(idx, self.current_term, cmd)
+        self.log.append(entry)
+        reply_mode = getattr(cmd, "reply_mode", None)
+        if reply_mode == ReplyMode.AFTER_LOG_APPEND and from_ is not None:
+            effects.append(Reply(from_, CommandResult(idx, self.current_term,
+                                                      None, self.id)))
+        return effects
+
+    def _defer_or_refuse(self, cmd: Any, from_: Any, effects: list) -> list:
+        if from_ is not None:
+            effects.append(Reply(from_, ErrorResult(
+                "cluster_change_not_permitted", self.id)))
+        return effects
+
+    def _append_cluster_change(self, cluster_spec: dict, cmd: Any,
+                               from_: Any, effects: list) -> list:
+        spec = tuple((sid, ms[0]) for sid, ms in cluster_spec.items())
+        change = ClusterChangeCommand(
+            cluster=spec, reply_mode=getattr(cmd, "reply_mode",
+                                             ReplyMode.AWAIT_CONSENSUS),
+            from_=from_)
+        idx = self.log.next_index()
+        prev = (self.cluster_index_term,
+                tuple((sid, p.membership) for sid, p in self.cluster.items()))
+        entry = Entry(idx, self.current_term, change)
+        self.log.append(entry)
+        # the new cluster takes effect immediately on append
+        # (pre-commit, ra_server.erl append_cluster_change)
+        new_cluster = {}
+        for sid, (membership, target) in cluster_spec.items():
+            peer = Peer(membership=membership, promote_target=target)
+            new_cluster[sid] = peer
+        self._set_cluster(new_cluster)
+        self.cluster_change_permitted = False
+        self.cluster_index_term = IdxTerm(idx, self.current_term)
+        self.previous_cluster = prev
+        return effects
+
+    def _maybe_promote_peer(self, peer_id: ServerId) -> list:
+        """Auto-promote a promotable non-voter that caught up
+        (ra_server.erl:3218-3293)."""
+        peer = self.cluster.get(peer_id)
+        if (peer is None or peer.membership != Membership.PROMOTABLE or
+                peer.match_index < peer.promote_target or
+                not self.cluster_change_permitted):
+            return []
+        new_cluster = {sid: ((p.membership if sid != peer_id
+                              else Membership.VOTER), p.promote_target)
+                       for sid, p in self.cluster.items()}
+        return self._append_cluster_change(
+            new_cluster, JoinCommand(peer_id, reply_mode=ReplyMode.NOREPLY),
+            None, [])
+
+    # -- quorum arithmetic: THE kernel (ra_server.erl:2941-2993) ----------
+
+    def match_indexes(self) -> list:
+        """Voter match indexes; self is represented by last *written*
+        (ra_server.erl:2977-2987)."""
+        lw = self.log.last_written()
+        snap = self.log.snapshot_index_term()
+        own = max(lw.index, snap.index)
+        idxs = [own]
+        for pid, peer in self.cluster.items():
+            if pid == self.id:
+                continue
+            if peer.membership != Membership.VOTER:
+                continue
+            idxs.append(peer.match_index)
+        return idxs
+
+    @staticmethod
+    def agreed_commit(indexes: list) -> int:
+        """Quorum-agreed index: sort desc, take element trunc(n/2)+1 (1-based)
+        (ra_server.erl:2989-2993).  This is the scalar oracle for the
+        batched kernel in ra_tpu.ops.quorum."""
+        s = sorted(indexes, reverse=True)
+        return s[len(s) // 2]
+
+    def _increment_commit_index(self) -> None:
+        potential = self.agreed_commit(self.match_indexes())
+        if potential <= self.commit_index:
+            return
+        # §5.4.2: only commit entries from the current term
+        t = self.log.fetch_term(potential)
+        if t == self.current_term:
+            self.commit_index = potential
+
+    def _evaluate_quorum(self) -> list:
+        ci0 = self.commit_index
+        self._increment_commit_index()
+        effects: list = []
+        if self.commit_index > ci0:
+            effects.append(AuxEffect("eval"))
+        self._apply_to(self.commit_index, effects)
+        return effects
+
+    # -- the apply fold (ra_server.erl:2557-2744) -------------------------
+
+    def _apply_to(self, apply_to: int, effects: list,
+                  suppress: bool = False) -> None:
+        if apply_to <= self.last_applied:
+            return
+        if self.machine_version < self.effective_machine_version:
+            return
+        last_idx = self.log.last_index_term().index
+        to = min(last_idx, apply_to)
+        notifys: dict = {}
+        t0 = time.monotonic()
+        for entry in self.log.read_range(self.last_applied + 1, to):
+            self._apply_one(entry, effects, notifys, suppress)
+        self.commit_latency = time.monotonic() - t0
+        if notifys and not suppress:
+            for to_pid, corrs in notifys.items():
+                effects.append(Notify(to_pid, tuple(corrs)))
+
+    def _apply_one(self, entry: Entry, effects: list, notifys: dict,
+                   suppress: bool) -> None:
+        idx, term, cmd = entry
+        if self.machine_version < self.effective_machine_version:
+            return  # cannot apply further (version gate)
+        if isinstance(cmd, UserCommand):
+            meta = ApplyMeta(index=idx, term=term,
+                             machine_version=self.effective_machine_version,
+                             from_=cmd.from_, reply_mode=cmd.reply_mode)
+            result = self.effective_machine.apply(meta, cmd.data,
+                                                  self.machine_state)
+            if len(result) == 3:
+                self.machine_state, reply, app_effs = result
+            else:
+                self.machine_state, reply = result
+                app_effs = []
+            self.last_applied = idx
+            if suppress:
+                return
+            effects.extend(app_effs)
+            self._add_reply(cmd, idx, term, reply, effects, notifys)
+            return
+        if isinstance(cmd, NoopCommand):
+            self._apply_noop(entry, cmd, effects, suppress)
+            return
+        if isinstance(cmd, ClusterChangeCommand):
+            if (idx > self.cluster_index_term.index and
+                    term >= self.cluster_index_term.term):
+                # recovery path: actually apply the change
+                self._set_cluster(dict_from_cluster_spec(cmd.cluster))
+                self.cluster_index_term = IdxTerm(idx, term)
+            self.cluster_change_permitted = True
+            self.last_applied = idx
+            if not suppress:
+                self._add_reply(cmd, idx, term, "ok", effects, notifys)
+            return
+        if isinstance(cmd, ClusterDeleteCommand):
+            self.last_applied = idx
+            self.raft_state = RaftState.DELETE_AND_TERMINATE
+            if not suppress:
+                self._add_reply(cmd, idx, term, "ok", effects, notifys)
+                effects.extend(self.machine.state_enter("eol",
+                                                        self.machine_state))
+            return
+        # unknown command: count as applied
+        self.last_applied = idx
+
+    def _apply_noop(self, entry: Entry, cmd: NoopCommand, effects: list,
+                    suppress: bool) -> None:
+        idx, term, _ = entry
+        if term == self.current_term:
+            self.cluster_change_permitted = True
+        next_ver = cmd.machine_version
+        if next_ver > self.effective_machine_version:
+            if self.machine_version >= next_ver:
+                old_ver = self.effective_machine_version
+                self.effective_machine_version = next_ver
+                self.machine_versions.insert(0, (idx, next_ver))
+                self.effective_machine = self.machine.which_module(next_ver)
+                # apply the version-bump as a pseudo user command
+                # (ra_server.erl:2695-2712)
+                meta = ApplyMeta(index=idx, term=term,
+                                 machine_version=next_ver)
+                result = self.effective_machine.apply(
+                    meta, ("machine_version", old_ver, next_ver),
+                    self.machine_state)
+                self.machine_state = result[0]
+                if len(result) == 3 and not suppress:
+                    effects.extend(result[2])
+                self.last_applied = idx
+            else:
+                # cannot understand the new version: stop applying
+                self.effective_machine_version = next_ver
+        else:
+            self.last_applied = idx
+
+    def _add_reply(self, cmd: Any, idx: int, term: int, reply: Any,
+                   effects: list, notifys: dict) -> None:
+        mode = getattr(cmd, "reply_mode", None)
+        if mode == ReplyMode.AWAIT_CONSENSUS and \
+                getattr(cmd, "from_", None) is not None:
+            effects.append(Reply(cmd.from_,
+                                 CommandResult(idx, term, reply, self.id)))
+        elif mode == ReplyMode.NOTIFY and \
+                getattr(cmd, "notify_to", None) is not None:
+            notifys.setdefault(cmd.notify_to, []).append(
+                (cmd.correlation, reply))
+
+    # -- replication rpcs (ra_server.erl:1862-2016) ------------------------
+
+    def _make_pipelined_rpcs(self) -> list:
+        """Per-peer pipelining with flow control: in-flight bounded by
+        max_pipeline_count, batches by max_append_entries_batch."""
+        effects: list = []
+        next_log_idx = self.log.next_index()
+        for pid, peer in self.cluster.items():
+            if pid == self.id or peer.status != PeerStatus.NORMAL:
+                continue
+            if not (peer.next_index < next_log_idx or
+                    peer.commit_index_sent < self.commit_index):
+                continue
+            in_flight = peer.next_index - peer.match_index - 1
+            if in_flight >= self.cfg.max_pipeline_count:
+                continue
+            batch = min(self.cfg.max_append_entries_batch,
+                        self.cfg.max_pipeline_count - in_flight)
+            eff = self._make_rpc_for_peer(pid, peer, batch)
+            if eff is not None:
+                peer.commit_index_sent = self.commit_index
+                effects.append(eff)
+        return effects
+
+    def _make_all_rpcs(self) -> list:
+        """Empty/heartbeat AERs to all normal-status peers (make_all_rpcs)."""
+        effects: list = []
+        effects.extend(self._update_heartbeat_rpcs())
+        for pid, peer in self.cluster.items():
+            if pid == self.id or peer.status != PeerStatus.NORMAL:
+                continue
+            eff = self._make_rpc_for_peer(pid, peer, 1)
+            if eff is not None:
+                effects.append(eff)
+        return effects
+
+    def _make_rpc_for_peer(self, pid: ServerId, peer: Peer,
+                           batch: int) -> Optional[Any]:
+        prev_idx = peer.next_index - 1
+        prev_term = self.log.fetch_term(prev_idx) if prev_idx > 0 else 0
+        if prev_term is None:
+            snap = self.log.snapshot_index_term()
+            if snap.index == prev_idx:
+                prev_term = snap.term
+            else:
+                # entry compacted away: peer needs a snapshot
+                # (ra_server.erl:1962-1981)
+                peer.status = PeerStatus.SENDING_SNAPSHOT
+                return SendSnapshot(pid, (self.id, self.current_term))
+        last_idx = self.log.last_index_term().index
+        to = min(last_idx, prev_idx + batch)
+        entries = tuple(self.log.read_range(prev_idx + 1, to)) \
+            if to > prev_idx else ()
+        if to > prev_idx:
+            peer.next_index = to + 1
+        return SendRpc(pid, AppendEntriesRpc(
+            term=self.current_term, leader_id=self.id,
+            prev_log_index=prev_idx, prev_log_term=prev_term or 0,
+            leader_commit=self.commit_index, entries=entries))
+
+    # -- consistent queries (ra_server.erl:3032-3190) ----------------------
+
+    def _leader_consistent_query(self, from_: Any, query_fn: Any) -> list:
+        if not self.cluster_change_permitted:
+            # a new leader must commit its noop first (:3174-3190)
+            self.pending_consistent_queries.append((from_, query_fn,
+                                                    self.commit_index))
+            return []
+        return self._make_heartbeat_rpcs(from_, query_fn, self.commit_index)
+
+    def _make_heartbeat_rpcs(self, from_: Any, query_fn: Any,
+                             commit_index: int) -> list:
+        self.query_index += 1
+        self.queries_waiting_heartbeats.append(
+            (self.query_index, from_, query_fn, commit_index))
+        effects: list = []
+        for pid, peer in self.cluster.items():
+            if pid == self.id or peer.membership != Membership.VOTER:
+                continue
+            effects.append(SendRpc(pid, HeartbeatRpc(
+                query_index=self.query_index, term=self.current_term,
+                leader_id=self.id)))
+        if self._voter_count() == 1:
+            effects.extend(self._apply_ready_queries())
+        return effects
+
+    def _update_heartbeat_rpcs(self) -> list:
+        if not self.queries_waiting_heartbeats:
+            return []
+        effects: list = []
+        for pid, peer in self.cluster.items():
+            if pid == self.id or peer.membership != Membership.VOTER:
+                continue
+            effects.append(SendRpc(pid, HeartbeatRpc(
+                query_index=self.query_index, term=self.current_term,
+                leader_id=self.id)))
+        return effects
+
+    def _heartbeat_rpc_quorum(self, reply_qidx: int,
+                              from_peer: ServerId) -> list:
+        peer = self.cluster.get(from_peer)
+        if peer is None:
+            return []
+        peer.query_index = max(peer.query_index, reply_qidx)
+        return self._apply_ready_queries()
+
+    def _agreed_query_index(self) -> int:
+        idxs = [self.query_index]
+        for pid, peer in self.cluster.items():
+            if pid == self.id or peer.membership != Membership.VOTER:
+                continue
+            idxs.append(peer.query_index)
+        return self.agreed_commit(idxs)
+
+    def _apply_ready_queries(self) -> list:
+        agreed = self._agreed_query_index()
+        ready = [q for q in self.queries_waiting_heartbeats if q[0] <= agreed]
+        if not ready:
+            return []
+        self.queries_waiting_heartbeats = [
+            q for q in self.queries_waiting_heartbeats if q[0] > agreed]
+        effects: list = []
+        for _qidx, from_, query_fn, _ci in ready:
+            result = query_fn(self.machine_state)
+            effects.append(Reply(from_, CommandResult(
+                self.last_applied, self.current_term, result, self.id)))
+        return effects
+
+    def _process_pending_consistent_queries(self) -> list:
+        if not self.pending_consistent_queries or \
+                not self.cluster_change_permitted:
+            return []
+        pending, self.pending_consistent_queries = \
+            self.pending_consistent_queries, []
+        effects: list = []
+        for from_, query_fn, ci in pending:
+            effects.extend(self._make_heartbeat_rpcs(from_, query_fn, ci))
+        return effects
+
+    # -- leader transfer (ra_server.erl:806-828) ---------------------------
+
+    def _leader_transfer(self, event: TransferLeadershipEvent) -> list:
+        target = event.target
+        if target == self.id:
+            if event.from_ is not None:
+                return [Reply(event.from_, "already_leader")]
+            return []
+        if target not in self.cluster:
+            if event.from_ is not None:
+                return [Reply(event.from_,
+                              ErrorResult("unknown_member", self.id))]
+            return []
+        self._transfer_target = target
+        self.condition = Condition(
+            predicate=_transfer_leadership_predicate,
+            transition_to=RaftState.LEADER,
+            timeout_ms=self.cfg.election_timeout_ms,
+            timeout_effects=[])
+        self.raft_state = RaftState.AWAIT_CONDITION
+        effects: list = [SendRpc(target, TransferLeadershipEvent(target))]
+        if event.from_ is not None:
+            effects.append(Reply(event.from_, "ok"))
+        return effects
+
+    # -- await_condition (ra_server.erl:946-1010 in proc; core predicates) -
+
+    def _handle_await_condition(self, event: Any) -> list:
+        if isinstance(event, ElectionTimeout):
+            # condition timed out
+            cond = self.condition
+            self.condition = None
+            self.raft_state = cond.transition_to if cond else \
+                RaftState.FOLLOWER
+            effs = list(cond.timeout_effects) if cond else []
+            effs.append(StartElectionTimeout("medium"))
+            return effs
+        if isinstance(event, (RequestVoteRpc, PreVoteRpc)):
+            # deny votes while waiting (higher term still adopted)
+            if event.term > self.current_term:
+                self.condition = None
+                self.raft_state = RaftState.FOLLOWER
+                return [NextEvent(event)]
+            cand = event.candidate_id
+            if isinstance(event, RequestVoteRpc):
+                return [SendRpc(cand, RequestVoteResult(
+                    term=self.current_term, vote_granted=False,
+                    from_=self.id))]
+            return [SendRpc(cand, PreVoteResult(
+                term=self.current_term, token=event.token,
+                vote_granted=False, from_=self.id))]
+        if isinstance(event, WrittenEvent):
+            self.log.handle_written(event)
+            if self.leader_id is not None and \
+                    self.condition is not None and \
+                    self.condition.transition_to == RaftState.FOLLOWER:
+                return [SendRpc(self.leader_id,
+                                self._aer_reply(self.current_term, True))]
+            return []
+        cond = self.condition
+        if cond is not None and cond.predicate(event, self):
+            self.condition = None
+            self.raft_state = cond.transition_to
+            return [NextEvent(event)]
+        # hold the event: in ra the gen_statem postpones; our shell drops
+        # non-matching events (the leader will resend)
+        return []
+
+    # -- tick (ra_server.erl tick/1 + proc tick handling) ------------------
+
+    def _tick(self) -> list:
+        effects = list(self.machine.tick(time.time(), self.machine_state))
+        effects.extend(self.log.tick(time.monotonic() * 1000.0))
+        return _filter_follower_effects(effects) \
+            if self.raft_state != RaftState.LEADER else effects
+
+    def _tick_leader(self) -> list:
+        effects = self._tick()
+        # refresh peers (periodic empty AERs stand in for ra's aten-driven
+        # liveness; ra sends no idle heartbeats, INTERNALS.md:291-328)
+        effects.extend(self._make_all_rpcs())
+        return effects
+
+    # -- machine effects executed in the core (release_cursor etc.) --------
+
+    def handle_machine_effect(self, eff: Any) -> list:
+        """Called by the shell for machine effects that mutate log state
+        (ra_server.erl:2018-2046)."""
+        cluster_spec = tuple((sid, p.membership)
+                             for sid, p in self.cluster.items())
+        if isinstance(eff, ReleaseCursor):
+            return self.log.update_release_cursor(
+                eff.index, cluster_spec, self.effective_machine_version,
+                eff.machine_state)
+        if isinstance(eff, Checkpoint):
+            return self.log.checkpoint(
+                eff.index, cluster_spec, self.effective_machine_version,
+                eff.machine_state)
+        if isinstance(eff, PromoteCheckpoint):
+            self.log.promote_checkpoint(eff.index)
+            return []
+        return []
+
+    # -- introspection -----------------------------------------------------
+
+    def overview(self) -> dict:
+        return {
+            "id": self.id,
+            "raft_state": self.raft_state.value,
+            "current_term": self.current_term,
+            "voted_for": self.voted_for,
+            "leader_id": self.leader_id,
+            "commit_index": self.commit_index,
+            "last_applied": self.last_applied,
+            "query_index": self.query_index,
+            "membership": self.membership.value,
+            "cluster_change_permitted": self.cluster_change_permitted,
+            "machine_version": self.machine_version,
+            "effective_machine_version": self.effective_machine_version,
+            "cluster": {pid: {"match_index": p.match_index,
+                              "next_index": p.next_index,
+                              "status": p.status.value,
+                              "membership": p.membership.value}
+                        for pid, p in self.cluster.items()},
+            "log": self.log.overview(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# module helpers
+# ---------------------------------------------------------------------------
+
+def _log_up_to_date(idx: int, term: int, last: IdxTerm) -> bool:
+    """§5.4.1 up-to-date check (ra_server.erl:2486-2493)."""
+    if term > last.term:
+        return True
+    if term == last.term and idx >= last.index:
+        return True
+    return False
+
+
+def dict_from_cluster_spec(spec: tuple) -> dict:
+    return {sid: Peer(membership=m) for sid, m in spec}
+
+
+def _follower_catchup_predicate(event: Any, server: "RaServer") -> bool:
+    """Condition is met when a message arrives that lets the follower make
+    progress again: an AER whose prev point we can evaluate, or a snapshot
+    (follower_catchup_cond_fun)."""
+    if isinstance(event, AppendEntriesRpc):
+        if event.term < server.current_term:
+            return False
+        if event.prev_log_index == 0:
+            return True
+        last_idx = server.last_idx_term().index
+        return event.prev_log_index <= last_idx
+    if isinstance(event, InstallSnapshotRpc):
+        return event.term >= server.current_term
+    return False
+
+
+def _transfer_leadership_predicate(event: Any, server: "RaServer") -> bool:
+    """Old leader waits in await_condition until it sees a message from the
+    new leader (an AER/vote in a higher term)."""
+    return isinstance(event, (AppendEntriesRpc, RequestVoteRpc, PreVoteRpc,
+                              HeartbeatRpc))
+
+
+_FOLLOWER_SAFE_EFFECTS = (ReleaseCursor, Checkpoint, AuxEffect,
+                          GarbageCollection, SendMsg, LogReadEffect, Monitor,
+                          TimerEffect, Reply, SendRpc, StartElectionTimeout,
+                          NextEvent, Notify)
+
+
+def _filter_follower_effects(effects: list) -> list:
+    """Followers suppress most machine effects — they are emitted by the
+    leader only (filter_follower_effects, ra_server.erl:1815-1860).
+    release_cursor/checkpoint/aux/gc and local sends are kept."""
+    out = []
+    for e in effects:
+        if isinstance(e, Monitor) and e.component == "machine":
+            continue
+        if isinstance(e, Notify):
+            continue
+        if isinstance(e, SendMsg) and "local" not in e.options:
+            continue
+        if isinstance(e, Reply) and isinstance(e.msg, CommandResult):
+            # consensus replies have replier=leader by default: follower
+            # copies are dropped ({reply,_,_,leader} filtering)
+            continue
+        if isinstance(e, _FOLLOWER_SAFE_EFFECTS):
+            out.append(e)
+    return out

@@ -1,0 +1,282 @@
+"""In-memory log backend.
+
+Implements the per-server log contract the pure core depends on — the same
+interface the durable log (ra_tpu.log.durable) provides.  Modeled on the
+reference's test double /root/reference/test/ra_log_memory.erl plus the parts
+of the real facade contract the core observes (/root/reference/src/ra_log.erl):
+
+* ``append``/``write`` are *asynchronous* with respect to durability: entries
+  become readable immediately (memtable semantics) but ``last_written`` only
+  advances when the owner processes a :class:`~ra_tpu.core.types.WrittenEvent`
+  (delivered via :meth:`take_events`).  The quorum arithmetic counts the
+  leader's own ``last_written`` (ra_server.erl:2977-2987), so this async
+  protocol is load-bearing even in memory.
+* ``write`` at an index ≤ ``last_index`` truncates everything after the batch
+  (overwrite semantics, ra_log.erl:315-330).
+* meta (current_term / voted_for / last_applied) is stored synchronously,
+  standing in for ra_log_meta.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Iterable, Optional
+
+from ..core.types import Entry, IdxTerm, SnapshotMeta, WrittenEvent
+
+
+class IntegrityError(Exception):
+    pass
+
+
+class MemoryLog:
+    def __init__(self, *, auto_written: bool = True,
+                 first_index: int = 1) -> None:
+        # idx -> Entry
+        self._entries: dict[int, Entry] = {}
+        self._last_index = first_index - 1
+        self._last_term = 0
+        self._first_index = first_index
+        self._last_written = IdxTerm(first_index - 1, 0)
+        self._auto_written = auto_written
+        self._pending_events: list[WrittenEvent] = []
+        # meta store (ra_log_meta stand-in)
+        self._meta: dict[str, Any] = {"current_term": 0, "voted_for": None,
+                                      "last_applied": 0}
+        # snapshot: (SnapshotMeta, machine_state)
+        self._snapshot: Optional[tuple] = None
+        self._checkpoints: list[tuple] = []  # [(SnapshotMeta, machine_state)]
+
+    # -- ranges -------------------------------------------------------------
+
+    def last_index_term(self) -> IdxTerm:
+        return IdxTerm(self._last_index, self._last_term)
+
+    def last_written(self) -> IdxTerm:
+        return self._last_written
+
+    def first_index(self) -> int:
+        return self._first_index
+
+    def next_index(self) -> int:
+        return self._last_index + 1
+
+    # -- writes -------------------------------------------------------------
+
+    def append(self, entry: Entry) -> None:
+        """Leader-path append; index must be exactly next_index
+        (ra_log:append/2 errors on integrity violation)."""
+        if entry.index != self._last_index + 1:
+            raise IntegrityError(
+                f"append gap: {entry.index} != {self._last_index + 1}")
+        self._entries[entry.index] = entry
+        self._last_index = entry.index
+        self._last_term = entry.term
+        self._queue_written(entry.index, entry.index, entry.term)
+
+    def write(self, entries: list) -> None:
+        """Follower-path write; may overwrite.  First index must be within
+        [first_index, last_index+1]; everything after the batch is
+        truncated."""
+        if not entries:
+            return
+        first = entries[0].index
+        if first > self._last_index + 1:
+            raise IntegrityError(
+                f"write gap: {first} > {self._last_index + 1}")
+        for e in entries:
+            self._entries[e.index] = e
+        last = entries[-1]
+        # truncate any stale tail
+        for idx in range(last.index + 1, self._last_index + 1):
+            self._entries.pop(idx, None)
+        self._last_index = last.index
+        self._last_term = last.term
+        if self._last_written.index > last.index:
+            self._last_written = IdxTerm(last.index, last.term)
+        self._queue_written(first, last.index, last.term)
+
+    def set_last_index(self, idx: int) -> None:
+        """Truncate back so last index == idx (ra_log:set_last_index,
+        used when a valid leader shows a shorter log, ra_server.erl:1058)."""
+        if idx >= self._last_index:
+            return
+        for i in range(idx + 1, self._last_index + 1):
+            self._entries.pop(i, None)
+        term = self.fetch_term(idx) or 0
+        self._last_index = idx
+        self._last_term = term
+        if self._last_written.index > idx:
+            self._last_written = IdxTerm(idx, term)
+
+    def _queue_written(self, from_idx: int, to_idx: int, term: int) -> None:
+        if self._auto_written:
+            self._pending_events.append(WrittenEvent(from_idx, to_idx, term))
+
+    # -- async written-event protocol --------------------------------------
+
+    def take_events(self) -> list:
+        evts, self._pending_events = self._pending_events, []
+        return evts
+
+    def release_written(self, from_idx: int, to_idx: int, term: int) -> None:
+        """Manual mode: tests script the WAL confirm."""
+        self._pending_events.append(WrittenEvent(from_idx, to_idx, term))
+
+    def handle_written(self, evt: WrittenEvent) -> None:
+        """Owner processed a written event: advance last_written if the
+        entries still match (term check guards against overwrites,
+        ra_log.erl:474-529)."""
+        term = self.fetch_term(evt.to_index)
+        if term == evt.term:
+            if evt.to_index > self._last_written.index:
+                self._last_written = IdxTerm(evt.to_index, evt.term)
+        elif term is None and self._snapshot is not None and \
+                self._snapshot[0].index >= evt.to_index:
+            # entries already truncated by a snapshot: written info subsumed
+            pass
+        # else: stale write for an overwritten term — ignore (the real log
+        # triggers resend_from; the memory log has nothing to resend)
+
+    def reset_to_last_known_written(self) -> None:
+        lw = self._last_written
+        self.set_last_index(lw.index)
+
+    # -- reads --------------------------------------------------------------
+
+    def fetch(self, idx: int) -> Optional[Entry]:
+        return self._entries.get(idx)
+
+    def fetch_term(self, idx: int) -> Optional[int]:
+        if self._snapshot is not None and idx == self._snapshot[0].index:
+            return self._snapshot[0].term
+        e = self._entries.get(idx)
+        return e.term if e is not None else None
+
+    def exists(self, idx: int, term: int) -> bool:
+        return self.fetch_term(idx) == term
+
+    def fold(self, from_idx: int, to_idx: int,
+             fn: Callable[[Entry, Any], Any], acc: Any) -> Any:
+        for i in range(from_idx, to_idx + 1):
+            e = self._entries.get(i)
+            if e is None:
+                continue
+            acc = fn(e, acc)
+        return acc
+
+    def read_range(self, from_idx: int, to_idx: int) -> list:
+        return [self._entries[i]
+                for i in range(from_idx, to_idx + 1) if i in self._entries]
+
+    def sparse_read(self, indexes: Iterable[int]) -> list:
+        return [self._entries[i] for i in indexes if i in self._entries]
+
+    # -- meta ---------------------------------------------------------------
+
+    def store_meta(self, **kv: Any) -> None:
+        self._meta.update(kv)
+
+    def fetch_meta(self, key: str, default: Any = None) -> Any:
+        return self._meta.get(key, default)
+
+    # -- snapshots ----------------------------------------------------------
+
+    def snapshot_index_term(self) -> IdxTerm:
+        if self._snapshot is None:
+            return IdxTerm(0, 0)
+        meta = self._snapshot[0]
+        return IdxTerm(meta.index, meta.term)
+
+    def snapshot(self) -> Optional[tuple]:
+        return self._snapshot
+
+    def update_release_cursor(self, idx: int, cluster: tuple,
+                              machine_version: int,
+                              machine_state: Any) -> list:
+        """Take a snapshot at idx if the entry exists; truncate ≤ idx.
+        Memory log does this synchronously (the durable log spawns a
+        writer, ra_snapshot.erl:357-398).  Returns effects (none here)."""
+        term = self.fetch_term(idx)
+        if term is None:
+            return []
+        meta = SnapshotMeta(index=idx, term=term, cluster=cluster,
+                            machine_version=machine_version)
+        self._snapshot = (meta, pickle.dumps(machine_state))
+        self._truncate_to_snapshot(idx)
+        return []
+
+    def checkpoint(self, idx: int, cluster: tuple, machine_version: int,
+                   machine_state: Any) -> list:
+        term = self.fetch_term(idx)
+        if term is None:
+            return []
+        meta = SnapshotMeta(index=idx, term=term, cluster=cluster,
+                            machine_version=machine_version)
+        self._checkpoints.append((meta, pickle.dumps(machine_state)))
+        # retention: keep at most 10 (ra.hrl:234)
+        self._checkpoints = self._checkpoints[-10:]
+        return []
+
+    def promote_checkpoint(self, idx: int) -> bool:
+        best = None
+        for meta, st in self._checkpoints:
+            if meta.index <= idx and (best is None or meta.index > best[0].index):
+                best = (meta, st)
+        if best is None:
+            return False
+        self._snapshot = best
+        self._checkpoints = [c for c in self._checkpoints
+                             if c[0].index > best[0].index]
+        self._truncate_to_snapshot(best[0].index)
+        return True
+
+    def install_snapshot(self, meta: SnapshotMeta, data: bytes) -> None:
+        """Follower side: accept a complete streamed snapshot; truncates the
+        whole log below/at the snapshot index (ra_log:install_snapshot)."""
+        self._snapshot = (meta, data)
+        self._entries = {i: e for i, e in self._entries.items()
+                         if i > meta.index}
+        self._first_index = meta.index + 1
+        if self._last_index < meta.index:
+            self._last_index = meta.index
+            self._last_term = meta.term
+        self._last_written = IdxTerm(max(self._last_written.index, meta.index),
+                                     meta.term if
+                                     self._last_written.index <= meta.index
+                                     else self._last_written.term)
+
+    def recover_snapshot_state(self) -> Optional[tuple]:
+        """Returns (SnapshotMeta, machine_state) or None."""
+        if self._snapshot is None:
+            return None
+        meta, data = self._snapshot
+        return meta, pickle.loads(data)
+
+    def snapshot_data(self) -> bytes:
+        assert self._snapshot is not None
+        return self._snapshot[1]
+
+    def _truncate_to_snapshot(self, idx: int) -> None:
+        for i in list(self._entries):
+            if i <= idx:
+                del self._entries[i]
+        self._first_index = idx + 1
+
+    # -- misc ---------------------------------------------------------------
+
+    def tick(self, now_ms: float) -> list:
+        return []
+
+    def close(self) -> None:
+        pass
+
+    def overview(self) -> dict:
+        return {
+            "type": "memory",
+            "last_index": self._last_index,
+            "last_term": self._last_term,
+            "first_index": self._first_index,
+            "last_written_index_term": tuple(self._last_written),
+            "num_entries": len(self._entries),
+            "snapshot_index_term": tuple(self.snapshot_index_term()),
+        }

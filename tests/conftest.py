@@ -1,0 +1,13 @@
+import os
+import sys
+
+# Tests run sharding on a virtual multi-device CPU mesh; the real chip is
+# only exercised by bench.py.  Must be set before jax import anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))

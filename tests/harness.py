@@ -1,0 +1,198 @@
+"""Deterministic in-process simulation harness for core conformance tests.
+
+Plays the role the mocked log + scripted events play in the reference's
+ra_server_SUITE (/root/reference/test/ra_server_SUITE.erl): drives pure
+RaServer cores directly, routing effect data between them with no real
+timers, threads, or I/O, so every interleaving is scriptable and
+assertions are data-in/data-out.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Optional
+
+from ra_tpu.core.machine import Machine, SimpleMachine
+from ra_tpu.core.server import RaServer
+from ra_tpu.core.types import (
+    CancelElectionTimeout,
+    Checkpoint,
+    CommandEvent,
+    ConsistentQueryEvent,
+    ElectionTimeout,
+    InstallSnapshotRpc,
+    NextEvent,
+    Notify,
+    PromoteCheckpoint,
+    ReleaseCursor,
+    Reply,
+    SendRpc,
+    SendSnapshot,
+    SendVoteRequests,
+    ServerConfig,
+    ServerId,
+    StartElectionTimeout,
+    TransferLeadershipEvent,
+    UserCommand,
+)
+from ra_tpu.log.memory import MemoryLog
+
+
+def mk_ids(n: int) -> list:
+    return [ServerId(f"s{i+1}", f"node{i+1}") for i in range(n)]
+
+
+class SimCluster:
+    """Synchronous router between N RaServer cores."""
+
+    def __init__(self, n: int = 3, machine_factory: Optional[Callable] = None,
+                 auto_written: bool = True,
+                 snapshot_chunk_size: int = 64) -> None:
+        self.ids = mk_ids(n)
+        if machine_factory is None:
+            machine_factory = lambda: SimpleMachine(  # noqa: E731
+                lambda cmd, st: st + cmd, 0)
+        self.servers: dict[ServerId, RaServer] = {}
+        self.queues: dict[ServerId, deque] = {sid: deque() for sid in self.ids}
+        self.replies: list = []         # (server_id, Reply)
+        self.notifies: list = []        # (server_id, Notify)
+        self.timer_kinds: dict[ServerId, Optional[str]] = {}
+        self.dropped: set = set()       # partitioned links (src, dst)
+        self.snapshot_chunk_size = snapshot_chunk_size
+        for sid in self.ids:
+            log = MemoryLog(auto_written=auto_written)
+            cfg = ServerConfig(server_id=sid, uid=f"uid_{sid.name}",
+                               cluster_name="simcluster",
+                               initial_members=tuple(self.ids),
+                               machine=machine_factory())
+            srv = RaServer(cfg, log)
+            srv.recover()
+            self.servers[sid] = srv
+            self.timer_kinds[sid] = None
+
+    # -- driving -----------------------------------------------------------
+
+    def handle(self, sid: ServerId, event: Any) -> None:
+        """Feed one event to a server and process its effects."""
+        srv = self.servers[sid]
+        effects = srv.handle(event)
+        self._process_effects(sid, effects)
+        self._drain_log_events(sid)
+
+    def _drain_log_events(self, sid: ServerId) -> None:
+        srv = self.servers[sid]
+        for evt in srv.log.take_events():
+            effects = srv.handle(evt)
+            self._process_effects(sid, effects)
+
+    def _process_effects(self, sid: ServerId, effects: list) -> None:
+        srv = self.servers[sid]
+        for eff in effects:
+            if isinstance(eff, SendRpc):
+                self._send(sid, eff.to, eff.msg)
+            elif isinstance(eff, SendVoteRequests):
+                for to, msg in eff.requests:
+                    self._send(sid, to, msg)
+            elif isinstance(eff, NextEvent):
+                inner = srv.handle(eff.event)
+                self._process_effects(sid, inner)
+            elif isinstance(eff, Reply):
+                self.replies.append((sid, eff))
+            elif isinstance(eff, Notify):
+                self.notifies.append((sid, eff))
+            elif isinstance(eff, StartElectionTimeout):
+                self.timer_kinds[sid] = eff.kind
+            elif isinstance(eff, CancelElectionTimeout):
+                self.timer_kinds[sid] = None
+            elif isinstance(eff, (ReleaseCursor, Checkpoint,
+                                  PromoteCheckpoint)):
+                self._process_effects(sid, srv.handle_machine_effect(eff))
+            elif isinstance(eff, SendSnapshot):
+                self._send_snapshot(sid, eff)
+            # other effects (aux, metrics, monitors...) are inert here
+
+    def _send(self, src: ServerId, dst: ServerId, msg: Any) -> None:
+        if (src, dst) in self.dropped:
+            return
+        self.queues[dst].append(msg)
+
+    def _send_snapshot(self, src: ServerId, eff: SendSnapshot) -> None:
+        """Chunked snapshot transfer, modeled synchronously."""
+        srv = self.servers[src]
+        snap = srv.log.snapshot()
+        if snap is None:
+            return
+        meta, data = snap
+        leader_id, term = eff.id_term
+        chunks = [data[i:i + self.snapshot_chunk_size]
+                  for i in range(0, max(len(data), 1),
+                                 self.snapshot_chunk_size)] or [b""]
+        for i, chunk in enumerate(chunks):
+            flag = "last" if i == len(chunks) - 1 else "next"
+            self._send(src, eff.to,
+                       InstallSnapshotRpc(term=term, leader_id=leader_id,
+                                          meta=meta, chunk_number=i + 1,
+                                          chunk_flag=flag, data=chunk))
+
+    def step(self) -> bool:
+        """Deliver one pending message (round-robin across servers)."""
+        for sid in self.ids:
+            if self.queues[sid]:
+                msg = self.queues[sid].popleft()
+                self.handle(sid, msg)
+                return True
+        return False
+
+    def run(self, max_steps: int = 10_000) -> int:
+        n = 0
+        while self.step():
+            n += 1
+            if n >= max_steps:
+                raise RuntimeError("simulation did not quiesce")
+        return n
+
+    # -- convenience -------------------------------------------------------
+
+    def elect(self, sid: ServerId) -> None:
+        """Trigger an election timeout at sid and run to quiescence."""
+        self.handle(sid, ElectionTimeout())
+        self.run()
+
+    def leader(self) -> Optional[ServerId]:
+        for sid, srv in self.servers.items():
+            if srv.raft_state.value == "leader":
+                return sid
+        return None
+
+    def command(self, sid: ServerId, data: Any, from_: Any = None,
+                **kw: Any) -> None:
+        self.handle(sid, CommandEvent(UserCommand(data, **kw), from_=from_))
+        self.run()
+
+    def consistent_query(self, sid: ServerId, fn: Callable,
+                         from_: Any = "qclient") -> None:
+        self.handle(sid, ConsistentQueryEvent(fn, from_=from_))
+        self.run()
+
+    def transfer_leadership(self, sid: ServerId, target: ServerId,
+                            from_: Any = "tclient") -> None:
+        self.handle(sid, TransferLeadershipEvent(target, from_=from_))
+        self.run()
+
+    def partition(self, a: ServerId, b: ServerId) -> None:
+        self.dropped.add((a, b))
+        self.dropped.add((b, a))
+
+    def heal(self) -> None:
+        self.dropped.clear()
+
+    def isolate(self, sid: ServerId) -> None:
+        for other in self.ids:
+            if other != sid:
+                self.partition(sid, other)
+
+    def machine_states(self) -> dict:
+        return {sid: srv.machine_state for sid, srv in self.servers.items()}
+
+    def states(self) -> dict:
+        return {sid: srv.raft_state.value
+                for sid, srv in self.servers.items()}

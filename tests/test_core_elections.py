@@ -1,0 +1,161 @@
+"""Election conformance tests — scenarios modeled on the election cases of
+/root/reference/test/ra_server_SUITE.erl (pre-vote, vote counting, higher
+term stepping, §5.4.1 up-to-date checks)."""
+from harness import SimCluster, mk_ids
+
+from ra_tpu.core.server import RaServer
+from ra_tpu.core.types import (
+    AppendEntriesRpc,
+    ElectionTimeout,
+    IdxTerm,
+    PreVoteResult,
+    PreVoteRpc,
+    RequestVoteRpc,
+    RequestVoteResult,
+)
+
+
+def test_pre_vote_then_election_elects_leader():
+    c = SimCluster(3)
+    s1 = c.ids[0]
+    assert all(st == "follower" for st in c.states().values())
+    c.elect(s1)
+    assert c.servers[s1].raft_state.value == "leader"
+    assert c.servers[s1].current_term == 1
+    # noop committed -> cluster changes permitted
+    assert c.servers[s1].cluster_change_permitted
+    # followers learned the leader
+    for sid in c.ids[1:]:
+        assert c.servers[sid].leader_id == s1
+        assert c.servers[sid].raft_state.value == "follower"
+
+
+def test_election_requires_quorum():
+    c = SimCluster(5)
+    s1 = c.ids[0]
+    # isolate s1 with only one peer reachable: 2 < quorum(3)
+    c.partition(s1, c.ids[2])
+    c.partition(s1, c.ids[3])
+    c.partition(s1, c.ids[4])
+    c.elect(s1)
+    assert c.servers[s1].raft_state.value in ("pre_vote", "candidate")
+    assert c.leader() is None
+
+
+def test_higher_term_aer_steps_leader_down():
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    leader = c.servers[s1]
+    # a new leader in a higher term appears
+    c.handle(s1, AppendEntriesRpc(term=99, leader_id=s2, prev_log_index=0,
+                                  prev_log_term=0, leader_commit=0))
+    assert leader.raft_state.value == "follower"
+    assert leader.current_term == 99
+
+
+def test_vote_denied_for_stale_log():
+    c = SimCluster(3)
+    s1, s2, s3 = c.ids
+    c.elect(s1)
+    c.command(s1, 5)
+    # s3's log is now behind; candidate with empty log must be denied
+    srv2 = c.servers[s2]
+    effs = srv2.handle(RequestVoteRpc(term=srv2.current_term + 1,
+                                      candidate_id=s3,
+                                      last_log_index=0, last_log_term=0))
+    results = [e.msg for e in effs if hasattr(e, "msg")
+               and isinstance(e.msg, RequestVoteResult)]
+    assert results and not results[0].vote_granted
+
+
+def test_vote_granted_once_per_term():
+    c = SimCluster(3)
+    s1, s2, s3 = c.ids
+    srv1 = c.servers[s1]
+    effs = srv1.handle(RequestVoteRpc(term=5, candidate_id=s2,
+                                      last_log_index=0, last_log_term=0))
+    granted = [e.msg for e in effs if hasattr(e, "msg")
+               and isinstance(e.msg, RequestVoteResult)]
+    assert granted[0].vote_granted
+    # second candidate in the same term is denied
+    effs = srv1.handle(RequestVoteRpc(term=5, candidate_id=s3,
+                                      last_log_index=10, last_log_term=5))
+    denied = [e.msg for e in effs if hasattr(e, "msg")
+              and isinstance(e.msg, RequestVoteResult)]
+    assert not denied[0].vote_granted
+
+
+def test_pre_vote_does_not_bump_term():
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    srv1 = c.servers[s1]
+    term0 = srv1.current_term
+    srv1.handle(PreVoteRpc(term=term0, token=object(), candidate_id=s2,
+                           version=1, machine_version=0,
+                           last_log_index=0, last_log_term=0))
+    assert srv1.current_term == term0
+
+
+def test_pre_vote_result_stale_token_ignored():
+    c = SimCluster(3)
+    s1 = c.ids[0]
+    srv1 = c.servers[s1]
+    srv1.handle(ElectionTimeout())  # -> pre_vote, effects not routed
+    assert srv1.raft_state.value == "pre_vote"
+    votes0 = srv1.votes
+    srv1.handle(PreVoteResult(term=srv1.current_term, token=object(),
+                              vote_granted=True, from_=c.ids[1]))
+    assert srv1.votes == votes0  # stale token did not count
+
+
+def test_non_voter_ignores_election_timeout():
+    from ra_tpu.core.types import Membership
+    c = SimCluster(3)
+    s1 = c.ids[0]
+    srv1 = c.servers[s1]
+    srv1.cluster[s1].membership = Membership.NON_VOTER
+    srv1.membership = Membership.NON_VOTER
+    assert srv1.handle(ElectionTimeout()) == []
+    assert srv1.raft_state.value == "follower"
+
+
+def test_candidate_steps_down_on_higher_term_vote_result():
+    from ra_tpu.core.types import NextEvent
+    c = SimCluster(3)
+    s1 = c.ids[0]
+    srv1 = c.servers[s1]
+    effs = srv1.handle(ElectionTimeout())
+    for e in effs:  # process the self pre-vote
+        if isinstance(e, NextEvent):
+            srv1.handle(e.event)
+    # one peer grant reaches quorum -> candidate
+    srv1.handle(PreVoteResult(term=srv1.current_term,
+                              token=srv1.pre_vote_token,
+                              vote_granted=True, from_=c.ids[1]))
+    assert srv1.raft_state.value == "candidate"
+    srv1.handle(RequestVoteResult(term=100, vote_granted=False,
+                                  from_=c.ids[1]))
+    assert srv1.raft_state.value == "follower"
+    assert srv1.current_term == 100
+
+
+def test_agreed_commit_median():
+    # the scalar oracle the XLA kernel must match (ra_server.erl:2989-2993)
+    assert RaServer.agreed_commit([5]) == 5
+    assert RaServer.agreed_commit([5, 3]) == 3
+    assert RaServer.agreed_commit([5, 3, 1]) == 3
+    assert RaServer.agreed_commit([7, 5, 3, 1]) == 3
+    assert RaServer.agreed_commit([9, 7, 5, 3, 1]) == 5
+    assert RaServer.agreed_commit([0, 0, 9]) == 0
+
+
+def test_leadership_transfer():
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    c.transfer_leadership(s1, s2)
+    assert c.servers[s2].raft_state.value == "leader"
+    # old leader followed the new leader
+    assert c.servers[s1].raft_state.value == "follower"
+    assert c.servers[s1].leader_id == s2
