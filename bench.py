@@ -1,0 +1,91 @@
+"""ra-tpu headline benchmark.
+
+The ra_bench-equivalent workload at the BASELINE.md north-star config:
+N concurrent M-member Raft clusters, counter machine (ra_bench's noop/'+'
+machine, /root/reference/src/ra_bench.erl:43-49), sustained pipelined
+commands, measuring **committed commands/sec** with quorum decisions
+computed on-TPU.
+
+Baseline (BASELINE.md): 10,000 clusters x 5 members >= 1,000,000 committed
+cmds/sec on a single chip.  vs_baseline = value / 1e6.
+
+Prints ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+N_LANES = 10_000
+N_MEMBERS = 5
+CMDS_PER_STEP = 128          # per-lane pipelined batch per round
+WARMUP_STEPS = 5
+MEASURE_SECONDS = 5.0
+BASELINE = 1_000_000.0       # north-star committed cmds/sec
+
+
+def main() -> None:
+    from ra_tpu.engine import LockstepEngine
+    from ra_tpu.models import CounterMachine
+
+    eng = LockstepEngine(CounterMachine(), N_LANES, N_MEMBERS,
+                         ring_capacity=1024, max_step_cmds=CMDS_PER_STEP,
+                         apply_window=CMDS_PER_STEP + 2, write_delay=1)
+
+    n_new = jnp.full((N_LANES,), CMDS_PER_STEP, jnp.int32)
+    payloads = jnp.ones((N_LANES, CMDS_PER_STEP, 1), jnp.int32)
+
+    for _ in range(WARMUP_STEPS):
+        eng.step(n_new, payloads)
+    eng.block_until_ready()
+    start_committed = eng.committed_total()
+
+    steps = 0
+    t0 = time.perf_counter()
+    while True:
+        eng.step(n_new, payloads)
+        steps += 1
+        if steps % 20 == 0:
+            eng.block_until_ready()
+            if time.perf_counter() - t0 >= MEASURE_SECONDS:
+                break
+    eng.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    committed = eng.committed_total() - start_committed
+
+    # latency phase: per-step wall times with a sync per step; a command
+    # enqueued at step k commits at step k+1 (write_delay=1), so commit
+    # latency ~= 2 step times.  p99 over the measured distribution.
+    lat = []
+    for _ in range(50):
+        t1 = time.perf_counter()
+        eng.step(n_new, payloads)
+        eng.block_until_ready()
+        lat.append(time.perf_counter() - t1)
+    lat.sort()
+    p99_step = lat[int(len(lat) * 0.99) - 1]
+    p50_step = lat[len(lat) // 2]
+
+    value = committed / elapsed
+    print(json.dumps({
+        "metric": "committed_cmds_per_sec_10k_clusters_5_members",
+        "value": round(value, 1),
+        "unit": "cmds/s",
+        "vs_baseline": round(value / BASELINE, 4),
+        "detail": {
+            "lanes": N_LANES, "members": N_MEMBERS,
+            "cmds_per_step": CMDS_PER_STEP, "steps": steps,
+            "elapsed_s": round(elapsed, 3),
+            "platform": jax.devices()[0].platform,
+            "device": str(jax.devices()[0]),
+            "p50_commit_latency_ms": round(2000.0 * p50_step, 3),
+            "p99_commit_latency_ms": round(2000.0 * p99_step, 3),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,9 +13,11 @@ Two flavours exist:
 * :class:`JitMachine` — the TPU-native variant (the ``ra_machine_xla`` of the
   north star).  Its ``apply`` must be a pure, shape-stable JAX function
   ``(meta_array, cmd_array, state_pytree) -> (state_pytree, reply_array)``
-  so committed batches can be folded on-device with ``lax.scan`` by the lane
-  engine (see ra_tpu/ops/apply_fold.py).  A JitMachine also provides the
-  host-side protocol so the same machine works on both paths.
+  so committed batches can be folded on-device by the lane engine — via
+  ``lax.scan`` or, for commutative machines, the one-shot
+  ``jit_apply_batch`` window fold (see ra_tpu/engine/lockstep.py, step 5).
+  A JitMachine also provides the host-side protocol so the same machine
+  works on both paths.
 """
 from __future__ import annotations
 
@@ -131,12 +133,25 @@ class JitMachine(Machine):
     #: shape/dtype spec of one reply
     reply_spec: tuple = ("int32", ())
 
+    #: set True and override jit_apply_batch when the machine can fold a
+    #: whole committed window in one shot (commutative/associative applies);
+    #: the engine then skips the sequential lax.scan — O(1) depth instead
+    #: of O(window)
+    supports_batch_apply: bool = False
+
     def jit_init(self, n_lanes: int) -> Any:
         """Return the initial state pytree with a leading lane axis."""
         raise NotImplementedError
 
     def jit_apply(self, meta, command, state):
         """Pure JAX apply: (meta arrays, encoded cmd, state) -> (state, reply)."""
+        raise NotImplementedError
+
+    def jit_apply_batch(self, meta, commands, mask, state):
+        """Fold a window of commands at once.  commands: [..., A, C];
+        mask: bool[..., A] (True = apply); state leading dims match the
+        ... prefix.  Returns the new state.  Only called when
+        supports_batch_apply is True."""
         raise NotImplementedError
 
     def encode_command(self, command: Any):

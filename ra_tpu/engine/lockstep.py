@@ -1,0 +1,381 @@
+"""Lockstep multi-Raft lane engine — thousands of co-hosted clusters as one
+XLA program.
+
+This is the TPU-native inversion of the reference's process-per-server
+design (SURVEY.md §7.1): instead of one gen_statem per member
+(ra_server_proc.erl), *all* members of *all* co-hosted clusters live in SoA
+device arrays with a leading lane axis, and one jitted ``step`` advances
+every cluster simultaneously:
+
+  1. leader append     — host-enqueued command batches land in a device
+                         payload ring (the host→HBM entry ring; the
+                         fan-in role of ra_log_wal.erl:193-214)
+  2. replication       — followers adopt the leader tail, bounded by the
+                         per-peer pipeline window (ra_server.hrl:7)
+  3. write confirm     — last_written tracks the WAL fsync confirm; with
+                         ``write_delay=1`` it lags one step, reproducing
+                         the async written-event protocol (ra_log.erl:474+)
+  4. reply fold + quorum — ops.quorum.update_match_next / evaluate_quorum
+                         (ra_server.erl:418-454, 2941-2993)
+  5. apply fold        — lax.scan over the committed window, vmapped over
+                         (lane, member), calling the machine's jit_apply
+                         (the ra_machine_xla contract; host machines use
+                         the oracle path instead)
+
+Rare/divergent transitions (member failure, election, membership change)
+are host-initiated: the host failure detector marks members down and
+requests elections via mask inputs; the election itself is a batched
+kernel (best-log argmax among active voters — the outcome a pre-vote +
+vote round converges to; vote *counting* for the distributed deployment
+is ops.quorum.election_quorum).
+
+The lane axis is embarrassingly parallel: sharding it over a
+jax.sharding.Mesh scales co-hosted clusters across chips with zero
+cross-lane collectives (see ra_tpu.parallel.mesh).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.machine import JitMachine
+from ..ops.quorum import evaluate_quorum, update_match_next
+
+Array = jax.Array
+
+
+class LaneState(NamedTuple):
+    """SoA state for N lanes × P member slots (ra_server_state() flattened —
+    the per-lane scalars and per-lane×peer fields listed in SURVEY.md §7.1)."""
+
+    term: Array           # int32[N]   shared current term (steady state)
+    leader_slot: Array    # int32[N]   which slot leads the lane
+    term_start: Array     # int32[N]   index of this term's noop (§5.4.2 gate)
+    last_index: Array     # int32[N,P] per-member log tail
+    last_written: Array   # int32[N,P] fsync-confirmed tail
+    match: Array          # int32[N,P] leader's view (own slot = own written)
+    next_index: Array     # int32[N,P] per-peer send cursor
+    commit: Array         # int32[N,P] per-member commit index
+    applied: Array        # int32[N,P] per-member last applied
+    voter: Array          # bool[N,P]  voting members
+    active: Array         # bool[N,P]  member exists and is up
+    ring: Array           # int32/…[N,R,C] payload ring (device log window)
+    ring_base: Array      # int32[N]   reclaim horizon (entries <= base may
+                          #            be recycled; mapping is (idx-1) % R)
+    total_committed: Array  # int32[N] cumulative committed entries per lane
+    mac: Any              # machine state pytree, leading dims [N,P]
+
+
+def _init_state(n_lanes: int, n_members: int, ring_capacity: int,
+                payload_width: int, mac_state: Any,
+                payload_dtype=jnp.int32) -> LaneState:
+    N, P, R, C = n_lanes, n_members, ring_capacity, payload_width
+    z = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
+    return LaneState(
+        term=jnp.ones((N,), jnp.int32),
+        leader_slot=z(N),
+        term_start=jnp.ones((N,), jnp.int32),
+        last_index=z(N, P),
+        last_written=z(N, P),
+        match=z(N, P),
+        next_index=jnp.ones((N, P), jnp.int32),
+        commit=z(N, P),
+        applied=z(N, P),
+        voter=jnp.ones((N, P), bool),
+        active=jnp.ones((N, P), bool),
+        ring=jnp.zeros((N, R, C), payload_dtype),
+        ring_base=z(N),
+        total_committed=jnp.zeros((N,), jnp.int32),
+        mac=mac_state,
+    )
+
+
+def _step(state: LaneState, n_new: Array, payloads: Array,
+          fail_mask: Array, elect_mask: Array, *, machine: JitMachine,
+          ring_capacity: int, apply_window: int,
+          pipeline_window: int, write_delay: int) -> LaneState:
+    """One lockstep round for every lane.  Pure; jitted by the engine."""
+    N, P = state.last_index.shape
+    R = ring_capacity
+    lane = jnp.arange(N)
+
+    # -- 0. failures + elections (host-requested, device-evaluated) -------
+    active = state.active & ~fail_mask
+    # election: next term's leader = active voter with the longest written
+    # log (the candidate every voter would grant to, §5.4.1); term += 1 and
+    # a noop opens the term (become-leader, ra_server.erl:845-859)
+    score = jnp.where(active & state.voter, state.last_written, -1)
+    best_slot = jnp.argmax(score, axis=-1).astype(jnp.int32)
+    leader_slot = jnp.where(elect_mask, best_slot, state.leader_slot)
+    term = jnp.where(elect_mask, state.term + 1, state.term)
+    leader_arm = jax.nn.one_hot(leader_slot, P, dtype=jnp.bool_)
+    leader_last = jnp.take_along_axis(state.last_index, leader_slot[:, None],
+                                      axis=-1)[:, 0]
+    leader_written = jnp.take_along_axis(state.last_written,
+                                         leader_slot[:, None], axis=-1)[:, 0]
+    # new leader discards unwritten/unreplicated tail beyond its own log and
+    # opens its term at written+1 (overwrite semantics are host-side for
+    # the distributed path; in lockstep the new leader's log is the lane's)
+    leader_last = jnp.where(elect_mask, leader_written, leader_last)
+    term_start = jnp.where(elect_mask, leader_last + 1, state.term_start)
+    # election appends the noop entry (payload 0)
+    n_noop = jnp.where(elect_mask, 1, 0).astype(jnp.int32)
+
+    # a lane whose leader is inactive cannot accept commands
+    leader_up = jnp.take_along_axis(active, leader_slot[:, None],
+                                    axis=-1)[:, 0]
+
+    # -- 1. leader append into the ring (with backpressure) ---------------
+    # ring headroom: entries not yet applied by every member must stay
+    min_applied = jnp.min(jnp.where(active, state.applied,
+                                    jnp.int32(2**30)), axis=-1)
+    ring_base = jnp.maximum(state.ring_base, jnp.minimum(min_applied,
+                                                         leader_last))
+    used = leader_last - ring_base
+    headroom = jnp.maximum(R - used - 1, 0)
+    n_acc = jnp.minimum(jnp.where(leader_up, n_new, 0), headroom)
+    n_acc = jnp.minimum(n_acc, payloads.shape[1])
+    total_app = n_acc + jnp.where(leader_up, n_noop, 0)
+
+    K = payloads.shape[1]
+    # entry index i lives at ring slot (i - 1) % R; ring_base only tracks
+    # the reclaim horizon.  scatter payloads at slots for indexes
+    # leader_last+1 .. leader_last+n_acc; masked writes routed OOB + dropped
+    k_idx = jnp.arange(K)
+    dest = (leader_last[:, None] + k_idx[None, :]) % R
+    write_mask = k_idx[None, :] < n_acc[:, None]
+    safe_dest = jnp.where(write_mask, dest, R).reshape(-1)
+    ring = state.ring.at[jnp.repeat(lane, K), safe_dest].set(
+        payloads.reshape(N * K, -1).astype(state.ring.dtype), mode="drop")
+    # an election appends the term-opening noop (after any accepted cmds —
+    # the host never enqueues commands on an elect step); its payload is
+    # the machine's noop encoding (zeros)
+    noop_slot = (leader_last + n_acc) % R
+    noop_row = jnp.where(elect_mask[:, None],
+                         jnp.zeros((N, ring.shape[-1]), ring.dtype),
+                         ring[lane, noop_slot])
+    ring = ring.at[lane, noop_slot].set(noop_row)
+    new_leader_last = leader_last + total_app
+
+    # -- 2. replication: followers adopt the leader tail ------------------
+    # per-peer pipeline window bounds in-flight entries (ra_server.hrl:7)
+    target = jnp.minimum(new_leader_last[:, None],
+                         state.match + pipeline_window)
+    last_index = jnp.where(active,
+                           jnp.maximum(state.last_index, target),
+                           state.last_index)
+    last_index = jnp.where(leader_arm,
+                           jnp.broadcast_to(new_leader_last[:, None], (N, P)),
+                           last_index)
+    # truncation on term change: followers adopt the new leader's log tail
+    # (overwrite semantics, ra_server.erl:1032-1113)
+    last_index = jnp.where(elect_mask[:, None] & active,
+                           jnp.minimum(last_index,
+                                       new_leader_last[:, None]),
+                           last_index)
+
+    # -- 3. write confirm (async WAL protocol) ----------------------------
+    if write_delay == 0:
+        last_written = jnp.where(active, last_index, state.last_written)
+    else:
+        # confirms lag one step: this step confirms the *previous* tail
+        last_written = jnp.where(active,
+                                 jnp.minimum(last_index, state.last_index),
+                                 state.last_written)
+    last_written = jnp.minimum(last_written, last_index)
+
+    # -- 4. reply fold + quorum -------------------------------------------
+    match, next_index = update_match_next(
+        state.match, state.next_index,
+        active, last_written, last_index + 1)
+    # election resets peer state (initialise_peers)
+    match = jnp.where(elect_mask[:, None], jnp.where(leader_arm,
+                                                     last_written, 0), match)
+    leader_commit0 = jnp.take_along_axis(state.commit, leader_slot[:, None],
+                                         axis=-1)[:, 0]
+    # NB: down members stay in the quorum denominator (their match just
+    # freezes) — a leader that lost a majority must stop committing
+    new_leader_commit = evaluate_quorum(leader_commit0, match,
+                                        state.voter, term_start)
+    # followers learn commit via the (lockstep) AER broadcast, bounded by
+    # their own log (evaluate_commit_index_follower: min(last_index, CI))
+    commit = jnp.minimum(new_leader_commit[:, None], last_index)
+    commit = jnp.where(active, jnp.maximum(commit, state.commit),
+                       state.commit)
+    delta = (jnp.take_along_axis(commit, leader_slot[:, None], axis=-1)[:, 0]
+             - leader_commit0)
+    total_committed = state.total_committed + delta
+
+    # -- 5. apply fold over the committed window ---------------------------
+    applied0 = state.applied
+    apply_to = jnp.minimum(commit, applied0 + apply_window)
+    A = apply_window
+
+    if machine.supports_batch_apply:
+        # one-shot masked window fold (commutative machines): no scan depth
+        a_idx = jnp.arange(A)
+        idx = applied0[..., None] + 1 + a_idx            # [N,P,A]
+        do = idx <= apply_to[..., None]
+        slot = (idx - 1) % R
+        cmds = ring[lane[:, None, None], slot]           # [N,P,A,C]
+        meta = {"index": idx, "term": term[:, None, None]}
+        mac = machine.jit_apply_batch(meta, cmds, do, state.mac)
+        applied = apply_to
+    else:
+        def body(carry, a):
+            mac, applied = carry
+            idx = applied0 + 1 + a                       # [N,P] candidate
+            do = idx <= apply_to                         # [N,P] mask
+            slot = (idx - 1) % R                         # ring position
+            cmd = ring[lane[:, None], slot]              # [N,P,C]
+            meta = {"index": idx, "term": jnp.broadcast_to(term[:, None],
+                                                           idx.shape)}
+            new_mac, _reply = machine.jit_apply(meta, cmd, mac)
+            mac = jax.tree.map(
+                lambda new, old: jnp.where(
+                    do.reshape(do.shape + (1,) * (new.ndim - 2)), new, old),
+                new_mac, mac)
+            applied = jnp.where(do, idx, applied)
+            return (mac, applied), None
+
+        (mac, applied), _ = jax.lax.scan(body, (state.mac, applied0),
+                                         jnp.arange(A))
+
+    return LaneState(term=term, leader_slot=leader_slot,
+                     term_start=term_start, last_index=last_index,
+                     last_written=last_written, match=match,
+                     next_index=next_index, commit=commit, applied=applied,
+                     voter=state.voter, active=active, ring=ring,
+                     ring_base=ring_base, total_committed=total_committed,
+                     mac=mac)
+
+
+class LockstepEngine:
+    """Host API around the jitted lockstep step function."""
+
+    def __init__(self, machine: JitMachine, n_lanes: int, n_members: int = 3,
+                 *, ring_capacity: int = 1024, max_step_cmds: int = 64,
+                 apply_window: Optional[int] = None,
+                 pipeline_window: int = 4096, write_delay: int = 0,
+                 donate: bool = True) -> None:
+        self.machine = machine
+        self.n_lanes = n_lanes
+        self.n_members = n_members
+        self.ring_capacity = ring_capacity
+        self.max_step_cmds = max_step_cmds
+        self.apply_window = apply_window or (max_step_cmds + 2)
+        dtype, shape = machine.command_spec
+        self.payload_width = int(np.prod(shape)) if shape else 1
+        self.payload_dtype = jnp.dtype(dtype)
+        mac = machine.jit_init(n_lanes)
+        # broadcast machine state over member slots: [N,...] -> [N,P,...]
+        mac = jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                jnp.asarray(x)[:, None], (n_lanes, n_members) +
+                jnp.asarray(x).shape[1:]),
+            mac)
+        self.state = _init_state(n_lanes, n_members, ring_capacity,
+                                 self.payload_width, mac,
+                                 self.payload_dtype)
+        step = functools.partial(_step, machine=machine,
+                                 ring_capacity=ring_capacity,
+                                 apply_window=self.apply_window,
+                                 pipeline_window=pipeline_window,
+                                 write_delay=write_delay)
+        self._step = jax.jit(step, donate_argnums=(0,) if donate else ())
+        self._zero_fail = jnp.zeros((n_lanes, n_members), bool)
+        self._zero_elect = jnp.zeros((n_lanes,), bool)
+        self._fail_host = np.zeros((n_lanes, n_members), bool)
+
+    # -- driving -----------------------------------------------------------
+
+    def step(self, n_new, payloads, elect_mask=None) -> None:
+        """Advance every lane one round.  n_new: int32[N]; payloads:
+        [N, K, C] with K <= max_step_cmds."""
+        fail = (jnp.asarray(self._fail_host)
+                if self._fail_host.any() else self._zero_fail)
+        elect = self._zero_elect if elect_mask is None \
+            else jnp.asarray(elect_mask)
+        self.state = self._step(self.state, jnp.asarray(n_new),
+                                jnp.asarray(payloads), fail, elect)
+
+    def uniform_step(self, cmds_per_lane: int, payload_value=1) -> None:
+        """Bench helper: every lane's leader receives the same number of
+        commands this round."""
+        N, K, C = self.n_lanes, self.max_step_cmds, self.payload_width
+        n_new = jnp.full((N,), min(cmds_per_lane, K), jnp.int32)
+        payloads = jnp.full((N, K, C), payload_value, self.payload_dtype)
+        self.step(n_new, payloads)
+
+    # -- failure injection / elections ------------------------------------
+
+    def fail_member(self, lane: int, slot: int) -> None:
+        self._fail_host[lane, slot] = True
+
+    def recover_member(self, lane: int, slot: int) -> None:
+        """Re-activate a member.  If the ring has reclaimed entries past the
+        member's applied index, replaying from the ring would apply recycled
+        slots — so the member is brought back via *snapshot install* from
+        the lane leader (the same escalation the reference takes when a
+        follower falls behind the log truncation horizon,
+        ra_server.erl:1962-1981): machine state and cursors are copied from
+        the leader's replica."""
+        self._fail_host[lane, slot] = False
+        st = self.state
+        leader = int(st.leader_slot[lane])
+        behind = int(st.applied[lane, slot]) < int(st.ring_base[lane])
+        if behind:
+            st = st._replace(
+                mac=jax.tree.map(
+                    lambda x: x.at[lane, slot].set(x[lane, leader]), st.mac),
+                applied=st.applied.at[lane, slot].set(
+                    st.applied[lane, leader]),
+                commit=st.commit.at[lane, slot].set(st.commit[lane, leader]),
+                last_index=st.last_index.at[lane, slot].set(
+                    st.last_written[lane, leader]),
+                last_written=st.last_written.at[lane, slot].set(
+                    st.last_written[lane, leader]))
+        self.state = st._replace(active=st.active.at[lane, slot].set(True))
+
+    def trigger_election(self, lanes) -> None:
+        mask = np.zeros((self.n_lanes,), bool)
+        mask[np.asarray(lanes)] = True
+        N, K, C = self.n_lanes, self.max_step_cmds, self.payload_width
+        self.step(jnp.zeros((N,), jnp.int32),
+                  jnp.zeros((N, K, C), self.payload_dtype),
+                  elect_mask=mask)
+
+    # -- readback ----------------------------------------------------------
+
+    def committed_total(self) -> int:
+        # per-lane counters are int32 (wrap needs 2^31 commits in ONE lane —
+        # unreachable in practice); the node-wide sum can exceed 2^31, so
+        # sum on host in int64
+        return int(np.asarray(self.state.total_committed)
+                   .astype(np.int64).sum())
+
+    def committed_per_lane(self) -> np.ndarray:
+        return np.asarray(self.state.total_committed)
+
+    def machine_states(self) -> Any:
+        return jax.tree.map(np.asarray, self.state.mac)
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self.state)
+
+    def overview(self, lane: int = 0) -> dict:
+        s = self.state
+        return {
+            "term": int(s.term[lane]),
+            "leader_slot": int(s.leader_slot[lane]),
+            "last_index": np.asarray(s.last_index[lane]).tolist(),
+            "last_written": np.asarray(s.last_written[lane]).tolist(),
+            "commit": np.asarray(s.commit[lane]).tolist(),
+            "applied": np.asarray(s.applied[lane]).tolist(),
+            "active": np.asarray(s.active[lane]).tolist(),
+            "total_committed": int(s.total_committed[lane]),
+        }

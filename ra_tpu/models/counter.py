@@ -1,0 +1,42 @@
+"""Counter machine — the simplest jittable state machine.
+
+The TPU-native analogue of wrapping ``erlang:'+'/2`` in ra_machine_simple
+(the machine ra_bench uses, /root/reference/src/ra_bench.erl:43-49): state
+is one int64 per lane-member, a command is one int32 increment, the reply
+is the new value.  Payload 0 encodes a noop (the term-opening entry), so
+the engine's election path composes with it for free.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.machine import JitMachine
+
+
+class CounterMachine(JitMachine):
+    command_spec = ("int32", (1,))
+    reply_spec = ("int32", ())
+    version = 0
+
+    def jit_init(self, n_lanes: int):
+        return jnp.zeros((n_lanes,), jnp.int32)
+
+    supports_batch_apply = True
+
+    def jit_apply(self, meta, command, state):
+        # command: [..., 1] int32; state: [...] int32
+        inc = command[..., 0]
+        new_state = state + inc
+        return new_state, new_state
+
+    def jit_apply_batch(self, meta, commands, mask, state):
+        # commands: [..., A, 1]; mask: [..., A] — addition commutes, so a
+        # whole committed window folds in one masked sum
+        inc = jnp.sum(jnp.where(mask, commands[..., 0], 0), axis=-1)
+        return state + inc
+
+    def encode_command(self, command):
+        return jnp.asarray([int(command)], jnp.int32)
+
+    def decode_reply(self, reply):
+        return int(reply)

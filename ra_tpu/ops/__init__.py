@@ -1,0 +1,8 @@
+from .quorum import (
+    agreed_commit,
+    election_quorum,
+    evaluate_quorum,
+    pipeline_credit,
+    query_quorum,
+    update_match_next,
+)

@@ -1,0 +1,133 @@
+"""Batched quorum/commit kernels — the hot Raft arithmetic as XLA ops.
+
+These lift the per-cluster functions of the reference's pure core into
+vectorized form over a leading *lane* axis (one lane = one Raft cluster):
+
+* :func:`agreed_commit` — the sorted-median quorum index
+  (ra_server.erl:2989-2993 ``agreed_commit``: sort descending, take the
+  ``trunc(n/2)+1``-th, 1-based), with voter masking
+  (ra_server.erl:2977-2987 ``match_indexes`` skips non-voters).
+* :func:`evaluate_quorum` — commit-index advancement with the §5.4.2
+  current-term gate (ra_server.erl:2955-2964 ``increment_commit_index``).
+  On device the term gate is expressed as ``agreed >= term_start_index``:
+  a leader's log tail from its first own-term append onward is entirely in
+  the current term, so "entry term == current term" ⟺ "index ≥ index of
+  the term-opening noop".
+* :func:`election_quorum` — vote counting (ra_server.erl:986-1002 and
+  :845-859: win iff granted votes ≥ trunc(voters/2)+1).
+* :func:`update_match_next` — the AER-reply success fold
+  (ra_server.erl:430-433: match := max(match, last_index),
+  next := max(next, next_index)).
+* :func:`query_quorum` — consistent-query heartbeat quorum: the agreed
+  query index is the same masked median over per-peer confirmed query
+  indexes (ra_server.erl:3101-3170, ``query_indexes`` :2966-2976).
+
+All kernels are shape-stable, control-flow-free, and dtype int32 — they
+fuse into a handful of VPU ops under jit, and vmap/shard_map cleanly over
+the lane axis (sharding spec: lanes are embarrassingly parallel).
+
+Oracle: ra_tpu.core.server.RaServer.agreed_commit and the scalar handlers;
+tests/test_ops_quorum.py checks equivalence on randomized cases.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def agreed_commit(match_index: Array, voter_mask: Array) -> Array:
+    """Quorum-agreed index per lane.
+
+    match_index: int32[..., P] — per-member match indexes; the leader's own
+        slot must hold its last *written* index (its fsync confirm counts
+        toward the quorum, ra_server.erl:2977-2987).
+    voter_mask: bool[..., P] — True for voting members (present + voter).
+
+    Returns int32[...]: the highest index replicated on a majority of
+    voters — element ``n//2`` (0-based) of the descending sort, i.e. the
+    ``trunc(n/2)+1``-th (1-based) as in the reference.
+    """
+    # -1 is a sentinel below any valid index (indexes are >= 0)
+    masked = jnp.where(voter_mask, match_index, -1)
+    sorted_desc = -jnp.sort(-masked, axis=-1)
+    n = jnp.sum(voter_mask.astype(jnp.int32), axis=-1)
+    k = n // 2
+    agreed = jnp.take_along_axis(sorted_desc, k[..., None], axis=-1)[..., 0]
+    # lanes with zero voters (unused padding lanes) yield -1 -> clamp to 0
+    return jnp.maximum(agreed, 0)
+
+
+def evaluate_quorum(commit_index: Array, match_index: Array,
+                    voter_mask: Array, term_start_index: Array) -> Array:
+    """Advance commit_index per lane iff a higher index is quorum-agreed
+    AND it lies in the leader's current term (§5.4.2 gate).
+
+    commit_index: int32[...]; match_index: int32[..., P];
+    voter_mask: bool[..., P]; term_start_index: int32[...] — index of the
+    noop the leader appended when it won its term (ra_server.erl:845-859).
+    """
+    agreed = agreed_commit(match_index, voter_mask)
+    ok = (agreed > commit_index) & (agreed >= term_start_index)
+    return jnp.where(ok, agreed, commit_index)
+
+
+def update_match_next(match_index: Array, next_index: Array,
+                      reply_success: Array, reply_last_index: Array,
+                      reply_next_index: Array) -> tuple:
+    """Fold a batch of successful AER replies into peer state
+    (ra_server.erl:430-433).  Failure repair is divergent control flow and
+    stays on the host oracle.
+
+    All args broadcast over [..., P]; reply_success masks which slots
+    actually replied this step.
+    """
+    new_match = jnp.where(reply_success,
+                          jnp.maximum(match_index, reply_last_index),
+                          match_index)
+    new_next = jnp.where(reply_success,
+                         jnp.maximum(next_index, reply_next_index),
+                         next_index)
+    return new_match, new_next
+
+
+def election_quorum(granted_mask: Array, voter_mask: Array) -> Array:
+    """True per lane iff granted votes reach trunc(voters/2)+1
+    (required_quorum, ra_server.hrl + ra_server.erl:845-859).
+
+    granted_mask must include the candidate's self-vote.
+    """
+    votes = jnp.sum((granted_mask & voter_mask).astype(jnp.int32), axis=-1)
+    needed = jnp.sum(voter_mask.astype(jnp.int32), axis=-1) // 2 + 1
+    return votes >= needed
+
+
+def query_quorum(query_index: Array, peer_query_index: Array,
+                 voter_mask: Array) -> Array:
+    """Agreed (majority-confirmed) consistent-query index per lane.
+
+    query_index: int32[...] — the leader's own counter; peer_query_index:
+    int32[..., P] with the leader's slot ignored via voter_mask handling in
+    the caller (pass the leader's own value in its slot — it confirms its
+    own heartbeats, query_indexes ra_server.erl:2966-2976).
+    """
+    return agreed_commit(peer_query_index, voter_mask)
+
+
+def pipeline_credit(next_index: Array, match_index: Array,
+                    last_index: Array, commit_index: Array,
+                    commit_index_sent: Array,
+                    max_pipeline: int, max_batch: int) -> tuple:
+    """Flow-control arithmetic of make_pipelined_rpc_effects
+    (ra_server.erl:1862-1918): how many entries to ship to each peer this
+    step, bounded by the in-flight window.
+
+    Returns (n_to_send[..., P], needs_rpc[..., P]).
+    """
+    in_flight = next_index - match_index - 1
+    headroom = jnp.maximum(max_pipeline - in_flight, 0)
+    avail = jnp.maximum(last_index[..., None] - next_index + 1, 0)
+    n = jnp.minimum(jnp.minimum(avail, headroom), max_batch)
+    needs = (n > 0) | (commit_index_sent < commit_index[..., None])
+    return n, needs

@@ -1,0 +1,1 @@
+from .mesh import lane_mesh, shard_engine_state, state_shardings

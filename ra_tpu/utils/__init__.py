@@ -1,0 +1,1 @@
+from .jaxenv import force_platform_from_env

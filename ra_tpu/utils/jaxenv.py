@@ -1,0 +1,28 @@
+"""JAX platform selection helper.
+
+In images where a TPU PJRT plugin (e.g. the axon tunnel) registers itself,
+the ``JAX_PLATFORMS`` environment variable alone does not demote it; the
+platform must also be forced through ``jax.config`` *before* the default
+backend initializes.  Both the test suite and the multichip dryrun share
+this single implementation so the workaround cannot drift.
+"""
+from __future__ import annotations
+
+import os
+
+
+def force_platform_from_env(default: str | None = None) -> None:
+    """Honor JAX_PLATFORMS (or ``default`` if unset) via jax.config.
+
+    Call before anything creates a concrete array.  No-op when neither the
+    env var nor ``default`` names a platform.
+    """
+    platform = os.environ.get("JAX_PLATFORMS") or default
+    if not platform:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform.split(",")[0])
+    except Exception:
+        pass  # backend already initialized; env var had its chance

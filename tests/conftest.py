@@ -2,7 +2,7 @@ import os
 import sys
 
 # Tests run sharding on a virtual multi-device CPU mesh; the real chip is
-# only exercised by bench.py.  Must be set before jax import anywhere.
+# only exercised by bench.py.  Export JAX_PLATFORMS=tpu to override.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
@@ -11,3 +11,7 @@ if "host_platform_device_count" not in flags:
 
 sys.path.insert(0, os.path.dirname(__file__))
 sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+from ra_tpu.utils import force_platform_from_env  # noqa: E402
+
+force_platform_from_env()

@@ -1,0 +1,105 @@
+"""Kernel-vs-oracle equivalence: the batched XLA quorum kernels must agree
+with the scalar pure core on randomized inputs (the TPU analogue of driving
+ra_server's quorum functions directly in ra_server_SUITE)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ra_tpu.core.server import RaServer
+from ra_tpu.ops import (
+    agreed_commit,
+    election_quorum,
+    evaluate_quorum,
+    update_match_next,
+)
+
+rng = np.random.default_rng(42)
+
+
+def test_agreed_commit_matches_oracle_randomized():
+    N, P = 257, 7
+    match = rng.integers(0, 1000, size=(N, P)).astype(np.int32)
+    # random voter masks with at least 1 voter
+    mask = rng.random((N, P)) < 0.7
+    mask[:, 0] = True
+    got = np.asarray(agreed_commit(jnp.asarray(match), jnp.asarray(mask)))
+    for i in range(N):
+        voters = [int(match[i, p]) for p in range(P) if mask[i, p]]
+        assert got[i] == RaServer.agreed_commit(voters), (i, voters, got[i])
+
+
+def test_agreed_commit_known_cases():
+    cases = [
+        ([5], [True], 5),
+        ([5, 3], [True, True], 3),
+        ([5, 3, 1], [True, True, True], 3),
+        ([7, 5, 3, 1], [True] * 4, 3),
+        ([9, 7, 5, 3, 1], [True] * 5, 5),
+        ([9, 7, 5, 3, 1], [True, True, True, False, False], 7),  # non-voters
+        ([0, 0, 9], [True] * 3, 0),
+    ]
+    for vals, mask, want in cases:
+        P = len(vals)
+        got = int(agreed_commit(jnp.asarray([vals], jnp.int32),
+                                jnp.asarray([mask]))[0])
+        assert got == want, (vals, mask, got, want)
+
+
+def test_evaluate_quorum_term_gate():
+    # agreed=5 but term_start=6 -> not committable (§5.4.2)
+    match = jnp.asarray([[5, 5, 5], [5, 5, 5]], jnp.int32)
+    mask = jnp.ones((2, 3), bool)
+    commit = jnp.asarray([2, 2], jnp.int32)
+    term_start = jnp.asarray([6, 3], jnp.int32)
+    out = np.asarray(evaluate_quorum(commit, match, mask, term_start))
+    assert out.tolist() == [2, 5]
+
+
+def test_evaluate_quorum_never_regresses():
+    N, P = 128, 5
+    match = rng.integers(0, 50, size=(N, P)).astype(np.int32)
+    mask = np.ones((N, P), bool)
+    commit = rng.integers(0, 60, size=N).astype(np.int32)
+    ts = rng.integers(0, 60, size=N).astype(np.int32)
+    out = np.asarray(evaluate_quorum(jnp.asarray(commit), jnp.asarray(match),
+                                     jnp.asarray(mask), jnp.asarray(ts)))
+    assert (out >= commit).all()
+
+
+def test_election_quorum():
+    granted = jnp.asarray([
+        [True, True, False, False, False],   # 2/5 -> no
+        [True, True, True, False, False],    # 3/5 -> yes
+        [True, False, False, False, False],  # 1/1 voter -> yes
+        [True, True, False, False, False],   # 2/3 voters -> yes
+    ])
+    mask = jnp.asarray([
+        [True] * 5,
+        [True] * 5,
+        [True, False, False, False, False],
+        [True, True, True, False, False],
+    ])
+    out = np.asarray(election_quorum(granted, mask))
+    assert out.tolist() == [False, True, True, True]
+
+
+def test_update_match_next_fold():
+    match = jnp.asarray([[3, 0, 7]], jnp.int32)
+    nxt = jnp.asarray([[4, 1, 8]], jnp.int32)
+    success = jnp.asarray([[True, False, True]])
+    r_last = jnp.asarray([[6, 9, 5]], jnp.int32)
+    r_next = jnp.asarray([[7, 10, 6]], jnp.int32)
+    m, n = update_match_next(match, nxt, success, r_last, r_next)
+    assert np.asarray(m).tolist() == [[6, 0, 7]]   # only replied slots move
+    assert np.asarray(n).tolist() == [[7, 1, 8]]   # max() never regresses
+
+
+def test_kernels_jit_and_vmap():
+    import jax
+    f = jax.jit(evaluate_quorum)
+    out = f(jnp.zeros((16,), jnp.int32),
+            jnp.ones((16, 5), jnp.int32) * 3,
+            jnp.ones((16, 5), bool),
+            jnp.ones((16,), jnp.int32))
+    assert np.asarray(out).tolist() == [3] * 16
