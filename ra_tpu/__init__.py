@@ -8,3 +8,22 @@ handler of rare/divergent transitions.
 """
 
 __version__ = "0.1.0"
+
+from .api import (  # noqa: E402,F401
+    add_member,
+    consistent_query,
+    delete_cluster,
+    key_metrics,
+    leader_query,
+    local_query,
+    members,
+    new_uid,
+    pipeline_command,
+    process_command,
+    remove_member,
+    start_cluster,
+    start_server,
+    transfer_leadership,
+    trigger_election,
+)
+from .node import LocalRouter, RaNode  # noqa: E402,F401

@@ -1,0 +1,321 @@
+"""Public API — the ra.erl equivalent (cited: /root/reference/src/ra.erl).
+
+Functions mirror the reference surface: start_cluster/4 (:374),
+process_command/3 (:804-828) with follower->leader redirect,
+pipeline_command/4 (:886-896), local_query (:962), leader_query (:1012),
+consistent_query (:1051), members, add_member (:593), remove_member (:628),
+trigger_election (:660), transfer_leadership (:687), delete_cluster (:556),
+restart_server (:188), key_metrics (:1229).
+
+All calls are synchronous wrappers around effect-routed futures; the
+engine-based deployments expose the same verbs through the lane engine's
+host API instead.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+from .core.machine import Machine
+from .core.types import (
+    CommandResult,
+    ConsistentQueryEvent,
+    ErrorResult,
+    ForceElectionEvent,
+    JoinCommand,
+    LeaveCommand,
+    ClusterDeleteCommand,
+    Membership,
+    Priority,
+    ReplyMode,
+    ServerConfig,
+    ServerId,
+    TransferLeadershipEvent,
+    UserCommand,
+)
+from .node import DEFAULT_ROUTER, Future, LocalRouter, RaNode
+
+
+def new_uid(prefix: str = "") -> str:
+    """Unique, filesystem-safe server UID (ra:new_uid/1 :735)."""
+    return f"{prefix}{uuid.uuid4().hex[:12]}"
+
+
+def start_cluster(cluster_name: str, machine_factory: Callable[[], Machine],
+                  server_ids: list, router: Optional[LocalRouter] = None,
+                  election_timeout_ms: int = 100,
+                  tick_interval_ms: int = 100,
+                  log_init_args: Optional[dict] = None) -> list:
+    """Start every member and trigger an election (ra:start_cluster/5 :374).
+    RaNodes named by each ServerId.node must already exist on the router."""
+    router = router or DEFAULT_ROUTER
+    started = []
+    for sid in server_ids:
+        node = router.nodes.get(sid.node)
+        if node is None:
+            raise RuntimeError(f"no RaNode registered for {sid.node}")
+        cfg = ServerConfig(server_id=sid, uid=new_uid(f"{sid.name}_"),
+                           cluster_name=cluster_name,
+                           initial_members=tuple(server_ids),
+                           machine=machine_factory(),
+                           election_timeout_ms=election_timeout_ms,
+                           tick_interval_ms=tick_interval_ms,
+                           log_init_args=dict(log_init_args or {}))
+        node.start_server(cfg)
+        started.append(sid)
+    # nudge the first member so a fresh cluster elects promptly
+    trigger_election(server_ids[0], router)
+    return started
+
+
+def start_server(cluster_name: str, machine_factory: Callable[[], Machine],
+                 server_id: ServerId, initial_members: list,
+                 router: Optional[LocalRouter] = None,
+                 election_timeout_ms: int = 100,
+                 tick_interval_ms: int = 100,
+                 membership: Membership = Membership.VOTER,
+                 log_init_args: Optional[dict] = None) -> ServerId:
+    """Start one member without electing (ra:start_server/4) — used before
+    add_member to bring the new member up."""
+    router = router or DEFAULT_ROUTER
+    node = router.nodes.get(server_id.node)
+    if node is None:
+        raise RuntimeError(f"no RaNode registered for {server_id.node}")
+    cfg = ServerConfig(server_id=server_id,
+                       uid=new_uid(f"{server_id.name}_"),
+                       cluster_name=cluster_name,
+                       initial_members=tuple(initial_members),
+                       machine=machine_factory(),
+                       election_timeout_ms=election_timeout_ms,
+                       tick_interval_ms=tick_interval_ms,
+                       membership=membership,
+                       log_init_args=dict(log_init_args or {}))
+    return node.start_server(cfg)
+
+
+def _node_of(sid: ServerId, router: LocalRouter) -> RaNode:
+    node = router.nodes.get(sid.node)
+    if node is None:
+        raise RuntimeError(f"node {sid.node} is not running")
+    return node
+
+
+def _leader_call(seed: ServerId, make_event: Callable[["Future"], Any],
+                 router: LocalRouter, timeout: float,
+                 retry_reasons: tuple = (),
+                 timeout_msg: str = "ra: command not completed") -> Any:
+    """Shared redirect/retry loop for leader-targeted calls — the
+    equivalent of ra_server_proc's leader_call redirect machinery
+    (ra_server_proc.erl:242-263).  make_event builds the event to submit
+    given the reply Future.  not_leader redirects follow the hinted
+    leader; reasons in retry_reasons back off and retry in place."""
+    deadline = time.monotonic() + timeout
+    target = seed
+    last_err: Any = None
+    while time.monotonic() < deadline:
+        fut = Future()
+        node = router.nodes.get(target.node)
+        if node is None or not node.submit(target.name, make_event(fut)):
+            last_err = ErrorResult("noproc", None)
+            target = seed
+            time.sleep(0.01)
+            continue
+        try:
+            result = fut.wait(min(timeout, deadline - time.monotonic()))
+        except TimeoutError:
+            last_err = ErrorResult("timeout", None)
+            break
+        if isinstance(result, ErrorResult):
+            last_err = result
+            if result.reason == "not_leader":
+                if result.leader is not None and result.leader != target:
+                    target = result.leader
+                else:
+                    time.sleep(0.01)  # election in progress
+                continue
+            if result.reason in retry_reasons:
+                time.sleep(0.02)
+                continue
+        return result
+    raise TimeoutError(f"{timeout_msg}: {last_err}")
+
+
+def process_command(server_id: ServerId, data: Any,
+                    router: Optional[LocalRouter] = None,
+                    timeout: float = 5.0,
+                    reply_mode: ReplyMode = ReplyMode.AWAIT_CONSENSUS) -> Any:
+    """Send a command and await consensus (ra:process_command/3 :804-828),
+    following not_leader redirects like the reference's leader_call loop."""
+    from .core.types import CommandEvent
+    router = router or DEFAULT_ROUTER
+    return _leader_call(
+        server_id,
+        lambda fut: CommandEvent(UserCommand(data, reply_mode=reply_mode),
+                                 from_=fut),
+        router, timeout, timeout_msg="ra: command not completed")
+
+
+def pipeline_command(server_id: ServerId, data: Any, correlation: Any = None,
+                     notify_to: Any = None,
+                     priority: Priority = Priority.LOW,
+                     router: Optional[LocalRouter] = None) -> None:
+    """Fire-and-forget with applied-notification (ra:pipeline_command/4
+    :886-896).  notify_to receives [(correlation, reply)] batches."""
+    router = router or DEFAULT_ROUTER
+    node = _node_of(server_id, router)
+    cmd = UserCommand(data, reply_mode=ReplyMode.NOTIFY,
+                      correlation=correlation, notify_to=notify_to)
+    node.submit_command(server_id.name, cmd, None, priority=priority)
+
+
+def local_query(server_id: ServerId, query_fn: Callable,
+                router: Optional[LocalRouter] = None) -> Any:
+    """Query this member's machine state directly (ra:local_query :962)."""
+    router = router or DEFAULT_ROUTER
+    node = _node_of(server_id, router)
+    shell = node.shells.get(server_id.name)
+    if shell is None:
+        raise RuntimeError(f"no such server {server_id}")
+    srv = shell.server
+    return CommandResult(srv.last_applied, srv.current_term,
+                         query_fn(srv.machine_state), srv.leader_id)
+
+
+def leader_query(any_member: ServerId, query_fn: Callable,
+                 router: Optional[LocalRouter] = None,
+                 timeout: float = 5.0) -> Any:
+    """Query the leader's machine state (ra:leader_query :1012)."""
+    router = router or DEFAULT_ROUTER
+    leader = _await_leader(any_member, router, timeout)
+    return local_query(leader, query_fn, router)
+
+
+def consistent_query(server_id: ServerId, query_fn: Callable,
+                     router: Optional[LocalRouter] = None,
+                     timeout: float = 5.0) -> Any:
+    """Linearizable read via heartbeat quorum (ra:consistent_query :1051,
+    core machinery ra_server.erl:3032-3190)."""
+    router = router or DEFAULT_ROUTER
+    return _leader_call(
+        server_id,
+        lambda fut: ConsistentQueryEvent(query_fn, from_=fut),
+        router, timeout, timeout_msg="ra: consistent_query timed out")
+
+
+def members(server_id: ServerId,
+            router: Optional[LocalRouter] = None) -> list:
+    router = router or DEFAULT_ROUTER
+    node = _node_of(server_id, router)
+    shell = node.shells.get(server_id.name)
+    if shell is None:
+        raise RuntimeError(f"no such server {server_id}")
+    return list(shell.server.cluster.keys())
+
+
+def add_member(server_id: ServerId, new_member: ServerId,
+               membership: Membership = Membership.VOTER,
+               router: Optional[LocalRouter] = None,
+               timeout: float = 5.0) -> Any:
+    """One-at-a-time join ('$ra_join', ra.erl:593-602).  The new member's
+    server must be started separately (ra:start_server then add_member)."""
+    router = router or DEFAULT_ROUTER
+    return _member_change(server_id, JoinCommand(new_member, membership),
+                          router, timeout)
+
+
+def remove_member(server_id: ServerId, old_member: ServerId,
+                  router: Optional[LocalRouter] = None,
+                  timeout: float = 5.0) -> Any:
+    router = router or DEFAULT_ROUTER
+    return _member_change(server_id, LeaveCommand(old_member), router,
+                          timeout)
+
+
+def _member_change(server_id: ServerId, cmd: Any, router: LocalRouter,
+                   timeout: float) -> Any:
+    from .core.types import CommandEvent
+    return _leader_call(
+        server_id, lambda fut: CommandEvent(cmd, from_=fut), router, timeout,
+        retry_reasons=("cluster_change_not_permitted",),
+        timeout_msg="ra: member change timed out")
+
+
+def delete_cluster(server_id: ServerId,
+                   router: Optional[LocalRouter] = None,
+                   timeout: float = 5.0) -> Any:
+    """Orderly cluster teardown ('$ra_cluster' delete, ra.erl:556)."""
+    router = router or DEFAULT_ROUTER
+    from .core.types import CommandEvent
+    return _leader_call(
+        server_id,
+        lambda fut: CommandEvent(ClusterDeleteCommand(), from_=fut),
+        router, timeout, timeout_msg="ra: delete_cluster timed out")
+
+
+def trigger_election(server_id: ServerId,
+                     router: Optional[LocalRouter] = None) -> None:
+    router = router or DEFAULT_ROUTER
+    node = _node_of(server_id, router)
+    node.submit(server_id.name, ForceElectionEvent())
+
+
+def transfer_leadership(server_id: ServerId, target: ServerId,
+                        router: Optional[LocalRouter] = None,
+                        timeout: float = 5.0) -> Any:
+    router = router or DEFAULT_ROUTER
+    leader = _await_leader(server_id, router, timeout)
+    node = _node_of(leader, router)
+    fut = Future()
+    node.submit(leader.name, TransferLeadershipEvent(target, from_=fut))
+    return fut.wait(timeout)
+
+
+def _await_leader(seed: ServerId, router: LocalRouter,
+                  timeout: float) -> ServerId:
+    """Resolve the current leader, polling through elections."""
+    deadline = time.monotonic() + timeout
+    target = seed
+    while time.monotonic() < deadline:
+        node = router.nodes.get(target.node)
+        shell = node.shells.get(target.name) if node else None
+        if shell is not None:
+            srv = shell.server
+            if srv.raft_state == srv.raft_state.LEADER:
+                return target
+            if srv.leader_id is not None:
+                if srv.leader_id == target:
+                    return target
+                target = srv.leader_id
+                continue
+        time.sleep(0.01)
+    raise TimeoutError(f"ra: no leader found via {seed}")
+
+
+def key_metrics(server_id: ServerId,
+                router: Optional[LocalRouter] = None) -> dict:
+    """Read metrics without touching the server's event loop
+    (ra:key_metrics :1229-1257)."""
+    router = router or DEFAULT_ROUTER
+    node = _node_of(server_id, router)
+    shell = node.shells.get(server_id.name)
+    if shell is None:
+        return {"state": "noproc"}
+    srv = shell.server
+    last = srv.log.last_index_term()
+    lw = srv.log.last_written()
+    return {
+        "state": srv.raft_state.value,
+        "raft_state": srv.raft_state.value,
+        "leader": srv.leader_id,
+        "term": srv.current_term,
+        "commit_index": srv.commit_index,
+        "last_applied": srv.last_applied,
+        "last_index": last.index,
+        "last_written_index": lw.index,
+        "snapshot_index": srv.log.snapshot_index_term().index,
+        "commit_latency_ms": srv.commit_latency * 1000.0,
+        "machine_version": srv.machine_version,
+        "effective_machine_version": srv.effective_machine_version,
+        "membership": srv.membership.value,
+    }

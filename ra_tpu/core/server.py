@@ -223,6 +223,18 @@ class RaServer:
         return out
 
     def _dispatch(self, event: Any) -> list:
+        # generic non-leader fallback for client events carrying a reply
+        # slot: every non-leader state (follower, candidate, pre_vote,
+        # await_condition, receive_snapshot) answers not_leader immediately
+        # instead of leaving the caller to time out
+        if (self.raft_state != RaftState.LEADER and
+                isinstance(event, (CommandEvent, ConsistentQueryEvent)) and
+                event.from_ is not None):
+            return [Reply(event.from_,
+                          ErrorResult("not_leader", self.leader_id))]
+        if self.raft_state in (RaftState.STOP,
+                               RaftState.DELETE_AND_TERMINATE):
+            return []  # terminal: the shell tears this server down
         handler = {
             RaftState.LEADER: self._handle_leader,
             RaftState.FOLLOWER: self._handle_follower,
@@ -490,17 +502,8 @@ class RaServer:
         if isinstance(event, TransferLeadershipEvent):
             # try_become_leader arrives at the transfer target as this event
             return self._call_for_election_pre_vote()
-        if isinstance(event, CommandEvent):
-            # not the leader: the shell redirects using leader_id
-            if event.from_ is not None:
-                return [Reply(event.from_,
-                              ErrorResult("not_leader", self.leader_id))]
-            return []
-        if isinstance(event, ConsistentQueryEvent):
-            if event.from_ is not None:
-                return [Reply(event.from_,
-                              ErrorResult("not_leader", self.leader_id))]
-            return []
+        if isinstance(event, (CommandEvent, ConsistentQueryEvent)):
+            return []  # from_-carrying events answered by _dispatch fallback
         if isinstance(event, TickEvent):
             return self._tick()
         return []
@@ -732,8 +735,6 @@ class RaServer:
         if isinstance(event, WrittenEvent):
             self.log.handle_written(event)
             return []
-        if isinstance(event, CommandEvent) and event.from_ is not None:
-            return [Reply(event.from_, ErrorResult("not_leader", None))]
         if isinstance(event, TickEvent):
             return self._tick()
         return []
@@ -785,8 +786,6 @@ class RaServer:
         if isinstance(event, WrittenEvent):
             self.log.handle_written(event)
             return []
-        if isinstance(event, CommandEvent) and event.from_ is not None:
-            return [Reply(event.from_, ErrorResult("not_leader", None))]
         if isinstance(event, TickEvent):
             return self._tick()
         return []

@@ -1,0 +1,424 @@
+"""Node runtime: the effect-executing shell around pure cores.
+
+The reference runs one gen_statem per member (ra_server_proc.erl) under a
+per-system supervision tree (ra_system_sup.erl:25-43).  The TPU-native
+inversion keeps *control flow on the host, state in cores*: a RaNode is a
+single event-loop thread cooperatively scheduling all member shells it
+hosts — the natural collector that forms device batches for the lane
+engine, and the 'node' unit for the classic (oracle) deployment.
+
+Responsibilities mirrored from ra_server_proc.erl:
+* effect execution (send_rpc, vote fan-out, replies, timers, machine
+  effects — handle_effect :1317-1566)
+* election timers with randomized durations (:1638-1657)
+* periodic tick (ra_server:tick + machine tick)
+* snapshot send tasks (:1446-1488) — chunked InstallSnapshotRpc casts
+* monitors/down routing (simplified; full failure detector in transport)
+* registration in the node directory + leaderboard updates
+
+Transport is pluggable: LocalRouter routes in-process between RaNodes
+(the ct_slave-style multi-node tests run this way); ra_tpu.transport.tcp
+carries the same six message families across OS processes.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from .core.machine import Machine
+from .core.server import RaServer
+from .core.types import (
+    AuxEffect,
+    CancelElectionTimeout,
+    Checkpoint,
+    CommandEvent,
+    CommandResult,
+    CommandsEvent,
+    ConsistentQueryEvent,
+    ElectionTimeout,
+    ErrorResult,
+    ForceElectionEvent,
+    GarbageCollection,
+    InstallSnapshotRpc,
+    LogReadEffect,
+    ModCall,
+    Monitor,
+    Notify,
+    Priority,
+    PromoteCheckpoint,
+    RaftState,
+    RecordLeader,
+    ReleaseCursor,
+    Reply,
+    SendMsg,
+    SendRpc,
+    SendSnapshot,
+    SendVoteRequests,
+    ServerConfig,
+    ServerId,
+    StartElectionTimeout,
+    TickEvent,
+    TimerEffect,
+    TransferLeadershipEvent,
+    UserCommand,
+)
+from .log.memory import MemoryLog
+
+logger = logging.getLogger("ra_tpu")
+
+#: multipliers applied to election_timeout_ms per timeout kind
+#: (ra_server_proc.erl:1638-1657: really_short/short/medium/long)
+_TIMEOUT_KINDS = {
+    "really_short": (0.05, 0.15),
+    "short": (0.3, 0.6),
+    "medium": (1.0, 1.6),
+    "long": (2.0, 3.2),
+}
+
+#: low-priority commands buffered before a {commands, ...} flush
+#: (?FLUSH_COMMANDS_SIZE, ra_server.hrl:11)
+FLUSH_COMMANDS_SIZE = 16
+
+
+class Future:
+    """Reply slot handed to blocking client calls."""
+
+    __slots__ = ("_event", "value")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.value: Any = None
+
+    def set(self, value: Any) -> None:
+        self.value = value
+        self._event.set()
+
+    def wait(self, timeout: Optional[float]) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("ra: command timed out")
+        return self.value
+
+
+class LocalRouter:
+    """In-process transport fabric: ServerId.node -> RaNode."""
+
+    def __init__(self) -> None:
+        self.nodes: dict[str, "RaNode"] = {}
+        self.lock = threading.Lock()
+        # (src_node, dst_node) pairs currently blocked (nemesis partitions)
+        self.blocked: set = set()
+
+    def register(self, node: "RaNode") -> None:
+        with self.lock:
+            self.nodes[node.name] = node
+
+    def unregister(self, node: "RaNode") -> None:
+        with self.lock:
+            self.nodes.pop(node.name, None)
+
+    def send(self, src_node: str, to: ServerId, msg: Any) -> bool:
+        """Nonblocking send; returns False when dropped (the noconnect/
+        nosuspend semantics of ra_server_proc:send_rpc :1317-1341)."""
+        if (src_node, to.node) in self.blocked:
+            return False
+        node = self.nodes.get(to.node)
+        if node is None:
+            return False
+        return node.deliver(to, msg)
+
+    def block(self, a: str, b: str) -> None:
+        self.blocked.add((a, b))
+        self.blocked.add((b, a))
+
+    def heal(self) -> None:
+        self.blocked.clear()
+
+
+#: default in-process fabric (tests may build private ones)
+DEFAULT_ROUTER = LocalRouter()
+
+
+class ServerShell:
+    """Per-member shell state owned by a RaNode."""
+
+    def __init__(self, server: RaServer, node: "RaNode") -> None:
+        self.server = server
+        self.node = node
+        self.inbox: deque = deque()
+        self.low_queue: deque = deque()  # low-priority commands awaiting flush
+        self.election_deadline: Optional[float] = None
+        self.tick_deadline: float = time.monotonic() + \
+            server.cfg.tick_interval_ms / 1000.0
+        self.stopped = False
+
+    @property
+    def sid(self) -> ServerId:
+        return self.server.id
+
+
+class RaNode:
+    """One 'node': hosts many cluster members on one event-loop thread."""
+
+    def __init__(self, name: str, router: Optional[LocalRouter] = None,
+                 log_factory: Optional[Callable] = None) -> None:
+        self.name = name
+        self.router = router or DEFAULT_ROUTER
+        self.log_factory = log_factory or (lambda cfg: MemoryLog())
+        self.shells: dict[str, ServerShell] = {}   # by server name
+        self.directory: dict[str, ServerConfig] = {}  # uid -> config
+        self.leaderboard: dict[str, tuple] = {}    # cluster -> (leader, members)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"ra-node-{name}")
+        self.router.register(self)
+        self._thread.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start_server(self, config: ServerConfig) -> ServerId:
+        """Init + recover a member on this node (ra:start_server)."""
+        assert config.server_id.node == self.name
+        log = self.log_factory(config)
+        server = RaServer(config, log)
+        server.recover()
+        shell = ServerShell(server, self)
+        with self._lock:
+            self.shells[config.server_id.name] = shell
+            self.directory[config.uid] = config
+        # new servers get an election timeout so a fresh cluster elects
+        self._arm_election(shell, "medium")
+        self._wake.set()
+        return config.server_id
+
+    def stop_server(self, name: str) -> None:
+        with self._lock:
+            shell = self.shells.pop(name, None)
+        if shell is not None:
+            shell.stopped = True
+            shell.server.log.close()
+
+    def restart_server(self, name: str) -> ServerId:
+        """Restart from the persisted log (ra:restart_server, §3.4)."""
+        with self._lock:
+            cfg = None
+            for c in self.directory.values():
+                if c.server_id.name == name:
+                    cfg = c
+            assert cfg is not None, f"unknown server {name}"
+        self.stop_server(name)
+        return self.start_server(cfg)
+
+    def kill_server(self, name: str) -> None:
+        """Abrupt stop without log close (crash simulation)."""
+        with self._lock:
+            shell = self.shells.pop(name, None)
+        if shell is not None:
+            shell.stopped = True
+
+    def stop(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=5)
+        self.router.unregister(self)
+
+    # -- ingress ------------------------------------------------------------
+
+    def deliver(self, to: ServerId, msg: Any) -> bool:
+        shell = self.shells.get(to.name)
+        if shell is None or shell.stopped:
+            return False
+        shell.inbox.append(msg)
+        self._wake.set()
+        return True
+
+    def submit(self, name: str, event: Any) -> bool:
+        shell = self.shells.get(name)
+        if shell is None or shell.stopped:
+            return False
+        shell.inbox.append(event)
+        self._wake.set()
+        return True
+
+    def submit_command(self, name: str, command: Any, from_: Any,
+                       priority: Priority = Priority.NORMAL) -> bool:
+        """Normal commands go straight in; low-priority commands buffer and
+        flush as {commands, Batch} (ra_server_proc.erl:458-513)."""
+        shell = self.shells.get(name)
+        if shell is None or shell.stopped:
+            return False
+        if priority == Priority.LOW:
+            # client threads only append; batches are formed exclusively by
+            # the event-loop thread (_poll_shell) so the deque is never
+            # iterated concurrently with appends
+            shell.low_queue.append(command)
+        else:
+            shell.inbox.append(CommandEvent(command, from_=from_))
+        self._wake.set()
+        return True
+
+    # -- event loop ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop:
+            busy = False
+            now = time.monotonic()
+            for shell in list(self.shells.values()):
+                if shell.stopped:
+                    continue
+                try:
+                    busy |= self._poll_shell(shell, now)
+                except Exception:
+                    logger.exception("ra_tpu node %s: server %s crashed",
+                                     self.name, shell.sid)
+                    shell.stopped = True
+                    # remove so clients get fast noproc instead of
+                    # blocking on a dead inbox / stale leader state
+                    with self._lock:
+                        self.shells.pop(shell.sid.name, None)
+            if not busy:
+                self._wake.wait(timeout=0.005)
+                self._wake.clear()
+
+    def _poll_shell(self, shell: ServerShell, now: float) -> bool:
+        busy = False
+        # timers
+        if shell.election_deadline is not None and \
+                now >= shell.election_deadline:
+            shell.election_deadline = None
+            self._handle(shell, ElectionTimeout())
+            busy = True
+        if now >= shell.tick_deadline:
+            shell.tick_deadline = now + \
+                shell.server.cfg.tick_interval_ms / 1000.0
+            self._handle(shell, TickEvent())
+            busy = True
+        # flush low-priority commands in batches of FLUSH_COMMANDS_SIZE
+        # (ra_server_proc.erl:458-513); only this thread removes items
+        if shell.low_queue:
+            n = min(len(shell.low_queue), FLUSH_COMMANDS_SIZE)
+            batch = tuple(shell.low_queue.popleft() for _ in range(n))
+            shell.inbox.append(CommandsEvent(batch))
+        # messages (bounded batch per poll to stay fair)
+        for _ in range(256):
+            if not shell.inbox:
+                break
+            self._handle(shell, shell.inbox.popleft())
+            busy = True
+        return busy
+
+    def _handle(self, shell: ServerShell, event: Any) -> None:
+        server = shell.server
+        effects = server.handle(event)
+        self._execute(shell, effects)
+        # drain WAL confirms produced by this event
+        for evt in server.log.take_events():
+            self._execute(shell, server.handle(evt))
+        if server.raft_state in (RaftState.STOP,
+                                 RaftState.DELETE_AND_TERMINATE):
+            # terminal states: leave the cluster / cluster deleted
+            # (ra_server_proc terminating_leader/_follower)
+            shell.stopped = True
+            with self._lock:
+                self.shells.pop(shell.sid.name, None)
+            server.log.close()
+
+    # -- effect executor (ra_server_proc:handle_effect :1317-1566) ----------
+
+    def _execute(self, shell: ServerShell, effects: list) -> None:
+        server = shell.server
+        for eff in effects:
+            if isinstance(eff, SendRpc):
+                ok = self.router.send(self.name, eff.to, eff.msg)
+                if not ok:
+                    pass  # dropped send: pipeline catch-up recovers (ra
+                    # counts these, ra.hrl:329-330; metrics in M5)
+            elif isinstance(eff, SendVoteRequests):
+                for to, msg in eff.requests:
+                    self.router.send(self.name, to, msg)
+            elif isinstance(eff, Reply):
+                if isinstance(eff.to, Future):
+                    eff.to.set(eff.msg)
+                elif callable(eff.to):
+                    eff.to(eff.msg)
+            elif isinstance(eff, Notify):
+                if isinstance(eff.to, Future):
+                    eff.to.set(eff.correlations)
+                elif callable(eff.to):
+                    eff.to(eff.correlations)
+            elif isinstance(eff, StartElectionTimeout):
+                self._arm_election(shell, eff.kind)
+            elif isinstance(eff, CancelElectionTimeout):
+                shell.election_deadline = None
+            elif isinstance(eff, (ReleaseCursor, Checkpoint,
+                                  PromoteCheckpoint)):
+                self._execute(shell, server.handle_machine_effect(eff))
+            elif isinstance(eff, SendSnapshot):
+                self._send_snapshot(shell, eff)
+            elif isinstance(eff, RecordLeader):
+                self.leaderboard[eff.cluster_name] = (eff.leader, eff.members)
+            elif isinstance(eff, SendMsg):
+                if isinstance(eff.to, Future):
+                    eff.to.set(eff.msg)
+                elif callable(eff.to):
+                    eff.to(eff.msg)
+                elif isinstance(eff.to, ServerId):
+                    self.router.send(self.name, eff.to, eff.msg)
+            elif isinstance(eff, ModCall):
+                try:
+                    eff.fn(*eff.args)
+                except Exception:
+                    logger.exception("mod_call effect failed")
+            elif isinstance(eff, LogReadEffect):
+                entries = server.log.sparse_read(eff.indexes)
+                try:
+                    eff.fn(entries)
+                except Exception:
+                    logger.exception("log effect failed")
+            elif isinstance(eff, (AuxEffect, GarbageCollection, Monitor,
+                                  TimerEffect)):
+                pass  # aux/monitor machinery lands with the transport layer
+            # unknown machine effects are ignored (forward compat)
+
+    def _arm_election(self, shell: ServerShell, kind: str) -> None:
+        lo, hi = _TIMEOUT_KINDS.get(kind, _TIMEOUT_KINDS["medium"])
+        dur = shell.server.cfg.election_timeout_ms / 1000.0
+        shell.election_deadline = time.monotonic() + random.uniform(
+            lo * dur, hi * dur)
+
+    def _send_snapshot(self, shell: ServerShell, eff: SendSnapshot) -> None:
+        """Chunked snapshot send (spawned in ra, :1446-1488; inline here —
+        memory-log snapshots are small; the durable log grows a thread)."""
+        server = shell.server
+        snap = server.log.snapshot()
+        if snap is None:
+            return
+        meta, data = snap
+        leader_id, term = eff.id_term
+        chunk = server.cfg.snapshot_chunk_size
+        chunks = [data[i:i + chunk] for i in range(0, max(len(data), 1),
+                                                   chunk)] or [b""]
+        for i, piece in enumerate(chunks):
+            flag = "last" if i == len(chunks) - 1 else "next"
+            self.router.send(self.name, eff.to,
+                             InstallSnapshotRpc(term=term,
+                                                leader_id=leader_id,
+                                                meta=meta,
+                                                chunk_number=i + 1,
+                                                chunk_flag=flag,
+                                                data=piece))
+
+    # -- introspection -------------------------------------------------------
+
+    def overview(self) -> dict:
+        return {
+            "name": self.name,
+            "servers": {n: s.server.overview()
+                        for n, s in self.shells.items()},
+            "leaderboard": dict(self.leaderboard),
+        }
