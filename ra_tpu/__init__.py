@@ -27,3 +27,4 @@ from .api import (  # noqa: E402,F401
     trigger_election,
 )
 from .node import LocalRouter, RaNode  # noqa: E402,F401
+from .system import RaSystem  # noqa: E402,F401

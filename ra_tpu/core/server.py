@@ -153,6 +153,7 @@ class RaServer:
         self.commit_latency: float = 0.0
         self._transfer_target: Optional[ServerId] = None
         self._accepting_snapshot: Optional[tuple] = None
+        self._persisted_last_applied: int = self.last_applied
 
         self._init_state()
 
@@ -161,12 +162,15 @@ class RaServer:
     # ------------------------------------------------------------------
 
     def _init_state(self) -> None:
+        # persisted apply progress (lazy, ra_log_meta) marks entries as
+        # known-committed; the machine state itself is rebuilt from the
+        # snapshot base by re-applying them with effects suppressed
+        persisted_la = self.last_applied
         snap = self.log.recover_snapshot_state()
         if snap is not None:
             meta, mac_state = snap
             self.machine_state = mac_state
-            self.last_applied = max(self.last_applied, meta.index)
-            self.commit_index = max(self.commit_index, meta.index)
+            base = meta.index
             self.effective_machine_version = meta.machine_version
             self.effective_machine = self.machine.which_module(
                 meta.machine_version)
@@ -177,14 +181,16 @@ class RaServer:
             self.machine_state = self.machine.init(
                 {"id": self.id, "uid": self.cfg.uid,
                  "name": self.cfg.cluster_name})
+            base = 0
             self.cluster = {sid: Peer() for sid in self.cfg.initial_members}
             self.machine_versions = [(0, 0)]
         if self.id not in self.cluster and not self.cluster:
             self.cluster[self.id] = Peer()
         self.membership = self._get_membership()
-        # commit index starts at last_applied; it is re-learned from the
-        # leader / quorum (ra_server.erl:305-320)
-        self.commit_index = max(self.commit_index, self.last_applied)
+        self.last_applied = base
+        # commit index resumes at the persisted apply watermark; recover()
+        # replays (base, commit_index] (ra_server.erl:305-320, 376-414)
+        self.commit_index = max(base, persisted_la)
 
     def recover(self) -> list:
         """Replay committed-but-unapplied entries with effects suppressed
@@ -1375,6 +1381,11 @@ class RaServer:
     def _tick(self) -> list:
         effects = list(self.machine.tick(time.time(), self.machine_state))
         effects.extend(self.log.tick(time.monotonic() * 1000.0))
+        # lazily persist apply progress so recovery can dedup effects
+        # (ra_log_meta last_applied, dets auto_save-style laziness)
+        if self.last_applied > self._persisted_last_applied:
+            self.log.store_meta(sync=False, last_applied=self.last_applied)
+            self._persisted_last_applied = self.last_applied
         return _filter_follower_effects(effects) \
             if self.raft_state != RaftState.LEADER else effects
 

@@ -1,1 +1,4 @@
+from .durable import DurableLog
 from .memory import MemoryLog
+from .segment import SegmentFile, SegmentWriter
+from .wal import Wal

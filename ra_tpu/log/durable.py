@@ -1,0 +1,606 @@
+"""DurableLog — the per-server log facade over WAL + segments + snapshots.
+
+Same contract as ra_tpu.log.memory.MemoryLog (the interface the pure core
+consumes), with real durability.  Mirrors ra_log.erl's division:
+
+* recent entries live in the in-process memtable and are readable
+  immediately; durability is observed through written events delivered by
+  the WAL after batch fsync (:474-529) — take_events() surfaces them to
+  the shell exactly like the memory log
+* the leader's own confirm participates in commit quorum; gap/resend and
+  stale-term confirms are handled in handle_written (:521-529, :641-644)
+* on WAL rollover the segment writer drains the memtable to this server's
+  segment files (flush_mem_to_segments) and prunes it (:534-574)
+* snapshots truncate segments and the memtable (:575-640); checkpoints
+  don't truncate; promote_checkpoint renames one into the snapshot slot
+  (ra_snapshot.erl:399-448); chunked accept for streamed installs
+* recovery: meta file + latest valid snapshot + segment ranges + WAL
+  recovered tables (:170-277 and §3.4 of SURVEY.md)
+
+Layout under <data_dir>/<uid>/:
+  meta                 pickled dict (current_term, voted_for, last_applied)
+  NNNNNNNN.segment     segment files
+  snapshot/snap_<idx>_<term>.rtsn
+  checkpoints/cp_<idx>_<term>.rtsn
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+from dataclasses import replace
+from typing import Any, Callable, Iterable, Optional
+
+from ..core.types import Entry, IdxTerm, SnapshotMeta, WrittenEvent
+from ..native import IO
+from .segment import DEFAULT_MAX_COUNT, SegmentFile
+
+SNAP_MAGIC = b"RTSN"
+_SNAP_HDR = struct.Struct("<4sII")  # magic, version, crc(meta+state)
+
+MAX_CHECKPOINTS = 10  # ra.hrl:234
+
+
+def _write_snapshot_file(path: str, meta: SnapshotMeta, data: bytes) -> None:
+    meta_b = pickle.dumps(meta)
+    body = struct.pack("<I", len(meta_b)) + meta_b + data
+    crc = IO.crc32(body)
+    tmp = path + ".partial"
+    with open(tmp, "wb") as f:
+        f.write(_SNAP_HDR.pack(SNAP_MAGIC, 1, crc) + body)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_snapshot_file(path: str) -> Optional[tuple]:
+    """Returns (meta, data) or None when invalid (validate,
+    ra_log_snapshot.erl:112+)."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+        magic, _version, crc = _SNAP_HDR.unpack_from(raw, 0)
+        body = raw[_SNAP_HDR.size:]
+        if magic != SNAP_MAGIC or IO.crc32(body) != crc:
+            return None
+        (mlen,) = struct.unpack_from("<I", body, 0)
+        meta = pickle.loads(body[4:4 + mlen])
+        return meta, body[4 + mlen:]
+    except Exception:
+        return None
+
+
+class DurableLog:
+    def __init__(self, uid: str, data_dir: str, wal, *,
+                 segment_max_count: int = DEFAULT_MAX_COUNT) -> None:
+        self.uid = uid
+        self.dir = os.path.join(data_dir, uid)
+        os.makedirs(self.dir, exist_ok=True)
+        os.makedirs(os.path.join(self.dir, "snapshot"), exist_ok=True)
+        os.makedirs(os.path.join(self.dir, "checkpoints"), exist_ok=True)
+        self.wal = wal
+        self.segment_max_count = segment_max_count
+        self._lock = threading.RLock()
+        # serializes segment-file I/O (flush vs snapshot truncation vs
+        # reads); ordering discipline: _io_lock before _lock, never inverse
+        self._io_lock = threading.Lock()
+        self._events: list = []            # pending events for the shell
+        self._memtable: dict[int, tuple] = {}  # idx -> (term, command_obj)
+        self._mem_bytes: dict[int, bytes] = {}  # idx -> payload (for flush)
+        self._segments: list[SegmentFile] = []  # ordered by range
+        self._seg_seq = 0
+        self._last_index = 0
+        self._last_term = 0
+        self._last_written = IdxTerm(0, 0)
+        self._first_index = 1
+        self._meta: dict = {"current_term": 0, "voted_for": None,
+                            "last_applied": 0}
+        self._snapshot: Optional[tuple] = None  # (meta, path)
+        self._checkpoints: list[tuple] = []     # [(meta, path)] sorted asc
+        self._truncate_next = False
+        self._recover_state()
+        wal.register(uid, self._wal_notify)
+
+    # ------------------------------------------------------------------
+    # recovery
+    # ------------------------------------------------------------------
+
+    def _recover_state(self) -> None:
+        meta_path = os.path.join(self.dir, "meta")
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path, "rb") as f:
+                    self._meta.update(pickle.load(f))
+            except Exception:
+                pass
+        # newest valid snapshot wins; fall back to older ones
+        # (ra_snapshot.erl:183-222)
+        snapdir = os.path.join(self.dir, "snapshot")
+        cands = sorted(os.listdir(snapdir), reverse=True)
+        for fname in cands:
+            got = _read_snapshot_file(os.path.join(snapdir, fname))
+            if got is not None:
+                self._snapshot = (got[0], os.path.join(snapdir, fname))
+                break
+        cpdir = os.path.join(self.dir, "checkpoints")
+        for fname in sorted(os.listdir(cpdir)):
+            got = _read_snapshot_file(os.path.join(cpdir, fname))
+            if got is not None:
+                self._checkpoints.append((got[0],
+                                          os.path.join(cpdir, fname)))
+        snap_idx = self._snapshot[0].index if self._snapshot else 0
+        # segments
+        for fname in sorted(os.listdir(self.dir)):
+            if not fname.endswith(".segment"):
+                continue
+            seq = int(fname.split(".")[0])
+            self._seg_seq = max(self._seg_seq, seq)
+            try:
+                seg = SegmentFile(os.path.join(self.dir, fname))
+            except Exception:
+                continue
+            if seg.range() is None:
+                seg.close()
+                os.unlink(os.path.join(self.dir, fname))
+                continue
+            self._segments.append(seg)
+        self._segments.sort(key=lambda s: s.range()[0])
+        last, last_term = 0, 0
+        if self._segments:
+            lo, hi = self._segments[-1].range()
+            last = hi
+            last_term = self._segments[-1].read(hi)[0]
+        # WAL recovered entries (newer than segments)
+        for idx, (term, payload) in sorted(
+                self.wal.recovered_table(self.uid).items()):
+            if idx <= snap_idx:
+                continue
+            cmd = pickle.loads(payload)
+            self._memtable[idx] = (term, cmd)
+            self._mem_bytes[idx] = payload
+            if idx >= last:
+                last, last_term = idx, term
+        if snap_idx > last:
+            last, last_term = snap_idx, self._snapshot[0].term
+        self._last_index, self._last_term = last, last_term
+        self._last_written = IdxTerm(last, last_term)
+        self._first_index = snap_idx + 1
+
+    # ------------------------------------------------------------------
+    # WAL callback (runs on the WAL thread)
+    # ------------------------------------------------------------------
+
+    def _wal_notify(self, uid: str, lo: Optional[int], hi: int,
+                    term: int) -> None:
+        with self._lock:
+            if lo is None:
+                # resend_from: re-submit memtable entries above hi
+                # (ra_log.erl:1125+)
+                for idx in range(hi + 1, self._last_index + 1):
+                    ent = self._memtable.get(idx)
+                    raw = self._mem_bytes.get(idx)
+                    if ent is not None and raw is not None:
+                        self.wal.write(self.uid, idx, ent[0], raw)
+                return
+            self._events.append(WrittenEvent(lo, hi, term))
+
+    # ------------------------------------------------------------------
+    # log contract (same as MemoryLog)
+    # ------------------------------------------------------------------
+
+    def last_index_term(self) -> IdxTerm:
+        return IdxTerm(self._last_index, self._last_term)
+
+    def last_written(self) -> IdxTerm:
+        return self._last_written
+
+    def first_index(self) -> int:
+        return self._first_index
+
+    def next_index(self) -> int:
+        return self._last_index + 1
+
+    def append(self, entry: Entry) -> None:
+        if entry.index != self._last_index + 1:
+            from .memory import IntegrityError
+            raise IntegrityError(
+                f"append gap: {entry.index} != {self._last_index + 1}")
+        self._put(entry)
+
+    def write(self, entries: list) -> None:
+        if not entries:
+            return
+        first = entries[0].index
+        if first > self._last_index + 1:
+            from .memory import IntegrityError
+            raise IntegrityError(
+                f"write gap: {first} > {self._last_index + 1}")
+        for e in entries:
+            self._put(e)
+
+    @staticmethod
+    def _persistable(cmd: Any) -> Any:
+        """Live reply handles (futures/callables) are process-local and not
+        serializable; they are stripped from the durable image.  Replies
+        are only ever owed by the member that accepted the call, which
+        still holds the full command in its memtable — after a restart the
+        caller has lost its handle anyway (recovery replays with effects
+        suppressed, ra_server.erl:376-414)."""
+        out = cmd
+        for field_ in ("from_", "notify_to"):
+            if getattr(out, field_, None) is not None and \
+                    not isinstance(getattr(out, field_), (str, int, tuple)):
+                out = replace(out, **{field_: None})
+        return out
+
+    def _put(self, entry: Entry) -> None:
+        payload = pickle.dumps(self._persistable(entry.command))
+        with self._lock:
+            if entry.index <= self._last_index:
+                # overwrite: invalidate the stale tail; rewind last_written
+                # to the real predecessor term so AER replies stay truthful
+                for k in range(entry.index + 1, self._last_index + 1):
+                    self._memtable.pop(k, None)
+                    self._mem_bytes.pop(k, None)
+                if self._last_written.index >= entry.index:
+                    prev = entry.index - 1
+                    self._last_written = IdxTerm(
+                        prev, self.fetch_term(prev) or 0)
+            self._memtable[entry.index] = (entry.term, entry.command)
+            self._mem_bytes[entry.index] = payload
+            self._last_index = entry.index
+            self._last_term = entry.term
+            truncate = self._truncate_next
+            self._truncate_next = False
+        self.wal.write(self.uid, entry.index, entry.term, payload,
+                       truncate=truncate)
+
+    def set_last_index(self, idx: int) -> None:
+        with self._lock:
+            if idx >= self._last_index:
+                return
+            for i in range(idx + 1, self._last_index + 1):
+                self._memtable.pop(i, None)
+                self._mem_bytes.pop(i, None)
+            term = self.fetch_term(idx) or 0
+            self._last_index, self._last_term = idx, term
+            if self._last_written.index > idx:
+                self._last_written = IdxTerm(idx, term)
+
+    def reset_to_last_known_written(self) -> None:
+        self.set_last_index(self._last_written.index)
+
+    # -- events -------------------------------------------------------------
+
+    def take_events(self) -> list:
+        with self._lock:
+            evts, self._events = self._events, []
+        return evts
+
+    def handle_written(self, evt: WrittenEvent) -> None:
+        with self._lock:
+            term = self.fetch_term(evt.to_index)
+            if term == evt.term:
+                if evt.to_index > self._last_written.index:
+                    self._last_written = IdxTerm(evt.to_index, evt.term)
+            elif term is None and self._snapshot is not None and \
+                    self._snapshot[0].index >= evt.to_index:
+                pass  # truncated by snapshot: subsumed
+            # else: stale confirm for an overwritten term — ignored; the
+            # rewrite is already queued to the WAL
+
+    # -- reads --------------------------------------------------------------
+
+    def fetch(self, idx: int) -> Optional[Entry]:
+        with self._lock:
+            # entries at/below the snapshot index are truncated even when a
+            # partially-covered segment still holds bytes for them
+            if idx < self._first_index or idx > self._last_index:
+                return None
+            ent = self._memtable.get(idx)
+            if ent is not None:
+                return Entry(idx, ent[0], ent[1])
+        got = self._segment_read(idx)
+        if got is None:
+            return None
+        term, payload = got
+        return Entry(idx, term, pickle.loads(payload))
+
+    def _segment_read(self, idx: int) -> Optional[tuple]:
+        with self._io_lock:
+            for seg in reversed(self._segments):
+                r = seg.range()
+                if r and r[0] <= idx <= r[1]:
+                    got = seg.read(idx)
+                    if got is not None:
+                        return got
+        return None
+
+    def fetch_term(self, idx: int) -> Optional[int]:
+        with self._lock:
+            if self._snapshot is not None and \
+                    idx == self._snapshot[0].index:
+                return self._snapshot[0].term
+            if idx < self._first_index or idx > self._last_index:
+                return None
+            ent = self._memtable.get(idx)
+            if ent is not None:
+                return ent[0]
+        got = self._segment_read(idx)
+        return got[0] if got else None
+
+    def exists(self, idx: int, term: int) -> bool:
+        return self.fetch_term(idx) == term
+
+    def fold(self, from_idx: int, to_idx: int, fn: Callable,
+             acc: Any) -> Any:
+        for e in self.read_range(from_idx, to_idx):
+            acc = fn(e, acc)
+        return acc
+
+    def read_range(self, from_idx: int, to_idx: int) -> list:
+        out = []
+        for i in range(max(from_idx, self._first_index),
+                       min(to_idx, self._last_index) + 1):
+            e = self.fetch(i)
+            if e is not None:
+                out.append(e)
+        return out
+
+    def sparse_read(self, indexes: Iterable[int]) -> list:
+        out = []
+        for i in indexes:
+            e = self.fetch(i)
+            if e is not None:
+                out.append(e)
+        return out
+
+    # -- meta ---------------------------------------------------------------
+
+    def store_meta(self, sync: bool = True, **kv: Any) -> None:
+        """Durable meta store.  term/voted_for fsync before the call
+        returns (MUST hit disk before vote replies; stricter than the
+        reference's batched ra_log_meta — votes are rare).  The lazy
+        last_applied watermark passes sync=False: atomic replace without
+        fsync, since losing it only costs effect-dedup precision."""
+        with self._lock:
+            self._meta.update(kv)
+            data = pickle.dumps(self._meta)
+        tmp = os.path.join(self.dir, "meta.partial")
+        with open(tmp, "wb") as f:
+            f.write(data)
+            if sync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.dir, "meta"))
+
+    def fetch_meta(self, key: str, default: Any = None) -> Any:
+        return self._meta.get(key, default)
+
+    # -- segment flush (called by the SegmentWriter thread) -----------------
+
+    def flush_mem_to_segments(self, up_to: int) -> None:
+        with self._io_lock:
+            with self._lock:
+                snap_idx = self._snapshot[0].index if self._snapshot else 0
+                items = sorted((i, self._mem_bytes[i], self._memtable[i][0])
+                               for i in self._mem_bytes
+                               if i <= up_to and i > snap_idx
+                               and i <= self._last_index)
+            if items:
+                seg = self._current_segment()
+                for idx, payload, term in items:
+                    if not seg.append(idx, term, payload):
+                        seg.flush()
+                        seg = self._new_segment()
+                        seg.append(idx, term, payload)
+                seg.flush()
+            with self._lock:
+                # ra swaps memtable for segment refs (:534-574): drop both
+                # copies; reads now resolve via the segment files
+                for idx, _, _ in items:
+                    self._mem_bytes.pop(idx, None)
+                    self._memtable.pop(idx, None)
+
+    def _current_segment(self) -> SegmentFile:
+        with self._lock:
+            if self._segments and not self._segments[-1].full:
+                return self._segments[-1]
+            return self._new_segment()
+
+    def _new_segment(self) -> SegmentFile:
+        with self._lock:
+            self._seg_seq += 1
+            path = os.path.join(self.dir, f"{self._seg_seq:08d}.segment")
+            seg = SegmentFile(path, self.segment_max_count, create=True)
+            self._segments.append(seg)
+            return seg
+
+    # -- snapshots ----------------------------------------------------------
+
+    def snapshot_index_term(self) -> IdxTerm:
+        if self._snapshot is None:
+            return IdxTerm(0, 0)
+        m = self._snapshot[0]
+        return IdxTerm(m.index, m.term)
+
+    def snapshot(self) -> Optional[tuple]:
+        """(meta, data_bytes) of the current snapshot, for chunked send."""
+        if self._snapshot is None:
+            return None
+        meta, path = self._snapshot
+        got = _read_snapshot_file(path)
+        if got is None:
+            return None
+        return meta, got[1]
+
+    def update_release_cursor(self, idx: int, cluster: tuple,
+                              machine_version: int,
+                              machine_state: Any) -> list:
+        term = self.fetch_term(idx)
+        if term is None:
+            return []
+        meta = SnapshotMeta(index=idx, term=term, cluster=cluster,
+                            machine_version=machine_version)
+        path = os.path.join(self.dir, "snapshot",
+                            f"snap_{idx:016d}_{term:010d}.rtsn")
+        _write_snapshot_file(path, meta, pickle.dumps(machine_state))
+        old = self._snapshot
+        with self._lock:
+            self._snapshot = (meta, path)
+        self._truncate_to(idx)
+        if old is not None and old[1] != path:
+            try:
+                os.unlink(old[1])
+            except FileNotFoundError:
+                pass
+        self._drop_stale_checkpoints(idx)
+        return []
+
+    def checkpoint(self, idx: int, cluster: tuple, machine_version: int,
+                   machine_state: Any) -> list:
+        term = self.fetch_term(idx)
+        if term is None:
+            return []
+        meta = SnapshotMeta(index=idx, term=term, cluster=cluster,
+                            machine_version=machine_version)
+        path = os.path.join(self.dir, "checkpoints",
+                            f"cp_{idx:016d}_{term:010d}.rtsn")
+        _write_snapshot_file(path, meta, pickle.dumps(machine_state))
+        with self._lock:
+            self._checkpoints.append((meta, path))
+            # retention (ra.hrl:234 + take_older_checkpoints)
+            while len(self._checkpoints) > MAX_CHECKPOINTS:
+                _, old_path = self._checkpoints.pop(0)
+                try:
+                    os.unlink(old_path)
+                except FileNotFoundError:
+                    pass
+        return []
+
+    def promote_checkpoint(self, idx: int) -> bool:
+        """Rename the newest checkpoint <= idx into the snapshot slot
+        (ra_snapshot.erl:399-448)."""
+        with self._lock:
+            best = None
+            for meta, path in self._checkpoints:
+                if meta.index <= idx and \
+                        (best is None or meta.index > best[0].index):
+                    best = (meta, path)
+            if best is None:
+                return False
+            self._checkpoints = [c for c in self._checkpoints
+                                 if c[0].index > best[0].index]
+        meta, cp_path = best
+        snap_path = os.path.join(
+            self.dir, "snapshot",
+            f"snap_{meta.index:016d}_{meta.term:010d}.rtsn")
+        os.replace(cp_path, snap_path)
+        old = self._snapshot
+        with self._lock:
+            self._snapshot = (meta, snap_path)
+        self._truncate_to(meta.index)
+        if old is not None:
+            try:
+                os.unlink(old[1])
+            except FileNotFoundError:
+                pass
+        return True
+
+    def install_snapshot(self, meta: SnapshotMeta, data: bytes) -> None:
+        path = os.path.join(self.dir, "snapshot",
+                            f"snap_{meta.index:016d}_{meta.term:010d}.rtsn")
+        _write_snapshot_file(path, meta, data)
+        old = self._snapshot
+        with self._lock:
+            self._snapshot = (meta, path)
+            if self._last_index < meta.index:
+                self._last_index = meta.index
+                self._last_term = meta.term
+            if self._last_written.index <= meta.index:
+                self._last_written = IdxTerm(meta.index, meta.term)
+            # the next follower write after an install truncates the WAL
+            # stream (wal_truncate_write, ra_log.erl:303,1033)
+            self._truncate_next = True
+        self._truncate_to(meta.index)
+        if old is not None and old[1] != path:
+            try:
+                os.unlink(old[1])
+            except FileNotFoundError:
+                pass
+
+    def recover_snapshot_state(self) -> Optional[tuple]:
+        if self._snapshot is None:
+            return None
+        meta, path = self._snapshot
+        got = _read_snapshot_file(path)
+        if got is None:
+            return None
+        return meta, pickle.loads(got[1])
+
+    def snapshot_data(self) -> bytes:
+        got = self.snapshot()
+        assert got is not None
+        return got[1]
+
+    def _truncate_to(self, idx: int) -> None:
+        """Drop memtable entries and whole segments covered by a snapshot
+        (delete_segments, ra_log.erl:1010).  Takes the io lock so an
+        in-flight segment flush never races the close/unlink."""
+        with self._io_lock:
+            with self._lock:
+                for i in [i for i in self._memtable if i <= idx]:
+                    self._memtable.pop(i, None)
+                    self._mem_bytes.pop(i, None)
+                self._first_index = idx + 1
+                keep = []
+                victims = []
+                for seg in self._segments:
+                    r = seg.range()
+                    if r is not None and r[1] <= idx:
+                        victims.append(seg)
+                    else:
+                        keep.append(seg)
+                self._segments = keep
+            for seg in victims:
+                seg.close()
+                try:
+                    os.unlink(seg.path)
+                except FileNotFoundError:
+                    pass
+
+    def _drop_stale_checkpoints(self, idx: int) -> None:
+        with self._lock:
+            stale = [c for c in self._checkpoints if c[0].index <= idx]
+            self._checkpoints = [c for c in self._checkpoints
+                                 if c[0].index > idx]
+        for _, path in stale:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+
+    # -- misc ---------------------------------------------------------------
+
+    def tick(self, now_ms: float) -> list:
+        return []
+
+    def close(self) -> None:
+        with self._lock:
+            for seg in self._segments:
+                seg.close()
+
+    def overview(self) -> dict:
+        return {
+            "type": "durable",
+            "uid": self.uid,
+            "last_index": self._last_index,
+            "last_term": self._last_term,
+            "first_index": self._first_index,
+            "last_written_index_term": tuple(self._last_written),
+            "num_mem_entries": len(self._memtable),
+            "num_segments": len(self._segments),
+            "snapshot_index_term": tuple(self.snapshot_index_term()),
+            "num_checkpoints": len(self._checkpoints),
+        }

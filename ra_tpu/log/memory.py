@@ -173,7 +173,7 @@ class MemoryLog:
 
     # -- meta ---------------------------------------------------------------
 
-    def store_meta(self, **kv: Any) -> None:
+    def store_meta(self, sync: bool = True, **kv: Any) -> None:
         self._meta.update(kv)
 
     def fetch_meta(self, key: str, default: Any = None) -> Any:

@@ -1,0 +1,229 @@
+"""Per-server segment files + the node-wide segment writer.
+
+Segment format follows the shape of the reference's (ra_log_segment.erl:
+30-43: magic, version, preallocated fixed-capacity index region, data
+region; entries carry crc32) with our own layout:
+
+  header:  magic "RTSG"(4) | version:u32 | max_count:u32 | reserved:u32
+  index:   max_count slots of (idx:u64 term:u64 offset:u64 len:u32 crc:u32)
+  data:    payloads
+
+Appends buffer in memory and reach disk in one pwrite-per-region + fsync
+flush (append/sync, ra_log_segment.erl:175-266).  A slot with idx 0 is
+empty (real indexes are >= 1).
+
+The SegmentWriter is the node-wide drain: the WAL hands it per-server
+ranges on rollover; it flushes each server's memtable to that server's
+segment files, notifies the server's log, and deletes the WAL file once
+every server's flush is done (ra_log_segment_writer.erl:129-201,
+accept_mem_tables/truncate_segments roles).
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import queue
+from typing import Callable, Optional
+
+from ..native import IO
+
+MAGIC = b"RTSG"
+_HDR = struct.Struct("<4sIII")
+_SLOT = struct.Struct("<QQQII")
+DEFAULT_MAX_COUNT = 4096  # entries per segment (ra.hrl:202)
+
+
+class SegmentFile:
+    """One append-optimized segment file."""
+
+    def __init__(self, path: str, max_count: int = DEFAULT_MAX_COUNT,
+                 create: bool = False) -> None:
+        self.path = path
+        self.max_count = max_count
+        self.index: dict[int, tuple] = {}  # idx -> (term, offset, len, crc)
+        self._pending: list = []           # [(idx, term, payload)]
+        self._count = 0
+        if create:
+            self.fd = IO.random_open(path, truncate=True)
+            hdr = _HDR.pack(MAGIC, 1, max_count, 0)
+            IO.pwrite(self.fd, hdr + b"\x00" * (_SLOT.size * max_count), 0)
+            self._data_off = _HDR.size + _SLOT.size * max_count
+            self._next_off = self._data_off
+        else:
+            self.fd = IO.random_open(path)
+            self._load()
+
+    def _load(self) -> None:
+        hdr = IO.pread(self.fd, _HDR.size, 0)
+        magic, version, max_count, _ = _HDR.unpack(hdr)
+        if magic != MAGIC:
+            raise ValueError(f"bad segment magic in {self.path}")
+        self.max_count = max_count
+        self._data_off = _HDR.size + _SLOT.size * max_count
+        raw = IO.pread(self.fd, _SLOT.size * max_count, _HDR.size)
+        self._next_off = self._data_off
+        for i in range(max_count):
+            idx, term, off, ln, crc = _SLOT.unpack_from(raw, i * _SLOT.size)
+            if idx == 0:
+                break
+            self.index[idx] = (term, off, ln, crc)
+            self._count += 1
+            self._next_off = max(self._next_off, off + ln)
+
+    # -- write side ---------------------------------------------------------
+
+    def append(self, idx: int, term: int, payload: bytes) -> bool:
+        """Buffer an entry; False when the segment is full
+        ({error, full} in the reference)."""
+        if self._count + len(self._pending) >= self.max_count:
+            return False
+        self._pending.append((idx, term, payload))
+        return True
+
+    def flush(self) -> None:
+        """Write pending data + index slots, then fsync (sync/flush,
+        ra_log_segment.erl:222-266)."""
+        if not self._pending:
+            return
+        data = bytearray()
+        slots = bytearray()
+        off = self._next_off
+        base_slot = self._count
+        for idx, term, payload in self._pending:
+            crc = IO.crc32(payload)
+            self.index[idx] = (term, off, len(payload), crc)
+            slots += _SLOT.pack(idx, term, off, len(payload), crc)
+            data += payload
+            off += len(payload)
+        IO.pwrite(self.fd, bytes(data), self._next_off)
+        IO.pwrite(self.fd, bytes(slots),
+                  _HDR.size + base_slot * _SLOT.size)
+        os.fsync(self.fd)
+        self._count += len(self._pending)
+        self._next_off = off
+        self._pending.clear()
+
+    # -- read side ----------------------------------------------------------
+
+    def read(self, idx: int) -> Optional[tuple]:
+        """Returns (term, payload) with crc verification
+        (ra_log_segment.erl:268-335)."""
+        ent = self.index.get(idx)
+        if ent is None:
+            return None
+        term, off, ln, crc = ent
+        payload = IO.pread(self.fd, ln, off)
+        if IO.crc32(payload) != crc:
+            raise ValueError(f"segment crc mismatch at {idx} in {self.path}")
+        return term, payload
+
+    def range(self) -> Optional[tuple]:
+        if not self.index:
+            return None
+        return min(self.index), max(self.index)
+
+    @property
+    def full(self) -> bool:
+        return self._count + len(self._pending) >= self.max_count
+
+    def close(self) -> None:
+        if self.fd is not None:
+            IO.close(self.fd)
+            self.fd = None
+
+
+class SegmentWriter:
+    """Node-wide background flusher: WAL rollover ranges -> segment files."""
+
+    def __init__(self, resolve: Optional[Callable] = None) -> None:
+        #: resolve(uid) -> DurableLog | None (set by the node/log registry)
+        self.resolve = resolve or (lambda uid: None)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ra-segment-writer")
+        self._thread.start()
+
+    def accept_ranges(self, ranges: dict, wal_path: str) -> None:
+        """Called by the WAL on rollover (accept_mem_tables/3)."""
+        self._queue.put(("__job__", ranges, wal_path))
+
+    def retire(self, uids: list, wal_files: list) -> None:
+        """Flush each uid's memtable up to its confirmed tail, then delete
+        the recovered WAL files they came from."""
+        self._queue.put(("__retire__", uids, wal_files))
+
+    def await_idle(self, timeout: float = 10.0) -> None:
+        """Barrier used by tests and log init (await/1 :87-100)."""
+        done = threading.Event()
+        self._queue.put(("__barrier__", done))
+        if not done.wait(timeout):
+            raise TimeoutError("segment writer barrier timed out")
+
+    def _run(self) -> None:
+        while not self._stop:
+            try:
+                job = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if job[0] == "__barrier__":
+                job[1].set()
+                continue
+            try:
+                if job[0] == "__retire__":
+                    self._retire_job(job[1], job[2])
+                elif job[0] == "__retire_retry__":
+                    self._retire_job(job[1], job[2], job[3])
+                else:
+                    self._flush_job(job[1], job[2])
+            except Exception:  # pragma: no cover
+                import logging
+                logging.getLogger("ra_tpu").exception(
+                    "segment writer job failed: %r", job[:1])
+
+    def _flush_job(self, ranges: dict, wal_path: str) -> None:
+        unresolved = False
+        for uid, (lo, hi) in ranges.items():
+            log = self.resolve(uid)
+            if log is None:
+                # a stopped server's entries live only in this WAL file;
+                # keep it so restart recovery can replay them
+                unresolved = True
+                continue
+            log.flush_mem_to_segments(hi)
+        if not unresolved:
+            # all servers flushed: the WAL file is redundant (:206-214)
+            try:
+                os.unlink(wal_path)
+            except FileNotFoundError:
+                pass
+
+    def _retire_job(self, uids: list, wal_files: list,
+                    attempt: int = 0) -> None:
+        for uid in uids:
+            log = self.resolve(uid)
+            if log is None:
+                # registration raced the registry insert: retry briefly,
+                # then keep the files (recovery will re-read them — safe)
+                if attempt < 20:
+                    t = threading.Timer(
+                        0.05, lambda: self._queue.put(
+                            ("__retire_retry__", uids, wal_files,
+                             attempt + 1)))
+                    t.daemon = True
+                    t.start()
+                return
+        for uid in uids:
+            log = self.resolve(uid)
+            if log is not None:
+                log.flush_mem_to_segments(log.last_written().index)
+        for path in wal_files:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+
+    def close(self) -> None:
+        self._stop = True
+        self._thread.join(timeout=5)

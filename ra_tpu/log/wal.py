@@ -1,0 +1,300 @@
+"""Fan-in write-ahead log — one writer for every server on the node.
+
+Mirrors the layering of the reference WAL (ra_log_wal.erl):
+* single fan-in writer batching the writes of ALL co-hosted servers,
+  amortizing one durability syscall across the batch (:193-214, :753-800)
+* per-record framing with writer id, idx/term, payload crc (:404-453)
+* out-of-sequence writer detection -> resend_from signal (:457-481)
+* rollover at max size: the closed file's per-writer ranges go to the
+  segment writer, which flushes each server's memtable to its segment
+  files and then deletes the WAL file (:593-620, 690-739,
+  ra_log_segment_writer.erl:129-201)
+* recovery re-reads surviving *.wal files in order into per-uid tables,
+  deduping overwrites; DurableLog init consumes them (:334-390, :871-955)
+
+Division of labour (simplified vs the reference, same guarantees): the
+*DurableLog* owns the per-server memtable (the reference keeps it in
+WAL-owned ETS so it survives WAL crashes; here both live in one process,
+so one copy suffices).  The WAL is purely the durability+ordering fan-in:
+entries stay in the owner's memtable until a segment flush confirm prunes
+them, and the closed WAL file is only deleted after that flush — so every
+entry is always recoverable from exactly one of {wal files, segments}.
+
+Hot path (encode+write+sync) goes through ra_tpu.native with the GIL
+released.
+
+File format "RTW1": magic(4B) then records:
+  type:u8
+    1 = writer registration: wid:u32 uid_len:u16 uid
+    2 = entry: wid:u32 idx:u64 term:u64 len:u32 crc:u32 payload
+"""
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+from typing import Callable, Optional
+
+from ..native import IO
+
+MAGIC = b"RTW1"
+_REG = struct.Struct("<BIH")        # type, wid, uid_len
+_ENT = struct.Struct("<BIQQII")     # type, wid, idx, term, len, crc
+
+DEFAULT_MAX_SIZE = 64 * 1024 * 1024   # ra.hrl:191 uses 256MB; scaled down
+DEFAULT_MAX_BATCH = 8192              # ra.hrl:192
+
+#: notify(uid, lo, hi, term) — lo None => resend_from(hi)
+NotifyFn = Callable[[str, Optional[int], int, int], None]
+
+
+class _Writer:
+    __slots__ = ("uid", "wid", "notify", "last_idx")
+
+    def __init__(self, uid: str, wid: int, notify: NotifyFn) -> None:
+        self.uid = uid
+        self.wid = wid
+        self.notify = notify
+        self.last_idx: Optional[int] = None
+
+
+class Wal:
+    """Node-wide fan-in WAL with a background batch thread."""
+
+    def __init__(self, data_dir: str, *, sync_mode: int = 1,
+                 max_size: int = DEFAULT_MAX_SIZE,
+                 max_batch: int = DEFAULT_MAX_BATCH,
+                 segment_writer=None) -> None:
+        self.dir = os.path.join(data_dir, "wal")
+        os.makedirs(self.dir, exist_ok=True)
+        self.sync_mode = sync_mode
+        self.max_size = max_size
+        self.max_batch = max_batch
+        self.segment_writer = segment_writer
+        self._writers: dict[str, _Writer] = {}
+        self._wid_seq = 0
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._fd: Optional[int] = None
+        self._file_seq = 0
+        self._file_size = 0
+        self._file_path = ""
+        self._file_ranges: dict[str, list] = {}  # uid -> [lo, hi] this file
+        self._registered_in_file: set = set()
+        self._stop = False
+        self._recovered: dict[str, dict] = {}
+        self._recover()
+        self._open_new_file()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ra-wal")
+        self._thread.start()
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, uid: str, notify: NotifyFn) -> None:
+        retire = None
+        with self._lock:
+            w = self._writers.get(uid)
+            if w is None:
+                self._wid_seq += 1
+                self._writers[uid] = _Writer(uid, self._wid_seq, notify)
+            else:
+                w.notify = notify
+                w.last_idx = None  # restarted writer: fresh sequence check
+            # once every uid found in recovered WAL files has re-registered,
+            # their entries (now in DurableLog memtables) can be flushed to
+            # segments and the old files retired (the reference deletes WAL
+            # files once their tables are flushed, :206-214)
+            if self._recovered_files and \
+                    set(self._recovered).issubset(self._writers):
+                retire = (list(self._recovered), list(self._recovered_files))
+                self._recovered_files = []
+        if retire is not None and self.segment_writer is not None:
+            uids, files = retire
+            self.segment_writer.retire(uids, files)
+
+    # -- write path ---------------------------------------------------------
+
+    def write(self, uid: str, index: int, term: int, payload: bytes,
+              truncate: bool = False) -> None:
+        """Async append; confirmation arrives via notify after the batch
+        reaches disk.  truncate marks a post-snapshot-install write
+        (wal_truncate_write, ra_log.erl:1033)."""
+        self._queue.put((uid, index, term, payload, truncate))
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Barrier: wait until everything queued so far is durable."""
+        done = threading.Event()
+        self._queue.put(("__flush__", 0, 0, b"", done))
+        if not done.wait(timeout):
+            raise TimeoutError("wal flush timed out")
+
+    def rollover(self) -> None:
+        """Force a rollover (tests + snapshot truncation)."""
+        self._queue.put(("__roll__", 0, 0, b"", None))
+
+    # -- batch thread -------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            while len(batch) < self.max_batch:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            try:
+                self._write_batch(batch)
+            except Exception:  # pragma: no cover - disk failure path
+                import logging
+                logging.getLogger("ra_tpu").exception("wal batch failed")
+
+    def _write_batch(self, batch: list) -> None:
+        buf = bytearray()
+        flushes = []
+        roll = False
+        confirms: dict[str, list] = {}  # uid -> [lo, hi, term]
+        with self._lock:
+            for uid, index, term, payload, extra in batch:
+                if uid == "__flush__":
+                    flushes.append(extra)
+                    continue
+                if uid == "__roll__":
+                    roll = True
+                    continue
+                w = self._writers.get(uid)
+                if w is None:
+                    continue
+                truncate = bool(extra)
+                if (w.last_idx is not None and index > w.last_idx + 1
+                        and not truncate):
+                    # gap: out-of-sequence write — tell the writer to
+                    # resend from its last accepted index (:457-481)
+                    w.notify(uid, None, w.last_idx, -1)
+                    continue
+                if w.wid not in self._registered_in_file:
+                    ub = w.uid.encode()
+                    buf += _REG.pack(1, w.wid, len(ub))
+                    buf += ub
+                    self._registered_in_file.add(w.wid)
+                crc = IO.crc32(payload)
+                buf += _ENT.pack(2, w.wid, index, term, len(payload), crc)
+                buf += payload
+                w.last_idx = index
+                r = self._file_ranges.setdefault(uid, [index, index])
+                r[0] = min(r[0], index)
+                r[1] = max(r[1], index)
+                c = confirms.setdefault(uid, [index, index, term])
+                c[0] = min(c[0], index)
+                c[1] = max(c[1], index)
+                c[2] = term
+        if buf:
+            n = IO.write_batch(self._fd, bytes(buf), self.sync_mode)
+            self._file_size += n
+        # notify AFTER durability (complete_batch, :753-800)
+        with self._lock:
+            notifiers = [(self._writers[uid].notify, uid, c)
+                         for uid, c in confirms.items()
+                         if uid in self._writers]
+        for notify, uid, (lo, hi, term) in notifiers:
+            notify(uid, lo, hi, term)
+        if roll or self._file_size >= self.max_size:
+            self._rollover()
+        # flush barriers release only after any requested rollover has been
+        # handed to the segment writer (callers chain await_idle after)
+        for done in flushes:
+            done.set()
+
+    # -- files / rollover / recovery ---------------------------------------
+
+    def _open_new_file(self) -> None:
+        self._file_seq += 1
+        self._file_path = os.path.join(self.dir,
+                                       f"{self._file_seq:08d}.wal")
+        self._fd = IO.wal_open(self._file_path, truncate=True)
+        IO.write_batch(self._fd, MAGIC, 0)
+        self._file_size = len(MAGIC)
+        self._registered_in_file = set()
+        self._file_ranges = {}
+
+    def _rollover(self) -> None:
+        old_fd, old_path = self._fd, self._file_path
+        with self._lock:
+            ranges = {uid: tuple(r) for uid, r in self._file_ranges.items()}
+        IO.close(old_fd)
+        self._open_new_file()
+        if ranges and self.segment_writer is not None:
+            self.segment_writer.accept_ranges(ranges, old_path)
+        elif not ranges:
+            os.unlink(old_path)
+
+    def _recover(self) -> None:
+        files = sorted(f for f in os.listdir(self.dir)
+                       if f.endswith(".wal"))
+        for fname in files:
+            path = os.path.join(self.dir, fname)
+            try:
+                self._recover_file(path)
+            except Exception:
+                import logging
+                logging.getLogger("ra_tpu").warning(
+                    "wal recovery: truncated/corrupt tail in %s", fname)
+            seq = int(fname.split(".")[0])
+            self._file_seq = max(self._file_seq, seq)
+        self._recovered_files = [os.path.join(self.dir, f) for f in files]
+
+    def _recover_file(self, path: str) -> None:
+        with open(path, "rb") as f:
+            data = f.read()
+        if data[:4] != MAGIC:
+            return
+        pos = 4
+        wid_to_uid: dict[int, str] = {}
+        while pos + 1 <= len(data):
+            rtype = data[pos]
+            if rtype == 1:
+                if pos + _REG.size > len(data):
+                    raise ValueError("torn registration")
+                _, wid, ulen = _REG.unpack_from(data, pos)
+                pos += _REG.size
+                uid = data[pos:pos + ulen].decode()
+                pos += ulen
+                wid_to_uid[wid] = uid
+            elif rtype == 2:
+                if pos + _ENT.size > len(data):
+                    raise ValueError("torn entry header")
+                _, wid, idx, term, plen, crc = _ENT.unpack_from(data, pos)
+                pos += _ENT.size
+                payload = data[pos:pos + plen]
+                pos += plen
+                if len(payload) < plen or IO.crc32(payload) != crc:
+                    raise ValueError("crc mismatch")  # torn tail: stop
+                uid = wid_to_uid.get(wid)
+                if uid is None:
+                    continue
+                tbl = self._recovered.setdefault(uid, {})
+                if idx in tbl or any(k > idx for k in tbl):
+                    # overwrite invalidates higher indexes (dedup,
+                    # ra_log_wal recovery semantics)
+                    for k in [k for k in tbl if k > idx]:
+                        del tbl[k]
+                tbl[idx] = (term, payload)
+            else:
+                break
+
+    def recovered_table(self, uid: str) -> dict:
+        """Entries for uid recovered from surviving WAL files
+        (idx -> (term, payload)); consumed by DurableLog init."""
+        return self._recovered.get(uid, {})
+
+    def close(self) -> None:
+        self._stop = True
+        self._thread.join(timeout=5)
+        if self._fd is not None:
+            IO.close(self._fd)
+            self._fd = None

@@ -1,0 +1,135 @@
+"""ctypes bindings for the native WAL/IO library, with pure-Python fallback.
+
+The .so is built on first import with g++ (cached next to the source);
+environments without a toolchain fall back to os-level Python I/O with
+zlib.crc32 — same semantics, lower throughput.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import zlib
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "wal_native.cpp")
+_SO = os.path.join(_HERE, "libra_wal.so")
+
+_lib = None
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+                       check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < \
+            os.path.getmtime(_SRC):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+        lib.ra_wal_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.ra_wal_open.restype = ctypes.c_int
+        lib.ra_wal_write_batch.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                           ctypes.c_size_t, ctypes.c_int]
+        lib.ra_wal_write_batch.restype = ctypes.c_long
+        lib.ra_wal_close.argtypes = [ctypes.c_int]
+        lib.ra_crc32.argtypes = [ctypes.c_uint32, ctypes.c_char_p,
+                                 ctypes.c_size_t]
+        lib.ra_crc32.restype = ctypes.c_uint32
+        lib.ra_pwrite.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                  ctypes.c_size_t, ctypes.c_long]
+        lib.ra_pwrite.restype = ctypes.c_long
+        lib.ra_pread.argtypes = [ctypes.c_int,
+                                 ctypes.POINTER(ctypes.c_char),
+                                 ctypes.c_size_t, ctypes.c_long]
+        lib.ra_pread.restype = ctypes.c_long
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+class NativeIO:
+    """Thin facade over the native lib (or the Python fallback)."""
+
+    def __init__(self) -> None:
+        self.lib = _load()
+        self.native = self.lib is not None
+
+    def random_open(self, path: str, truncate: bool = False) -> int:
+        """Open for positioned I/O (pwrite/pread).  MUST NOT use O_APPEND:
+        Linux pwrite ignores the offset on O_APPEND fds."""
+        flags = os.O_CREAT | os.O_RDWR
+        if truncate:
+            flags |= os.O_TRUNC
+        return os.open(path, flags, 0o644)
+
+    # sync_mode: 0=none, 1=fdatasync, 2=fsync
+    def wal_open(self, path: str, truncate: bool = False) -> int:
+        if self.native:
+            fd = self.lib.ra_wal_open(path.encode(), 1 if truncate else 0)
+        else:
+            flags = os.O_CREAT | os.O_RDWR | os.O_APPEND
+            if truncate:
+                flags |= os.O_TRUNC
+            fd = os.open(path, flags, 0o644)
+        if fd < 0:
+            raise OSError(f"wal_open failed for {path}: {fd}")
+        return fd
+
+    def write_batch(self, fd: int, buf: bytes, sync_mode: int = 1) -> int:
+        if self.native:
+            n = self.lib.ra_wal_write_batch(fd, buf, len(buf), sync_mode)
+            if n < 0:
+                raise OSError(f"wal write failed: errno {-n}")
+            return n
+        os.write(fd, buf)
+        if sync_mode == 1:
+            try:
+                os.fdatasync(fd)
+            except AttributeError:
+                os.fsync(fd)
+        elif sync_mode == 2:
+            os.fsync(fd)
+        return len(buf)
+
+    def pwrite(self, fd: int, buf: bytes, off: int) -> int:
+        if self.native:
+            n = self.lib.ra_pwrite(fd, buf, len(buf), off)
+            if n < 0:
+                raise OSError(f"pwrite failed: errno {-n}")
+            return n
+        return os.pwrite(fd, buf, off)
+
+    def pread(self, fd: int, length: int, off: int) -> bytes:
+        if self.native:
+            buf = ctypes.create_string_buffer(length)
+            n = self.lib.ra_pread(fd, buf, length, off)
+            if n < 0:
+                raise OSError(f"pread failed: errno {-n}")
+            return buf.raw[:n]
+        return os.pread(fd, length, off)
+
+    def crc32(self, data: bytes, seed: int = 0) -> int:
+        if self.native:
+            return self.lib.ra_crc32(seed, data, len(data))
+        return zlib.crc32(data, seed)
+
+    def close(self, fd: int) -> None:
+        if self.native:
+            self.lib.ra_wal_close(fd)
+        else:
+            os.close(fd)
+
+
+IO = NativeIO()

@@ -197,11 +197,13 @@ class RaNode:
         return config.server_id
 
     def stop_server(self, name: str) -> None:
+        # NB: the log is NOT closed here — it is owned by its factory/system
+        # and survives server restarts (storage identity vs process
+        # identity, ra_log_wal.erl:44-51)
         with self._lock:
             shell = self.shells.pop(name, None)
         if shell is not None:
             shell.stopped = True
-            shell.server.log.close()
 
     def restart_server(self, name: str) -> ServerId:
         """Restart from the persisted log (ra:restart_server, §3.4)."""
@@ -287,6 +289,13 @@ class RaNode:
 
     def _poll_shell(self, shell: ServerShell, now: float) -> bool:
         busy = False
+        # async WAL confirms arrive independently of inbox traffic; route
+        # through _handle so terminal states are honored
+        for evt in shell.server.log.take_events():
+            self._handle(shell, evt)
+            busy = True
+            if shell.stopped:
+                return busy
         # timers
         if shell.election_deadline is not None and \
                 now >= shell.election_deadline:
@@ -326,7 +335,6 @@ class RaNode:
             shell.stopped = True
             with self._lock:
                 self.shells.pop(shell.sid.name, None)
-            server.log.close()
 
     # -- effect executor (ra_server_proc:handle_effect :1317-1566) ----------
 

@@ -1,0 +1,80 @@
+"""RaSystem — a named instance of the full durable-log stack.
+
+The reference's 'system' (ra_system.erl) is one isolated set of log
+infrastructure: WAL + segment writer + registries, hosting many servers.
+Multiple systems can coexist with separate data dirs/tunables
+(ra_system.erl:18-63).  This is exactly that, minus supervision trees:
+component threads are owned by this object and restarted by it.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from .core.types import ServerConfig
+from .log.durable import DurableLog
+from .log.segment import SegmentWriter
+from .log.wal import DEFAULT_MAX_BATCH, DEFAULT_MAX_SIZE, Wal
+
+
+class RaSystem:
+    def __init__(self, data_dir: str, *, name: str = "default",
+                 wal_sync_mode: int = 1,
+                 wal_max_size: int = DEFAULT_MAX_SIZE,
+                 wal_max_batch: int = DEFAULT_MAX_BATCH,
+                 segment_max_count: int = 4096) -> None:
+        self.name = name
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.segment_max_count = segment_max_count
+        self._logs: dict[str, DurableLog] = {}
+        self._lock = threading.Lock()
+        self.segment_writer = SegmentWriter(resolve=self._resolve)
+        self.wal = Wal(data_dir, sync_mode=wal_sync_mode,
+                       max_size=wal_max_size, max_batch=wal_max_batch,
+                       segment_writer=self.segment_writer)
+
+    def _resolve(self, uid: str) -> Optional[DurableLog]:
+        with self._lock:
+            return self._logs.get(uid)
+
+    def log_factory(self, cfg: ServerConfig) -> DurableLog:
+        """Factory handed to RaNode: per-server durable log over the shared
+        WAL/segment-writer.  The log is the server's *storage identity* and
+        survives server crashes within a running system — a restarted
+        server reuses it (the ra_log_ets role: memtables outlive the
+        processes that fill them)."""
+        with self._lock:
+            log = self._logs.get(cfg.uid)
+            if log is not None:
+                log.take_events()  # drop confirms addressed to the old shell
+                self.wal.register(cfg.uid, log._wal_notify)
+                return log
+            # create under the lock: two concurrent starts for one uid must
+            # not build two logs over one directory
+            log = DurableLog(cfg.uid, self.data_dir, self.wal,
+                             segment_max_count=self.segment_max_count)
+            self._logs[cfg.uid] = log
+            return log
+
+    def registered_uids(self) -> list:
+        with self._lock:
+            return list(self._logs)
+
+    def close(self) -> None:
+        self.wal.close()
+        self.segment_writer.close()
+        with self._lock:
+            for log in self._logs.values():
+                log.close()
+            self._logs.clear()
+
+    def overview(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "data_dir": self.data_dir,
+                "servers": {uid: log.overview()
+                            for uid, log in self._logs.items()},
+            }

@@ -1,0 +1,257 @@
+"""Durable log subsystem tests — WAL batching/rollover/recovery, segment
+flush, snapshots/checkpoints, corruption tolerance.  Scenario shapes follow
+the reference's ra_log_2_SUITE / ra_log_wal_SUITE / ra_checkpoint_SUITE."""
+import os
+import pickle
+import time
+
+import pytest
+
+from ra_tpu.core.types import Entry, SnapshotMeta, UserCommand
+from ra_tpu.log.durable import DurableLog
+from ra_tpu.log.segment import SegmentFile
+from ra_tpu.log.wal import Wal
+from ra_tpu.system import RaSystem
+
+
+def drain(log, timeout=5.0):
+    """Wait for WAL confirms and apply them."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        evts = log.take_events()
+        for e in evts:
+            log.handle_written(e)
+        if log.last_written().index >= log.last_index_term().index:
+            return
+        time.sleep(0.005)
+    raise TimeoutError("log never confirmed")
+
+
+def mk_system(tmp_path, **kw):
+    return RaSystem(str(tmp_path), **kw)
+
+
+def mk_log(system, uid="u1"):
+    from ra_tpu.core.types import ServerConfig, ServerId
+    cfg = ServerConfig(server_id=None, uid=uid, cluster_name="c",
+                       initial_members=(), machine=None)
+    return system.log_factory(cfg)
+
+
+def test_append_and_written_confirm(tmp_path):
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 101):
+        log.append(Entry(i, 1, UserCommand(i)))
+    assert log.last_index_term().index == 100
+    drain(log)
+    assert log.last_written().index == 100
+    assert log.fetch(50).command.data == 50
+    sys_.close()
+
+
+def test_rollover_flushes_to_segments_and_deletes_wal(tmp_path):
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 201):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    assert log.overview()["num_segments"] >= 1
+    assert log.overview()["num_mem_entries"] == 0
+    # reads served from segments, with crc verification
+    assert log.fetch(123).command.data == 123
+    assert log.fetch_term(200) == 1
+    # the rolled WAL file is gone; only the fresh one remains
+    wal_files = os.listdir(os.path.join(str(tmp_path), "wal"))
+    assert len(wal_files) == 1
+    sys_.close()
+
+
+def test_recovery_from_wal_only(tmp_path):
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 51):
+        log.append(Entry(i, 3, UserCommand(i * 2)))
+    drain(log)
+    log.store_meta(current_term=3, voted_for=None, last_applied=50)
+    sys_.close()  # "crash": entries only in WAL files
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    assert log2.last_index_term().index == 50
+    assert log2.last_written().index == 50
+    assert log2.fetch(25).command.data == 50
+    assert log2.fetch_meta("current_term") == 3
+    # recovered WAL files are retired once their entries reach segments —
+    # no unbounded *.wal accumulation across restarts
+    deadline = time.monotonic() + 5
+    waldir = os.path.join(str(tmp_path), "wal")
+    while time.monotonic() < deadline:
+        if len(os.listdir(waldir)) == 1:  # only the fresh live file
+            break
+        time.sleep(0.02)
+    assert len(os.listdir(waldir)) == 1
+    assert log2.fetch(25).command.data == 50  # now served from segments
+    sys2.close()
+
+
+def test_recovery_from_segments_plus_wal(tmp_path):
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 101):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    for i in range(101, 131):
+        log.append(Entry(i, 2, UserCommand(i)))
+    drain(log)
+    sys_.close()
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    assert log2.last_index_term() == (130, 2)
+    assert log2.fetch(42).command.data == 42     # from segment
+    assert log2.fetch(120).command.data == 120   # from recovered WAL
+    sys2.close()
+
+
+def test_overwrite_invalidates_tail_across_recovery(tmp_path):
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 11):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    # a new leader overwrites from index 5 in term 2
+    log.write([Entry(5, 2, UserCommand(500))])
+    drain(log)
+    assert log.last_index_term() == (5, 2)
+    sys_.close()
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    assert log2.last_index_term() == (5, 2)
+    assert log2.fetch(5).command.data == 500
+    assert log2.fetch(6) is None
+    sys2.close()
+
+
+def test_snapshot_truncates_and_recovers(tmp_path):
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 101):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    log.update_release_cursor(80, (), 0, {"acc": 4080})
+    assert log.snapshot_index_term() == (80, 1)
+    assert log.first_index() == 81
+    assert log.fetch(80) is None
+    assert log.fetch(90).command.data == 90
+    sys_.close()
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    meta, state = log2.recover_snapshot_state()
+    assert meta.index == 80 and state == {"acc": 4080}
+    assert log2.last_index_term().index == 100
+    sys2.close()
+
+
+def test_checkpoint_promote(tmp_path):
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 31):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    log.checkpoint(10, (), 0, {"acc": 55})
+    log.checkpoint(20, (), 0, {"acc": 210})
+    assert log.overview()["num_checkpoints"] == 2
+    assert log.promote_checkpoint(15)  # promotes cp@10
+    assert log.snapshot_index_term().index == 10
+    meta, state = log.recover_snapshot_state()
+    assert state == {"acc": 55}
+    # checkpoint retention cap
+    for i in range(12):
+        log.checkpoint(20 + i // 2, (), 0, {"i": i})
+    assert log.overview()["num_checkpoints"] <= 10
+    sys_.close()
+
+
+def test_corrupt_wal_tail_is_tolerated(tmp_path):
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 21):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    sys_.close()
+    # corrupt the tail of the wal file (torn write)
+    waldir = os.path.join(str(tmp_path), "wal")
+    fname = sorted(os.listdir(waldir))[0]
+    path = os.path.join(waldir, fname)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 7)
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    # last entry lost, the rest intact
+    assert 0 < log2.last_index_term().index < 20
+    sys2.close()
+
+
+def test_corrupt_snapshot_falls_back_to_older(tmp_path):
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 31):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    log.update_release_cursor(10, (), 0, {"acc": 55})
+    # write a newer snapshot then corrupt it on disk
+    log.update_release_cursor(20, (), 0, {"acc": 210})
+    sys_.close()
+    snapdir = os.path.join(str(tmp_path), "u1", "snapshot")
+    snaps = sorted(os.listdir(snapdir))
+    newest = os.path.join(snapdir, snaps[-1])
+    with open(newest, "r+b") as f:
+        f.seek(20)
+        f.write(b"\xff\xff\xff")
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    got = log2.recover_snapshot_state()
+    # newest is invalid; recovery must not produce garbage. The older
+    # snapshot was deleted when the newer one landed, so None is also
+    # acceptable — but never a corrupt load.
+    if got is not None:
+        assert got[1] == {"acc": 55}
+    sys2.close()
+
+
+def test_segment_file_roundtrip(tmp_path):
+    path = str(tmp_path / "t.segment")
+    seg = SegmentFile(path, max_count=8, create=True)
+    for i in range(1, 9):
+        assert seg.append(i, 1, pickle.dumps(i * 11))
+    assert not seg.append(9, 1, b"x")  # full
+    seg.flush()
+    seg.close()
+    seg2 = SegmentFile(path)
+    assert seg2.range() == (1, 8)
+    assert pickle.loads(seg2.read(5)[1]) == 55
+    seg2.close()
+
+
+def test_wal_gap_triggers_resend(tmp_path):
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    log.append(Entry(1, 1, UserCommand(1)))
+    drain(log)
+    # bypass the log and inject an out-of-sequence WAL write
+    sys_.wal.write("u1", 5, 1, pickle.dumps(UserCommand(5)))
+    sys_.wal.flush()
+    # the WAL rejected it; log state unchanged and a fresh append works
+    log.append(Entry(2, 1, UserCommand(2)))
+    drain(log)
+    assert log.last_written().index == 2
+    sys_.close()
