@@ -11,13 +11,17 @@ __version__ = "0.1.0"
 
 from .api import (  # noqa: E402,F401
     add_member,
+    aux_command,
+    cast_aux_command,
     consistent_query,
     delete_cluster,
     key_metrics,
     leader_query,
     local_query,
+    member_overview,
     members,
     new_uid,
+    overview,
     pipeline_command,
     process_command,
     remove_member,

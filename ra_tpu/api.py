@@ -114,17 +114,29 @@ def _leader_call(seed: ServerId, make_event: Callable[["Future"], Any],
     target = seed
     last_err: Any = None
     while time.monotonic() < deadline:
-        fut = Future()
         node = router.nodes.get(target.node)
-        if node is None or not node.submit(target.name, make_event(fut)):
-            last_err = ErrorResult("noproc", None)
-            target = seed
-            time.sleep(0.01)
-            continue
+        if node is not None:
+            fut = Future()
+            if not node.submit(target.name, make_event(fut)):
+                last_err = ErrorResult("noproc", None)
+                target = seed
+                time.sleep(0.01)
+                continue
+        else:
+            # remote node: full cross-host call (TcpRouter); in-process
+            # routers have no reach and report noproc
+            fut = router.remote_call(target, make_event)
+            if fut is None:
+                last_err = ErrorResult("noproc", None)
+                target = seed
+                time.sleep(0.01)
+                continue
         try:
             result = fut.wait(min(timeout, deadline - time.monotonic()))
         except TimeoutError:
             last_err = ErrorResult("timeout", None)
+            if hasattr(router, "forget_call"):
+                router.forget_call(fut)
             break
         if isinstance(result, ErrorResult):
             last_err = result
@@ -292,6 +304,45 @@ def _await_leader(seed: ServerId, router: LocalRouter,
     raise TimeoutError(f"ra: no leader found via {seed}")
 
 
+def aux_command(server_id: ServerId, cmd: Any,
+                router: Optional[LocalRouter] = None,
+                timeout: float = 5.0) -> Any:
+    """Route a command to the machine's handle_aux on a specific member
+    (ra:aux_command)."""
+    from .core.types import AuxCommandEvent
+    router = router or DEFAULT_ROUTER
+    node = _node_of(server_id, router)
+    fut = Future()
+    if not node.submit(server_id.name, AuxCommandEvent(cmd, from_=fut)):
+        raise RuntimeError(f"no such server {server_id}")
+    return fut.wait(timeout)
+
+
+def cast_aux_command(server_id: ServerId, cmd: Any,
+                     router: Optional[LocalRouter] = None) -> None:
+    from .core.types import AuxCommandEvent
+    router = router or DEFAULT_ROUTER
+    node = _node_of(server_id, router)
+    node.submit(server_id.name, AuxCommandEvent(cmd))
+
+
+def member_overview(server_id: ServerId,
+                    router: Optional[LocalRouter] = None) -> dict:
+    """Full state dump of one member (ra:member_overview)."""
+    router = router or DEFAULT_ROUTER
+    node = _node_of(server_id, router)
+    shell = node.shells.get(server_id.name)
+    if shell is None:
+        return {"state": "noproc"}
+    return shell.server.overview()
+
+
+def overview(router: Optional[LocalRouter] = None) -> dict:
+    """Node-level overview across all local RaNodes (ra:overview)."""
+    router = router or DEFAULT_ROUTER
+    return {name: node.overview() for name, node in router.nodes.items()}
+
+
 def key_metrics(server_id: ServerId,
                 router: Optional[LocalRouter] = None) -> dict:
     """Read metrics without touching the server's event loop
@@ -318,4 +369,5 @@ def key_metrics(server_id: ServerId,
         "machine_version": srv.machine_version,
         "effective_machine_version": srv.effective_machine_version,
         "membership": srv.membership.value,
+        "counters": node.counters.fetch(srv.cfg.uid),
     }

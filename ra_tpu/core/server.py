@@ -29,6 +29,7 @@ from .types import (
     RA_PROTO_VERSION,
     AppendEntriesReply,
     AppendEntriesRpc,
+    AuxCommandEvent,
     AuxEffect,
     CancelElectionTimeout,
     Checkpoint,
@@ -38,6 +39,7 @@ from .types import (
     CommandsEvent,
     CommandResult,
     ConsistentQueryEvent,
+    DownEvent,
     ElectionTimeout,
     Entry,
     ErrorResult,
@@ -54,6 +56,7 @@ from .types import (
     Membership,
     Monitor,
     NextEvent,
+    NodeEvent,
     NoopCommand,
     Notify,
     PeerStatus,
@@ -241,6 +244,8 @@ class RaServer:
         if self.raft_state in (RaftState.STOP,
                                RaftState.DELETE_AND_TERMINATE):
             return []  # terminal: the shell tears this server down
+        if isinstance(event, AuxCommandEvent):
+            return self.handle_aux("cmd", event.cmd, event.from_)
         handler = {
             RaftState.LEADER: self._handle_leader,
             RaftState.FOLLOWER: self._handle_follower,
@@ -328,7 +333,10 @@ class RaServer:
     # ------------------------------------------------------------------
 
     def _call_for_election_pre_vote(self) -> list:
-        self.pre_vote_token = object()
+        # token must survive serialization (compared by value, not
+        # identity — it crosses the wire on TCP transports)
+        import uuid as _uuid
+        self.pre_vote_token = _uuid.uuid4().hex
         last = self.last_idx_term()
         reqs = tuple(
             (pid, PreVoteRpc(term=self.current_term, token=self.pre_vote_token,
@@ -510,6 +518,19 @@ class RaServer:
             return self._call_for_election_pre_vote()
         if isinstance(event, (CommandEvent, ConsistentQueryEvent)):
             return []  # from_-carrying events answered by _dispatch fallback
+        if isinstance(event, NodeEvent):
+            # failure-detector verdict on the leader's node: arm an election
+            # (the aten-driven path, ra_server_proc.erl:790-810)
+            if (event.status == "down" and self.leader_id is not None
+                    and event.node == self.leader_id.node
+                    and self.is_voter()):
+                return [StartElectionTimeout("short")]
+            return []
+        if isinstance(event, DownEvent):
+            if (self.leader_id is not None and event.target == self.leader_id
+                    and self.is_voter()):
+                return [StartElectionTimeout("really_short")]
+            return []
         if isinstance(event, TickEvent):
             return self._tick()
         return []
@@ -753,7 +774,7 @@ class RaServer:
         if isinstance(event, PreVoteResult):
             if event.term > self.current_term:
                 return self._become_follower(event.term)
-            if (event.vote_granted and event.token is self.pre_vote_token
+            if (event.vote_granted and event.token == self.pre_vote_token
                     and event.term == self.current_term):
                 self.votes += 1
                 if self.votes == self.required_quorum():
@@ -878,6 +899,26 @@ class RaServer:
             return []
         if isinstance(event, TransferLeadershipEvent):
             return self._leader_transfer(event)
+        if isinstance(event, NodeEvent):
+            # peer node status drives per-peer replication state
+            # (handle_node_status, ra_server.erl:2107-2167)
+            changed = False
+            for pid, peer in self.cluster.items():
+                if pid == self.id or pid.node != event.node:
+                    continue
+                if event.status == "down" and \
+                        peer.status == PeerStatus.NORMAL:
+                    peer.status = PeerStatus.DISCONNECTED
+                elif event.status == "up" and \
+                        peer.status == PeerStatus.DISCONNECTED:
+                    peer.status = PeerStatus.NORMAL
+                    changed = True
+            return self._make_all_rpcs() if changed else []
+        if isinstance(event, DownEvent):
+            peer = self.cluster.get(event.target)
+            if peer is not None and peer.status == PeerStatus.NORMAL:
+                peer.status = PeerStatus.DISCONNECTED
+            return []
         if isinstance(event, ElectionTimeout):
             return []
         if isinstance(event, TickEvent):
@@ -893,6 +934,8 @@ class RaServer:
             self.leader_id = None
             return self._become_follower(reply.term)
         if reply.success and reply.term == self.current_term:
+            if peer.status == PeerStatus.DISCONNECTED:
+                peer.status = PeerStatus.NORMAL  # hearing from it = alive
             peer.match_index = max(peer.match_index, reply.last_index)
             peer.next_index = max(peer.next_index, reply.next_index)
             effects = self._maybe_promote_peer(reply.from_)
@@ -1394,6 +1437,30 @@ class RaServer:
         # refresh peers (periodic empty AERs stand in for ra's aten-driven
         # liveness; ra sends no idle heartbeats, INTERNALS.md:291-328)
         effects.extend(self._make_all_rpcs())
+        return effects
+
+    # -- aux state machinery (ra_machine handle_aux + ra_aux accessors) ----
+
+    def handle_aux(self, kind: str, msg: Any, from_: Any = None) -> list:
+        """Route an aux command/eval into the machine's handle_aux
+        (ra_server.erl handle_aux dispatch; ra_aux gives the callback
+        read access to server internals via ``internal=self``)."""
+        result = self.effective_machine.handle_aux(
+            self.raft_state.value, kind, msg, self.aux_state, self)
+        effects: list = []
+        reply = None
+        if isinstance(result, tuple):
+            if len(result) >= 1:
+                self.aux_state = result[0]
+            if len(result) >= 2 and result[1] is not None:
+                effects = list(result[1]) if \
+                    isinstance(result[1], (list, tuple)) else []
+            if len(result) >= 3:
+                reply = result[2]
+        if from_ is not None and not any(isinstance(e, Reply)
+                                         for e in effects):
+            effects.append(Reply(from_, reply if reply is not None
+                                 else "ok"))
         return effects
 
     # -- machine effects executed in the core (release_cursor etc.) --------

@@ -373,6 +373,15 @@ class ForceElectionEvent:
     pass
 
 
+@dataclass(frozen=True)
+class AuxCommandEvent:
+    """{aux_command, Type, Cmd} — routed to the machine's handle_aux
+    (ra.erl aux_command/cast_aux_command)."""
+
+    cmd: Any
+    from_: Any = None
+
+
 # ---------------------------------------------------------------------------
 # Effects — returned by the pure core / machine, executed by the shell
 # (ra_machine.erl:121-142 + ra_server internal effects)
@@ -551,6 +560,33 @@ class CommandResult:
 class ErrorResult:
     reason: Any
     leader: Optional[ServerId] = None
+
+
+def strip_local_handles(cmd: Any) -> Any:
+    """Drop process-local reply handles (futures/callables) from a command
+    before it leaves the process (wire or disk).  Replies are only ever
+    owed by the member that accepted the call; remote/recovered copies
+    never fire them (recovery replays with effects suppressed,
+    ra_server.erl:376-414)."""
+    from dataclasses import replace as _replace
+    out = cmd
+    for field_ in ("from_", "notify_to"):
+        v = getattr(out, field_, None)
+        if v is not None and not isinstance(v, (str, int, tuple)):
+            out = _replace(out, **{field_: None})
+    return out
+
+
+def strip_msg_handles(msg: Any) -> Any:
+    """Sanitize an outbound RPC: AER entries may embed commands carrying
+    local reply handles."""
+    if isinstance(msg, AppendEntriesRpc) and msg.entries:
+        from dataclasses import replace as _replace
+        entries = tuple(
+            Entry(e.index, e.term, strip_local_handles(e.command))
+            for e in msg.entries)
+        return _replace(msg, entries=entries)
+    return msg
 
 
 # ---------------------------------------------------------------------------

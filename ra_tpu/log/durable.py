@@ -29,10 +29,10 @@ import os
 import pickle
 import struct
 import threading
-from dataclasses import replace
 from typing import Any, Callable, Iterable, Optional
 
-from ..core.types import Entry, IdxTerm, SnapshotMeta, WrittenEvent
+from ..core.types import (Entry, IdxTerm, SnapshotMeta, WrittenEvent,
+                          strip_local_handles)
 from ..native import IO
 from .segment import DEFAULT_MAX_COUNT, SegmentFile
 
@@ -219,23 +219,10 @@ class DurableLog:
         for e in entries:
             self._put(e)
 
-    @staticmethod
-    def _persistable(cmd: Any) -> Any:
-        """Live reply handles (futures/callables) are process-local and not
-        serializable; they are stripped from the durable image.  Replies
-        are only ever owed by the member that accepted the call, which
-        still holds the full command in its memtable — after a restart the
-        caller has lost its handle anyway (recovery replays with effects
-        suppressed, ra_server.erl:376-414)."""
-        out = cmd
-        for field_ in ("from_", "notify_to"):
-            if getattr(out, field_, None) is not None and \
-                    not isinstance(getattr(out, field_), (str, int, tuple)):
-                out = replace(out, **{field_: None})
-        return out
-
     def _put(self, entry: Entry) -> None:
-        payload = pickle.dumps(self._persistable(entry.command))
+        # live reply handles are process-local: stripped from the durable
+        # image (the memtable keeps the full command for leader replies)
+        payload = pickle.dumps(strip_local_handles(entry.command))
         with self._lock:
             if entry.index <= self._last_index:
                 # overwrite: invalidate the stale tail; rewind last_written

@@ -49,6 +49,50 @@ DEFAULT_MAX_BATCH = 8192              # ra.hrl:192
 NotifyFn = Callable[[str, Optional[int], int, int], None]
 
 
+def scan_wal_file(path: str, tables: dict) -> None:
+    """Parse one WAL file into per-uid tables (idx -> (term, payload)),
+    deduping overwrites; raises on a torn/corrupt tail (callers keep the
+    prefix parsed so far).  Shared by live recovery and offline replay
+    (ra_dbg)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:4] != MAGIC:
+        return
+    pos = 4
+    wid_to_uid: dict[int, str] = {}
+    while pos + 1 <= len(data):
+        rtype = data[pos]
+        if rtype == 1:
+            if pos + _REG.size > len(data):
+                raise ValueError("torn registration")
+            _, wid, ulen = _REG.unpack_from(data, pos)
+            pos += _REG.size
+            uid = data[pos:pos + ulen].decode()
+            pos += ulen
+            wid_to_uid[wid] = uid
+        elif rtype == 2:
+            if pos + _ENT.size > len(data):
+                raise ValueError("torn entry header")
+            _, wid, idx, term, plen, crc = _ENT.unpack_from(data, pos)
+            pos += _ENT.size
+            payload = data[pos:pos + plen]
+            pos += plen
+            if len(payload) < plen or IO.crc32(payload) != crc:
+                raise ValueError("crc mismatch")  # torn tail: stop
+            uid = wid_to_uid.get(wid)
+            if uid is None:
+                continue
+            tbl = tables.setdefault(uid, {})
+            if idx in tbl or any(k > idx for k in tbl):
+                # overwrite invalidates higher indexes (dedup,
+                # ra_log_wal recovery semantics :871-955)
+                for k in [k for k in tbl if k > idx]:
+                    del tbl[k]
+            tbl[idx] = (term, payload)
+        else:
+            break
+
+
 class _Writer:
     __slots__ = ("uid", "wid", "notify", "last_idx")
 
@@ -249,43 +293,7 @@ class Wal:
         self._recovered_files = [os.path.join(self.dir, f) for f in files]
 
     def _recover_file(self, path: str) -> None:
-        with open(path, "rb") as f:
-            data = f.read()
-        if data[:4] != MAGIC:
-            return
-        pos = 4
-        wid_to_uid: dict[int, str] = {}
-        while pos + 1 <= len(data):
-            rtype = data[pos]
-            if rtype == 1:
-                if pos + _REG.size > len(data):
-                    raise ValueError("torn registration")
-                _, wid, ulen = _REG.unpack_from(data, pos)
-                pos += _REG.size
-                uid = data[pos:pos + ulen].decode()
-                pos += ulen
-                wid_to_uid[wid] = uid
-            elif rtype == 2:
-                if pos + _ENT.size > len(data):
-                    raise ValueError("torn entry header")
-                _, wid, idx, term, plen, crc = _ENT.unpack_from(data, pos)
-                pos += _ENT.size
-                payload = data[pos:pos + plen]
-                pos += plen
-                if len(payload) < plen or IO.crc32(payload) != crc:
-                    raise ValueError("crc mismatch")  # torn tail: stop
-                uid = wid_to_uid.get(wid)
-                if uid is None:
-                    continue
-                tbl = self._recovered.setdefault(uid, {})
-                if idx in tbl or any(k > idx for k in tbl):
-                    # overwrite invalidates higher indexes (dedup,
-                    # ra_log_wal recovery semantics)
-                    for k in [k for k in tbl if k > idx]:
-                        del tbl[k]
-                tbl[idx] = (term, payload)
-            else:
-                break
+        scan_wal_file(path, self._recovered)
 
     def recovered_table(self, uid: str) -> dict:
         """Entries for uid recovered from surviving WAL files

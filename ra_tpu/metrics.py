@@ -1,0 +1,77 @@
+"""Counters + leaderboard — the observability surface.
+
+The reference keeps ~47 flat atomic counter fields per server behind
+seshat (ra_counters.erl, field specs ra.hrl:236-390) plus lock-free ETS
+tables for leader lookup (ra_leaderboard.erl).  Here: a Counters registry
+of plain int dicts (GIL-atomic increments), sampled without touching the
+server event loop — the same contract as ra:key_metrics (ra.erl:1229).
+On the lane engine, the equivalent metrics live *on device* as the
+total_committed / term / commit arrays and are sampled via readback.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+#: counter fields kept per server (subset of ra.hrl:236-390, same names)
+SERVER_FIELDS = (
+    "commands", "command_flushes", "aer_received_follower",
+    "aer_replies_success", "aer_replies_failed", "elections",
+    "pre_vote_elections", "snapshots_written", "snapshot_installed",
+    "dropped_sends", "msgs_processed",
+)
+
+
+class Counters:
+    """Named counter groups (the seshat role)."""
+
+    def __init__(self) -> None:
+        self._groups: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def new(self, name: str, fields=SERVER_FIELDS) -> dict:
+        with self._lock:
+            g = self._groups.get(name)
+            if g is None:
+                g = {f: 0 for f in fields}
+                self._groups[name] = g
+            return g
+
+    def incr(self, name: str, field: str, n: int = 1) -> None:
+        g = self._groups.get(name)
+        if g is not None and field in g:
+            g[field] += n
+
+    def fetch(self, name: str) -> Optional[dict]:
+        g = self._groups.get(name)
+        return dict(g) if g is not None else None
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            self._groups.pop(name, None)
+
+    def overview(self) -> dict:
+        with self._lock:
+            return {k: dict(v) for k, v in self._groups.items()}
+
+
+class Leaderboard:
+    """cluster name -> (leader, members); written on leader change, read
+    lock-free by clients (ra_leaderboard.erl:23-34)."""
+
+    def __init__(self) -> None:
+        self._tab: dict[str, tuple] = {}
+
+    def record(self, cluster_name: str, leader, members) -> None:
+        self._tab[cluster_name] = (leader, tuple(members))
+
+    def lookup_leader(self, cluster_name: str):
+        got = self._tab.get(cluster_name)
+        return got[0] if got else None
+
+    def lookup_members(self, cluster_name: str):
+        got = self._tab.get(cluster_name)
+        return got[1] if got else None
+
+    def overview(self) -> dict:
+        return dict(self._tab)

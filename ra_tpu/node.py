@@ -137,6 +137,15 @@ class LocalRouter:
     def heal(self) -> None:
         self.blocked.clear()
 
+    def remote_call(self, target: ServerId, make_event) -> Optional["Future"]:
+        """Cross-host client call; the in-process router has no remote
+        reach (TcpRouter overrides)."""
+        return None
+
+    def reply_remote(self, handle: tuple, msg: Any) -> None:
+        """Route a reply for a remote call handle (TcpRouter overrides)."""
+        return None
+
 
 #: default in-process fabric (tests may build private ones)
 DEFAULT_ROUTER = LocalRouter()
@@ -168,6 +177,9 @@ class RaNode:
         self.name = name
         self.router = router or DEFAULT_ROUTER
         self.log_factory = log_factory or (lambda cfg: MemoryLog())
+        from .metrics import Counters, Leaderboard
+        self.counters = Counters()
+        self.leaderboard_tab = Leaderboard()
         self.shells: dict[str, ServerShell] = {}   # by server name
         self.directory: dict[str, ServerConfig] = {}  # uid -> config
         self.leaderboard: dict[str, tuple] = {}    # cluster -> (leader, members)
@@ -188,6 +200,7 @@ class RaNode:
         server = RaServer(config, log)
         server.recover()
         shell = ServerShell(server, self)
+        self.counters.new(config.uid)
         with self._lock:
             self.shells[config.server_id.name] = shell
             self.directory[config.uid] = config
@@ -222,6 +235,17 @@ class RaNode:
             shell = self.shells.pop(name, None)
         if shell is not None:
             shell.stopped = True
+            self._notify_down(shell.sid)
+
+    def _notify_down(self, dead: ServerId) -> None:
+        """Local process-monitor role (ra_monitors): co-hosted members
+        learn immediately that a sibling died — followers of a dead leader
+        arm a really_short election (ra_server_proc.erl:760-788)."""
+        from .core.types import DownEvent
+        for other in list(self.shells.values()):
+            if not other.stopped:
+                other.inbox.append(DownEvent(dead))
+        self._wake.set()
 
     def stop(self) -> None:
         self._stop = True
@@ -283,6 +307,7 @@ class RaNode:
                     # blocking on a dead inbox / stale leader state
                     with self._lock:
                         self.shells.pop(shell.sid.name, None)
+                    self._notify_down(shell.sid)
             if not busy:
                 self._wake.wait(timeout=0.005)
                 self._wake.clear()
@@ -323,7 +348,32 @@ class RaNode:
 
     def _handle(self, shell: ServerShell, event: Any) -> None:
         server = shell.server
+        c = self.counters
+        key = server.cfg.uid
+        c.incr(key, "msgs_processed")
+        if isinstance(event, CommandEvent):
+            c.incr(key, "commands")
+        elif isinstance(event, CommandsEvent):
+            c.incr(key, "command_flushes")
+            c.incr(key, "commands", len(event.commands))
+        else:
+            from .core.types import AppendEntriesReply, AppendEntriesRpc
+            if isinstance(event, AppendEntriesRpc):
+                c.incr(key, "aer_received_follower")
+            elif isinstance(event, AppendEntriesReply):
+                c.incr(key, "aer_replies_success" if event.success
+                       else "aer_replies_failed")
+        state_before = server.raft_state
         effects = server.handle(event)
+        state_after = server.raft_state
+        if state_after != state_before:
+            if state_after == RaftState.PRE_VOTE:
+                c.incr(key, "pre_vote_elections")
+            elif state_after == RaftState.CANDIDATE:
+                c.incr(key, "elections")
+            elif state_before == RaftState.RECEIVE_SNAPSHOT and \
+                    state_after == RaftState.FOLLOWER:
+                c.incr(key, "snapshot_installed")
         self._execute(shell, effects)
         # drain WAL confirms produced by this event
         for evt in server.log.take_events():
@@ -344,14 +394,18 @@ class RaNode:
             if isinstance(eff, SendRpc):
                 ok = self.router.send(self.name, eff.to, eff.msg)
                 if not ok:
-                    pass  # dropped send: pipeline catch-up recovers (ra
-                    # counts these, ra.hrl:329-330; metrics in M5)
+                    # dropped send: pipeline catch-up recovers; counted
+                    # like the reference (ra.hrl:329-330)
+                    self.counters.incr(server.cfg.uid, "dropped_sends")
             elif isinstance(eff, SendVoteRequests):
                 for to, msg in eff.requests:
                     self.router.send(self.name, to, msg)
             elif isinstance(eff, Reply):
                 if isinstance(eff.to, Future):
                     eff.to.set(eff.msg)
+                elif isinstance(eff.to, tuple) and eff.to and \
+                        eff.to[0] == "rcall":
+                    self.router.reply_remote(eff.to, eff.msg)
                 elif callable(eff.to):
                     eff.to(eff.msg)
             elif isinstance(eff, Notify):
@@ -365,11 +419,15 @@ class RaNode:
                 shell.election_deadline = None
             elif isinstance(eff, (ReleaseCursor, Checkpoint,
                                   PromoteCheckpoint)):
+                if isinstance(eff, ReleaseCursor):
+                    self.counters.incr(server.cfg.uid, "snapshots_written")
                 self._execute(shell, server.handle_machine_effect(eff))
             elif isinstance(eff, SendSnapshot):
                 self._send_snapshot(shell, eff)
             elif isinstance(eff, RecordLeader):
                 self.leaderboard[eff.cluster_name] = (eff.leader, eff.members)
+                self.leaderboard_tab.record(eff.cluster_name, eff.leader,
+                                            eff.members)
             elif isinstance(eff, SendMsg):
                 if isinstance(eff.to, Future):
                     eff.to.set(eff.msg)
@@ -388,9 +446,11 @@ class RaNode:
                     eff.fn(entries)
                 except Exception:
                     logger.exception("log effect failed")
-            elif isinstance(eff, (AuxEffect, GarbageCollection, Monitor,
-                                  TimerEffect)):
-                pass  # aux/monitor machinery lands with the transport layer
+            elif isinstance(eff, AuxEffect):
+                self._execute(shell, server.handle_aux("eval", eff.msg))
+            elif isinstance(eff, (GarbageCollection, Monitor, TimerEffect)):
+                pass  # monitor machinery is subsumed by the failure
+                # detector; machine timers land with the fifo machine
             # unknown machine effects are ignored (forward compat)
 
     def _arm_election(self, shell: ServerShell, kind: str) -> None:

@@ -1,0 +1,385 @@
+"""TCP transport + node failure detection — the cross-host fabric.
+
+Replicates the semantics the reference gets from Erlang distribution +
+aten (SURVEY.md §2.4 'Distributed communication backend'):
+
+* sends are NEVER blocking: each peer has a bounded outbound queue and a
+  sender thread; a full queue or broken/unreachable connection drops the
+  message and counts it (the [noconnect, nosuspend] cast semantics of
+  ra_server_proc.erl:1317-1341 — Raft's pipeline catch-up recovers)
+* per-peer connection status (normal | disconnected) feeds drop decisions
+  and metrics (ra.hrl:329-330 drop counters)
+* a lightweight heartbeat failure detector stands in for aten: every
+  connected peer is pinged on an interval; silence beyond a threshold
+  emits NodeEvent(node, "down") to every local server shell, recovery
+  emits NodeEvent(node, "up") (aten's poll-interval role,
+  ra_server_proc.erl:790-810, 1690-1700)
+* frames are length-prefixed pickles between cluster hosts — the same
+  mutual-trust model as Erlang distribution inside a cluster; do not
+  expose the port beyond it
+
+TcpRouter extends the in-process LocalRouter: ServerIds whose node is
+hosted locally are delivered directly; remote nodes resolve through the
+address book.
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from ..core.types import NodeEvent, ServerId, strip_msg_handles
+from ..node import LocalRouter
+
+logger = logging.getLogger("ra_tpu.transport")
+
+_LEN = struct.Struct("<I")
+FRAME_MSG = 0
+FRAME_PING = 1
+FRAME_HELLO = 2
+FRAME_REPLY = 3
+
+SEND_QUEUE_MAX = 10_000
+MAX_FRAME = 64 * 1024 * 1024  # snapshot chunks are 1MB; generous headroom
+PING_INTERVAL = 0.5
+DOWN_AFTER = 2.0          # silence threshold (aten default poll is 1s)
+CONNECT_TIMEOUT = 1.0
+RECONNECT_BACKOFF = 0.5
+
+
+class _Peer:
+    __slots__ = ("name", "addr", "queue", "sock", "thread", "status",
+                 "last_attempt", "lock", "send_lock")
+
+    def __init__(self, name: str, addr: tuple) -> None:
+        self.name = name
+        self.addr = addr
+        self.queue: "queue.Queue" = queue.Queue(maxsize=SEND_QUEUE_MAX)
+        self.sock: Optional[socket.socket] = None
+        self.thread: Optional[threading.Thread] = None
+        self.status = "disconnected"
+        self.last_attempt = 0.0
+        self.lock = threading.Lock()
+        # serializes sendall between the sender and detector threads: an
+        # interleaved ping inside a partially-sent frame corrupts the stream
+        self.send_lock = threading.Lock()
+
+
+class TcpRouter(LocalRouter):
+    """LocalRouter + TCP reach to remote nodes."""
+
+    def __init__(self, listen_addr: tuple, address_book: dict) -> None:
+        super().__init__()
+        self.listen_addr = listen_addr
+        self.address_book = dict(address_book)  # node name -> (host, port)
+        self.peers: dict[str, _Peer] = {}
+        self.dropped_sends = 0
+        self.last_heard: dict[str, float] = {}
+        self.node_status: dict[str, str] = {}
+        self._stop = False
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(listen_addr)
+        self._server.listen(64)
+        self.listen_addr = self._server.getsockname()
+        # outstanding cross-host client calls: call id -> Future
+        self._calls: dict = {}
+        self._call_seq = 0
+        self._call_lock = threading.Lock()
+        # lazily-created peers keyed by raw address (reply routing)
+        self._addr_peers: dict[tuple, _Peer] = {}
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True,
+                                               name="ra-tcp-accept")
+        self._accept_thread.start()
+        self._detector_thread = threading.Thread(target=self._detector_loop,
+                                                 daemon=True,
+                                                 name="ra-failure-detector")
+        self._detector_thread.start()
+
+    # ------------------------------------------------------------------
+    # send path
+    # ------------------------------------------------------------------
+
+    def send(self, src_node: str, to: ServerId, msg) -> bool:
+        if to.node in self.nodes or (src_node, to.node) in self.blocked:
+            return super().send(src_node, to, msg)
+        peer = self._peer_for(to.node)
+        if peer is None:
+            self.dropped_sends += 1
+            return False
+        try:
+            peer.queue.put_nowait((to, msg))
+        except queue.Full:
+            # nosuspend: never block the Raft loop on a slow connection
+            self.dropped_sends += 1
+            return False
+        self._ensure_sender(peer)
+        return True
+
+    def _peer_for(self, node: str) -> Optional[_Peer]:
+        peer = self.peers.get(node)
+        if peer is None:
+            addr = self.address_book.get(node)
+            if addr is None:
+                return None
+            peer = self.peers.setdefault(node, _Peer(node, tuple(addr)))
+        return peer
+
+    def _ensure_sender(self, peer: _Peer) -> None:
+        with peer.lock:
+            if peer.thread is None or not peer.thread.is_alive():
+                peer.thread = threading.Thread(
+                    target=self._sender_loop, args=(peer,), daemon=True,
+                    name=f"ra-tcp-send-{peer.name}")
+                peer.thread.start()
+
+    def _sender_loop(self, peer: _Peer) -> None:
+        while not self._stop:
+            try:
+                item = peer.queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if not self._send_item(peer, item):
+                # drop the item (and drain cheaply while down: pipeline
+                # catch-up will resend what matters)
+                self.dropped_sends += 1
+
+    def _send_item(self, peer: _Peer, item) -> bool:
+        sock = self._peer_sock(peer)
+        if sock is None:
+            return False
+        to, msg = item
+        try:
+            if to == "__reply__":
+                frame = bytes([FRAME_REPLY]) + pickle.dumps(
+                    msg, protocol=pickle.HIGHEST_PROTOCOL)
+            else:
+                payload = pickle.dumps((to, strip_msg_handles(msg)),
+                                       protocol=pickle.HIGHEST_PROTOCOL)
+                frame = bytes([FRAME_MSG]) + payload
+        except (pickle.PicklingError, TypeError, AttributeError):
+            # per-message failure: drop it, the connection is healthy
+            return False
+        try:
+            with peer.send_lock:
+                sock.sendall(_LEN.pack(len(frame)) + frame)
+            return True
+        except OSError:
+            self._close_peer(peer)
+            return False
+
+    def _peer_sock(self, peer: _Peer) -> Optional[socket.socket]:
+        if peer.sock is not None:
+            return peer.sock
+        now = time.monotonic()
+        if now - peer.last_attempt < RECONNECT_BACKOFF:
+            return None
+        peer.last_attempt = now
+        try:
+            sock = socket.create_connection(peer.addr,
+                                            timeout=CONNECT_TIMEOUT)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            hello = bytes([FRAME_HELLO]) + self._my_name().encode()
+            sock.sendall(_LEN.pack(len(hello)) + hello)
+            peer.sock = sock
+            peer.status = "normal"
+            self._mark_heard(peer.name)
+            return sock
+        except OSError:
+            peer.status = "disconnected"
+            return None
+
+    def _close_peer(self, peer: _Peer) -> None:
+        if peer.sock is not None:
+            try:
+                peer.sock.close()
+            except OSError:
+                pass
+            peer.sock = None
+        peer.status = "disconnected"
+
+    def _my_name(self) -> str:
+        return ",".join(sorted(self.nodes)) or "?"
+
+    # ------------------------------------------------------------------
+    # cross-host client calls (the gen_statem:call-over-dist role)
+    # ------------------------------------------------------------------
+
+    def remote_call(self, target: ServerId, make_event):
+        """Send a client event to a server on a remote node; returns a
+        Future resolved by the FRAME_REPLY, or None when unroutable."""
+        from ..node import Future
+        peer = self._peer_for(target.node)
+        if peer is None:
+            return None
+        with self._call_lock:
+            self._call_seq += 1
+            call_id = self._call_seq
+            fut = Future()
+            self._calls[call_id] = fut
+        handle = ("rcall", tuple(self.listen_addr), call_id)
+        event = make_event(handle)
+        if not self.send("?", target, event):
+            with self._call_lock:
+                self._calls.pop(call_id, None)
+            return None
+        return fut
+
+    def forget_call(self, fut) -> None:
+        with self._call_lock:
+            for cid, f in list(self._calls.items()):
+                if f is fut:
+                    del self._calls[cid]
+
+    def reply_remote(self, handle: tuple, msg) -> None:
+        _tag, origin, call_id = handle
+        origin = tuple(origin)
+        if origin == tuple(self.listen_addr):
+            with self._call_lock:
+                fut = self._calls.pop(call_id, None)
+            if fut is not None:
+                fut.set(msg)
+            return
+        peer = self._addr_peers.get(origin)
+        if peer is None:
+            peer = self._addr_peers.setdefault(
+                origin, _Peer(f"addr:{origin[0]}:{origin[1]}", origin))
+        try:
+            peer.queue.put_nowait(("__reply__", (call_id, msg)))
+        except queue.Full:
+            self.dropped_sends += 1
+            return
+        self._ensure_sender(peer)
+
+    # ------------------------------------------------------------------
+    # receive path
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._recv_loop, args=(conn,),
+                                 daemon=True, name="ra-tcp-recv")
+            t.start()
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        remote_names: list = []  # every node co-hosted behind this conn
+        try:
+            while not self._stop:
+                hdr = self._recv_exact(conn, _LEN.size)
+                if hdr is None:
+                    break
+                (length,) = _LEN.unpack(hdr)
+                if length == 0 or length > MAX_FRAME:
+                    break  # corrupt/hostile header: drop the connection
+                frame = self._recv_exact(conn, length)
+                if frame is None:
+                    break
+                kind = frame[0]
+                if kind == FRAME_MSG:
+                    to, msg = pickle.loads(frame[1:])
+                    for name in remote_names:
+                        self._mark_heard(name)
+                    node = self.nodes.get(to.node)
+                    if node is not None:
+                        node.deliver(to, msg)
+                elif kind == FRAME_REPLY:
+                    call_id, reply = pickle.loads(frame[1:])
+                    with self._call_lock:
+                        fut = self._calls.pop(call_id, None)
+                    if fut is not None:
+                        fut.set(reply)
+                elif kind == FRAME_PING:
+                    for name in remote_names:
+                        self._mark_heard(name)
+                elif kind == FRAME_HELLO:
+                    remote_names = frame[1:].decode().split(",")
+                    for name in remote_names:
+                        self._mark_heard(name)
+        except (OSError, pickle.UnpicklingError, EOFError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # ------------------------------------------------------------------
+    # failure detector (the aten role)
+    # ------------------------------------------------------------------
+
+    def _mark_heard(self, node: str) -> None:
+        self.last_heard[node] = time.monotonic()
+        if self.node_status.get(node) == "down":
+            self.node_status[node] = "up"
+            self._broadcast_node_event(node, "up")
+        else:
+            self.node_status.setdefault(node, "up")
+
+    def _detector_loop(self) -> None:
+        while not self._stop:
+            time.sleep(PING_INTERVAL)
+            now = time.monotonic()
+            # ping every peer we have a live connection to
+            for peer in list(self.peers.values()):
+                sock = peer.sock
+                if sock is not None:
+                    try:
+                        frame = bytes([FRAME_PING])
+                        with peer.send_lock:
+                            sock.sendall(_LEN.pack(len(frame)) + frame)
+                    except OSError:
+                        self._close_peer(peer)
+            # verdicts
+            for node, heard in list(self.last_heard.items()):
+                if node in self.nodes:
+                    continue
+                status = self.node_status.get(node, "up")
+                if status != "down" and now - heard > DOWN_AFTER:
+                    self.node_status[node] = "down"
+                    self._broadcast_node_event(node, "down")
+
+    def _broadcast_node_event(self, node: str, status: str) -> None:
+        evt = NodeEvent(node, status)
+        for ranode in list(self.nodes.values()):
+            for name in list(ranode.shells):
+                ranode.submit(name, evt)
+
+    # ------------------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        for peer in self.peers.values():
+            self._close_peer(peer)
+
+    def overview(self) -> dict:
+        return {
+            "listen": self.listen_addr,
+            "dropped_sends": self.dropped_sends,
+            "peers": {p.name: p.status for p in self.peers.values()},
+            "node_status": dict(self.node_status),
+        }

@@ -1,0 +1,119 @@
+"""Ops-surface tests: aux commands, counters/key_metrics, overview,
+offline debug replay (ra_dbg role)."""
+import time
+
+import pytest
+
+import ra_tpu
+from ra_tpu import LocalRouter, RaNode, RaSystem
+from ra_tpu.core.machine import Machine, SimpleMachine
+from ra_tpu.core.types import ServerConfig, ServerId
+
+
+class AuxCounter(Machine):
+    """Machine with aux state: counts aux evals, answers aux queries."""
+
+    def init(self, config):
+        return 0
+
+    def apply(self, meta, command, state):
+        return state + command, state + command
+
+    def init_aux(self, name):
+        return {"evals": 0}
+
+    def handle_aux(self, raft_state, kind, msg, aux_state, internal):
+        aux = dict(aux_state or {"evals": 0})
+        if msg == "eval":
+            aux["evals"] += 1
+            return aux, []
+        if msg == "get_stats":
+            return aux, [], {"evals": aux["evals"],
+                             "machine": internal.machine_state,
+                             "commit": internal.commit_index}
+        return aux, []
+
+
+@pytest.fixture
+def fabric():
+    router = LocalRouter()
+    nodes = [RaNode(f"o{i}", router=router) for i in (1, 2, 3)]
+    yield router, nodes
+    for n in nodes:
+        n.stop()
+
+
+def ids():
+    return [ServerId(f"a{i+1}", f"o{i+1}") for i in range(3)]
+
+
+def test_aux_command_and_eval(fabric):
+    router, _ = fabric
+    sids = ids()
+    ra_tpu.start_cluster("aux1", AuxCounter, sids, router=router)
+    leader = None
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and leader is None:
+        for s in sids:
+            if ra_tpu.key_metrics(s, router=router)["state"] == "leader":
+                leader = s
+        time.sleep(0.01)
+    ra_tpu.process_command(leader, 4, router=router)
+    res = ra_tpu.aux_command(leader, "get_stats", router=router)
+    assert res["machine"] == 4
+    assert res["commit"] >= 2
+    assert res["evals"] >= 1  # {aux, eval} fired on commit advance
+
+
+def test_counters_and_overview(fabric):
+    router, _ = fabric
+    sids = ids()
+    ra_tpu.start_cluster("aux2", lambda: SimpleMachine(
+        lambda c, s: s + c, 0), sids, router=router)
+    leader = None
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and leader is None:
+        for s in sids:
+            if ra_tpu.key_metrics(s, router=router)["state"] == "leader":
+                leader = s
+        time.sleep(0.01)
+    for _ in range(5):
+        ra_tpu.process_command(leader, 1, router=router)
+    m = ra_tpu.key_metrics(leader, router=router)
+    assert m["counters"]["commands"] >= 5
+    assert m["counters"]["msgs_processed"] > 5
+    ov = ra_tpu.overview(router=router)
+    assert set(ov) == {"o1", "o2", "o3"}
+    mo = ra_tpu.member_overview(leader, router=router)
+    assert mo["raft_state"] == "leader"
+    # leaderboard lock-free lookup
+    node = router.nodes[leader.node]
+    assert node.leaderboard_tab.lookup_leader("aux2") == leader
+
+
+def test_dbg_replay(tmp_path):
+    from ra_tpu.dbg import replay_log
+    router = LocalRouter()
+    system = RaSystem(str(tmp_path))
+    node = RaNode("dbg1", router=router, log_factory=system.log_factory)
+    sid = ServerId("d1", "dbg1")
+    node.start_server(ServerConfig(
+        server_id=sid, uid="uid_dbg", cluster_name="dbgc",
+        initial_members=(sid,),
+        machine=SimpleMachine(lambda c, s: s + c, 0),
+        election_timeout_ms=50, tick_interval_ms=50))
+    ra_tpu.trigger_election(sid, router)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if ra_tpu.key_metrics(sid, router=router)["state"] == "leader":
+            break
+        time.sleep(0.01)
+    for v in range(1, 21):
+        ra_tpu.process_command(sid, v, router=router)
+    time.sleep(0.2)
+    node.stop()
+    system.close()
+    # offline: fold the persisted log through a fresh machine
+    final = replay_log(str(tmp_path), "uid_dbg",
+                       SimpleMachine(lambda c, s: s + c, 0))
+    assert final == 210

@@ -1,0 +1,133 @@
+"""Cross-process TCP transport tests — the coordination_SUITE role: real OS
+processes as nodes, real sockets, leader kill, failure detection."""
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+
+def _worker(node_name, port_map, cmd_q, res_q):
+    """One OS process hosting one RaNode behind a TcpRouter."""
+    import ra_tpu
+    from ra_tpu.core.machine import SimpleMachine
+    from ra_tpu.core.types import ServerConfig, ServerId
+    from ra_tpu.node import RaNode
+    from ra_tpu.transport.tcp import TcpRouter
+
+    my_addr = ("127.0.0.1", port_map[node_name])
+    book = {n: ("127.0.0.1", p) for n, p in port_map.items()
+            if n != node_name}
+    router = TcpRouter(my_addr, book)
+    node = RaNode(node_name, router=router)
+    sids = [ServerId(f"m_{n}", n) for n in sorted(port_map)]
+    me = ServerId(f"m_{node_name}", node_name)
+    node.start_server(ServerConfig(
+        server_id=me, uid=f"uid_{node_name}", cluster_name="tcp",
+        initial_members=tuple(sids),
+        machine=SimpleMachine(lambda c, s: s + c, 0),
+        election_timeout_ms=150, tick_interval_ms=150))
+    while True:
+        cmd = cmd_q.get()
+        if cmd[0] == "stop":
+            res_q.put(("stopped", node_name))
+            return
+        if cmd[0] == "elect":
+            ra_tpu.trigger_election(me, router)
+            res_q.put(("ok",))
+        elif cmd[0] == "command":
+            try:
+                r = ra_tpu.process_command(me, cmd[1], router=router,
+                                           timeout=10.0)
+                res_q.put(("ok", r.reply, str(r.leader)))
+            except Exception as e:
+                res_q.put(("err", repr(e)))
+        elif cmd[0] == "state":
+            sh = node.shells.get(me.name)
+            res_q.put(("ok", sh.server.raft_state.value,
+                       sh.server.machine_state,
+                       sh.server.current_term))
+        elif cmd[0] == "metrics":
+            res_q.put(("ok", ra_tpu.key_metrics(me, router=router)))
+
+
+@pytest.fixture
+def procs():
+    import socket
+    ctx = mp.get_context("spawn")
+    names = ["tn1", "tn2", "tn3"]
+    ports = {}
+    socks = []
+    for n in names:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports[n] = s.getsockname()[1]
+        socks.append(s)
+    for s in socks:
+        s.close()
+    chans = {}
+    workers = {}
+    for n in names:
+        cq, rq = ctx.Queue(), ctx.Queue()
+        p = ctx.Process(target=_worker, args=(n, ports, cq, rq),
+                        daemon=True)
+        p.start()
+        chans[n] = (cq, rq)
+        workers[n] = p
+    time.sleep(0.5)  # listeners up
+    yield names, chans, workers
+    for n, p in workers.items():
+        if p.is_alive():
+            chans[n][0].put(("stop",))
+    time.sleep(0.3)
+    for p in workers.values():
+        if p.is_alive():
+            p.terminate()
+
+
+def _ask(chans, n, *cmd, timeout=15):
+    cq, rq = chans[n]
+    cq.put(cmd)
+    return rq.get(timeout=timeout)
+
+
+def test_cross_process_cluster(procs):
+    names, chans, workers = procs
+    _ask(chans, "tn1", "elect")
+    # committed via TCP across 3 OS processes
+    r = _ask(chans, "tn1", "command", 5)
+    assert r[0] == "ok" and r[1] == 5, r
+    r = _ask(chans, "tn2", "command", 7)  # redirect over TCP
+    assert r[0] == "ok" and r[1] == 12, r
+    # replicas converge
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        states = [_ask(chans, n, "state") for n in names]
+        if all(s[2] == 12 for s in states):
+            break
+        time.sleep(0.1)
+    assert all(s[2] == 12 for s in states), states
+
+
+def test_leader_process_kill_failover(procs):
+    names, chans, workers = procs
+    _ask(chans, "tn1", "elect")
+    r = _ask(chans, "tn1", "command", 1)
+    assert r[0] == "ok"
+    leader_node = r[2].split("@")[1]
+    # SIGKILL the leader's OS process: detector + election timers recover
+    workers[leader_node].terminate()
+    rest = [n for n in names if n != leader_node]
+    deadline = time.monotonic() + 20
+    got = None
+    while time.monotonic() < deadline:
+        r = _ask(chans, rest[0], "command", 2, timeout=20)
+        if r[0] == "ok":
+            got = r
+            break
+        time.sleep(0.2)
+    assert got is not None and got[1] == 3, got
+    assert got[2].split("@")[1] != leader_node
