@@ -463,6 +463,11 @@ class RaServer:
         effects.append(RecordLeader(self.cfg.cluster_name, self.id,
                                     tuple(self.cluster)))
         effects.append(CancelElectionTimeout())
+        # machine state_enter(leader) — re-establishes machine monitors
+        # after failover (ra_server_proc state_enter effects; ra_machine
+        # state_enter/2)
+        effects.extend(self.effective_machine.state_enter(
+            "leader", self.machine_state) or [])
         return effects
 
     # ------------------------------------------------------------------
@@ -516,6 +521,16 @@ class RaServer:
         if isinstance(event, TransferLeadershipEvent):
             # try_become_leader arrives at the transfer target as this event
             return self._call_for_election_pre_vote()
+        if isinstance(event, CommandsEvent):
+            # relay pipelined batches to the leader (the reference's
+            # follower cast-forwarding, ra_server_proc.erl:822-849)
+            if self.leader_id is not None and self.leader_id != self.id:
+                return [SendRpc(self.leader_id, event)]
+            return []
+        if isinstance(event, CommandEvent) and event.from_ is None:
+            if self.leader_id is not None and self.leader_id != self.id:
+                return [SendRpc(self.leader_id, event)]
+            return []
         if isinstance(event, (CommandEvent, ConsistentQueryEvent)):
             return []  # from_-carrying events answered by _dispatch fallback
         if isinstance(event, NodeEvent):

@@ -1,1 +1,7 @@
 from .counter import CounterMachine
+from .fifo import FifoMachine
+from .fifo_client import FifoClient, Mailbox
+from .queue import QueueMachine
+
+__all__ = ["CounterMachine", "FifoMachine", "FifoClient", "Mailbox",
+           "QueueMachine"]

@@ -39,6 +39,7 @@ from .core.types import (
     CommandResult,
     CommandsEvent,
     ConsistentQueryEvent,
+    Demonitor,
     ElectionTimeout,
     ErrorResult,
     ForceElectionEvent,
@@ -146,6 +147,11 @@ class LocalRouter:
         """Route a reply for a remote call handle (TcpRouter overrides)."""
         return None
 
+    def notify_remote(self, handle: tuple, correlations: Any) -> None:
+        """Route an applied-notification for a remote-notify handle
+        (TcpRouter overrides)."""
+        return None
+
 
 #: default in-process fabric (tests may build private ones)
 DEFAULT_ROUTER = LocalRouter()
@@ -159,6 +165,8 @@ class ServerShell:
         self.node = node
         self.inbox: deque = deque()
         self.low_queue: deque = deque()  # low-priority commands awaiting flush
+        # pids the machine asked to monitor (ra_monitors component=machine)
+        self.machine_monitors: set = set()
         self.election_deadline: Optional[float] = None
         self.tick_deadline: float = time.monotonic() + \
             server.cfg.tick_interval_ms / 1000.0
@@ -245,6 +253,20 @@ class RaNode:
         for other in list(self.shells.values()):
             if not other.stopped:
                 other.inbox.append(DownEvent(dead))
+        self._wake.set()
+
+    def process_down(self, pid: Any, reason: Any = "normal") -> None:
+        """Report death of a machine-monitored external process.  Members
+        monitoring ``pid`` get a ``("down", pid, reason)`` builtin command
+        (ra_server:handle_down machine branch).  In practice only the
+        current leader holds machine monitors — followers filter machine
+        Monitor effects and a demoted leader clears its set — so exactly
+        one member appends the command."""
+        for shell in list(self.shells.values()):
+            if not shell.stopped and pid in shell.machine_monitors:
+                shell.machine_monitors.discard(pid)
+                shell.inbox.append(CommandEvent(
+                    UserCommand(("down", pid, reason)), from_=None))
         self._wake.set()
 
     def stop(self) -> None:
@@ -367,6 +389,12 @@ class RaNode:
         effects = server.handle(event)
         state_after = server.raft_state
         if state_after != state_before:
+            if state_before == RaftState.LEADER:
+                # machine monitors are a leader responsibility; the new
+                # leader re-establishes them via state_enter(leader), and a
+                # stale set here would make this ex-leader relay duplicate
+                # ('down', ...) commands
+                shell.machine_monitors.clear()
             if state_after == RaftState.PRE_VOTE:
                 c.incr(key, "pre_vote_elections")
             elif state_after == RaftState.CANDIDATE:
@@ -411,6 +439,9 @@ class RaNode:
             elif isinstance(eff, Notify):
                 if isinstance(eff.to, Future):
                     eff.to.set(eff.correlations)
+                elif isinstance(eff.to, tuple) and eff.to and \
+                        eff.to[0] == "rnotify":
+                    self.router.notify_remote(eff.to, eff.correlations)
                 elif callable(eff.to):
                     eff.to(eff.correlations)
             elif isinstance(eff, StartElectionTimeout):
@@ -448,9 +479,15 @@ class RaNode:
                     logger.exception("log effect failed")
             elif isinstance(eff, AuxEffect):
                 self._execute(shell, server.handle_aux("eval", eff.msg))
-            elif isinstance(eff, (GarbageCollection, Monitor, TimerEffect)):
-                pass  # monitor machinery is subsumed by the failure
-                # detector; machine timers land with the fifo machine
+            elif isinstance(eff, Monitor):
+                if eff.component == "machine" and eff.kind == "process":
+                    shell.machine_monitors.add(eff.target)
+                # node/peer monitoring is subsumed by the failure detector
+            elif isinstance(eff, Demonitor):
+                if eff.component == "machine" and eff.kind == "process":
+                    shell.machine_monitors.discard(eff.target)
+            elif isinstance(eff, (GarbageCollection, TimerEffect)):
+                pass  # machine timers: not yet surfaced to machines
             # unknown machine effects are ignored (forward compat)
 
     def _arm_election(self, shell: ServerShell, kind: str) -> None:

@@ -31,9 +31,18 @@ import socket
 import struct
 import threading
 import time
+import uuid
+from collections import OrderedDict
+from dataclasses import replace as _dc_replace
 from typing import Optional
 
-from ..core.types import NodeEvent, ServerId, strip_msg_handles
+from ..core.types import (
+    CommandEvent,
+    CommandsEvent,
+    NodeEvent,
+    ServerId,
+    strip_msg_handles,
+)
 from ..node import LocalRouter
 
 logger = logging.getLogger("ra_tpu.transport")
@@ -43,6 +52,7 @@ FRAME_MSG = 0
 FRAME_PING = 1
 FRAME_HELLO = 2
 FRAME_REPLY = 3
+FRAME_NOTIFY = 4
 
 SEND_QUEUE_MAX = 10_000
 MAX_FRAME = 64 * 1024 * 1024  # snapshot chunks are 1MB; generous headroom
@@ -91,6 +101,18 @@ class TcpRouter(LocalRouter):
         self._calls: dict = {}
         self._call_seq = 0
         self._call_lock = threading.Lock()
+        # durable applied-notification sinks for pipelined commands that
+        # cross hosts: nid -> callable, id(callable) -> nid.  Unlike
+        # _calls these are multi-shot (one client receives many Notify
+        # batches), so they persist; an LRU cap bounds them when callers
+        # pass a fresh callable per command instead of reusing a sink
+        self._notify_handles: OrderedDict = OrderedDict()
+        self._notify_ids: dict = {}
+        self._notify_seq = 0
+        # distinguishes this router in rnotify handles: bind-address
+        # equality is unreliable under wildcard binds (0.0.0.0 on every
+        # host would alias all routers)
+        self._router_id = uuid.uuid4().hex[:12]
         # lazily-created peers keyed by raw address (reply routing)
         self._addr_peers: dict[tuple, _Peer] = {}
         self._accept_thread = threading.Thread(target=self._accept_loop,
@@ -114,13 +136,50 @@ class TcpRouter(LocalRouter):
             self.dropped_sends += 1
             return False
         try:
-            peer.queue.put_nowait((to, msg))
+            peer.queue.put_nowait((to, self._rewrite_for_wire(msg)))
         except queue.Full:
             # nosuspend: never block the Raft loop on a slow connection
             self.dropped_sends += 1
             return False
         self._ensure_sender(peer)
         return True
+
+    def _rewrite_for_wire(self, msg):
+        """Relayed command events carry local ack sinks (notify_to
+        callables); swap them for ('rnotify', addr, id) handles so
+        applied-notifications route back across hosts instead of landing
+        on an orphan unpickled copy."""
+        if isinstance(msg, CommandsEvent):
+            return CommandsEvent(tuple(self._rewrite_cmd(c)
+                                       for c in msg.commands))
+        if isinstance(msg, CommandEvent):
+            return _dc_replace(msg, command=self._rewrite_cmd(msg.command))
+        return msg
+
+    def _rewrite_cmd(self, cmd):
+        nt = getattr(cmd, "notify_to", None)
+        if nt is not None and callable(nt):
+            handle = ("rnotify", tuple(self.listen_addr), self._router_id,
+                      self._notify_id(nt))
+            return _dc_replace(cmd, notify_to=handle)
+        return cmd
+
+    NOTIFY_SINK_MAX = 4096
+
+    def _notify_id(self, fn) -> int:
+        with self._call_lock:
+            nid = self._notify_ids.get(id(fn))
+            if nid is None:
+                self._notify_seq += 1
+                nid = self._notify_seq
+                self._notify_ids[id(fn)] = nid
+                self._notify_handles[nid] = fn
+                while len(self._notify_handles) > self.NOTIFY_SINK_MAX:
+                    old_nid, old_fn = self._notify_handles.popitem(last=False)
+                    self._notify_ids.pop(id(old_fn), None)
+            else:
+                self._notify_handles.move_to_end(nid)
+            return nid
 
     def _peer_for(self, node: str) -> Optional[_Peer]:
         peer = self.peers.get(node)
@@ -158,6 +217,9 @@ class TcpRouter(LocalRouter):
         try:
             if to == "__reply__":
                 frame = bytes([FRAME_REPLY]) + pickle.dumps(
+                    msg, protocol=pickle.HIGHEST_PROTOCOL)
+            elif to == "__notify__":
+                frame = bytes([FRAME_NOTIFY]) + pickle.dumps(
                     msg, protocol=pickle.HIGHEST_PROTOCOL)
             else:
                 payload = pickle.dumps((to, strip_msg_handles(msg)),
@@ -257,6 +319,27 @@ class TcpRouter(LocalRouter):
             return
         self._ensure_sender(peer)
 
+    def notify_remote(self, handle: tuple, correlations) -> None:
+        """Route an applied-notification batch back to the host that
+        registered the sink (see _rewrite_cmd)."""
+        _tag, origin, router_id, nid = handle
+        origin = tuple(origin)
+        if router_id == self._router_id:
+            fn = self._notify_handles.get(nid)
+            if fn is not None:
+                fn(correlations)
+            return
+        peer = self._addr_peers.get(origin)
+        if peer is None:
+            peer = self._addr_peers.setdefault(
+                origin, _Peer(f"addr:{origin[0]}:{origin[1]}", origin))
+        try:
+            peer.queue.put_nowait(("__notify__", (nid, correlations)))
+        except queue.Full:
+            self.dropped_sends += 1
+            return
+        self._ensure_sender(peer)
+
     # ------------------------------------------------------------------
     # receive path
     # ------------------------------------------------------------------
@@ -299,6 +382,11 @@ class TcpRouter(LocalRouter):
                         fut = self._calls.pop(call_id, None)
                     if fut is not None:
                         fut.set(reply)
+                elif kind == FRAME_NOTIFY:
+                    nid, correlations = pickle.loads(frame[1:])
+                    fn = self._notify_handles.get(nid)
+                    if fn is not None:
+                        fn(correlations)
                 elif kind == FRAME_PING:
                     for name in remote_names:
                         self._mark_heard(name)

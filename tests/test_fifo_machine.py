@@ -1,0 +1,356 @@
+"""FIFO machine tests — the capability proof (reference: test/ra_fifo.erl
+driven by ra_fifo_SUITE scenarios + ra_machine_int_SUITE effect tests).
+
+Part 1 drives FifoMachine.apply directly (pure data-in/data-out, the
+mocked-log style of ra_server_SUITE); part 2 runs it on a live 3-member
+cluster through FifoClient, including monitor-driven consumer death and
+release-cursor-driven log truncation."""
+import time
+
+import pytest
+
+import ra_tpu
+from ra_tpu.core.machine import ApplyMeta
+from ra_tpu.core.types import Monitor, ReleaseCursor, SendMsg, ServerId
+from ra_tpu.models import FifoClient, FifoMachine, Mailbox
+from ra_tpu.models.fifo import (
+    query_consumer_count,
+    query_messages_checked_out,
+    query_messages_ready,
+)
+from ra_tpu.node import LocalRouter, RaNode
+
+
+# ---------------------------------------------------------------------------
+# part 1: pure apply
+# ---------------------------------------------------------------------------
+
+class Driver:
+    """Applies commands with auto-incrementing raft indexes."""
+
+    def __init__(self, machine=None):
+        self.m = machine or FifoMachine()
+        self.state = self.m.init({"name": "q"})
+        self.idx = 0
+        self.effects = []
+
+    def apply(self, cmd):
+        self.idx += 1
+        st, reply, effs = self.m.apply(ApplyMeta(self.idx, 1), cmd,
+                                       self.state)
+        self.state = st
+        self.effects.extend(effs)
+        return reply
+
+    def deliveries(self, pid):
+        out = []
+        for e in self.effects:
+            if isinstance(e, SendMsg) and e.to is pid and \
+                    e.msg[0] == "delivery":
+                out.extend(e.msg[2])
+        return out
+
+
+def test_enqueue_dedup_and_ordering():
+    d = Driver()
+    enq = Mailbox("e1")
+    d.apply(("enqueue", enq, 1, "a"))
+    d.apply(("enqueue", enq, 1, "a"))      # duplicate: dropped
+    d.apply(("enqueue", enq, 3, "c"))      # gap: held pending
+    assert query_messages_ready(d.state) == 1
+    d.apply(("enqueue", enq, 2, "b"))      # fills gap, releases both
+    assert query_messages_ready(d.state) == 3
+    order = [raw for (_i, _h, raw) in d.state.messages.values()]
+    assert order == ["a", "b", "c"]
+    # first contact monitors the enqueuer
+    assert any(isinstance(e, Monitor) and e.target is enq
+               for e in d.effects)
+
+
+def test_checkout_auto_delivers_and_settle_frees_credit():
+    d = Driver()
+    con = Mailbox("c1")
+    d.apply(("enqueue", None, None, "m1"))
+    d.apply(("enqueue", None, None, "m2"))
+    d.apply(("enqueue", None, None, "m3"))
+    d.apply(("checkout", ("auto", 2), ("t", con)))
+    got = d.deliveries(con)
+    assert [raw for (_id, _h, raw) in got] == ["m1", "m2"]  # credit caps at 2
+    assert query_messages_checked_out(d.state) == 2
+    assert query_messages_ready(d.state) == 1
+    d.apply(("settle", (got[0][0],), ("t", con)))
+    got2 = d.deliveries(con)
+    assert [raw for (_id, _h, raw) in got2][-1] == "m3"  # freed credit refills
+    assert query_messages_ready(d.state) == 0
+
+
+def test_return_redelivers_with_delivery_count():
+    d = Driver()
+    con = Mailbox("c1")
+    d.apply(("enqueue", None, None, "m1"))
+    d.apply(("checkout", ("auto", 1), ("t", con)))
+    (mid, header, raw) = d.deliveries(con)[0]
+    assert header["delivery_count"] == 0
+    d.apply(("return", (mid,), ("t", con)))
+    redelivered = d.deliveries(con)[-1]
+    assert redelivered[2] == "m1"
+    assert redelivered[1]["delivery_count"] == 1
+
+
+def test_returned_messages_keep_fifo_order():
+    d = Driver()
+    con = Mailbox("c1")
+    d.apply(("enqueue", None, None, "m1"))
+    d.apply(("enqueue", None, None, "m2"))
+    d.apply(("checkout", ("auto", 1), ("t", con)))
+    (mid, _h, raw) = d.deliveries(con)[0]
+    assert raw == "m1"
+    d.apply(("return", (mid,), ("t", con)))
+    # m1 must come back before m2
+    assert d.deliveries(con)[-1][2] == "m1"
+
+
+def test_discard_and_purge():
+    d = Driver()
+    con = Mailbox("c1")
+    d.apply(("enqueue", None, None, "m1"))
+    d.apply(("enqueue", None, None, "m2"))
+    d.apply(("checkout", ("auto", 1), ("t", con)))
+    (mid, _h, _r) = d.deliveries(con)[0]
+    d.apply(("discard", (mid,), ("t", con)))
+    assert query_messages_checked_out(d.state) == 1  # m2 auto-delivered
+    reply = d.apply(("purge",))
+    assert reply == ("purge", 0)  # all ready msgs were checked out
+    d.apply(("enqueue", None, None, "m3"))
+    d.apply(("checkout", "cancel", ("t", con)))
+    reply = d.apply(("purge",))
+    assert reply[0] == "purge" and reply[1] >= 1
+
+
+def test_dequeue_modes():
+    d = Driver()
+    con = Mailbox("c1")
+    assert d.apply(("checkout", ("dequeue", "settled"),
+                    ("t", con))) == ("dequeue", "empty")
+    d.apply(("enqueue", None, None, "m1"))
+    d.apply(("enqueue", None, None, "m2"))
+    kind, (header, raw) = d.apply(("checkout", ("dequeue", "settled"),
+                                   ("t", con)))
+    assert (kind, raw) == ("dequeue", "m1")
+    kind, (msg_id, header, raw) = d.apply(
+        ("checkout", ("dequeue", "unsettled"), ("t", con)))
+    assert raw == "m2"
+    assert query_messages_checked_out(d.state) == 1
+    d.apply(("settle", (msg_id,), ("t", con)))
+    assert query_messages_checked_out(d.state) == 0
+
+
+def test_consumer_down_requeues_messages():
+    d = Driver()
+    c1, c2 = Mailbox("c1"), Mailbox("c2")
+    d.apply(("enqueue", None, None, "m1"))
+    d.apply(("checkout", ("auto", 5), ("t1", c1)))
+    assert len(d.deliveries(c1)) == 1
+    d.apply(("down", c1, "killed"))
+    assert query_consumer_count(d.state) == 0
+    assert query_messages_ready(d.state) == 1      # requeued
+    d.apply(("checkout", ("auto", 5), ("t2", c2)))
+    re = d.deliveries(c2)[0]
+    assert re[2] == "m1" and re[1]["delivery_count"] == 1
+
+
+def test_noconnection_suspects_then_nodeup_restores():
+    d = Driver()
+    con = Mailbox("c1", node="nodeB")
+    d.apply(("enqueue", None, None, "m1"))
+    d.apply(("checkout", ("auto", 5), ("t", con)))
+    d.apply(("settle", (d.deliveries(con)[0][0],), ("t", con)))
+    d.apply(("down", con, "noconnection"))
+    d.apply(("enqueue", None, None, "m2"))
+    # suspected consumer must not receive deliveries
+    assert len(d.deliveries(con)) == 1
+    assert query_messages_ready(d.state) == 1
+    d.apply(("nodeup", "nodeB"))
+    assert d.deliveries(con)[-1][2] == "m2"
+
+
+def test_release_cursor_on_drain_and_interval():
+    d = Driver(FifoMachine(shadow_copy_interval=10))
+    con = Mailbox("c1")
+    d.apply(("enqueue", None, None, "m1"))
+    d.apply(("checkout", ("auto", 5), ("t", con)))
+    d.apply(("settle", (d.deliveries(con)[0][0],), ("t", con)))
+    drains = [e for e in d.effects if isinstance(e, ReleaseCursor)]
+    assert drains and drains[-1].index == d.idx   # drained => cursor
+    d.effects.clear()
+    for i in range(12):
+        d.apply(("enqueue", None, None, f"x{i}"))
+    assert any(isinstance(e, ReleaseCursor) for e in d.effects)
+    # snapshot state must be detached from live state
+    snap = [e for e in d.effects if isinstance(e, ReleaseCursor)][-1]
+    before = query_messages_ready(snap.machine_state)
+    d.apply(("enqueue", None, None, "y"))
+    assert query_messages_ready(snap.machine_state) == before
+
+
+# ---------------------------------------------------------------------------
+# part 2: live cluster
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fabric():
+    router = LocalRouter()
+    nodes = [RaNode(f"n{i}", router=router) for i in (1, 2, 3)]
+    yield router, nodes
+    for n in nodes:
+        n.stop()
+
+
+def ids(n=3):
+    return [ServerId(f"f{i+1}", f"n{i+1}") for i in range(n)]
+
+
+def await_leader(router, sids, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for sid in sids:
+            node = router.nodes.get(sid.node)
+            shell = node.shells.get(sid.name) if node else None
+            if shell and shell.server.raft_state.value == "leader":
+                return sid
+        time.sleep(0.01)
+    raise TimeoutError("no leader elected")
+
+
+def test_fifo_end_to_end(fabric):
+    router, _ = fabric
+    sids = ids()
+    ra_tpu.start_cluster("fifo-e2e", lambda: FifoMachine(), sids,
+                         router=router)
+    leader = await_leader(router, sids)
+    client = FifoClient(sids, router=router, tag="w1")
+    client.checkout("auto", credit=50)
+    for i in range(30):
+        client.enqueue(f"msg-{i}")
+    client.flush(timeout=10.0)
+    deadline = time.monotonic() + 5.0
+    while len(client.deliveries) < 30 and time.monotonic() < deadline:
+        client.poll_deliveries()
+        time.sleep(0.02)
+    assert [raw for (_i, _h, raw) in client.deliveries] == \
+        [f"msg-{i}" for i in range(30)]
+    client.settle([i for (i, _h, _r) in client.deliveries])
+    res = ra_tpu.leader_query(leader, query_messages_checked_out,
+                              router=router)
+    assert res.reply == 0
+
+
+def test_fifo_consumer_death_redelivers(fabric):
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("fifo-death", lambda: FifoMachine(), sids,
+                         router=router)
+    leader = await_leader(router, sids)
+    c1 = FifoClient(sids, router=router, tag="dead")
+    c2 = FifoClient(sids, router=router, tag="alive")
+    c1.checkout("auto", credit=10)
+    for i in range(5):
+        c1.enqueue_sync(i)
+    deadline = time.monotonic() + 5.0
+    while len(c1.deliveries) < 5 and time.monotonic() < deadline:
+        c1.poll_deliveries()
+        time.sleep(0.02)
+    assert len(c1.deliveries) == 5
+    # kill consumer 1: the leader's node reports the monitored pid down
+    for node in nodes:
+        node.process_down(c1.mailbox, "killed")
+    c2.checkout("auto", credit=10)
+    deadline = time.monotonic() + 5.0
+    while len(c2.deliveries) < 5 and time.monotonic() < deadline:
+        c2.poll_deliveries()
+        time.sleep(0.02)
+    assert sorted(r for (_i, _h, r) in c2.deliveries) == [0, 1, 2, 3, 4]
+    assert all(h["delivery_count"] == 1 for (_i, h, _r) in c2.deliveries)
+
+
+def test_fifo_release_cursor_truncates_log(fabric):
+    router, _ = fabric
+    sids = ids()
+    ra_tpu.start_cluster("fifo-rc",
+                         lambda: FifoMachine(shadow_copy_interval=8),
+                         sids, router=router)
+    leader = await_leader(router, sids)
+    client = FifoClient(sids, router=router, tag="rc")
+    for i in range(40):
+        client.enqueue(i)
+    client.flush(timeout=10.0)
+    # drain the queue so the cursor lands
+    client.checkout("auto", credit=64)
+    deadline = time.monotonic() + 5.0
+    while len(client.deliveries) < 40 and time.monotonic() < deadline:
+        client.poll_deliveries()
+        time.sleep(0.02)
+    client.settle([i for (i, _h, _r) in client.deliveries])
+    deadline = time.monotonic() + 5.0
+    node = router.nodes[leader.node]
+    while time.monotonic() < deadline:
+        log = node.shells[leader.name].server.log
+        if log.first_index() > 1:
+            break
+        time.sleep(0.05)
+    assert node.shells[leader.name].server.log.first_index() > 1
+
+
+def test_fifo_cross_host_pipeline_acks():
+    """Three single-node hosts over real TCP.  A client co-hosted with a
+    FOLLOWER pipelines enqueues: the follower must relay the batch to the
+    leader, applied-notifications must route back across hosts (rnotify),
+    and seqno dedup must survive the pickle boundary — resends may commit
+    twice on the wire but must apply once."""
+    import socket
+
+    from ra_tpu import api
+    from ra_tpu.transport.tcp import TcpRouter
+
+    names = ("h1", "h2", "h3")
+    ports, socks = {}, []
+    for n in names:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports[n] = s.getsockname()[1]
+        socks.append(s)
+    for s in socks:
+        s.close()
+    routers, nodes = {}, {}
+    for n in names:
+        book = {m: ("127.0.0.1", ports[m]) for m in names if m != n}
+        routers[n] = TcpRouter(("127.0.0.1", ports[n]), book)
+        nodes[n] = RaNode(n, router=routers[n])
+    sids = {n: ServerId(f"q_{n}", n) for n in names}
+    try:
+        for n in names:
+            api.start_server("xq", lambda: FifoMachine(), sids[n],
+                             list(sids.values()), router=routers[n])
+        ra_tpu.trigger_election(sids["h1"], routers["h1"])
+        leader_host = None
+        deadline = time.monotonic() + 10.0
+        while leader_host is None and time.monotonic() < deadline:
+            for n in names:
+                sh = nodes[n].shells.get(sids[n].name)
+                if sh and sh.server.raft_state.value == "leader":
+                    leader_host = n
+            time.sleep(0.02)
+        assert leader_host, "no leader over TCP"
+        follower_host = next(n for n in names if n != leader_host)
+        client = FifoClient([sids[follower_host]],
+                            router=routers[follower_host], tag="xh")
+        for i in range(10):
+            client.enqueue(i)
+        client.flush(timeout=20.0)
+        res = ra_tpu.leader_query(sids[leader_host], query_messages_ready,
+                                  router=routers[leader_host])
+        assert res.reply == 10  # exactly once despite any resends
+    finally:
+        for n in names:
+            nodes[n].stop()
+            routers[n].stop()
