@@ -1,0 +1,105 @@
+"""Scripted fault scheduler for partition/nemesis tests.
+
+The reference drives its partition suite with ``test/nemesis.erl``: a
+small interpreter over fault scripts — ``{part, Nodes, Time}`` blocks
+traffic between a chosen split for a while, ``heal`` removes all blocks,
+``{app_restart, Servers}`` stops and restarts ra servers mid-workload,
+``{wait, Time}`` paces the schedule (nemesis.erl:29-35,100-126).  The
+transport hook there is the inet_tcp_proxy dist carrier; here it is
+LocalRouter.block/heal, which the node runtime consults on every send —
+the same "links silently drop" failure model.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Iterable, Optional
+
+from ra_tpu.core.types import ServerId
+from ra_tpu.node import LocalRouter, RaNode
+
+
+class Nemesis:
+    """Interprets fault schedules against a router + set of RaNodes."""
+
+    def __init__(self, router: LocalRouter, nodes: Iterable[RaNode],
+                 seed: int = 0) -> None:
+        self.router = router
+        self.nodes = {n.name: n for n in nodes}
+        self.rng = random.Random(seed)
+        self.history: list = []
+
+    # -- schedule interpreter ----------------------------------------------
+
+    def run(self, schedule: Iterable[tuple]) -> None:
+        for step in schedule:
+            self.history.append(step)
+            op, args = step[0], step[1:]
+            getattr(self, f"_op_{op}")(*args)
+
+    def _op_wait(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+    def _op_heal(self) -> None:
+        self.router.heal()
+
+    def _op_part(self, split: tuple, seconds: float) -> None:
+        """Block every link crossing the (group_a, group_b) split for
+        ``seconds``, then unblock exactly those links ({part, Nodes,
+        Time}) — blocks installed outside this op are left alone so
+        partitions compose."""
+        group_a, group_b = split
+        pairs = [(a, b) for a in group_a for b in group_b]
+        for a, b in pairs:
+            self.router.block(a, b)
+        time.sleep(seconds)
+        for a, b in pairs:
+            self.router.blocked.discard((a, b))
+            self.router.blocked.discard((b, a))
+
+    def _op_part_random(self, seconds: float) -> None:
+        """Random minority/majority split (the reference nemesis picks
+        random node subsets)."""
+        names = list(self.nodes)
+        self.rng.shuffle(names)
+        cut = self.rng.randint(1, (len(names) - 1) // 2)
+        self._op_part((names[:cut], names[cut:]), seconds)
+
+    def _op_part_leader(self, leader_node: str, seconds: float) -> None:
+        """Partition the given node into a minority island."""
+        others = [n for n in self.nodes if n != leader_node]
+        self._op_part(([leader_node], others), seconds)
+
+    def _op_app_restart(self, servers: Iterable[ServerId]) -> None:
+        """Stop and restart ra servers in place ({app_restart, Servers})."""
+        for sid in servers:
+            node = self.nodes.get(sid.node)
+            if node is not None and sid.name in node.shells:
+                node.restart_server(sid.name)
+
+    def _op_kill(self, servers: Iterable[ServerId]) -> None:
+        for sid in servers:
+            node = self.nodes.get(sid.node)
+            if node is not None and sid.name in node.shells:
+                node.kill_server(sid.name)
+
+
+def current_leader(router: LocalRouter,
+                   sids: Iterable[ServerId]) -> Optional[ServerId]:
+    for sid in sids:
+        node = router.nodes.get(sid.node)
+        shell = node.shells.get(sid.name) if node else None
+        if shell and shell.server.raft_state.value == "leader":
+            return sid
+    return None
+
+
+def await_leader(router: LocalRouter, sids: list,
+                 timeout: float = 10.0) -> ServerId:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = current_leader(router, sids)
+        if got is not None:
+            return got
+        time.sleep(0.01)
+    raise TimeoutError("no leader elected")

@@ -210,16 +210,7 @@ def ids(n=3):
     return [ServerId(f"f{i+1}", f"n{i+1}") for i in range(n)]
 
 
-def await_leader(router, sids, timeout=5.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        for sid in sids:
-            node = router.nodes.get(sid.node)
-            shell = node.shells.get(sid.name) if node else None
-            if shell and shell.server.raft_state.value == "leader":
-                return sid
-        time.sleep(0.01)
-    raise TimeoutError("no leader elected")
+from nemesis import await_leader  # noqa: E402  (shared helper)
 
 
 def test_fifo_end_to_end(fabric):

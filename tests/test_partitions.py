@@ -1,0 +1,249 @@
+"""Partition/nemesis suite — the reference's partitions_SUITE.erl run
+against the in-process fabric: a 5-member fifo cluster under scripted
+faults (partitions, heals, server restarts) with a continuous enqueuer
+workload, asserting **no message loss and no duplicate applies** once the
+cluster heals (partitions_SUITE.erl:29-57 + nemesis scripts).
+
+The failure model matches the reference's inet_tcp_proxy carrier: links
+silently drop in both directions while blocked; processes keep running.
+"""
+import threading
+import time
+
+import pytest
+
+import ra_tpu
+from ra_tpu.core.types import ServerId
+from ra_tpu.models import FifoClient, FifoMachine
+from ra_tpu.node import LocalRouter, RaNode
+
+from nemesis import Nemesis, await_leader
+
+N_MEMBERS = 5
+
+
+@pytest.fixture
+def fabric():
+    router = LocalRouter()
+    nodes = [RaNode(f"pn{i}", router=router) for i in range(1, N_MEMBERS + 1)]
+    yield router, nodes
+    router.heal()
+    for n in nodes:
+        n.stop()
+
+
+def ids():
+    return [ServerId(f"p{i}", f"pn{i}") for i in range(1, N_MEMBERS + 1)]
+
+
+class Enqueuer:
+    """Continuous pipelined-enqueue workload (test/enqueuer.erl): keeps
+    enqueueing unique payloads until stopped; never gives up on a message
+    — the client resends unacknowledged seqnos after leader changes."""
+
+    def __init__(self, sids, router, tag="enq"):
+        self.client = FifoClient(sids, router=router, tag=tag)
+        self.sent: list = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        i = 0
+        while not self._stop.is_set():
+            payload = f"{self.client.tag}-{i}"
+            self.client.enqueue(payload)
+            self.sent.append(payload)
+            i += 1
+            # periodic resend keeps progress through leader changes
+            if i % 25 == 0:
+                self.client.resend()
+            time.sleep(0.005)
+
+    def stop_and_flush(self, timeout=60.0):
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        self.client.flush(timeout=timeout)
+        return self.sent
+
+
+def drain_all(sids, router, expect, timeout=30.0):
+    """Dequeue until the queue is empty; returns the list of raw payloads
+    (settled dequeues, so every message is consumed exactly once)."""
+    client = FifoClient(sids, router=router, tag="drain")
+    got = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        res = client.dequeue(settled=True)
+        if res == ("dequeue", "empty"):
+            if len(got) >= expect:
+                break
+            time.sleep(0.1)
+            continue
+        kind, (_header, raw) = res
+        assert kind == "dequeue"
+        got.append(raw)
+    return got
+
+
+def test_enq_drain_minority_partitioned_leader(fabric):
+    """Partition the leader into a minority mid-stream: a new leader must
+    emerge in the majority, the enqueuer must keep committing against it
+    while the partition holds, and after heal every message must be
+    present exactly once."""
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("part-q1", lambda: FifoMachine(), sids,
+                         router=router, election_timeout_ms=100)
+    leader = await_leader(router, sids)
+    # steer the enqueuer at the majority side: a client pinned to the
+    # minority leader would just stall for the partition's duration
+    majority = [s for s in sids if s.node != leader.node]
+    enq = Enqueuer(majority, router)
+    enq.start()
+    time.sleep(0.5)
+    for other in majority:
+        router.block(leader.node, other.node)
+    # a majority-side leader must take over while the partition holds
+    new_leader = await_leader(router, majority, timeout=10.0)
+    assert new_leader != leader
+    acked_at_takeover = len(enq.sent) - enq.client.pending_count()
+    time.sleep(1.5)
+    acked_later = len(enq.sent) - enq.client.pending_count()
+    assert acked_later > acked_at_takeover, \
+        "no commits landed under the majority leader during the partition"
+    router.heal()
+    time.sleep(1.0)
+    sent = enq.stop_and_flush()
+    got = drain_all(sids, router, expect=len(sent))
+    assert sorted(got) == sorted(sent)          # no loss, no duplicates
+
+
+def test_random_partition_schedule(fabric):
+    """Several random partitions back to back (the reference's scripted
+    nemesis): convergence + exactly-once delivery at the end."""
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("part-q2", lambda: FifoMachine(), sids,
+                         router=router, election_timeout_ms=100)
+    await_leader(router, sids)
+    nem = Nemesis(router, nodes, seed=42)
+    enq = Enqueuer(sids, router)
+    enq.start()
+    nem.run([
+        ("part_random", 1.5),
+        ("wait", 0.5),
+        ("part_random", 1.5),
+        ("wait", 0.5),
+        ("part_random", 1.5),
+        ("heal",),
+        ("wait", 1.0),
+    ])
+    sent = enq.stop_and_flush()
+    got = drain_all(sids, router, expect=len(sent))
+    assert sorted(got) == sorted(sent)
+
+
+def test_app_restart_under_load(tmp_path):
+    """Restart servers (including the leader) while enqueuing
+    ({app_restart, Servers}): restarted members rejoin, catch up, and the
+    queue converges with no loss.  Servers run over durable RaSystem logs
+    — a restart must come back with its log and term/voted_for intact, or
+    acked-entry durability doesn't hold and the no-loss assertion is
+    meaningless with 3 of 5 members bouncing."""
+    from ra_tpu import RaSystem
+    from ra_tpu.core.types import ServerConfig
+
+    router = LocalRouter()
+    sids = ids()
+    systems = {s.node: RaSystem(str(tmp_path / s.node)) for s in sids}
+    nodes = [RaNode(s.node, router=router,
+                    log_factory=systems[s.node].log_factory) for s in sids]
+    for sid in sids:
+        router.nodes[sid.node].start_server(ServerConfig(
+            server_id=sid, uid=f"uid_{sid.name}", cluster_name="part-q3",
+            initial_members=tuple(sids), machine=FifoMachine(),
+            election_timeout_ms=100))
+    ra_tpu.trigger_election(sids[0], router)
+    leader = await_leader(router, sids)
+    nem = Nemesis(router, nodes, seed=7)
+    enq = Enqueuer(sids, router)
+    enq.start()
+    time.sleep(0.5)
+    followers = [s for s in sids if s != leader]
+    try:
+        nem.run([
+            ("app_restart", followers[:2]),
+            ("wait", 1.0),
+            ("app_restart", [leader]),
+            ("wait", 1.5),
+        ])
+        sent = enq.stop_and_flush()
+        got = drain_all(sids, router, expect=len(sent))
+        assert sorted(got) == sorted(sent)
+    finally:
+        for n in nodes:
+            n.stop()
+        for s in systems.values():
+            s.close()
+
+
+def test_two_enqueuers_through_partitions(fabric):
+    """Two competing enqueuers through a partition round: per-enqueuer
+    FIFO order must hold in the delivered stream and nothing is lost
+    (partitions_SUITE's multi-publisher variant)."""
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("part-q4", lambda: FifoMachine(), sids,
+                         router=router, election_timeout_ms=100)
+    await_leader(router, sids)
+    nem = Nemesis(router, nodes, seed=9)
+    e1 = Enqueuer(sids, router, tag="alpha")
+    e2 = Enqueuer(sids, router, tag="beta")
+    e1.start()
+    e2.start()
+    nem.run([
+        ("part_random", 1.5),
+        ("wait", 1.0),
+    ])
+    sent1 = e1.stop_and_flush()
+    sent2 = e2.stop_and_flush()
+    got = drain_all(sids, router, expect=len(sent1) + len(sent2))
+    assert sorted(got) == sorted(sent1 + sent2)
+    # per-enqueuer order is preserved in the drain stream
+    for tag, sent in (("alpha", sent1), ("beta", sent2)):
+        stream = [g for g in got if g.startswith(tag)]
+        assert stream == sent
+
+
+def test_leader_in_minority_cannot_commit(fabric):
+    """While the old leader sits in a minority island, commands sent to it
+    must not be lost-and-acked: anything it acked before the partition is
+    preserved; anything during must either fail or commit after heal."""
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("part-q5", lambda: FifoMachine(), sids,
+                         router=router, election_timeout_ms=100)
+    leader = await_leader(router, sids)
+    client = FifoClient(sids, router=router, tag="strict")
+    for i in range(10):
+        client.enqueue_sync(f"pre-{i}")
+    # cut the leader off
+    others = [s.node for s in sids if s.node != leader.node]
+    for o in others:
+        router.block(leader.node, o)
+    # the majority elects a new leader
+    majority = [s for s in sids if s.node != leader.node]
+    new_leader = await_leader(router, majority, timeout=10.0)
+    assert new_leader != leader
+    # a sync command to the minority leader must time out, not falsely ack
+    with pytest.raises((TimeoutError, RuntimeError)):
+        ra_tpu.process_command(leader, ("enqueue", None, None, "ghost"),
+                               router=router, timeout=1.0)
+    router.heal()
+    time.sleep(1.0)
+    got = drain_all(sids, router, expect=10)
+    assert [g for g in got if g.startswith("pre-")] == \
+        [f"pre-{i}" for i in range(10)]
