@@ -88,7 +88,10 @@ class DurableLog:
         self._events: list = []            # pending events for the shell
         self._memtable: dict[int, tuple] = {}  # idx -> (term, command_obj)
         self._mem_bytes: dict[int, bytes] = {}  # idx -> payload (for flush)
-        self._segments: list[SegmentFile] = []  # ordered by range
+        # creation order, newest LAST — load-bearing: _segment_read scans
+        # reversed so a newer segment's entries supersede older ones where
+        # they overlap, and _current_segment appends to [-1]
+        self._segments: list[SegmentFile] = []
         self._seg_seq = 0
         self._last_index = 0
         self._last_term = 0
@@ -130,7 +133,12 @@ class DurableLog:
                 self._checkpoints.append((got[0],
                                           os.path.join(cpdir, fname)))
         snap_idx = self._snapshot[0].index if self._snapshot else 0
-        # segments
+        # segments in creation order, newest last: a newer segment's
+        # entries supersede older ones wherever they overlap
+        # (ra_log_reader:update_segments compaction, :93-108), and the
+        # NEWEST segment defines the durable tail — an older segment
+        # holding higher indexes is a stale tail from before an overwrite
+        found = []
         for fname in sorted(os.listdir(self.dir)):
             if not fname.endswith(".segment"):
                 continue
@@ -144,16 +152,37 @@ class DurableLog:
                 seg.close()
                 os.unlink(os.path.join(self.dir, fname))
                 continue
-            self._segments.append(seg)
-        self._segments.sort(key=lambda s: s.range()[0])
+            found.append((seq, seg))
+        found.sort(key=lambda p: p[0])
+        self._segments = [seg for _seq, seg in found]
         last, last_term = 0, 0
         if self._segments:
             lo, hi = self._segments[-1].range()
             last = hi
             last_term = self._segments[-1].read(hi)[0]
-        # WAL recovered entries (newer than segments)
-        for idx, (term, payload) in sorted(
-                self.wal.recovered_table(self.uid).items()):
+        # WAL recovered entries (newer than segments).  If a WAL entry
+        # CONFLICTS with segment content at the same index (different
+        # term), that write overwrote the log from there: segment entries
+        # above the WAL table's own tail are stale and must not define
+        # last_index (the ra_log init equivalent: the memtable range wins
+        # over overlapping segment refs, ra_log.erl:199-277).  The term
+        # comparison matters: a retained stale WAL file (kept because
+        # another uid on the node was unresolved at flush time) overlaps
+        # already-flushed segments with *agreeing* terms, and rewinding on
+        # mere overlap would lose acknowledged entries above it.  Checked
+        # against the RAW table — the snapshot floor is applied after,
+        # else a snapshot covering the overwrite record hides the
+        # truncation and resurrects the stale segment tail.
+        wal_items = sorted(self.wal.recovered_table(self.uid).items())
+        for idx, (term, _payload) in wal_items:
+            if idx > last:
+                break
+            got = self._segment_read(idx)
+            if got is not None and got[0] != term:
+                last = wal_items[-1][0]
+                last_term = wal_items[-1][1][0]
+                break
+        for idx, (term, payload) in wal_items:
             if idx <= snap_idx:
                 continue
             cmd = pickle.loads(payload)
@@ -550,6 +579,14 @@ class DurableLog:
                     else:
                         keep.append(seg)
                 self._segments = keep
+                # a kept segment holding slots above last_index is a stale
+                # overwritten tail; once the snapshot swallows the WAL's
+                # truncation record this segment would be the only durable
+                # "evidence" for those indexes — truncate it physically
+                for seg in keep:
+                    r = seg.range()
+                    if r is not None and r[1] > self._last_index:
+                        seg.truncate_from(self._last_index + 1)
             for seg in victims:
                 seg.close()
                 try:

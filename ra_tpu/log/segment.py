@@ -67,6 +67,13 @@ class SegmentFile:
             idx, term, off, ln, crc = _SLOT.unpack_from(raw, i * _SLOT.size)
             if idx == 0:
                 break
+            # slots are strictly append-ordered, so a slot that rewrites a
+            # lower index is an overwrite: it invalidates every entry above
+            # it written earlier (same dedup as WAL recovery — a stale
+            # tail must not survive a reload)
+            if self.index:
+                for k in [k for k in self.index if k >= idx]:
+                    del self.index[k]
             self.index[idx] = (term, off, ln, crc)
             self._count += 1
             self._next_off = max(self._next_off, off + ln)
@@ -103,6 +110,37 @@ class SegmentFile:
         self._count += len(self._pending)
         self._next_off = off
         self._pending.clear()
+
+    def truncate_from(self, idx: int) -> None:
+        """Durably drop every entry >= idx.  Used when a snapshot makes an
+        overwritten segment tail the only remaining durable record of
+        those indexes — it must not resurrect on reload.  The surviving
+        entries are rewritten to a fresh file swapped in with an atomic
+        rename: an in-place slot-region rewrite would break the
+        append-only crash discipline (a torn rewrite could interleave new
+        and old slot layouts, resurrecting — or corrupting — the tail).
+        Rare (snapshot-covering-an-overwrite only), so the copy is
+        acceptable."""
+        self._pending = [p for p in self._pending if p[0] < idx]
+        stale = [k for k in self.index if k >= idx]
+        if not stale:
+            return
+        survivors = [(k, self.index[k][0], self.read(k)[1])
+                     for k in sorted(self.index) if k < idx]
+        tmp_path = self.path + ".trunc"
+        fresh = SegmentFile(tmp_path, self.max_count, create=True)
+        for k, term, payload in survivors:
+            fresh.append(k, term, payload)
+        fresh.flush()
+        os.fsync(fresh.fd)   # flush() early-returns when there are no
+        fresh.close()        # survivors; the header must still be durable
+        IO.close(self.fd)
+        os.replace(tmp_path, self.path)
+        self.fd = IO.random_open(self.path)
+        self.index = {}
+        self._pending = []
+        self._count = 0
+        self._load()
 
     # -- read side ----------------------------------------------------------
 

@@ -1,0 +1,325 @@
+"""Property-based tests (seeded-random, many trials per property).
+
+Mirrors the reference's two PropEr suites:
+
+* ra_props_SUITE.erl:51-60 — replicated **non-associative** arithmetic:
+  clusters fed interleaved commands under adversarial scheduling must
+  converge to the same machine state on every replica, and that state
+  must equal the sequential fold of the leader's committed log.  A
+  non-associative, non-commutative operation makes any ordering or
+  duplication divergence observable.
+
+* ra_log_props_SUITE.erl — random command sequences against the real
+  durable log (writes, overwrites, rollovers, snapshots, restarts)
+  checked against a trivial in-memory model after every step.
+"""
+import random
+
+import pytest
+
+from ra_tpu.core.machine import SimpleMachine
+from ra_tpu.core.types import (CommandEvent, ElectionTimeout, Entry,
+                               ServerConfig, ServerId, UserCommand)
+
+from harness import SimCluster
+
+
+# ---------------------------------------------------------------------------
+# property 1: replicated non-associative arithmetic convergence
+# ---------------------------------------------------------------------------
+
+def apply_op(state, cmd):
+    op, n = cmd
+    if op == "add":
+        return state + n
+    if op == "sub":
+        return state - n
+    if op == "mul":
+        return state * n
+    # non-associative, non-commutative integer op; keeps values bounded
+    return state // n if n else state
+
+
+OPS = ("add", "sub", "mul", "div")
+
+
+def random_cmd(rng):
+    return (rng.choice(OPS), rng.randint(0, 9))
+
+
+def _converge(cluster):
+    """Heal, establish a single live leader, and push one barrier command
+    until it commits on every replica.  Stale minority leaders linger
+    after a heal (no idle heartbeats — INTERNALS.md:291-328), so the
+    highest-term leader is the real one and the barrier may need a retry
+    when a stale leader absorbs (and loses) it while stepping down."""
+    cluster.heal()
+    for attempt in range(25):
+        cluster.run()
+        leaders = [sid for sid, srv in cluster.servers.items()
+                   if srv.raft_state.value == "leader"]
+        if not leaders:
+            cluster.elect(cluster.ids[attempt % len(cluster.ids)])
+            continue
+        leader = max(leaders,
+                     key=lambda s: cluster.servers[s].current_term)
+        cluster.command(leader, ("add", 0))
+        cluster.run()
+        srv = cluster.servers[leader]
+        if srv.raft_state.value != "leader":
+            continue  # was stale after all; the barrier died with it
+        applied = srv.last_applied
+        if applied > 0 and all(s.last_applied == applied
+                               for s in cluster.servers.values()):
+            return leader
+    raise AssertionError("cluster did not converge after heal")
+
+
+def _sequential_fold(server):
+    """Fold the *applied* prefix of a server's log — entries past
+    last_applied (an ex-leader's never-committed tail) are not state."""
+    state = 0
+    for entry in server.log.read_range(1, server.last_applied):
+        if isinstance(entry.command, UserCommand):
+            state = apply_op(state, entry.command.data)
+    return state
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_replicated_nonassoc_arithmetic_converges(seed):
+    rng = random.Random(seed)
+    n_members = rng.choice((3, 5))
+    cluster = SimCluster(
+        n_members,
+        machine_factory=lambda: SimpleMachine(
+            lambda cmd, st: apply_op(st, cmd), 0))
+    cluster.elect(cluster.ids[0])
+    sent = 0
+    for _ in range(250):
+        roll = rng.random()
+        if roll < 0.55:
+            # deliver one pending message at a random member
+            ready = [sid for sid in cluster.ids if cluster.queues[sid]]
+            if ready:
+                sid = rng.choice(ready)
+                cluster.handle(sid, cluster.queues[sid].popleft())
+        elif roll < 0.75 and sent < 120:
+            leader = cluster.leader()
+            if leader is not None:
+                cluster.handle(
+                    leader, CommandEvent(UserCommand(random_cmd(rng)),
+                                         from_=None))
+                sent += 1
+        elif roll < 0.82:
+            # spurious election timeout at a random member
+            cluster.handle(rng.choice(cluster.ids), ElectionTimeout())
+        elif roll < 0.90:
+            a, b = rng.sample(cluster.ids, 2)
+            cluster.partition(a, b)
+        else:
+            cluster.heal()
+    leader = _converge(cluster)
+    states = set(cluster.machine_states().values())
+    assert len(states) == 1, f"replicas diverged: {states}"
+    expected = _sequential_fold(cluster.servers[leader])
+    assert states == {expected}
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_convergence_through_repeated_isolation(seed):
+    """Repeatedly isolate random members (including leaders mid-command
+    burst); the survivors keep committing and everyone converges."""
+    rng = random.Random(1000 + seed)
+    cluster = SimCluster(5, machine_factory=lambda: SimpleMachine(
+        lambda cmd, st: apply_op(st, cmd), 0))
+    cluster.elect(cluster.ids[0])
+    for _round in range(6):
+        victim = rng.choice(cluster.ids)
+        cluster.isolate(victim)
+        # someone on the majority side must (re)take leadership
+        majority = [s for s in cluster.ids if s != victim]
+        if cluster.leader() in (victim, None):
+            cluster.elect(rng.choice(majority))
+        leader = cluster.leader()
+        if leader is None or leader == victim:
+            cluster.elect(rng.choice(majority))
+            leader = cluster.leader()
+        for _ in range(rng.randint(1, 8)):
+            cluster.command(leader, random_cmd(rng))
+        cluster.heal()
+        cluster.run()
+    leader = _converge(cluster)
+    states = set(cluster.machine_states().values())
+    assert len(states) == 1
+    assert states == {_sequential_fold(cluster.servers[leader])}
+
+
+# ---------------------------------------------------------------------------
+# property 2: durable log vs model under random op sequences
+# ---------------------------------------------------------------------------
+
+class LogModel:
+    """The obviously-correct in-memory twin of DurableLog."""
+
+    def __init__(self):
+        self.entries: dict[int, tuple] = {}   # idx -> (term, payload)
+        self.first = 1
+        self.last = 0
+        self.snap = (0, 0)
+
+    def write(self, idx, term, payload):
+        for k in [k for k in self.entries if k >= idx]:
+            del self.entries[k]
+        self.entries[idx] = (term, payload)
+        self.last = idx
+
+    def snapshot(self, idx, term):
+        for k in [k for k in self.entries if k <= idx]:
+            del self.entries[k]
+        self.first = idx + 1
+        self.snap = (idx, term)
+        self.last = max(self.last, idx)
+
+
+def _mk_log(system, uid):
+    cfg = ServerConfig(server_id=ServerId(uid, "n1"), uid=uid,
+                       cluster_name="props",
+                       initial_members=(ServerId(uid, "n1"),),
+                       machine=SimpleMachine(lambda c, s: s, 0))
+    return system.log_factory(cfg)
+
+
+def _settle(system, log):
+    """Make everything queued durable and consume written confirms."""
+    system.wal.flush()
+    system.segment_writer.await_idle()
+    for evt in log.take_events():
+        log.handle_written(evt)
+
+
+def _check(log, model):
+    assert log.first_index() == model.first
+    lit = log.last_index_term()
+    assert lit.index == model.last
+    if model.last >= model.first:
+        expect_term = (model.entries[model.last][0]
+                       if model.last in model.entries else model.snap[1])
+        assert lit.term == expect_term
+    assert tuple(log.snapshot_index_term()) == model.snap
+    for idx in range(model.first, model.last + 1):
+        ent = log.fetch(idx)
+        assert ent is not None, f"missing idx {idx}"
+        term, payload = model.entries[idx]
+        assert ent.term == term and ent.command == payload, \
+            f"mismatch at {idx}: {(ent.term, ent.command)} != " \
+            f"{(term, payload)}"
+    # reads outside the live range answer None
+    assert log.fetch(model.first - 1) is None
+    assert log.fetch(model.last + 1) is None
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_durable_log_random_ops_match_model(tmp_path, seed):
+    from ra_tpu import RaSystem
+
+    rng = random.Random(seed)
+    data_dir = str(tmp_path / f"props{seed}")
+    system = RaSystem(data_dir, segment_max_count=16)
+    uid = f"prop_uid_{seed}"
+    log = _mk_log(system, uid)
+    model = LogModel()
+    term = 1
+    try:
+        for _step in range(60):
+            roll = rng.random()
+            if roll < 0.45:
+                # append a batch at the tail
+                n = rng.randint(1, 5)
+                entries = []
+                for _ in range(n):
+                    idx = model.last + 1 if not entries \
+                        else entries[-1].index + 1
+                    payload = f"s{seed}-{idx}-t{term}"
+                    entries.append(Entry(idx, term, payload))
+                log.write(entries)
+                for e in entries:
+                    model.write(e.index, e.term, e.command)
+            elif roll < 0.60 and model.last >= model.first:
+                # overwrite: a new term rewrites a random suffix
+                term += 1
+                idx = rng.randint(model.first, model.last)
+                payload = f"s{seed}-{idx}-t{term}"
+                log.write([Entry(idx, term, payload)])
+                model.write(idx, term, payload)
+            elif roll < 0.72:
+                system.wal.rollover()
+                _settle(system, log)
+            elif roll < 0.85 and model.last >= model.first:
+                # snapshot at a random durable index
+                _settle(system, log)
+                idx = rng.randint(model.first, model.last)
+                snap_term = model.entries[idx][0]
+                log.update_release_cursor(idx, (), 0, {"v": idx})
+                model.snapshot(idx, snap_term)
+            else:
+                # restart the whole log stack and recover
+                _settle(system, log)
+                system.close()
+                system = RaSystem(data_dir, segment_max_count=16)
+                log = _mk_log(system, uid)
+            _settle(system, log)
+            _check(log, model)
+        # final restart must reproduce the model exactly
+        _settle(system, log)
+        system.close()
+        system = RaSystem(data_dir, segment_max_count=16)
+        log = _mk_log(system, uid)
+        _check(log, model)
+    finally:
+        system.close()
+
+
+def test_stale_retained_wal_file_does_not_rewind_tail(tmp_path):
+    """A WAL file can be RETAINED across a rollover because some other
+    uid on the node was unresolved at flush time — while this uid's
+    entries from that file were flushed to segments and more entries were
+    appended after it.  On recovery the stale file's table overlaps the
+    segments with agreeing terms; that overlap must NOT be read as an
+    overwrite, or acknowledged entries above it are lost."""
+    from ra_tpu import RaSystem
+
+    data_dir = str(tmp_path / "retain")
+    system = RaSystem(data_dir, segment_max_count=1024)
+    logx = _mk_log(system, "uidX")
+    logy = _mk_log(system, "uidY")
+    logx.write([Entry(i, 1, f"x{i}") for i in range(1, 11)])
+    logy.write([Entry(i, 1, f"y{i}") for i in range(1, 6)])
+    _settle(system, logx)
+    # simulate a stopped server: Y becomes unresolvable, so the WAL file
+    # containing its entries must be kept at rollover while X's entries
+    # are drained to segments
+    with system._lock:
+        system._logs.pop("uidY")
+    system.wal.rollover()
+    _settle(system, logx)
+    # X keeps appending; this lands in (and is flushed from) a later file
+    logx.write([Entry(i, 1, f"x{i}") for i in range(11, 21)])
+    system.wal.rollover()
+    _settle(system, logx)
+    system.close()
+
+    system2 = RaSystem(data_dir, segment_max_count=1024)
+    logx2 = _mk_log(system2, "uidX")
+    try:
+        assert logx2.last_index_term().index == 20, \
+            "stale retained WAL file rewound the durable tail"
+        for i in range(1, 21):
+            ent = logx2.fetch(i)
+            assert ent is not None and ent.command == f"x{i}"
+        # and the uid whose entries lived only in the retained file
+        # recovers them from it
+        logy2 = _mk_log(system2, "uidY")
+        assert logy2.last_index_term().index == 5
+        assert logy2.fetch(3).command == "y3"
+    finally:
+        system2.close()
