@@ -1,0 +1,177 @@
+"""Machine-version upgrade rules — the reference's
+ra_machine_version_SUITE.erl:29-39 scenarios against the deterministic
+harness: version exchange in pre-vote, the noop-carried version bump, the
+('machine_version', Old, New) pseudo-command through which_module
+dispatch, followers that cannot understand the new version stalling
+their apply fold, and snapshot metadata carrying the version across
+installs (ra_server.erl:2671-2732, :2260-2319)."""
+from harness import SimCluster
+
+from ra_tpu.core.machine import Machine
+from ra_tpu.core.types import ElectionTimeout, SnapshotMeta
+
+
+class CounterV0(Machine):
+    """v0: commands add; knows nothing about versions."""
+
+    version = 0
+
+    def init(self, config):
+        return 0
+
+    def apply(self, meta, command, state):
+        if isinstance(command, tuple) and command[0] == "machine_version":
+            raise AssertionError(
+                "an unversioned machine must never see a "
+                "machine_version command")
+        return state + command, state + command, []
+
+
+class CounterV1(Machine):
+    """v1: commands add DOUBLE; upgrade marker recorded in state.
+
+    State becomes (value, upgraded_at_meta_index) after upgrade so tests
+    can see the pseudo-command."""
+
+    version = 1
+
+    def __init__(self):
+        self._v0 = CounterV0()
+
+    def init(self, config):
+        return 0
+
+    def which_module(self, version):
+        return self._v0 if version == 0 else self
+
+    def apply(self, meta, command, state):
+        if isinstance(command, tuple) and command[0] == "machine_version":
+            _tag, old, new = command
+            assert (old, new) == (0, 1)
+            return ("v1", state, meta.index), None, []
+        tag, base, at = state if isinstance(state, tuple) else \
+            ("v1", state, None)
+        new_val = base + 2 * command
+        return (tag, new_val, at), new_val, []
+
+
+def mixed_cluster(n=3, upgraded=(0, 1)):
+    """SimCluster where servers at positions in `upgraded` run the v1
+    machine and the rest still run v0 (a rolling upgrade in progress)."""
+    calls = iter(range(n))
+    return SimCluster(n, machine_factory=lambda: (
+        CounterV1() if next(calls) in upgraded else CounterV0()))
+
+
+def test_upgraded_leader_bumps_effective_version():
+    c = mixed_cluster()
+    s1, s2, s3 = c.ids
+    c.elect(s1)
+    assert c.leader() == s1
+    srv1 = c.servers[s1]
+    assert srv1.effective_machine_version == 1
+    # the bump was applied as a pseudo-command through the v1 module
+    assert srv1.machine_state[0] == "v1"
+    # v1 semantics now in force: +5 adds 10
+    c.command(s1, 5)
+    assert srv1.machine_state[1] == 10
+    # the upgraded follower tracked the bump and the command
+    assert c.servers[s2].effective_machine_version == 1
+    assert c.servers[s2].machine_state[1] == 10
+
+
+def test_stale_version_follower_stalls_apply():
+    c = mixed_cluster()
+    s1, _s2, s3 = c.ids
+    c.elect(s1)
+    c.command(s1, 5)
+    srv3 = c.servers[s3]
+    # the v0 member saw the noop, recorded the new effective version, but
+    # cannot run it: its apply fold stops (ra_server.erl:2713-2732)
+    assert srv3.effective_machine_version == 1
+    assert srv3.machine_state == 0
+    assert srv3.last_applied < c.servers[s1].last_applied
+
+
+def test_pre_vote_denies_too_new_candidate():
+    c = mixed_cluster(3, upgraded=(0,))
+    s1, s2, s3 = c.ids
+    # v1 candidate, both peers v0 with effective version 0: they must
+    # deny (they could not run a v1 leader's machine), so no quorum
+    c.handle(s1, ElectionTimeout())
+    c.run()
+    assert c.leader() is None
+    assert c.servers[s1].raft_state.value in ("pre_vote", "follower",
+                                              "candidate")
+
+
+def test_pre_vote_denies_stale_candidate_after_upgrade():
+    c = mixed_cluster()
+    s1, s2, s3 = c.ids
+    c.elect(s1)          # effective version now 1 on s1, s2
+    # the v0 member times out; its pre-vote carries machine_version 0,
+    # below the upgraded members' effective version: denied
+    c.handle(s3, ElectionTimeout())
+    c.run()
+    assert c.servers[s3].raft_state.value != "leader"
+    assert c.leader() in (s1, None)
+
+
+def test_unversioned_cluster_sees_no_version_command():
+    # all v0: CounterV0.apply raises if it ever sees the pseudo-command
+    c = SimCluster(3, machine_factory=CounterV0)
+    c.elect(c.ids[0])
+    c.command(c.ids[0], 7)
+    assert c.servers[c.ids[0]].machine_state == 7
+    assert c.servers[c.ids[0]].effective_machine_version == 0
+
+
+def test_snapshot_meta_carries_machine_version():
+    c = mixed_cluster(3, upgraded=(0, 1, 2))
+    s1, _, _ = c.ids
+    c.elect(s1)
+    for v in (1, 2, 3):
+        c.command(s1, v)
+    srv = c.servers[s1]
+    idx = srv.last_applied
+    srv.log.update_release_cursor(
+        idx, tuple((sid, p.membership) for sid, p in srv.cluster.items()),
+        srv.effective_machine_version, srv.machine_state)
+    got = srv.log.snapshot()
+    assert got is not None
+    meta: SnapshotMeta = got[0]
+    assert meta.machine_version == 1
+    assert meta.index == idx
+
+
+def test_snapshot_install_rejected_by_stale_member():
+    """A follower whose machine cannot run the snapshot's version must
+    refuse the install (the version gate on the receive path,
+    ra_server.erl:1260-1296) and confirm only its own progress, instead
+    of accepting state it cannot interpret.  Driven as a single injected
+    RPC: in a live cluster the leader just retries later (the member
+    stays behind until it is upgraded), which a synchronous sim cannot
+    run to quiescence."""
+    from ra_tpu.core.types import InstallSnapshotRpc, SendRpc
+
+    c = mixed_cluster()
+    s1, _s2, s3 = c.ids
+    c.elect(s1)
+    c.command(s1, 5)
+    srv1, srv3 = c.servers[s1], c.servers[s3]
+    meta = SnapshotMeta(
+        index=srv1.last_applied, term=srv1.current_term,
+        cluster=tuple((sid, p.membership)
+                      for sid, p in srv1.cluster.items()),
+        machine_version=1)
+    effects = srv3.handle(InstallSnapshotRpc(
+        term=srv1.current_term, leader_id=s1, meta=meta,
+        chunk_number=1, chunk_flag="last", data=b""))
+    # stays a follower, machine state untouched, nothing installed
+    assert srv3.raft_state.value == "follower"
+    assert srv3.machine_state == 0
+    assert srv3.log.snapshot_index_term().index == 0
+    # and the reply confirms only its own (stale) progress
+    replies = [e for e in effects if isinstance(e, SendRpc)]
+    assert replies and replies[0].msg.last_index == \
+        srv3.log.last_index_term().index
