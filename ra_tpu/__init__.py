@@ -15,6 +15,7 @@ from .api import (  # noqa: E402,F401
     cast_aux_command,
     consistent_query,
     delete_cluster,
+    force_delete_server,
     key_metrics,
     leader_query,
     local_query,
@@ -25,10 +26,14 @@ from .api import (  # noqa: E402,F401
     pipeline_command,
     process_command,
     remove_member,
+    restart_server,
     start_cluster,
     start_server,
+    stop_server,
     transfer_leadership,
     trigger_election,
 )
+from .core import aux  # noqa: E402,F401
+from .directory import Directory  # noqa: E402,F401
 from .node import LocalRouter, RaNode  # noqa: E402,F401
 from .system import RaSystem  # noqa: E402,F401

@@ -94,6 +94,41 @@ def start_server(cluster_name: str, machine_factory: Callable[[], Machine],
     return node.start_server(cfg)
 
 
+def restart_server(server_id: ServerId,
+                   router: Optional[LocalRouter] = None) -> ServerId:
+    """Stop and re-init one member over its existing log
+    (ra:restart_server/2 :188-199)."""
+    router = router or DEFAULT_ROUTER
+    return _node_of(server_id, router).restart_server(server_id.name)
+
+
+def stop_server(server_id: ServerId,
+                router: Optional[LocalRouter] = None) -> None:
+    """Gracefully stop one member; its durable state stays on disk
+    (ra:stop_server/2)."""
+    router = router or DEFAULT_ROUTER
+    _node_of(server_id, router).stop_server(server_id.name)
+
+
+def force_delete_server(server_id: ServerId, system=None,
+                        router: Optional[LocalRouter] = None) -> None:
+    """Stop one member and wipe its durable footprint without consensus
+    (ra:force_delete_server/2 — used when the cluster is already gone).
+    Pass the member's RaSystem to delete its on-disk data.  Works on a
+    stopped member too: the uid then resolves through the system
+    directory rather than the live shell."""
+    router = router or DEFAULT_ROUTER
+    node = _node_of(server_id, router)
+    shell = node.shells.get(server_id.name)
+    uid = shell.server.cfg.uid if shell is not None else None
+    if uid is None and system is not None:
+        uid = system.directory.where_is(server_id.name)
+    node.kill_server(server_id.name)
+    node.forget_server(server_id.name)
+    if system is not None and uid is not None:
+        system.delete_server_data(uid)
+
+
 def _node_of(sid: ServerId, router: LocalRouter) -> RaNode:
     node = router.nodes.get(sid.node)
     if node is None:
@@ -338,9 +373,16 @@ def member_overview(server_id: ServerId,
 
 
 def overview(router: Optional[LocalRouter] = None) -> dict:
-    """Node-level overview across all local RaNodes (ra:overview)."""
+    """Node-level overview across all local RaNodes (ra:overview), plus
+    process-wide io metrics (the ra_io_metrics ETS role)."""
+    from .native import IO
+
     router = router or DEFAULT_ROUTER
-    return {name: node.overview() for name, node in router.nodes.items()}
+    return {
+        "nodes": {name: node.overview()
+                  for name, node in router.nodes.items()},
+        "io": IO.stats(),
+    }
 
 
 def key_metrics(server_id: ServerId,

@@ -34,7 +34,12 @@ from typing import Any, Callable, Iterable, Optional
 from ..core.types import (Entry, IdxTerm, SnapshotMeta, WrittenEvent,
                           strip_local_handles)
 from ..native import IO
+from ..utils.flru import Flru
 from .segment import DEFAULT_MAX_COUNT, SegmentFile
+
+#: open segment fds per server (ra_flru's open_segments cap,
+#: ra_log_reader.erl:45-49)
+MAX_OPEN_SEGMENTS = 5
 
 SNAP_MAGIC = b"RTSN"
 _SNAP_HDR = struct.Struct("<4sII")  # magic, version, crc(meta+state)
@@ -92,6 +97,10 @@ class DurableLog:
         # reversed so a newer segment's entries supersede older ones where
         # they overlap, and _current_segment appends to [-1]
         self._segments: list[SegmentFile] = []
+        # caps open descriptors: indexes stay in memory, evicted segments
+        # reopen transparently on the next read (guarded by _io_lock)
+        self._open_segments = Flru(
+            MAX_OPEN_SEGMENTS, on_evict=lambda _path, seg: seg.close_fd())
         self._seg_seq = 0
         self._last_index = 0
         self._last_term = 0
@@ -155,6 +164,8 @@ class DurableLog:
             found.append((seq, seg))
         found.sort(key=lambda p: p[0])
         self._segments = [seg for _seq, seg in found]
+        for seg in self._segments:
+            self._open_segments.touch(seg.path, seg)
         last, last_term = 0, 0
         if self._segments:
             lo, hi = self._segments[-1].range()
@@ -328,6 +339,7 @@ class DurableLog:
             for seg in reversed(self._segments):
                 r = seg.range()
                 if r and r[0] <= idx <= r[1]:
+                    self._open_segments.touch(seg.path, seg)
                     got = seg.read(idx)
                     if got is not None:
                         return got
@@ -406,10 +418,12 @@ class DurableLog:
                                and i <= self._last_index)
             if items:
                 seg = self._current_segment()
+                self._open_segments.touch(seg.path, seg)
                 for idx, payload, term in items:
                     if not seg.append(idx, term, payload):
                         seg.flush()
                         seg = self._new_segment()
+                        self._open_segments.touch(seg.path, seg)
                         seg.append(idx, term, payload)
                 seg.flush()
             with self._lock:
@@ -431,6 +445,7 @@ class DurableLog:
             path = os.path.join(self.dir, f"{self._seg_seq:08d}.segment")
             seg = SegmentFile(path, self.segment_max_count, create=True)
             self._segments.append(seg)
+            self._open_segments.touch(seg.path, seg)
             return seg
 
     # -- snapshots ----------------------------------------------------------
@@ -588,6 +603,7 @@ class DurableLog:
                     if r is not None and r[1] > self._last_index:
                         seg.truncate_from(self._last_index + 1)
             for seg in victims:
+                self._open_segments.pop(seg.path)
                 seg.close()
                 try:
                     os.unlink(seg.path)

@@ -54,6 +54,21 @@ class SegmentFile:
             self.fd = IO.random_open(path)
             self._load()
 
+    def _ensure_open(self) -> int:
+        """Reopen the fd after a close_fd() eviction; the in-memory index
+        is kept, so reopening is just an open(2)."""
+        if self.fd is None:
+            self.fd = IO.random_open(self.path)
+        return self.fd
+
+    def close_fd(self) -> None:
+        """Close only the descriptor (LRU eviction by the log's open-
+        segment cache, the ra_flru role); the index stays loaded and any
+        read/flush transparently reopens."""
+        if self.fd is not None:
+            IO.close(self.fd)
+            self.fd = None
+
     def _load(self) -> None:
         hdr = IO.pread(self.fd, _HDR.size, 0)
         magic, version, max_count, _ = _HDR.unpack(hdr)
@@ -93,6 +108,7 @@ class SegmentFile:
         ra_log_segment.erl:222-266)."""
         if not self._pending:
             return
+        self._ensure_open()
         data = bytearray()
         slots = bytearray()
         off = self._next_off
@@ -134,7 +150,7 @@ class SegmentFile:
         fresh.flush()
         os.fsync(fresh.fd)   # flush() early-returns when there are no
         fresh.close()        # survivors; the header must still be durable
-        IO.close(self.fd)
+        self.close_fd()
         os.replace(tmp_path, self.path)
         self.fd = IO.random_open(self.path)
         self.index = {}
@@ -151,7 +167,7 @@ class SegmentFile:
         if ent is None:
             return None
         term, off, ln, crc = ent
-        payload = IO.pread(self.fd, ln, off)
+        payload = IO.pread(self._ensure_open(), ln, off)
         if IO.crc32(payload) != crc:
             raise ValueError(f"segment crc mismatch at {idx} in {self.path}")
         return term, payload

@@ -158,6 +158,27 @@ class Wal:
             uids, files = retire
             self.segment_writer.retire(uids, files)
 
+    def purge(self, uid: str) -> None:
+        """Forget a deleted server: drop its writer registration, its
+        range in the current file, and its recovered table.  Without this
+        a force-deleted uid pins WAL files forever — rollover keeps any
+        file whose ranges contain an unresolvable uid, and the recovery
+        retirement gate (register) waits for a registration that will
+        never come.  Its already-written bytes in shared files remain
+        until those files rotate out, as in the reference's shared WAL."""
+        retire = None
+        with self._lock:
+            self._writers.pop(uid, None)
+            self._file_ranges.pop(uid, None)
+            self._recovered.pop(uid, None)
+            if self._recovered_files and \
+                    set(self._recovered).issubset(self._writers):
+                retire = (list(self._recovered),
+                          list(self._recovered_files))
+                self._recovered_files = []
+        if retire is not None and self.segment_writer is not None:
+            self.segment_writer.retire(*retire)
+
     # -- write path ---------------------------------------------------------
 
     def write(self, uid: str, index: int, term: int, payload: bytes,

@@ -60,11 +60,20 @@ def _load():
 
 
 class NativeIO:
-    """Thin facade over the native lib (or the Python fallback)."""
+    """Thin facade over the native lib (or the Python fallback).
+
+    Records io operation counts/bytes (the ra_file_handle role,
+    ra_file_handle.erl:26-40).  Plain int adds — approximate under
+    concurrency, like any sampled io metric; reads via :meth:`stats`."""
 
     def __init__(self) -> None:
         self.lib = _load()
         self.native = self.lib is not None
+        self._stats = {"reads": 0, "read_bytes": 0, "writes": 0,
+                       "write_bytes": 0, "syncs": 0, "opens": 0}
+
+    def stats(self) -> dict:
+        return dict(self._stats)
 
     def random_open(self, path: str, truncate: bool = False) -> int:
         """Open for positioned I/O (pwrite/pread).  MUST NOT use O_APPEND:
@@ -72,6 +81,7 @@ class NativeIO:
         flags = os.O_CREAT | os.O_RDWR
         if truncate:
             flags |= os.O_TRUNC
+        self._stats["opens"] += 1
         return os.open(path, flags, 0o644)
 
     # sync_mode: 0=none, 1=fdatasync, 2=fsync
@@ -85,9 +95,14 @@ class NativeIO:
             fd = os.open(path, flags, 0o644)
         if fd < 0:
             raise OSError(f"wal_open failed for {path}: {fd}")
+        self._stats["opens"] += 1
         return fd
 
     def write_batch(self, fd: int, buf: bytes, sync_mode: int = 1) -> int:
+        self._stats["writes"] += 1
+        self._stats["write_bytes"] += len(buf)
+        if sync_mode:
+            self._stats["syncs"] += 1
         if self.native:
             n = self.lib.ra_wal_write_batch(fd, buf, len(buf), sync_mode)
             if n < 0:
@@ -104,6 +119,8 @@ class NativeIO:
         return len(buf)
 
     def pwrite(self, fd: int, buf: bytes, off: int) -> int:
+        self._stats["writes"] += 1
+        self._stats["write_bytes"] += len(buf)
         if self.native:
             n = self.lib.ra_pwrite(fd, buf, len(buf), off)
             if n < 0:
@@ -112,6 +129,8 @@ class NativeIO:
         return os.pwrite(fd, buf, off)
 
     def pread(self, fd: int, length: int, off: int) -> bytes:
+        self._stats["reads"] += 1
+        self._stats["read_bytes"] += length
         if self.native:
             buf = ctypes.create_string_buffer(length)
             n = self.lib.ra_pread(fd, buf, length, off)

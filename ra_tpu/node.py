@@ -245,6 +245,17 @@ class RaNode:
             shell.stopped = True
             self._notify_down(shell.sid)
 
+    def forget_server(self, name: str) -> None:
+        """Drop a member's config from the node directory so
+        restart_server can no longer recreate it — the node-side half of
+        force_delete (a deleted member resurrected over an empty log
+        would rejoin with amnesia under its old identity and could vote
+        unsafely)."""
+        with self._lock:
+            for uid, c in list(self.directory.items()):
+                if c.server_id.name == name:
+                    del self.directory[uid]
+
     def _notify_down(self, dead: ServerId) -> None:
         """Local process-monitor role (ra_monitors): co-hosted members
         learn immediately that a sibling died — followers of a dead leader

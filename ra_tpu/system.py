@@ -12,10 +12,28 @@ import os
 import threading
 from typing import Optional
 
-from .core.types import ServerConfig
+from .core.types import Membership, ServerConfig, ServerId
+from .directory import Directory
 from .log.durable import DurableLog
 from .log.segment import SegmentWriter
 from .log.wal import DEFAULT_MAX_BATCH, DEFAULT_MAX_SIZE, Wal
+
+
+def _config_snapshot(cfg: ServerConfig) -> dict:
+    """The reconstructable (picklable) parts of a server config, persisted
+    in the directory for recover_servers — the ra_server_sup_sup
+    recover_config role (:80-103).  The machine is resolved at recovery
+    time."""
+    return {
+        "server_id": tuple(cfg.server_id),
+        "cluster_name": cfg.cluster_name,
+        "initial_members": tuple(tuple(m) for m in cfg.initial_members),
+        "election_timeout_ms": cfg.election_timeout_ms,
+        "tick_interval_ms": cfg.tick_interval_ms,
+        "broadcast_time_ms": cfg.broadcast_time_ms,
+        "membership": cfg.membership.value,
+        "system_name": cfg.system_name,
+    }
 
 
 class RaSystem:
@@ -30,6 +48,7 @@ class RaSystem:
         self.segment_max_count = segment_max_count
         self._logs: dict[str, DurableLog] = {}
         self._lock = threading.Lock()
+        self.directory = Directory(data_dir)
         self.segment_writer = SegmentWriter(resolve=self._resolve)
         self.wal = Wal(data_dir, sync_mode=wal_sync_mode,
                        max_size=wal_max_size, max_batch=wal_max_batch,
@@ -45,6 +64,9 @@ class RaSystem:
         survives server crashes within a running system — a restarted
         server reuses it (the ra_log_ets role: memtables outlive the
         processes that fill them)."""
+        if cfg.server_id is not None:
+            self.directory.register(cfg.uid, cfg.server_id.name,
+                                    cfg.cluster_name, _config_snapshot(cfg))
         with self._lock:
             log = self._logs.get(cfg.uid)
             if log is not None:
@@ -57,6 +79,57 @@ class RaSystem:
                              segment_max_count=self.segment_max_count)
             self._logs[cfg.uid] = log
             return log
+
+    # -- recovery / deletion (ra_system_recover + force_delete) ------------
+
+    def recover_servers(self, node, machine_for) -> list:
+        """Restart every registered server on ``node`` — the boot-time
+        `server_recovery_strategy: registered` (ra_system_recover.erl:
+        34-68).  ``machine_for(cluster_name, server_name) -> Machine``
+        resolves the user machine (the durable equivalent of the module
+        reference the reference persists); returning None skips that
+        server.  Already-running servers are left alone."""
+        started = []
+        for uid in self.directory.uids():
+            snap = self.directory.config_of(uid)
+            if not snap:
+                continue
+            name = self.directory.name_of(uid)
+            if name is None or name in node.shells:
+                continue
+            machine = machine_for(snap["cluster_name"], name)
+            if machine is None:
+                continue
+            cfg = ServerConfig(
+                server_id=ServerId(*snap["server_id"]),
+                uid=uid,
+                cluster_name=snap["cluster_name"],
+                initial_members=tuple(ServerId(*m)
+                                      for m in snap["initial_members"]),
+                machine=machine,
+                election_timeout_ms=snap["election_timeout_ms"],
+                tick_interval_ms=snap["tick_interval_ms"],
+                broadcast_time_ms=snap["broadcast_time_ms"],
+                membership=Membership(snap["membership"]),
+                system_name=snap.get("system_name", "default"),
+            )
+            started.append(node.start_server(cfg))
+        return started
+
+    def delete_server_data(self, uid: str) -> None:
+        """Wipe a server's durable footprint (the data-dir half of
+        ra:force_delete_server).  The caller stops the process first."""
+        import shutil
+
+        with self._lock:
+            log = self._logs.pop(uid, None)
+        if log is not None:
+            log.close()
+        self.wal.purge(uid)
+        self.directory.unregister(uid)
+        target = os.path.join(self.data_dir, uid)
+        if os.path.isdir(target):
+            shutil.rmtree(target, ignore_errors=True)
 
     def registered_uids(self) -> list:
         with self._lock:
@@ -77,4 +150,5 @@ class RaSystem:
                 "data_dir": self.data_dir,
                 "servers": {uid: log.overview()
                             for uid, log in self._logs.items()},
+                "directory": self.directory.overview(),
             }

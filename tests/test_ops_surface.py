@@ -83,7 +83,8 @@ def test_counters_and_overview(fabric):
     assert m["counters"]["commands"] >= 5
     assert m["counters"]["msgs_processed"] > 5
     ov = ra_tpu.overview(router=router)
-    assert set(ov) == {"o1", "o2", "o3"}
+    assert set(ov["nodes"]) == {"o1", "o2", "o3"}
+    assert "writes" in ov["io"]
     mo = ra_tpu.member_overview(leader, router=router)
     assert mo["raft_state"] == "leader"
     # leaderboard lock-free lookup

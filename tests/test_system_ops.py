@@ -1,0 +1,273 @@
+"""Directory registry, system-level server recovery, force-delete, and
+the ra_aux accessor surface (reference: ra_directory_SUITE,
+ra_system_recover.erl, ra.erl force_delete/restart, ra_aux.erl)."""
+import os
+import time
+
+import ra_tpu
+from ra_tpu import Directory, LocalRouter, RaNode, RaSystem
+from ra_tpu.core import aux
+from ra_tpu.core.machine import Machine, SimpleMachine
+from ra_tpu.core.types import ServerConfig, ServerId
+
+from nemesis import await_leader
+
+
+def counter():
+    return SimpleMachine(lambda c, s: s + c, 0)
+
+
+def mk_cfg(sid, sids, machine=None):
+    return ServerConfig(server_id=sid, uid=f"uid_{sid.name}",
+                        cluster_name="ops", initial_members=tuple(sids),
+                        machine=machine or counter(),
+                        election_timeout_ms=80, tick_interval_ms=100)
+
+
+# ---------------------------------------------------------------------------
+# directory
+# ---------------------------------------------------------------------------
+
+def test_directory_roundtrip_and_persistence(tmp_path):
+    d = Directory(str(tmp_path))
+    d.register("u1", "m1", "clusterA", {"k": 1})
+    d.register("u2", "m2", "clusterA")
+    assert d.where_is("m1") == "u1"
+    assert d.name_of("u2") == "m2"
+    assert d.cluster_of("u1") == "clusterA"
+    assert d.config_of("u1") == {"k": 1}
+    assert d.is_registered_uid("u1")
+    # re-registering a name under a new uid supersedes the old record
+    d.register("u3", "m1", "clusterA")
+    assert d.where_is("m1") == "u3"
+    assert not d.is_registered_uid("u1")
+    # survives a reload
+    d2 = Directory(str(tmp_path))
+    assert d2.where_is("m1") == "u3"
+    assert sorted(d2.uids()) == ["u2", "u3"]
+    d2.unregister("u2")
+    d3 = Directory(str(tmp_path))
+    assert d3.where_is("m2") is None
+
+
+# ---------------------------------------------------------------------------
+# system recovery (server_recovery_strategy: registered)
+# ---------------------------------------------------------------------------
+
+def test_recover_servers_restarts_registered_cluster(tmp_path):
+    router = LocalRouter()
+    sids = [ServerId(f"r{i}", f"rn{i}") for i in (1, 2, 3)]
+    systems = {s.node: RaSystem(str(tmp_path / s.node)) for s in sids}
+    nodes = {s.node: RaNode(s.node, router=router,
+                            log_factory=systems[s.node].log_factory)
+             for s in sids}
+    for sid in sids:
+        nodes[sid.node].start_server(mk_cfg(sid, sids))
+    ra_tpu.trigger_election(sids[0], router)
+    leader = await_leader(router, sids)
+    for v in range(1, 11):
+        ra_tpu.process_command(leader, v, router=router)
+    for n in nodes.values():
+        n.stop()
+    for s in systems.values():
+        s.close()
+
+    # boot fresh systems over the same dirs; recover from the directory
+    # alone — no caller-side config needed beyond the machine resolver
+    router2 = LocalRouter()
+    systems2 = {s.node: RaSystem(str(tmp_path / s.node)) for s in sids}
+    nodes2 = {s.node: RaNode(s.node, router=router2,
+                             log_factory=systems2[s.node].log_factory)
+              for s in sids}
+    started = []
+    for s in sids:
+        started += systems2[s.node].recover_servers(
+            nodes2[s.node], lambda cluster, name: counter())
+    assert sorted(x.name for x in started) == ["r1", "r2", "r3"]
+    leader2 = await_leader(router2, sids)
+    res = ra_tpu.consistent_query(leader2, lambda s: s, router=router2)
+    assert res.reply == 55
+    # resolver returning None skips (machine unknown to this deployment)
+    assert systems2[sids[0].node].recover_servers(
+        nodes2[sids[0].node], lambda c, n: None) == []
+    for n in nodes2.values():
+        n.stop()
+    for s in systems2.values():
+        s.close()
+
+
+def test_force_delete_server_wipes_data(tmp_path):
+    router = LocalRouter()
+    sid = ServerId("solo", "sn1")
+    system = RaSystem(str(tmp_path / "sn1"))
+    node = RaNode("sn1", router=router, log_factory=system.log_factory)
+    node.start_server(mk_cfg(sid, [sid]))
+    ra_tpu.trigger_election(sid, router)
+    await_leader(router, [sid])
+    ra_tpu.process_command(sid, 1, router=router)
+    uid = node.shells[sid.name].server.cfg.uid
+    assert os.path.isdir(os.path.join(system.data_dir, uid))
+    ra_tpu.force_delete_server(sid, system=system, router=router)
+    deadline = time.monotonic() + 5
+    while sid.name in node.shells and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert sid.name not in node.shells
+    assert not os.path.isdir(os.path.join(system.data_dir, uid))
+    assert not system.directory.is_registered_uid(uid)
+    node.stop()
+    system.close()
+
+
+def test_restart_and_stop_server_api(tmp_path):
+    router = LocalRouter()
+    sids = [ServerId(f"a{i}", f"an{i}") for i in (1, 2, 3)]
+    systems = {s.node: RaSystem(str(tmp_path / s.node)) for s in sids}
+    nodes = {s.node: RaNode(s.node, router=router,
+                            log_factory=systems[s.node].log_factory)
+             for s in sids}
+    for sid in sids:
+        nodes[sid.node].start_server(mk_cfg(sid, sids))
+    ra_tpu.trigger_election(sids[0], router)
+    leader = await_leader(router, sids)
+    ra_tpu.process_command(leader, 9, router=router)
+    follower = next(s for s in sids if s != leader)
+    ra_tpu.stop_server(follower, router=router)
+    assert follower.name not in nodes[follower.node].shells
+    # restart = start over the persisted config/log: state recovers
+    systems[follower.node].recover_servers(
+        nodes[follower.node], lambda c, n: counter())
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        st = ra_tpu.local_query(follower, lambda s: s, router=router)
+        if st.reply == 9:
+            break
+        time.sleep(0.02)
+    assert st.reply == 9
+    # in-place restart API on a running member
+    ra_tpu.restart_server(leader, router=router)
+    await_leader(router, sids)
+    for n in nodes.values():
+        n.stop()
+    for s in systems.values():
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# ra_aux accessor surface
+# ---------------------------------------------------------------------------
+
+class AuxProbe(Machine):
+    """Machine whose aux handler reports server internals via the
+    sanctioned accessor surface."""
+
+    def init(self, config):
+        return 0
+
+    def apply(self, meta, command, state):
+        return state + command, state + command, []
+
+    def init_aux(self, name):
+        return {"name": name}
+
+    def handle_aux(self, raft_state, kind, msg, aux_state, internal):
+        if msg == "probe":
+            report = {
+                "machine_state": aux.machine_state(internal),
+                "leader": aux.leader_id(internal),
+                "term": aux.current_term(internal),
+                "members": sorted(m.name for m in aux.members(internal)),
+                "last": tuple(aux.log_last_index_term(internal)),
+                "entry3": aux.log_fetch(3, internal),
+                "log_type": aux.log_stats(internal).get("type",
+                                                        "memory"),
+                "mac_ver": aux.effective_machine_version(internal),
+            }
+            return aux_state, [], report
+        return aux_state, [], None
+
+
+def test_aux_accessors_via_aux_command():
+    router = LocalRouter()
+    nodes = [RaNode(f"xn{i}", router=router) for i in (1, 2, 3)]
+    sids = [ServerId(f"x{i}", f"xn{i}") for i in (1, 2, 3)]
+    try:
+        ra_tpu.start_cluster("auxq", AuxProbe, sids, router=router)
+        leader = await_leader(router, sids)
+        for v in (5, 7):
+            ra_tpu.process_command(leader, v, router=router)
+        rep = ra_tpu.aux_command(leader, "probe", router=router)
+        assert rep["machine_state"] == 12
+        assert rep["leader"] == leader
+        assert rep["members"] == ["x1", "x2", "x3"]
+        assert rep["last"][0] >= 3
+        assert rep["term"] >= 1
+        assert rep["mac_ver"] == 0
+        # log_fetch resolves a real committed entry
+        assert rep["entry3"] is not None
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_force_delete_stopped_member_and_no_resurrection(tmp_path):
+    """force_delete on an already-stopped member must still wipe its data
+    (uid resolved via the system directory), and restart_server must not
+    be able to resurrect the deleted identity over an empty log."""
+    import pytest
+
+    router = LocalRouter()
+    sid = ServerId("gone", "gn1")
+    system = RaSystem(str(tmp_path / "gn1"))
+    node = RaNode("gn1", router=router, log_factory=system.log_factory)
+    node.start_server(mk_cfg(sid, [sid]))
+    ra_tpu.trigger_election(sid, router)
+    await_leader(router, [sid])
+    ra_tpu.process_command(sid, 1, router=router)
+    uid = node.shells[sid.name].server.cfg.uid
+    ra_tpu.stop_server(sid, router=router)            # stopped first
+    ra_tpu.force_delete_server(sid, system=system, router=router)
+    assert not os.path.isdir(os.path.join(system.data_dir, uid))
+    assert not system.directory.is_registered_uid(uid)
+    # the node directory forgot it too: no amnesiac resurrection
+    with pytest.raises(AssertionError):
+        ra_tpu.restart_server(sid, router=router)
+    # and system recovery skips it (nothing registered anymore)
+    assert system.recover_servers(node, lambda c, n: counter()) == []
+    node.stop()
+    system.close()
+
+
+def test_force_delete_does_not_pin_wal_files(tmp_path):
+    """A force-deleted uid must not keep WAL files alive: after purge, a
+    rollover whose file contains the deleted uid's entries can still be
+    retired once the surviving servers' entries are flushed."""
+    router = LocalRouter()
+    a, b = ServerId("wa", "wn1"), ServerId("wb", "wn1")
+    system = RaSystem(str(tmp_path / "wn1"))
+    node = RaNode("wn1", router=router, log_factory=system.log_factory)
+    node.start_server(mk_cfg(a, [a]))
+    node.start_server(mk_cfg(b, [b]))
+    ra_tpu.trigger_election(a, router)
+    ra_tpu.trigger_election(b, router)
+    await_leader(router, [a])
+    await_leader(router, [b])
+    ra_tpu.process_command(a, 1, router=router)
+    ra_tpu.process_command(b, 2, router=router)
+    system.wal.flush()
+    ra_tpu.force_delete_server(a, system=system, router=router)
+    system.wal.rollover()
+    system.wal.flush()
+    system.segment_writer.await_idle()
+    wal_dir = os.path.join(system.data_dir, "wal")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        files = sorted(os.listdir(wal_dir))
+        if len(files) == 1:       # only the fresh post-rollover file
+            break
+        time.sleep(0.05)
+    assert len(files) == 1, f"WAL files pinned by deleted uid: {files}"
+    # the survivor still works
+    res = ra_tpu.process_command(b, 3, router=router)
+    assert res.reply == 5
+    node.stop()
+    system.close()
