@@ -97,7 +97,8 @@ def _init_state(n_lanes: int, n_members: int, ring_capacity: int,
 def _step(state: LaneState, n_new: Array, payloads: Array,
           fail_mask: Array, elect_mask: Array, *, machine: JitMachine,
           ring_capacity: int, apply_window: int,
-          pipeline_window: int, write_delay: int) -> LaneState:
+          pipeline_window: int, write_delay: int,
+          quorum_fn=evaluate_quorum) -> LaneState:
     """One lockstep round for every lane.  Pure; jitted by the engine."""
     N, P = state.last_index.shape
     R = ring_capacity
@@ -199,8 +200,8 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
                                          axis=-1)[:, 0]
     # NB: down members stay in the quorum denominator (their match just
     # freezes) — a leader that lost a majority must stop committing
-    new_leader_commit = evaluate_quorum(leader_commit0, match,
-                                        state.voter, term_start)
+    new_leader_commit = quorum_fn(leader_commit0, match,
+                                  state.voter, term_start)
     # followers learn commit via the (lockstep) AER broadcast, bounded by
     # their own log (evaluate_commit_index_follower: min(last_index, CI))
     commit = jnp.minimum(new_leader_commit[:, None], last_index)
@@ -261,7 +262,7 @@ class LockstepEngine:
                  *, ring_capacity: int = 1024, max_step_cmds: int = 64,
                  apply_window: Optional[int] = None,
                  pipeline_window: int = 4096, write_delay: int = 0,
-                 donate: bool = True) -> None:
+                 donate: bool = True, quorum_impl: str = "xla") -> None:
         self.machine = machine
         self.n_lanes = n_lanes
         self.n_members = n_members
@@ -281,11 +282,13 @@ class LockstepEngine:
         self.state = _init_state(n_lanes, n_members, ring_capacity,
                                  self.payload_width, mac,
                                  self.payload_dtype)
+        from ..ops.pallas_quorum import make_evaluate_quorum
         step = functools.partial(_step, machine=machine,
                                  ring_capacity=ring_capacity,
                                  apply_window=self.apply_window,
                                  pipeline_window=pipeline_window,
-                                 write_delay=write_delay)
+                                 write_delay=write_delay,
+                                 quorum_fn=make_evaluate_quorum(quorum_impl))
         self._step = jax.jit(step, donate_argnums=(0,) if donate else ())
         self._zero_fail = jnp.zeros((n_lanes, n_members), bool)
         self._zero_elect = jnp.zeros((n_lanes,), bool)

@@ -155,17 +155,21 @@ class DurableLog:
             self._seg_seq = max(self._seg_seq, seq)
             try:
                 seg = SegmentFile(os.path.join(self.dir, fname))
-            except Exception:
-                continue
+            except (ValueError, struct.error):
+                continue  # corrupt header/index: skip the file
+            # NB: OSError deliberately propagates — EMFILE/EIO here is an
+            # environment fault; swallowing it would drop committed
+            # entries and report a short log as healthy
             if seg.range() is None:
                 seg.close()
                 os.unlink(os.path.join(self.dir, fname))
                 continue
             found.append((seq, seg))
+            # enforce the fd cap DURING the scan: a long log would
+            # otherwise hold every fd open until the post-scan eviction
+            self._open_segments.touch(seg.path, seg)
         found.sort(key=lambda p: p[0])
         self._segments = [seg for _seq, seg in found]
-        for seg in self._segments:
-            self._open_segments.touch(seg.path, seg)
         last, last_term = 0, 0
         if self._segments:
             lo, hi = self._segments[-1].range()

@@ -193,11 +193,19 @@ class SegmentWriter:
     def __init__(self, resolve: Optional[Callable] = None) -> None:
         #: resolve(uid) -> DurableLog | None (set by the node/log registry)
         self.resolve = resolve or (lambda uid: None)
+        # force-deleted uids: an unresolvable uid in this set means "skip
+        # its entries", not "keep the WAL file for a future restart"
+        self._deleted: set = set()
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = False
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ra-segment-writer")
         self._thread.start()
+
+    def mark_deleted(self, uid: str) -> None:
+        """Called on force-delete so flush jobs already queued (or queued
+        later) for this uid do not pin their WAL files forever."""
+        self._deleted.add(uid)
 
     def accept_ranges(self, ranges: dict, wal_path: str) -> None:
         """Called by the WAL on rollover (accept_mem_tables/3)."""
@@ -241,9 +249,12 @@ class SegmentWriter:
         for uid, (lo, hi) in ranges.items():
             log = self.resolve(uid)
             if log is None:
-                # a stopped server's entries live only in this WAL file;
-                # keep it so restart recovery can replay them
-                unresolved = True
+                # a STOPPED server's entries live only in this WAL file:
+                # keep it so restart recovery can replay them.  A DELETED
+                # server's entries are garbage — they must not pin the
+                # file (purge may race a job already queued at rollover)
+                if uid not in self._deleted:
+                    unresolved = True
                 continue
             log.flush_mem_to_segments(hi)
         if not unresolved:

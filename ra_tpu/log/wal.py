@@ -176,8 +176,13 @@ class Wal:
                 retire = (list(self._recovered),
                           list(self._recovered_files))
                 self._recovered_files = []
-        if retire is not None and self.segment_writer is not None:
-            self.segment_writer.retire(*retire)
+        if self.segment_writer is not None:
+            # flush jobs already queued for this uid must skip it rather
+            # than keep their WAL file waiting for a server that will
+            # never come back
+            self.segment_writer.mark_deleted(uid)
+            if retire is not None:
+                self.segment_writer.retire(*retire)
 
     # -- write path ---------------------------------------------------------
 

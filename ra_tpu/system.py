@@ -53,6 +53,14 @@ class RaSystem:
         self.wal = Wal(data_dir, sync_mode=wal_sync_mode,
                        max_size=wal_max_size, max_batch=wal_max_batch,
                        segment_writer=self.segment_writer)
+        # WAL entries recovered for uids absent from the durable directory
+        # belong to force-deleted servers (every live server registers
+        # through log_factory): purge them, or the retirement gate would
+        # wait forever for a registration that never comes and pin every
+        # recovered WAL file
+        for uid in list(self.wal._recovered):
+            if not self.directory.is_registered_uid(uid):
+                self.wal.purge(uid)
 
     def _resolve(self, uid: str) -> Optional[DurableLog]:
         with self._lock:
@@ -64,9 +72,15 @@ class RaSystem:
         survives server crashes within a running system — a restarted
         server reuses it (the ra_log_ets role: memtables outlive the
         processes that fill them)."""
+        # every uid that owns a log MUST be in the durable directory — the
+        # boot purge treats absence as "force-deleted".  Log-only configs
+        # (no server_id; tests/tools) register under their uid with an
+        # empty config snapshot, which recover_servers skips.
         if cfg.server_id is not None:
             self.directory.register(cfg.uid, cfg.server_id.name,
                                     cfg.cluster_name, _config_snapshot(cfg))
+        else:
+            self.directory.register(cfg.uid, cfg.uid, cfg.cluster_name, {})
         with self._lock:
             log = self._logs.get(cfg.uid)
             if log is not None:

@@ -2,8 +2,14 @@ import os
 import sys
 
 # Tests run sharding on a virtual multi-device CPU mesh; the real chip is
-# only exercised by bench.py.  Export JAX_PLATFORMS=tpu to override.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# only exercised by bench.py.  Export RA_TPU_TEST_PLATFORM to override.
+# This must OVERRIDE (not setdefault): images with a TPU tunnel export
+# JAX_PLATFORMS=<plugin> globally, which would silently point the whole
+# suite at the tunnel and hang every test when the tunnel is down.
+# (If the tunnel's site hook already registered a plugin whose discovery
+# blocks on a dead endpoint, additionally launch pytest with PYTHONPATH=
+# so the hook never runs.)
+os.environ["JAX_PLATFORMS"] = os.environ.get("RA_TPU_TEST_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

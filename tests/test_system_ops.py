@@ -271,3 +271,88 @@ def test_force_delete_does_not_pin_wal_files(tmp_path):
     assert res.reply == 5
     node.stop()
     system.close()
+
+
+def test_queued_flush_job_skips_deleted_uid(tmp_path):
+    """A flush job already queued when its uid is force-deleted must skip
+    the uid instead of keeping the WAL file forever (purge/rollover
+    race).  Driven directly against the segment writer."""
+    import pickle
+
+    router = LocalRouter()
+    a, b = ServerId("qa", "qn1"), ServerId("qb", "qn1")
+    system = RaSystem(str(tmp_path / "qn1"))
+    node = RaNode("qn1", router=router, log_factory=system.log_factory)
+    node.start_server(mk_cfg(a, [a]))
+    node.start_server(mk_cfg(b, [b]))
+    logb = system._logs["uid_qb"]
+    from ra_tpu.core.types import Entry
+    logb.write([Entry(1, 1, "x")])
+    system.wal.flush()
+    for evt in logb.take_events():
+        logb.handle_written(evt)
+    # force-delete A, then hand the writer a job that still names uid_qa
+    # (as a job queued before the delete would)
+    ra_tpu.force_delete_server(a, system=system, router=router)
+    fake_wal = os.path.join(system.data_dir, "wal", "99999999.wal")
+    with open(fake_wal, "wb") as f:
+        f.write(b"RTW1")
+    system.segment_writer.accept_ranges({"uid_qa": (1, 1),
+                                         "uid_qb": (1, 1)}, fake_wal)
+    system.segment_writer.await_idle()
+    assert not os.path.exists(fake_wal), \
+        "deleted uid pinned a queued WAL flush job"
+    node.stop()
+    system.close()
+
+
+def test_boot_purges_wal_entries_of_deleted_uids(tmp_path):
+    """WAL-recovered entries for uids absent from the durable directory
+    (force-deleted before their file rotated out) must be purged at boot,
+    or the retirement gate never fires again and every recovered WAL file
+    is pinned across all future restarts."""
+    router = LocalRouter()
+    a, b = ServerId("ba", "bn1"), ServerId("bb", "bn1")
+    system = RaSystem(str(tmp_path / "bn1"))
+    node = RaNode("bn1", router=router, log_factory=system.log_factory)
+    node.start_server(mk_cfg(a, [a]))
+    node.start_server(mk_cfg(b, [b]))
+    ra_tpu.trigger_election(a, router)
+    ra_tpu.trigger_election(b, router)
+    await_leader(router, [a])
+    await_leader(router, [b])
+    ra_tpu.process_command(a, 1, router=router)
+    ra_tpu.process_command(b, 2, router=router)
+    system.wal.flush()
+    # delete A's directory record only — simulating a force-delete whose
+    # purge didn't cover the on-disk WAL (e.g. crash right after)
+    uid_a = "uid_ba"
+    system.directory.unregister(uid_a)
+    node.stop()
+    system.close()
+
+    router2 = LocalRouter()
+    system2 = RaSystem(str(tmp_path / "bn1"))
+    node2 = RaNode("bn1", router=router2, log_factory=system2.log_factory)
+    # boot purge dropped the orphan uid; once B re-registers, the
+    # recovered WAL files retire instead of pinning forever
+    assert uid_a not in system2.wal._recovered
+    started = system2.recover_servers(node2, lambda c, n: counter())
+    assert [s.name for s in started] == ["bb"]
+    system2.wal.flush()
+    system2.segment_writer.await_idle()
+    wal_dir = os.path.join(system2.data_dir, "wal")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        files = sorted(os.listdir(wal_dir))
+        if len(files) == 1:
+            break
+        time.sleep(0.05)
+    assert len(files) == 1, f"recovered WAL files pinned: {files}"
+    # B's state survived
+    ra_tpu.trigger_election(b, router2)
+    await_leader(router2, [b])
+    res = ra_tpu.consistent_query(b, lambda s: s, router=router2)
+    assert res.reply == 2
+    node2.stop()
+    system2.close()
