@@ -1,7 +1,8 @@
 from .counter import CounterMachine
 from .fifo import FifoMachine
 from .fifo_client import FifoClient, Mailbox
+from .kv import KvMachine
 from .queue import QueueMachine
 
-__all__ = ["CounterMachine", "FifoMachine", "FifoClient", "Mailbox",
-           "QueueMachine"]
+__all__ = ["CounterMachine", "FifoMachine", "FifoClient", "KvMachine",
+           "Mailbox", "QueueMachine"]

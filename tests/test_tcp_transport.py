@@ -97,8 +97,17 @@ def _ask(chans, n, *cmd, timeout=15):
 def test_cross_process_cluster(procs):
     names, chans, workers = procs
     _ask(chans, "tn1", "elect")
-    # committed via TCP across 3 OS processes
-    r = _ask(chans, "tn1", "command", 5)
+    # the election is fire-and-forget: wait for a leader FIRST, then send
+    # the (non-idempotent) command exactly once — retrying a counter
+    # command after a lost reply would double-apply it
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        states = [_ask(chans, n, "state") for n in names]
+        if any(s[1] == "leader" for s in states):
+            break
+        time.sleep(0.2)
+    assert any(s[1] == "leader" for s in states), states
+    r = _ask(chans, "tn1", "command", 5, timeout=20)
     assert r[0] == "ok" and r[1] == 5, r
     r = _ask(chans, "tn2", "command", 7)  # redirect over TCP
     assert r[0] == "ok" and r[1] == 12, r
