@@ -2,7 +2,8 @@ from .counter import CounterMachine
 from .fifo import FifoMachine
 from .fifo_client import FifoClient, Mailbox
 from .kv import KvMachine
+from .registers import RegisterMachine
 from .queue import QueueMachine
 
 __all__ = ["CounterMachine", "FifoMachine", "FifoClient", "KvMachine",
-           "Mailbox", "QueueMachine"]
+           "Mailbox", "QueueMachine", "RegisterMachine"]
