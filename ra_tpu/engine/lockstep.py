@@ -344,6 +344,52 @@ class LockstepEngine:
                     st.last_written[lane, leader]))
         self.state = st._replace(active=st.active.at[lane, slot].set(True))
 
+    # -- membership (per-lane add/remove/promote, SURVEY §2.1 membership) --
+
+    def add_member(self, lane: int, slot: int,
+                   voter: bool = False) -> None:
+        """Bring a member slot into a lane's cluster.  Joins as nonvoter
+        by default (the reference's join→catch-up→promote flow,
+        ra_server.erl:3218-3293): the new member is seeded from the
+        leader's replica (snapshot install) and only counts toward
+        quorum once promoted."""
+        st = self.state
+        leader = int(st.leader_slot[lane])
+        st = st._replace(
+            mac=jax.tree.map(
+                lambda x: x.at[lane, slot].set(x[lane, leader]), st.mac),
+            applied=st.applied.at[lane, slot].set(st.applied[lane, leader]),
+            commit=st.commit.at[lane, slot].set(st.commit[lane, leader]),
+            last_index=st.last_index.at[lane, slot].set(
+                st.last_written[lane, leader]),
+            last_written=st.last_written.at[lane, slot].set(
+                st.last_written[lane, leader]),
+            active=st.active.at[lane, slot].set(True),
+            voter=st.voter.at[lane, slot].set(bool(voter)))
+        self.state = st
+        self._fail_host[lane, slot] = False
+
+    def promote_member(self, lane: int, slot: int) -> None:
+        """Nonvoter -> voter once caught up ('$ra_join' promotion)."""
+        self.state = self.state._replace(
+            voter=self.state.voter.at[lane, slot].set(True))
+
+    def remove_member(self, lane: int, slot: int) -> None:
+        """Drop a member from a lane's cluster: it leaves the quorum
+        denominator immediately ('$ra_leave').  Removing the lane's
+        current leader is refused — transfer leadership first (trigger an
+        election for the lane), as the reference does when the leader is
+        asked to leave; silently deactivating the leader slot would stall
+        the lane forever with no error."""
+        if int(self.state.leader_slot[lane]) == slot:
+            raise ValueError(
+                f"slot {slot} is lane {lane}'s leader; "
+                "trigger_election first")
+        st = self.state
+        self.state = st._replace(
+            active=st.active.at[lane, slot].set(False),
+            voter=st.voter.at[lane, slot].set(False))
+
     def trigger_election(self, lanes) -> None:
         mask = np.zeros((self.n_lanes,), bool)
         mask[np.asarray(lanes)] = True

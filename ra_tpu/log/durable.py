@@ -76,6 +76,55 @@ def _read_snapshot_file(path: str) -> Optional[tuple]:
         return None
 
 
+class LogReader:
+    """External-reader handle over a DurableLog's segment-flushed entries
+    (the registered-reader role, ra_log.erl:983-1008).  Reads resolve
+    per-call under the log's io lock; while the reader is open, snapshot
+    truncation pins (rather than deletes) covered segment files, so a
+    slow reader never loses entries it could already see.  Entries still
+    in the memtable (not yet segment-flushed) are NOT visible — the
+    reference's external readers consume flushed segrefs only."""
+
+    def __init__(self, log: "DurableLog", name: str) -> None:
+        self._log = log
+        self.name = name
+        self._closed = False
+
+    def fetch(self, idx: int) -> Optional[Entry]:
+        got = self._log._reader_read(idx)
+        if got is None:
+            return None
+        term, payload = got
+        return Entry(idx, term, pickle.loads(payload))
+
+    def sparse_read(self, indexes: Iterable[int]) -> list:
+        out = []
+        for i in indexes:
+            e = self.fetch(i)
+            if e is not None:
+                out.append(e)
+        return out
+
+    def fold(self, from_idx: int, to_idx: int, fn: Callable,
+             acc: Any) -> Any:
+        for i in range(from_idx, to_idx + 1):
+            e = self.fetch(i)
+            if e is not None:
+                acc = fn(e, acc)
+        return acc
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._log.close_reader(self.name)
+
+    def __enter__(self) -> "LogReader":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
 class DurableLog:
     def __init__(self, uid: str, data_dir: str, wal, *,
                  segment_max_count: int = DEFAULT_MAX_COUNT) -> None:
@@ -111,6 +160,12 @@ class DurableLog:
         self._snapshot: Optional[tuple] = None  # (meta, path)
         self._checkpoints: list[tuple] = []     # [(meta, path)] sorted asc
         self._truncate_next = False
+        #: registered external readers (ra_log.erl:983-1008) and segments
+        #: kept alive for them past a snapshot truncation.  name -> count:
+        #: two consumers may register under the same name; the pins hold
+        #: until the LAST registration closes
+        self._readers: dict = {}
+        self._pinned_segments: list = []
         self._recover_state()
         wal.register(uid, self._wal_notify)
 
@@ -160,7 +215,11 @@ class DurableLog:
             # NB: OSError deliberately propagates — EMFILE/EIO here is an
             # environment fault; swallowing it would drop committed
             # entries and report a short log as healthy
-            if seg.range() is None:
+            r = seg.range()
+            if r is None or r[1] <= snap_idx:
+                # empty, or wholly covered by the snapshot: a pinned
+                # segment left behind by a shutdown with an open reader —
+                # dead weight below first_index, reclaim it now
                 seg.close()
                 os.unlink(os.path.join(self.dir, fname))
                 continue
@@ -608,11 +667,18 @@ class DurableLog:
                         seg.truncate_from(self._last_index + 1)
             for seg in victims:
                 self._open_segments.pop(seg.path)
-                seg.close()
-                try:
-                    os.unlink(seg.path)
-                except FileNotFoundError:
-                    pass
+                if self._readers:
+                    # external readers hold the pre-truncation view: move
+                    # the segment to the pinned list instead of deleting
+                    # (the reference defers its memtable/segment deletion
+                    # while registered readers exist, ra_log.erl:534-574)
+                    self._pinned_segments.append(seg)
+                else:
+                    seg.close()
+                    try:
+                        os.unlink(seg.path)
+                    except FileNotFoundError:
+                        pass
 
     def _drop_stale_checkpoints(self, idx: int) -> None:
         with self._lock:
@@ -625,6 +691,55 @@ class DurableLog:
             except FileNotFoundError:
                 pass
 
+    # -- external readers (ra_log.erl:983-1008) -----------------------------
+
+    def register_reader(self, name: str) -> "LogReader":
+        """Register an external reader over the segment-flushed portion of
+        the log.  While any reader is registered, snapshot truncation
+        defers segment deletion (the files move to a pinned list the
+        readers can still resolve) — the role the reference fills with
+        deferred ETS/segment deletion for registered readers."""
+        with self._lock:
+            self._readers[name] = self._readers.get(name, 0) + 1
+        return LogReader(self, name)
+
+    def close_reader(self, name: str) -> None:
+        with self._io_lock:
+            with self._lock:
+                n = self._readers.get(name, 0) - 1
+                if n > 0:
+                    self._readers[name] = n
+                else:
+                    self._readers.pop(name, None)
+                if self._readers:
+                    return
+                victims, self._pinned_segments = self._pinned_segments, []
+            for seg in victims:
+                self._open_segments.pop(seg.path)
+                seg.close()
+                try:
+                    os.unlink(seg.path)
+                except FileNotFoundError:
+                    pass
+
+    def _reader_read(self, idx: int) -> Optional[tuple]:
+        """Resolve an index for an external reader: live segments first,
+        then segments pinned past a truncation."""
+        with self._io_lock:
+            # newest wins: live segments (newer) before pinned (older,
+            # pre-truncation) — so the concat is pinned first, reversed
+            for seg in reversed(self._pinned_segments + self._segments):
+                r = seg.range()
+                if r and r[0] <= idx <= r[1]:
+                    # reader reads respect the fd cap too: an untracked
+                    # reopen would defeat MAX_OPEN_SEGMENTS over a long
+                    # fold (pinned segments share the same cache)
+                    self._open_segments.touch(seg.path, seg)
+                    got = seg.read(idx)
+                    if got is not None:
+                        return got
+        return None
+
     # -- misc ---------------------------------------------------------------
 
     def tick(self, now_ms: float) -> list:
@@ -632,7 +747,7 @@ class DurableLog:
 
     def close(self) -> None:
         with self._lock:
-            for seg in self._segments:
+            for seg in self._segments + self._pinned_segments:
                 seg.close()
 
     def overview(self) -> dict:

@@ -84,7 +84,8 @@ class RegisterMachine(JitMachine):
                     return jnp.asarray([3, int(command[1]),
                                         int(command[3]),
                                         int(command[2])], jnp.int32)
-        except (TypeError, ValueError):
+        except (TypeError, ValueError, IndexError, OverflowError):
+            # IndexError: empty tuple; OverflowError: out-of-int32 field
             pass
         return jnp.zeros((4,), jnp.int32)
 

@@ -255,3 +255,104 @@ def test_wal_gap_triggers_resend(tmp_path):
     drain(log)
     assert log.last_written().index == 2
     sys_.close()
+
+
+def test_external_reader_survives_snapshot_truncation(tmp_path):
+    """ra_2_SUITE's external-reader scenario: a registered reader keeps
+    segment-flushed entries readable across a snapshot truncation; the
+    pinned files are deleted once the last reader closes."""
+    import os as _os
+
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 41):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    reader = log.register_reader("stream-1")
+    seg_paths = [s.path for s in log._segments]
+    assert seg_paths, "entries should be segment-flushed"
+    # snapshot far past the flushed entries truncates the live log
+    log.update_release_cursor(35, (), 0, {"v": 35})
+    assert log.first_index() == 36
+    assert log.fetch(10) is None            # live reads: truncated
+    got = reader.sparse_read([1, 10, 35])   # reader: still visible
+    assert [e.command.data for e in got] == [1, 10, 35]
+    total = reader.fold(1, 35, lambda e, a: a + e.command.data, 0)
+    assert total == sum(range(1, 36))
+    # pinned files still on disk until the reader closes
+    assert any(_os.path.exists(p) for p in seg_paths)
+    reader.close()
+    assert not any(_os.path.exists(p) for p in seg_paths
+                   if p not in [s.path for s in log._segments])
+    sys_.close()
+
+
+def test_two_readers_pin_until_last_closes(tmp_path):
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 21):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    r1 = log.register_reader("r1")
+    r2 = log.register_reader("r2")
+    log.update_release_cursor(20, (), 0, {})
+    assert r1.fetch(5) is not None
+    r1.close()
+    assert r2.fetch(5) is not None          # r2 still pins
+    r2.close()
+    assert log._pinned_segments == []
+    sys_.close()
+
+
+def test_same_name_readers_refcount(tmp_path):
+    """Two consumers under one reader name: pins hold until the LAST
+    close (a set would collapse them and unpin early)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 21):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    r1 = log.register_reader("stream")
+    r2 = log.register_reader("stream")
+    log.update_release_cursor(20, (), 0, {})
+    r1.close()
+    assert r2.fetch(5) is not None, "second reader lost its pins"
+    r2.close()
+    assert log._pinned_segments == []
+    sys_.close()
+
+
+def test_recovery_reclaims_orphaned_pinned_segments(tmp_path):
+    """Shutdown with an open reader leaves pinned (fully-truncated)
+    segment files on disk; recovery must reclaim them instead of
+    re-adopting dead weight below first_index."""
+    import os as _os
+
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 21):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    log.register_reader("leaky")       # never closed
+    log.update_release_cursor(20, (), 0, {"v": 20})
+    pinned = [s.path for s in log._pinned_segments]
+    assert pinned
+    sys_.close()                       # reader still open: files survive
+    assert all(_os.path.exists(p) for p in pinned)
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    assert not any(_os.path.exists(p) for p in pinned)
+    assert log2.snapshot_index_term().index == 20
+    sys2.close()

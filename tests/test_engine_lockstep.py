@@ -117,3 +117,77 @@ def test_large_lane_count_smoke():
     e.uniform_step(0)
     assert e.committed_per_lane().min() >= 24
     assert (e.machine_states()[:, 0] == 24).all()
+
+
+def test_membership_add_promote_remove_quorum():
+    """Per-lane membership: a removed voter leaves the quorum
+    denominator, a joined nonvoter does not count until promoted, and a
+    promoted member does (ra_server.erl:3218-3293 on the lane engine)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ra_tpu.engine import LockstepEngine
+    from ra_tpu.models import CounterMachine
+
+    N, P, K = 4, 5, 4
+    eng = LockstepEngine(CounterMachine(), N, P, ring_capacity=128,
+                         max_step_cmds=K, donate=False)
+    n_new = jnp.full((N,), K, jnp.int32)
+    payloads = jnp.ones((N, K, 1), jnp.int32)
+    zero = jnp.zeros((N,), jnp.int32)
+    zpay = jnp.zeros((N, K, 1), jnp.int32)
+
+    def drain():
+        for _ in range(3):
+            eng.step(zero, zpay)
+        eng.block_until_ready()
+
+    eng.step(n_new, payloads)
+    drain()
+    base = eng.committed_per_lane()[0]
+    assert base > 0
+
+    # remove two voters from lane 0: 3 voters remain -> quorum 2 holds
+    eng.remove_member(0, 3)
+    eng.remove_member(0, 4)
+    eng.step(n_new, payloads)
+    drain()
+    after_remove = eng.committed_per_lane()[0]
+    assert after_remove > base
+
+    # fail one of the remaining three: 2 of 3 active -> still commits
+    eng.fail_member(0, 2)
+    eng.step(n_new, payloads)
+    drain()
+    after_fail = eng.committed_per_lane()[0]
+    assert after_fail > after_remove
+
+    # fail another: 1 of 3 voters active -> lane 0 stalls, others advance
+    eng.fail_member(0, 1)
+    before_stall = eng.committed_per_lane().copy()
+    eng.step(n_new, payloads)
+    drain()
+    now = eng.committed_per_lane()
+    assert now[0] == before_stall[0], "minority lane must not commit"
+    assert now[1] > before_stall[1]
+
+    # dead members stay in the quorum denominator until REMOVED (a
+    # leader that lost its majority must not commit); removing one dead
+    # voter leaves voters {0,1} with only slot 0 alive -> still stalled
+    eng.remove_member(0, 2)
+    eng.step(n_new, payloads)
+    drain()
+    assert eng.committed_per_lane()[0] == before_stall[0]
+    # a joining NONVOTER must not restore quorum...
+    eng.add_member(0, 3, voter=False)
+    eng.step(n_new, payloads)
+    drain()
+    assert eng.committed_per_lane()[0] == before_stall[0]
+    # ...but promoting it does: voters {0,1,3}, alive {0,3} = quorum 2
+    eng.promote_member(0, 3)
+    eng.step(n_new, payloads)
+    drain()
+    assert eng.committed_per_lane()[0] > before_stall[0]
+    # machine state on the joined member matches the leader's replica
+    mac = np.asarray(eng.state.mac)
+    leader = int(np.asarray(eng.state.leader_slot)[0])
+    assert mac[0, 3] == mac[0, leader]

@@ -108,6 +108,7 @@ def test_malformed_commands_encode_as_noop():
     arity or non-int fields encode as noop."""
     m = RegisterMachine(n_slots=4)
     for bad in (("cas", 1, 5), ("put", "a", 1), ("add",), ("put", 0, 1, 2),
-                "put", 7, None, ("frobnicate", 1, 2)):
+                "put", 7, None, ("frobnicate", 1, 2), (),
+                ("put", 0, 2**31), ("add", 0, -2**40)):
         enc = np.asarray(m.encode_command(bad))
         assert enc.tolist() == [0, 0, 0, 0], bad
