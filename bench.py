@@ -31,9 +31,12 @@ def main() -> None:
     from ra_tpu.engine import LockstepEngine
     from ra_tpu.models import CounterMachine
 
+    import os
+    quorum_impl = os.environ.get("RA_TPU_QUORUM_IMPL", "xla")
     eng = LockstepEngine(CounterMachine(), N_LANES, N_MEMBERS,
                          ring_capacity=1024, max_step_cmds=CMDS_PER_STEP,
-                         apply_window=CMDS_PER_STEP + 2, write_delay=1)
+                         apply_window=CMDS_PER_STEP + 2, write_delay=1,
+                         quorum_impl=quorum_impl)
 
     n_new = jnp.full((N_LANES,), CMDS_PER_STEP, jnp.int32)
     payloads = jnp.ones((N_LANES, CMDS_PER_STEP, 1), jnp.int32)
@@ -76,6 +79,7 @@ def main() -> None:
         "unit": "cmds/s",
         "vs_baseline": round(value / BASELINE, 4),
         "detail": {
+            "quorum_impl": quorum_impl,
             "lanes": N_LANES, "members": N_MEMBERS,
             "cmds_per_step": CMDS_PER_STEP, "steps": steps,
             "elapsed_s": round(elapsed, 3),

@@ -398,6 +398,42 @@ class LockstepEngine:
                   jnp.zeros((N, K, C), self.payload_dtype),
                   elect_mask=mask)
 
+    # -- checkpoint / resume (device-state snapshot, SURVEY §5) ------------
+
+    def save(self, path: str) -> None:
+        """Write the full lane state to one .npz (atomic replace): the
+        lockstep analogue of the checkpoint/snapshot subsystem — all
+        clusters' Raft cursors + machine states in one device pull."""
+        import os
+
+        flat, treedef = jax.tree.flatten(self.state)
+        arrays = {f"a{i}": np.asarray(x) for i, x in enumerate(flat)}
+        meta = {"n_lanes": self.n_lanes, "n_members": self.n_members,
+                "ring_capacity": self.ring_capacity,
+                "treedef": str(treedef)}
+        tmp = path + ".partial"
+        with open(tmp, "wb") as f:
+            np.savez(f, __meta__=np.frombuffer(
+                repr(meta).encode(), dtype=np.uint8), **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def restore(self, path: str) -> None:
+        """Load a .npz written by :meth:`save` into this engine.  Engine
+        geometry (lanes/members/ring) must match construction — the
+        snapshot is state, not config."""
+        with np.load(path) as z:
+            flat, treedef = jax.tree.flatten(self.state)
+            n = len(flat)
+            loaded = [jnp.asarray(z[f"a{i}"]) for i in range(n)]
+            for want, got in zip(flat, loaded):
+                if want.shape != got.shape:
+                    raise ValueError(
+                        f"checkpoint geometry mismatch: {got.shape} "
+                        f"!= {want.shape}")
+            self.state = jax.tree.unflatten(treedef, loaded)
+
     # -- readback ----------------------------------------------------------
 
     def committed_total(self) -> int:
