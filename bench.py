@@ -9,43 +9,92 @@ computed on-TPU.
 Baseline (BASELINE.md): 10,000 clusters x 5 members >= 1,000,000 committed
 cmds/sec on a single chip.  vs_baseline = value / 1e6.
 
-Prints ONE JSON line.
+Robustness contract (this script must never leave the driver without a
+number): the parent process never imports jax — it probes the backend in a
+subprocess under a timeout, runs each measurement in a child under a
+timeout, retries once, and on TPU unavailability emits a valid JSON line
+with an explicit ``"error": "tpu_unavailable"`` marker plus a CPU smoke
+datapoint (run with the axon site hook stripped so backend init cannot
+hang).  Always prints ONE JSON line; always exits 0.
+
+Latency is measured honestly: per sample, the host clock runs from command
+enqueue until the commit is observable in a device readback (not a
+step-time proxy).
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
+BASELINE = 1_000_000.0       # north-star committed cmds/sec
 N_LANES = 10_000
 N_MEMBERS = 5
 CMDS_PER_STEP = 128          # per-lane pipelined batch per round
-WARMUP_STEPS = 5
-MEASURE_SECONDS = 5.0
-BASELINE = 1_000_000.0       # north-star committed cmds/sec
+
+PROBE_TIMEOUT_S = 120
+CHILD_TIMEOUT_S = 480
 
 
-def main() -> None:
+# ---------------------------------------------------------------------------
+# child mode: one measurement in one process (safe to kill from the parent)
+# ---------------------------------------------------------------------------
+
+def _child_main() -> None:
+    import jax
+    import jax.numpy as jnp
+
     from ra_tpu.engine import LockstepEngine
     from ra_tpu.models import CounterMachine
 
-    import os
+    n_lanes = int(os.environ.get("RA_TPU_BENCH_LANES", N_LANES))
+    n_members = int(os.environ.get("RA_TPU_BENCH_MEMBERS", N_MEMBERS))
+    cmds = int(os.environ.get("RA_TPU_BENCH_CMDS", CMDS_PER_STEP))
+    measure_s = float(os.environ.get("RA_TPU_BENCH_SECONDS", "5.0"))
     quorum_impl = os.environ.get("RA_TPU_QUORUM_IMPL", "xla")
-    eng = LockstepEngine(CounterMachine(), N_LANES, N_MEMBERS,
-                         ring_capacity=1024, max_step_cmds=CMDS_PER_STEP,
-                         apply_window=CMDS_PER_STEP + 2, write_delay=1,
+    machine_name = os.environ.get("RA_TPU_BENCH_MACHINE", "counter")
+
+    # BASELINE.md rows: counter (north star), fifo (5k x 5 enqueue/
+    # dequeue), kv (2k mixed put/get with jittable apply)
+    if machine_name == "fifo":
+        from ra_tpu.models import JitFifoMachine
+        machine = JitFifoMachine(capacity=64, checkout_slots=8)
+        import numpy as np
+        host_payloads = np.zeros((n_lanes, cmds, 2), np.int32)
+        host_payloads[:, 0::2] = (1, 7)        # enqueue 7
+        host_payloads[:, 1::2] = (2, 0)        # dequeue settled
+        payloads = jnp.asarray(host_payloads)
+    elif machine_name == "kv":
+        from ra_tpu.models import JitKvMachine
+        machine = JitKvMachine(n_keys=64)
+        import numpy as np
+        rng = np.random.default_rng(0)
+        host_payloads = np.zeros((n_lanes, cmds, 4), np.int32)
+        host_payloads[..., 0] = rng.integers(1, 3, (n_lanes, cmds))  # put/get
+        host_payloads[..., 1] = rng.integers(0, 64, (n_lanes, cmds))
+        host_payloads[..., 2] = rng.integers(0, 1000, (n_lanes, cmds))
+        payloads = jnp.asarray(host_payloads)
+    else:
+        machine = CounterMachine()
+        payloads = jnp.ones((n_lanes, cmds, 1), jnp.int32)
+
+    eng = LockstepEngine(machine, n_lanes, n_members,
+                         ring_capacity=1024, max_step_cmds=cmds,
+                         apply_window=cmds + 2, write_delay=1,
                          quorum_impl=quorum_impl)
 
-    n_new = jnp.full((N_LANES,), CMDS_PER_STEP, jnp.int32)
-    payloads = jnp.ones((N_LANES, CMDS_PER_STEP, 1), jnp.int32)
+    n_new = jnp.full((n_lanes,), cmds, jnp.int32)
+    zero_n = jnp.zeros((n_lanes,), jnp.int32)
+    zero_p = jnp.zeros_like(payloads)
 
-    for _ in range(WARMUP_STEPS):
+    for _ in range(5):
         eng.step(n_new, payloads)
     eng.block_until_ready()
-    start_committed = eng.committed_total()
 
+    # -- throughput phase -------------------------------------------------
+    start_committed = eng.committed_total()
     steps = 0
     t0 = time.perf_counter()
     while True:
@@ -53,43 +102,233 @@ def main() -> None:
         steps += 1
         if steps % 20 == 0:
             eng.block_until_ready()
-            if time.perf_counter() - t0 >= MEASURE_SECONDS:
+            if time.perf_counter() - t0 >= measure_s:
                 break
     eng.block_until_ready()
     elapsed = time.perf_counter() - t0
     committed = eng.committed_total() - start_committed
+    value = committed / elapsed
 
-    # latency phase: per-step wall times with a sync per step; a command
-    # enqueued at step k commits at step k+1 (write_delay=1), so commit
-    # latency ~= 2 step times.  p99 over the measured distribution.
-    lat = []
-    for _ in range(50):
+    # -- latency phase: honest enqueue->commit clock ----------------------
+    # A sample enqueues one pipelined batch on every lane, then drives
+    # empty rounds until the batch is committed (observable via the
+    # total_committed readback, which forces a device sync).  The clock
+    # covers dispatch + append + write-confirm + quorum + readback — what
+    # a pipelining client actually waits for a commit notification
+    # (ra_bench.erl:153-190 measures the same edge via applied events).
+    expected_per_sample = n_lanes * cmds
+    lats = []
+    truncated = 0
+    for _ in range(40):
+        before = eng.committed_total()
         t1 = time.perf_counter()
         eng.step(n_new, payloads)
-        eng.block_until_ready()
-        lat.append(time.perf_counter() - t1)
-    lat.sort()
-    p99_step = lat[int(len(lat) * 0.99) - 1]
-    p50_step = lat[len(lat) // 2]
+        eng.step(zero_n, zero_p)  # write-confirm + quorum round
+        spins = 0
+        committed_ok = True
+        while eng.committed_total() - before < expected_per_sample:
+            eng.step(zero_n, zero_p)
+            spins += 1
+            if spins > 8:  # safety: never spin forever on a wedged backend
+                committed_ok = False
+                break
+        if committed_ok:
+            lats.append(time.perf_counter() - t1)
+        else:
+            # a sample whose commit was never observed must not pollute
+            # the distribution with a bogus-low wall time
+            truncated += 1
+    lats.sort()
+    p50 = lats[len(lats) // 2] if lats else -1.0
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] if lats else -1.0
 
-    value = committed / elapsed
     print(json.dumps({
-        "metric": "committed_cmds_per_sec_10k_clusters_5_members",
         "value": round(value, 1),
-        "unit": "cmds/s",
-        "vs_baseline": round(value / BASELINE, 4),
-        "detail": {
-            "quorum_impl": quorum_impl,
-            "lanes": N_LANES, "members": N_MEMBERS,
-            "cmds_per_step": CMDS_PER_STEP, "steps": steps,
-            "elapsed_s": round(elapsed, 3),
-            "platform": jax.devices()[0].platform,
-            "device": str(jax.devices()[0]),
-            "p50_commit_latency_ms": round(2000.0 * p50_step, 3),
-            "p99_commit_latency_ms": round(2000.0 * p99_step, 3),
-        },
+        "committed": int(committed),
+        "steps": steps,
+        "elapsed_s": round(elapsed, 3),
+        "p50_commit_latency_ms": round(1000.0 * p50, 3),
+        "p99_commit_latency_ms": round(1000.0 * p99, 3),
+        "latency_samples": len(lats),
+        "latency_samples_dropped": truncated,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "quorum_impl": quorum_impl, "machine": machine_name,
+        "lanes": n_lanes, "members": n_members, "cmds_per_step": cmds,
     }))
 
 
+# ---------------------------------------------------------------------------
+# parent mode: orchestration that cannot hang
+# ---------------------------------------------------------------------------
+
+_CHILD_ERRORS: list = []  # (config, rc/timeout, stderr tail) of failed runs
+
+
+def _run_child(env_extra: dict, timeout_s: float):
+    """Run one measurement child; return its parsed JSON or None (the
+    failure reason is recorded in _CHILD_ERRORS for the output detail)."""
+    env = {**os.environ, **env_extra, "RA_TPU_BENCH_CHILD": "1"}
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True, timeout=timeout_s,
+                           env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        _CHILD_ERRORS.append({"config": env_extra, "rc": "timeout"})
+        return None
+    if r.returncode != 0:
+        _CHILD_ERRORS.append({"config": env_extra, "rc": r.returncode,
+                              "stderr_tail": r.stderr[-2000:]})
+        return None
+    for line in reversed(r.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+                if isinstance(parsed, dict) and "value" in parsed:
+                    return parsed
+            except json.JSONDecodeError:
+                pass
+            break
+    _CHILD_ERRORS.append({"config": env_extra, "rc": 0,
+                          "note": "no parsable result line"})
+    return None
+
+
+def _probe_platform() -> str | None:
+    """Return the default jax platform, or None if backend init hangs/fails.
+    Runs in a subprocess so a dead axon tunnel cannot hang the parent."""
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode != 0:
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].strip()
+    return None
+
+
+def main() -> None:
+    if os.environ.get("RA_TPU_BENCH_CHILD"):
+        _child_main()
+        return
+
+    platform = _probe_platform()
+    tpu_up = platform is not None and platform not in ("cpu",)
+
+    if tpu_up:
+        # full-config run, both quorum impls; retry each once
+        results = {}
+        for impl in ("xla", "pallas"):
+            for _attempt in range(2):
+                res = _run_child({"RA_TPU_QUORUM_IMPL": impl},
+                                 CHILD_TIMEOUT_S)
+                if res is not None:
+                    results[impl] = res
+                    break
+        if results:
+            best_impl = max(results, key=lambda k: results[k]["value"])
+            best = results[best_impl]
+            value = best["value"]
+            detail = {"best_quorum_impl": best_impl}
+            for impl, res in results.items():
+                detail[impl] = res
+            # secondary BASELINE.md rows (short windows): 5k x 5 fifo
+            # enqueue/dequeue and 2k-lane kv mixed put/get
+            for row, env in (
+                ("fifo_5k_x5", {"RA_TPU_BENCH_MACHINE": "fifo",
+                                "RA_TPU_BENCH_LANES": "5000",
+                                "RA_TPU_BENCH_SECONDS": "2.0"}),
+                ("kv_2k", {"RA_TPU_BENCH_MACHINE": "kv",
+                           "RA_TPU_BENCH_LANES": "2000",
+                           "RA_TPU_BENCH_SECONDS": "2.0"}),
+            ):
+                res = _run_child({**env, "RA_TPU_QUORUM_IMPL": best_impl},
+                                 CHILD_TIMEOUT_S)
+                if res is not None:
+                    detail[row] = res
+            print(json.dumps({
+                "metric": "committed_cmds_per_sec_10k_clusters_5_members",
+                "value": value,
+                "unit": "cmds/s",
+                "vs_baseline": round(value / BASELINE, 4),
+                "detail": detail,
+            }))
+            return
+        # TPU probed up but every child failed — a bench/engine problem,
+        # not a tunnel problem; report it as such (with the children's
+        # stderr) rather than masquerading as tpu_unavailable
+        print(json.dumps({
+            "metric": "committed_cmds_per_sec_10k_clusters_5_members",
+            "value": 0.0,
+            "unit": "cmds/s",
+            "error": "bench_children_failed",
+            "vs_baseline": 0.0,
+            "detail": {"note": "TPU backend is reachable but the "
+                               "measurement children failed",
+                       "platform": platform,
+                       "child_errors": _CHILD_ERRORS[-4:]},
+        }))
+        return
+
+    # CPU fallback: strip the axon site hook so backend init cannot hang
+    # (the sitecustomize PJRT registration blocks on a dead tunnel even for
+    # JAX_PLATFORMS=cpu), run a scaled-down smoke config, and mark the
+    # result clearly so the driver knows no hardware number was captured.
+    smoke_env = {
+        "PYTHONPATH": "",
+        "JAX_PLATFORMS": "cpu",
+        "RA_TPU_BENCH_LANES": "512",
+        "RA_TPU_BENCH_MEMBERS": str(N_MEMBERS),
+        "RA_TPU_BENCH_CMDS": "64",
+        "RA_TPU_BENCH_SECONDS": "3.0",
+    }
+    res = _run_child(smoke_env, CHILD_TIMEOUT_S) or \
+        _run_child(smoke_env, CHILD_TIMEOUT_S)
+    if res is not None:
+        print(json.dumps({
+            "metric": "committed_cmds_per_sec_10k_clusters_5_members",
+            "value": res["value"],
+            "unit": "cmds/s",
+            "error": "tpu_unavailable",
+            "vs_baseline": round(res["value"] / BASELINE, 4),
+            "detail": {
+                "note": "TPU backend unreachable; value is a CPU smoke "
+                        "datapoint at 512 lanes (not the headline config)",
+                "cpu_smoke": res,
+            },
+        }))
+    else:
+        print(json.dumps({
+            "metric": "committed_cmds_per_sec_10k_clusters_5_members",
+            "value": 0.0,
+            "unit": "cmds/s",
+            "error": "tpu_unavailable",
+            "vs_baseline": 0.0,
+            "detail": {"note": "TPU backend unreachable and CPU smoke "
+                               "fallback failed",
+                       "child_errors": _CHILD_ERRORS[-4:]},
+        }))
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("RA_TPU_BENCH_CHILD"):
+        # children may crash loudly — the parent captures rc + stderr
+        main()
+    else:
+        try:
+            main()
+        except BaseException as exc:  # noqa: BLE001 — contract: always JSON
+            print(json.dumps({
+                "metric": "committed_cmds_per_sec_10k_clusters_5_members",
+                "value": 0.0,
+                "unit": "cmds/s",
+                "error": f"bench_parent_crashed: {type(exc).__name__}",
+                "vs_baseline": 0.0,
+                "detail": {"exception": repr(exc)[:500]},
+            }))
+        sys.exit(0)

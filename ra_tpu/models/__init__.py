@@ -1,9 +1,12 @@
 from .counter import CounterMachine
 from .fifo import FifoMachine
 from .fifo_client import FifoClient, Mailbox
+from .jit_fifo import JitFifoMachine
+from .jit_kv import JitKvMachine
 from .kv import KvMachine
 from .registers import RegisterMachine
 from .queue import QueueMachine
 
-__all__ = ["CounterMachine", "FifoMachine", "FifoClient", "KvMachine",
-           "Mailbox", "QueueMachine", "RegisterMachine"]
+__all__ = ["CounterMachine", "FifoMachine", "FifoClient", "JitFifoMachine",
+           "JitKvMachine", "KvMachine", "Mailbox", "QueueMachine",
+           "RegisterMachine"]

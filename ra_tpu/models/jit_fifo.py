@@ -1,0 +1,221 @@
+"""JitFifoMachine — the FIFO capability machine on the device apply path.
+
+The host :class:`~ra_tpu.models.fifo.FifoMachine` mirrors the reference's
+``test/ra_fifo.erl`` (1,520 LoC) with unbounded Python state, consumer
+processes, and delivery effects.  That shape cannot fold on-device.  This
+machine is the TPU-native counterpart for the BASELINE.md "5,000 clusters
+x 5 members, fifo machine, enqueue/dequeue" row: a **fixed-capacity**
+per-lane queue whose state is a handful of dense arrays, covering the
+core ra_fifo verbs — ordered enqueue, settled and unsettled dequeue,
+settlement, return-with-redelivery-count, and purge
+(ra_fifo.erl apply clauses :254-368) — as a shape-stable ``lax.scan``
+fold (order matters, so ``supports_batch_apply = False``).
+
+State (leading lane axis added by ``jit_init``; the engine broadcasts a
+member axis):
+
+* ``buf/dc/mid int32[Q]`` — ready-message ring: payload value, delivery
+  count, and enqueue ticket (the host machine's ``msg_in_id``)
+* ``head/tail int32`` — ready window is ``head..tail-1`` (slot = idx % Q)
+* ``co_id/co_val/co_dc/co_mid int32[K]`` — checked-out (unsettled) table;
+  ``co_id < 0`` marks a free row
+* ``next_id int32`` — monotonic message-id source for unsettled dequeues
+* ``next_mid int32`` — monotonic enqueue-ticket source
+
+Command encoding (command_spec int32[2]): ``[op, arg]``
+
+  op 0 noop                       (term-opening entry)
+  op 1 enqueue(value)             reply  1 ok | -2 queue full
+  op 2 dequeue settled            reply  value | -1 empty
+  op 3 dequeue unsettled          reply  msg_id | -1 empty | -3 table full
+  op 4 settle(msg_id)             reply  1 | 0 unknown id
+  op 5 return(msg_id)             reply  1 | 0 unknown id or queue full
+  op 6 purge                      reply  number of ready messages dropped
+
+A returned message re-enters the ready window at its **original enqueue
+position** relative to the other ready messages (sorted insert by
+ticket), exactly like the host machine's sorted re-insert
+(fifo.py ``_return_entries``), with delivery_count+1.  The insert is a
+masked ``roll`` of the window prefix — shape-stable, O(Q) VPU work.
+Payload values must be >= 0 so they never collide with error replies.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.machine import JitMachine
+
+_I32 = jnp.int32
+
+
+def _take(arr, idx):
+    return jnp.take_along_axis(arr, idx[..., None], axis=-1)[..., 0]
+
+
+class JitFifoMachine(JitMachine):
+    command_spec = ("int32", (2,))
+    reply_spec = ("int32", ())
+    version = 0
+    supports_batch_apply = False  # queue ops do not commute
+
+    def __init__(self, capacity: int = 64, checkout_slots: int = 8) -> None:
+        self.capacity = capacity
+        self.checkout_slots = checkout_slots
+
+    def jit_init(self, n_lanes: int):
+        N, Q, K = n_lanes, self.capacity, self.checkout_slots
+        return {
+            "buf": jnp.zeros((N, Q), _I32),
+            "dc": jnp.zeros((N, Q), _I32),
+            "mid": jnp.zeros((N, Q), _I32),
+            "head": jnp.zeros((N,), _I32),
+            "tail": jnp.zeros((N,), _I32),
+            "co_id": jnp.full((N, K), -1, _I32),
+            "co_val": jnp.zeros((N, K), _I32),
+            "co_dc": jnp.zeros((N, K), _I32),
+            "co_mid": jnp.zeros((N, K), _I32),
+            "next_id": jnp.zeros((N,), _I32),
+            "next_mid": jnp.zeros((N,), _I32),
+        }
+
+    def jit_apply(self, meta, command, state):
+        Q, K = self.capacity, self.checkout_slots
+        op = command[..., 0]
+        arg = command[..., 1]
+        head, tail = state["head"], state["tail"]
+        next_id, next_mid = state["next_id"], state["next_mid"]
+        buf, dc, mid = state["buf"], state["dc"], state["mid"]
+        co_id, co_val = state["co_id"], state["co_val"]
+        co_dc, co_mid = state["co_dc"], state["co_mid"]
+
+        size = tail - head
+        empty = size <= 0
+        full = size >= Q
+
+        # -- enqueue -------------------------------------------------------
+        enq = (op == 1) & ~full
+        tail_slot = jnp.mod(tail, Q)
+
+        # -- dequeue (settled / unsettled) --------------------------------
+        head_slot = jnp.mod(head, Q)
+        head_val = _take(buf, head_slot)
+        head_dc = _take(dc, head_slot)
+        head_mid = _take(mid, head_slot)
+        free_mask = co_id < 0                              # [..., K]
+        have_free = jnp.any(free_mask, axis=-1)
+        free_slot = jnp.argmax(free_mask, axis=-1).astype(_I32)
+        deq_s = (op == 2) & ~empty
+        deq_u = (op == 3) & ~empty & have_free
+        pop = deq_s | deq_u
+
+        # -- settle / return: locate the checked-out row -------------------
+        match = (co_id == arg[..., None]) & (arg[..., None] >= 0)
+        found = jnp.any(match, axis=-1)
+        match_slot = jnp.argmax(match, axis=-1).astype(_I32)
+        m_val = _take(co_val, match_slot)
+        m_dc = _take(co_dc, match_slot)
+        m_mid = _take(co_mid, match_slot)
+        settle = (op == 4) & found
+        ret = (op == 5) & found & ~full
+
+        purge = op == 6
+
+        # -- cursor updates ------------------------------------------------
+        new_head = head + pop.astype(_I32) - ret.astype(_I32)
+        new_head = jnp.where(purge, tail, new_head)
+        new_tail = tail + enq.astype(_I32)
+
+        # -- enqueue ring write -------------------------------------------
+        qr = jnp.arange(Q)
+        enq_hot = (qr == tail_slot[..., None]) & enq[..., None]
+        buf = jnp.where(enq_hot, arg[..., None], buf)
+        dc = jnp.where(enq_hot, 0, dc)
+        mid = jnp.where(enq_hot, next_mid[..., None], mid)
+        new_next_mid = next_mid + enq.astype(_I32)
+
+        # -- return: sorted insert by enqueue ticket ----------------------
+        # The returned message goes at window position p = number of ready
+        # messages with an older ticket; ready entries before p shift one
+        # slot toward the (new) front at head-1, entries at/after p stay.
+        # For destination slot d with new-window position jd, the shifted
+        # content is the old slot d+1 — i.e. roll(-1).
+        in_window = jnp.mod(qr - head[..., None], Q) < size[..., None]
+        p = jnp.sum((in_window & (mid < m_mid[..., None])).astype(_I32),
+                    axis=-1)
+        jd = jnp.mod(qr - (head[..., None] - 1), Q)
+        rolled_buf = jnp.roll(buf, -1, axis=-1)
+        rolled_dc = jnp.roll(dc, -1, axis=-1)
+        rolled_mid = jnp.roll(mid, -1, axis=-1)
+        shift = ret[..., None] & (jd < p[..., None])
+        place = ret[..., None] & (jd == p[..., None])
+        buf = jnp.where(place, m_val[..., None],
+                        jnp.where(shift, rolled_buf, buf))
+        dc = jnp.where(place, (m_dc + 1)[..., None],
+                       jnp.where(shift, rolled_dc, dc))
+        mid = jnp.where(place, m_mid[..., None],
+                        jnp.where(shift, rolled_mid, mid))
+
+        # -- checkout-table writes ----------------------------------------
+        kr = jnp.arange(K)
+        take_hot = (kr == free_slot[..., None]) & deq_u[..., None]
+        rel_hot = (kr == match_slot[..., None]) & (settle | ret)[..., None]
+        co_val = jnp.where(take_hot, head_val[..., None], co_val)
+        co_dc = jnp.where(take_hot, head_dc[..., None], co_dc)
+        co_mid = jnp.where(take_hot, head_mid[..., None], co_mid)
+        co_id = jnp.where(take_hot, next_id[..., None], co_id)
+        co_id = jnp.where(rel_hot, -1, co_id)
+        new_next_id = next_id + deq_u.astype(_I32)
+
+        # -- reply ---------------------------------------------------------
+        reply = jnp.where(op == 1, jnp.where(enq, 1, -2), 0)
+        reply = jnp.where(op == 2, jnp.where(deq_s, head_val, -1), reply)
+        reply = jnp.where(op == 3,
+                          jnp.where(deq_u, next_id,
+                                    jnp.where(empty, -1, -3)), reply)
+        reply = jnp.where(op == 4, settle.astype(_I32), reply)
+        reply = jnp.where(op == 5, ret.astype(_I32), reply)
+        reply = jnp.where(op == 6, size, reply)
+
+        new_state = {"buf": buf, "dc": dc, "mid": mid, "head": new_head,
+                     "tail": new_tail, "co_id": co_id, "co_val": co_val,
+                     "co_dc": co_dc, "co_mid": co_mid,
+                     "next_id": new_next_id, "next_mid": new_next_mid}
+        return new_state, reply
+
+    # -- host protocol -----------------------------------------------------
+
+    def encode_command(self, command):
+        try:
+            if isinstance(command, tuple) and command:
+                kind = command[0]
+                if kind == "enqueue" and len(command) == 2:
+                    v = int(command[1])
+                    if v >= 0:
+                        return jnp.asarray([1, v], _I32)
+                elif kind == "dequeue" and len(command) == 2:
+                    if command[1] == "settled":
+                        return jnp.asarray([2, 0], _I32)
+                    if command[1] == "unsettled":
+                        return jnp.asarray([3, 0], _I32)
+                elif kind == "settle" and len(command) == 2:
+                    return jnp.asarray([4, int(command[1])], _I32)
+                elif kind == "return" and len(command) == 2:
+                    return jnp.asarray([5, int(command[1])], _I32)
+                elif kind == "purge":
+                    return jnp.asarray([6, 0], _I32)
+        except (TypeError, ValueError, OverflowError):
+            pass
+        return jnp.zeros((2,), _I32)
+
+    def decode_reply(self, reply) -> int:
+        return int(reply)
+
+
+def query_depth(state) -> int:
+    """Ready-message count (host-path query fun)."""
+    return int(state["tail"]) - int(state["head"])
+
+
+def query_checked_out(state) -> int:
+    import numpy as np
+    return int((np.asarray(state["co_id"]) >= 0).sum())

@@ -1,0 +1,116 @@
+"""JitKvMachine — the replicated KV store on the device apply path.
+
+The host :class:`~ra_tpu.models.kv.KvMachine` (the ra-kv-store role,
+README.md:33-35) keeps a Python dict plus watcher effects.  This is its
+TPU-native counterpart for the BASELINE.md "2,000 clusters, kv machine,
+mixed put/get, jittable apply/3" row: a fixed key space of ``n_keys``
+int32 cells per lane, folded on-device under ``lax.scan`` (put/cas
+sequences are order-dependent, so ``supports_batch_apply = False``).
+
+Absence is encoded as -1 (mirroring the host machine's ``None`` reply for
+a missing key), so stored values must be >= 0.  ``get`` exists as a
+committed command — a linearizable read through the log, the device-path
+stand-in for ``consistent_query`` — while the host path keeps using query
+funs.
+
+Command encoding (command_spec int32[4]): ``[op, key, value, expected]``
+
+  op 0 noop
+  op 1 put(key, value)            reply [1, old]         (old -1 if absent)
+  op 2 get(key)                   reply [present, value]
+  op 3 delete(key)                reply [present, old]
+  op 4 cas(key, expected, value)  reply [ok, current]    (expected/value -1
+                                   mean absent: expect-missing / delete-on-
+                                   success, matching KvMachine's None args)
+
+Reply is int32[2] = [code, value].  A key outside [0, n_keys) makes the
+command a no-op with reply [-2, -1] (never aliased onto a boundary cell).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.machine import JitMachine
+
+_I32 = jnp.int32
+
+
+class JitKvMachine(JitMachine):
+    command_spec = ("int32", (4,))
+    reply_spec = ("int32", (2,))
+    version = 0
+    supports_batch_apply = False  # put/cas do not commute
+
+    def __init__(self, n_keys: int = 64) -> None:
+        self.n_keys = n_keys
+
+    def jit_init(self, n_lanes: int):
+        # -1 = absent
+        return jnp.full((n_lanes, self.n_keys), -1, _I32)
+
+    def jit_apply(self, meta, command, state):
+        S = self.n_keys
+        op = command[..., 0]
+        raw_key = command[..., 1]
+        key_ok = (raw_key >= 0) & (raw_key < S)
+        key = jnp.clip(raw_key, 0, S - 1)
+        value = command[..., 2]
+        expected = command[..., 3]
+        cur = jnp.take_along_axis(state, key[..., None], axis=-1)[..., 0]
+        present = (cur >= 0).astype(_I32)
+
+        # an out-of-range key must not alias onto the boundary cell: the
+        # whole command degrades to a no-op with a distinct error reply
+        put = (op == 1) & key_ok
+        dele = (op == 3) & key_ok
+        cas_ok = (op == 4) & key_ok & (cur == expected)
+        new_val = jnp.where(put, value,
+                            jnp.where(dele, -1,
+                                      jnp.where(cas_ok, value, cur)))
+        write = put | dele | cas_ok
+        onehot = (jnp.arange(S) == key[..., None])
+        new_state = jnp.where(onehot & write[..., None],
+                              new_val[..., None], state)
+
+        code = jnp.where(put, 1,
+                         jnp.where(op == 4, cas_ok.astype(_I32),
+                                   jnp.where((op == 2) | dele, present, 0)))
+        bad = (op > 0) & ~key_ok
+        code = jnp.where(bad, -2, code)
+        reply = jnp.stack([code, jnp.where(bad, -1, cur)], axis=-1)
+        return new_state, reply
+
+    # -- host protocol -----------------------------------------------------
+
+    def encode_command(self, command):
+        def _v(x):
+            return -1 if x is None else int(x)
+        try:
+            if isinstance(command, tuple) and command:
+                kind = command[0]
+                if kind == "put" and len(command) == 3:
+                    return jnp.asarray(
+                        [1, int(command[1]), _v(command[2]), 0], _I32)
+                if kind == "get" and len(command) == 2:
+                    return jnp.asarray([2, int(command[1]), 0, 0], _I32)
+                if kind == "delete" and len(command) == 2:
+                    return jnp.asarray([3, int(command[1]), 0, 0], _I32)
+                if kind == "cas" and len(command) == 4:
+                    # host order: ("cas", key, expected, new)
+                    return jnp.asarray(
+                        [4, int(command[1]), _v(command[3]),
+                         _v(command[2])], _I32)
+        except (TypeError, ValueError, OverflowError):
+            pass
+        return jnp.zeros((4,), _I32)
+
+    def decode_reply(self, reply):
+        code, val = int(reply[..., 0]), int(reply[..., 1])
+        return (code, None if val < 0 else val)
+
+
+def query_kv(state) -> dict:
+    """Query fun: present keys as a plain dict (host path)."""
+    import numpy as np
+    arr = np.asarray(state)
+    return {int(k): int(v) for k, v in enumerate(arr) if v >= 0}

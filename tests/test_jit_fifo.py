@@ -1,0 +1,279 @@
+"""JitFifoMachine — device-path FIFO semantics, differential-tested against
+the host FifoMachine oracle (models/fifo.py) and a plain-Python fold, and
+run under the lane engine and the classic replicated path."""
+import jax.numpy as jnp
+import numpy as np
+
+import ra_tpu
+from ra_tpu.core.machine import ApplyMeta
+from ra_tpu.core.types import ServerId
+from ra_tpu.engine import LockstepEngine
+from ra_tpu.models import FifoMachine, JitFifoMachine
+from ra_tpu.models.jit_fifo import query_depth
+from ra_tpu.node import LocalRouter, RaNode
+
+from nemesis import await_leader
+
+META = {"index": jnp.int32(1), "term": jnp.int32(1)}
+
+
+def apply_seq(m, state, cmds):
+    replies = []
+    for cmd in cmds:
+        state, r = m.jit_apply(META, jnp.asarray(cmd, jnp.int32), state)
+        replies.append(int(r))
+    return state, replies
+
+
+def ready_window(state):
+    """(value, delivery_count) list in FIFO order from the device state."""
+    head, tail = int(state["head"]), int(state["tail"])
+    Q = np.asarray(state["buf"]).shape[-1]
+    buf, dc = np.asarray(state["buf"]), np.asarray(state["dc"])
+    return [(int(buf[i % Q]), int(dc[i % Q])) for i in range(head, tail)]
+
+
+def checked_out(state):
+    """Multiset of (value, delivery_count) currently unsettled."""
+    ids = np.asarray(state["co_id"])
+    vals, dcs = np.asarray(state["co_val"]), np.asarray(state["co_dc"])
+    return sorted((int(v), int(d))
+                  for i, v, d in zip(ids, vals, dcs) if i >= 0)
+
+
+def test_scripted_semantics():
+    m = JitFifoMachine(capacity=4, checkout_slots=2)
+    st = {k: v[0] for k, v in m.jit_init(1).items()}
+
+    # enqueue 3, dequeue settled pops in order
+    st, r = apply_seq(m, st, [[1, 10], [1, 11], [1, 12], [2, 0]])
+    assert r == [1, 1, 1, 10]
+    assert ready_window(st) == [(11, 0), (12, 0)]
+
+    # unsettled dequeue hands out msg ids 0,1; table then full
+    st, r = apply_seq(m, st, [[3, 0], [3, 0]])
+    assert r == [0, 1]
+    st, _ = apply_seq(m, st, [[1, 13]])
+    st, r = apply_seq(m, st, [[3, 0]])
+    assert r == [-3]  # checkout table full
+    assert checked_out(st) == [(11, 0), (12, 0)]
+
+    # settle one, return the other (redelivery count bumps, goes to front)
+    st, r = apply_seq(m, st, [[4, 0], [5, 1], [4, 1]])
+    assert r == [1, 1, 0]  # settle ok, return ok, settle of returned id fails
+    assert ready_window(st) == [(12, 1), (13, 0)]
+    assert checked_out(st) == []
+
+    # unknown ids are rejected; empty dequeue replies -1
+    st, r = apply_seq(m, st, [[4, 99], [5, 99], [6, 0], [2, 0], [3, 0]])
+    assert r == [0, 0, 2, -1, -1]
+
+    # queue-full enqueue rejected
+    st, r = apply_seq(m, st, [[1, 1], [1, 2], [1, 3], [1, 4], [1, 5]])
+    assert r == [1, 1, 1, 1, -2]
+
+    # noop leaves state untouched
+    st2, r = apply_seq(m, st, [[0, 0]])
+    assert r == [0]
+    for k in st:
+        assert np.array_equal(np.asarray(st[k]), np.asarray(st2[k])), k
+
+
+def fifo_fold(cmds, Q, K):
+    """Plain-Python oracle of the encoded op semantics.  Ready entries are
+    (mid, val, dc); returns re-insert sorted by enqueue ticket."""
+    ready: list = []
+    co: dict = {}
+    next_id = next_mid = 0
+    for op, arg in cmds:
+        if op == 1 and len(ready) < Q:
+            ready.append((next_mid, arg, 0))
+            next_mid += 1
+        elif op == 2 and ready:
+            ready.pop(0)
+        elif op == 3 and ready and len(co) < K:
+            co[next_id] = ready.pop(0)
+            next_id += 1
+        elif op == 4:
+            co.pop(arg, None)
+        elif op == 5 and arg in co and len(ready) < Q:
+            m, v, d = co.pop(arg)
+            ready.append((m, v, d + 1))
+            ready.sort()
+        elif op == 6:
+            ready.clear()
+    return ([(v, d) for (_m, v, d) in ready],
+            sorted((v, d) for (_m, v, d) in co.values()))
+
+
+def test_randomized_vs_python_oracle():
+    rng = np.random.default_rng(7)
+    m = JitFifoMachine(capacity=8, checkout_slots=3)
+    st = {k: v[0] for k, v in m.jit_init(1).items()}
+    cmds = []
+    outstanding = []
+    for i in range(400):
+        roll = rng.integers(0, 10)
+        if roll < 4:
+            cmd = (1, int(rng.integers(0, 1000)))
+        elif roll < 6:
+            cmd = (2, 0)
+        elif roll < 8:
+            cmd = (3, 0)
+        elif outstanding and roll == 8:
+            cmd = (4, outstanding[rng.integers(0, len(outstanding))])
+        elif outstanding:
+            cmd = (5, outstanding[rng.integers(0, len(outstanding))])
+        else:
+            cmd = (6, 0) if rng.integers(0, 20) == 0 else (1, i)
+        st, r = apply_seq(m, st, [list(cmd)])
+        if cmd[0] == 3 and r[0] >= 0:
+            outstanding.append(r[0])
+        elif cmd[0] in (4, 5) and r[0] == 1:
+            outstanding.remove(cmd[1])
+        cmds.append(cmd)
+    want_ready, want_co = fifo_fold(cmds, 8, 3)
+    assert ready_window(st) == want_ready
+    assert checked_out(st) == want_co
+
+
+def test_differential_vs_host_fifo_machine():
+    """The device machine's observable queue state tracks the host
+    FifoMachine oracle on a shared random workload.
+
+    Alignment notes: host unsettled dequeues go through a one-shot "once"
+    consumer; a host "return" auto-redelivers the returned message to that
+    consumer (ra_fifo checkout loop), so the harness issues a matching
+    device unsettled dequeue after every return."""
+    rng = np.random.default_rng(11)
+    host = FifoMachine()
+    hstate = host.init({})
+    dev = JitFifoMachine(capacity=64, checkout_slots=16)
+    dstate = {k: v[0] for k, v in dev.jit_init(1).items()}
+    cid = ("tag", "pid1")
+    idx = 0
+
+    def h_apply(cmd):
+        nonlocal hstate, idx
+        idx += 1
+        hstate, reply, _eff = host.apply(
+            ApplyMeta(index=idx, term=1), cmd, hstate)
+        return reply
+
+    def d_apply(cmd):
+        nonlocal dstate
+        dstate, r = dev.jit_apply(META, dev.encode_command(cmd), dstate)
+        return r
+
+    # outstanding: list of (host_msg_id, dev_msg_id) pairs
+    outstanding = []
+    for i in range(300):
+        roll = rng.integers(0, 12)
+        if roll < 5:
+            v = int(rng.integers(0, 10_000))
+            h_apply(("enqueue", None, None, v))
+            assert int(d_apply(("enqueue", v))) == 1
+        elif roll < 7:
+            hr = h_apply(("checkout", ("dequeue", "settled"), cid))
+            dr = int(d_apply(("dequeue", "settled")))
+            if hr == ("dequeue", "empty"):
+                assert dr == -1
+            else:
+                assert dr == hr[1][1]  # same value in FIFO order
+        elif roll < 9 and len(outstanding) < 12:
+            hr = h_apply(("checkout", ("dequeue", "unsettled"), cid))
+            dr = int(d_apply(("dequeue", "unsettled")))
+            if hr == ("dequeue", "empty"):
+                assert dr == -1
+            else:
+                outstanding.append((hr[1][0], dr))
+        elif roll == 9 and outstanding:
+            hid, did = outstanding.pop(rng.integers(0, len(outstanding)))
+            h_apply(("settle", (hid,), cid))
+            assert int(d_apply(("settle", did))) == 1
+        elif roll == 10 and outstanding:
+            hid, did = outstanding.pop(rng.integers(0, len(outstanding)))
+            con = hstate.consumers.get(cid)
+            ids_before = set(con.checked_out) if con else set()
+            ids_before.discard(hid)
+            h_apply(("return", (hid,), cid))
+            assert int(d_apply(("return", did))) == 1
+            # the host auto-redelivers the front message iff the consumer
+            # regained credit (ra_fifo checkout loop); mirror any actual
+            # redelivery with an explicit device unsettled dequeue
+            con = hstate.consumers.get(cid)
+            new_ids = (set(con.checked_out) - ids_before) if con else set()
+            if new_ids:
+                new_hid = new_ids.pop()
+                new_did = int(d_apply(("dequeue", "unsettled")))
+                assert new_did >= 0
+                outstanding.append((new_hid, new_did))
+        elif rng.integers(0, 30) == 0 and not outstanding:
+            h_apply(("purge",))
+            d_apply(("purge",))
+
+        # continuous alignment: ready window (values + delivery counts)
+        hready = [(raw, h["delivery_count"])
+                  for (_i, h, raw) in hstate.messages.values()]
+        assert ready_window(dstate) == hready
+        hco = sorted(
+            (raw, h["delivery_count"])
+            for con in hstate.consumers.values()
+            for (_mid, _idx, h, raw) in con.checked_out.values())
+        assert checked_out(dstate) == hco
+
+
+def test_engine_replicas_match_oracle():
+    """Under the lane engine every member of every lane folds the same
+    command order (FIFO ops do not commute — exercises the scan path)."""
+    rng = np.random.default_rng(5)
+    N, K, STEPS = 8, 4, 8
+    m = JitFifoMachine(capacity=32, checkout_slots=4)
+    eng = LockstepEngine(m, N, 3, ring_capacity=128, max_step_cmds=K,
+                         donate=False)
+    lane_cmds = [[] for _ in range(N)]
+    for _ in range(STEPS):
+        payloads = np.zeros((N, K, 2), np.int32)
+        for lane in range(N):
+            for k in range(K):
+                op = int(rng.integers(1, 4))  # enqueue / deq-s / deq-u
+                arg = int(rng.integers(0, 100)) if op == 1 else 0
+                payloads[lane, k] = (op, arg)
+                lane_cmds[lane].append((op, arg))
+        eng.step(jnp.full((N,), K, jnp.int32), jnp.asarray(payloads))
+    for _ in range(4):
+        eng.step(jnp.zeros((N,), jnp.int32), jnp.zeros((N, K, 2), jnp.int32))
+    eng.block_until_ready()
+    mac = {k: np.asarray(v) for k, v in eng.state.mac.items()}  # [N,P,...]
+    for lane in range(N):
+        want_ready, want_co = fifo_fold(lane_cmds[lane], 32, 4)
+        for member in range(3):
+            st = {k: v[lane, member] for k, v in mac.items()}
+            assert ready_window(st) == want_ready, (lane, member)
+            assert checked_out(st) == want_co, (lane, member)
+
+
+def test_same_machine_on_classic_path():
+    router = LocalRouter()
+    nodes = [RaNode(f"jfn{i}", router=router) for i in (1, 2, 3)]
+    sids = [ServerId(f"jf{i}", f"jfn{i}") for i in (1, 2, 3)]
+    try:
+        ra_tpu.start_cluster("jfifo", lambda: JitFifoMachine(capacity=16),
+                             sids, router=router)
+        leader = await_leader(router, sids)
+        assert ra_tpu.process_command(
+            leader, ("enqueue", 41), router=router).reply == 1
+        assert ra_tpu.process_command(
+            leader, ("enqueue", 42), router=router).reply == 1
+        mid = ra_tpu.process_command(
+            leader, ("dequeue", "unsettled"), router=router).reply
+        assert mid >= 0
+        assert ra_tpu.process_command(
+            leader, ("settle", mid), router=router).reply == 1
+        assert ra_tpu.process_command(
+            leader, ("dequeue", "settled"), router=router).reply == 42
+        res = ra_tpu.consistent_query(leader, query_depth, router=router)
+        assert res.reply == 0
+    finally:
+        for n in nodes:
+            n.stop()

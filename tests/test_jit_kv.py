@@ -1,0 +1,152 @@
+"""JitKvMachine — device-path KV semantics, differential-tested against
+the host KvMachine oracle (models/kv.py), and run under the lane engine
+and the classic replicated path."""
+import jax.numpy as jnp
+import numpy as np
+
+import ra_tpu
+from ra_tpu.core.machine import ApplyMeta
+from ra_tpu.core.types import ServerId
+from ra_tpu.engine import LockstepEngine
+from ra_tpu.models import JitKvMachine, KvMachine
+from ra_tpu.models.jit_kv import query_kv
+from ra_tpu.node import LocalRouter, RaNode
+
+from nemesis import await_leader
+
+META = {"index": jnp.int32(1), "term": jnp.int32(1)}
+
+
+def test_scripted_semantics():
+    m = JitKvMachine(n_keys=4)
+    st = m.jit_init(1)[0]
+
+    st, r = m.jit_apply(META, m.encode_command(("put", 1, 10)), st)
+    assert r.tolist() == [1, -1]  # old value absent
+    st, r = m.jit_apply(META, m.encode_command(("get", 1)), st)
+    assert r.tolist() == [1, 10]
+    st, r = m.jit_apply(META, m.encode_command(("get", 2)), st)
+    assert r.tolist() == [0, -1]
+    st, r = m.jit_apply(META, m.encode_command(("cas", 1, 10, 20)), st)
+    assert r.tolist() == [1, 10] and int(st[1]) == 20
+    st, r = m.jit_apply(META, m.encode_command(("cas", 1, 10, 30)), st)
+    assert r.tolist() == [0, 20] and int(st[1]) == 20
+    # cas expecting absence; cas deleting on success (None -> -1)
+    st, r = m.jit_apply(META, m.encode_command(("cas", 2, None, 7)), st)
+    assert r.tolist() == [1, -1] and int(st[2]) == 7
+    st, r = m.jit_apply(META, m.encode_command(("cas", 2, 7, None)), st)
+    assert r.tolist() == [1, 7] and int(st[2]) == -1
+    st, r = m.jit_apply(META, m.encode_command(("delete", 1)), st)
+    assert r.tolist() == [1, 20] and int(st[1]) == -1
+    st, r = m.jit_apply(META, m.encode_command(("delete", 1)), st)
+    assert r.tolist() == [0, -1]
+    # noop untouched
+    st2, r = m.jit_apply(META, jnp.zeros((4,), jnp.int32), st)
+    assert np.array_equal(np.asarray(st), np.asarray(st2))
+    # out-of-range keys: rejected with -2, no aliasing onto boundary cells
+    for bad_key in (-1, 4, 1000):
+        st3, r = m.jit_apply(META, m.encode_command(("put", bad_key, 5)), st)
+        assert r.tolist() == [-2, -1]
+        assert np.array_equal(np.asarray(st), np.asarray(st3))
+
+
+def test_differential_vs_host_kv_machine():
+    rng = np.random.default_rng(13)
+    host = KvMachine()
+    hstate = host.init({})
+    dev = JitKvMachine(n_keys=16)
+    dstate = dev.jit_init(1)[0]
+    idx = 0
+
+    for _ in range(500):
+        key = int(rng.integers(0, 16))
+        roll = rng.integers(0, 10)
+        if roll < 4:
+            cmd = ("put", key, int(rng.integers(0, 50)))
+        elif roll < 6:
+            cmd = ("delete", key)
+        else:
+            expect = (None if rng.integers(0, 3) == 0
+                      else int(rng.integers(0, 50)))
+            new = (None if rng.integers(0, 5) == 0
+                   else int(rng.integers(0, 50)))
+            cmd = ("cas", key, expect, new)
+        idx += 1
+        hstate, hreply, _ = host.apply(ApplyMeta(index=idx, term=1),
+                                       cmd, hstate)
+        dstate, dreply = dev.jit_apply(META, dev.encode_command(cmd),
+                                       dstate)
+        code, val = int(dreply[0]), int(dreply[1])
+        if cmd[0] == "put":
+            assert (None if val < 0 else val) == hreply
+        elif cmd[0] == "delete":
+            assert (None if val < 0 else val) == hreply
+        elif cmd[0] == "cas":
+            assert ("ok" if code else "failed") == hreply[0]
+            assert (None if val < 0 else val) == hreply[1]
+        # full-state alignment
+        want = {k: v for k, v in hstate.data.items()}
+        got = {k: int(v) for k, v in enumerate(np.asarray(dstate))
+               if v >= 0}
+        assert got == want
+
+
+def test_engine_replicas_match_oracle():
+    rng = np.random.default_rng(17)
+    N, K, STEPS, S = 16, 8, 6, 8
+    m = JitKvMachine(n_keys=S)
+    eng = LockstepEngine(m, N, 5, ring_capacity=256, max_step_cmds=K,
+                         donate=False)
+    lane_cmds = [[] for _ in range(N)]
+    for _ in range(STEPS):
+        payloads = np.zeros((N, K, 4), np.int32)
+        for lane in range(N):
+            for k in range(K):
+                op = int(rng.integers(1, 5))
+                key = int(rng.integers(0, S))
+                value = int(rng.integers(0, 30))
+                expected = int(rng.integers(-1, 30))
+                payloads[lane, k] = (op, key, value, expected)
+                lane_cmds[lane].append((op, key, value, expected))
+        eng.step(jnp.full((N,), K, jnp.int32), jnp.asarray(payloads))
+    for _ in range(4):
+        eng.step(jnp.zeros((N,), jnp.int32), jnp.zeros((N, K, 4), jnp.int32))
+    eng.block_until_ready()
+
+    def fold(cmds):
+        vals = [-1] * S
+        for op, key, value, expected in cmds:
+            if op == 1:
+                vals[key] = value
+            elif op == 3:
+                vals[key] = -1
+            elif op == 4 and vals[key] == expected:
+                vals[key] = value
+        return vals
+
+    mac = np.asarray(eng.state.mac)  # [N, P, S]
+    for lane in range(N):
+        want = fold(lane_cmds[lane])
+        for member in range(5):
+            assert mac[lane, member].tolist() == want, (lane, member)
+
+
+def test_same_machine_on_classic_path():
+    router = LocalRouter()
+    nodes = [RaNode(f"jkn{i}", router=router) for i in (1, 2, 3)]
+    sids = [ServerId(f"jk{i}", f"jkn{i}") for i in (1, 2, 3)]
+    try:
+        ra_tpu.start_cluster("jkv", lambda: JitKvMachine(n_keys=8),
+                             sids, router=router)
+        leader = await_leader(router, sids)
+        assert ra_tpu.process_command(
+            leader, ("put", 3, 9), router=router).reply == (1, None)
+        assert ra_tpu.process_command(
+            leader, ("cas", 3, 9, 11), router=router).reply == (1, 9)
+        assert ra_tpu.process_command(
+            leader, ("get", 3), router=router).reply == (1, 11)
+        res = ra_tpu.consistent_query(leader, query_kv, router=router)
+        assert res.reply == {3: 11}
+    finally:
+        for n in nodes:
+            n.stop()
