@@ -374,7 +374,12 @@ def member_overview(server_id: ServerId,
 
 def overview(router: Optional[LocalRouter] = None) -> dict:
     """Node-level overview across all local RaNodes (ra:overview), plus
-    process-wide io metrics (the ra_io_metrics ETS role)."""
+    process-wide io metrics (the ra_io_metrics ETS role).
+
+    Shape: ``{"nodes": {node_name: node_overview}, "io": io_stats}``.
+    NOTE: before round 1's io-stats addition this returned the node map at
+    top level; callers iterating node names must use ``overview()["nodes"]``.
+    """
     from .native import IO
 
     router = router or DEFAULT_ROUTER

@@ -28,19 +28,36 @@ class Directory:
         self._lock = threading.Lock()
         self._by_uid: dict[str, dict] = {}
         self._by_name: dict[str, str] = {}
+        #: uids whose servers were force-deleted — recovered WAL data for
+        #: these (and ONLY these) may be purged at boot.  Absence from the
+        #: registry alone proves nothing: the file may predate a record,
+        #: so unknown uids are kept conservatively (see RaSystem boot).
+        self._tombstones: set[str] = set()
+        #: True when a directory file exists but could not be read — the
+        #: registry contents are unknown and nothing may be purged on its
+        #: authority
+        self.load_failed = False
         if os.path.exists(self.path):
             try:
                 with open(self.path, "rb") as f:
-                    self._by_uid = pickle.load(f)
+                    raw = pickle.load(f)
+                if isinstance(raw, dict) and "records" in raw:
+                    self._by_uid = raw["records"]
+                    self._tombstones = set(raw.get("tombstones", ()))
+                else:  # pre-tombstone format: plain records dict
+                    self._by_uid = raw
                 self._by_name = {rec["name"]: uid
                                  for uid, rec in self._by_uid.items()}
             except Exception:
                 self._by_uid, self._by_name = {}, {}
+                self._tombstones = set()
+                self.load_failed = True
 
     def _persist(self) -> None:
         tmp = self.path + ".partial"
         with open(tmp, "wb") as f:
-            pickle.dump(self._by_uid, f)
+            pickle.dump({"records": self._by_uid,
+                         "tombstones": self._tombstones}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
@@ -56,14 +73,37 @@ class Directory:
             self._by_uid[uid] = {"name": name, "cluster": cluster_name,
                                  "config": config or {}}
             self._by_name[name] = uid
+            self._tombstones.discard(uid)
             self._persist()
 
-    def unregister(self, uid: str) -> None:
+    def unregister(self, uid: str, *, tombstone: bool = False) -> None:
+        """Remove a uid; with ``tombstone=True`` (force-delete) durably
+        record that this uid's WAL remnants are garbage, authorising the
+        boot purge to destroy them."""
         with self._lock:
             rec = self._by_uid.pop(uid, None)
             if rec is not None and self._by_name.get(rec["name"]) == uid:
                 del self._by_name[rec["name"]]
+            if tombstone:
+                self._tombstones.add(uid)
             self._persist()
+
+    def is_tombstoned(self, uid: str) -> bool:
+        with self._lock:
+            return uid in self._tombstones
+
+    def tombstones(self) -> set:
+        with self._lock:
+            return set(self._tombstones)
+
+    def prune_tombstones(self, uids) -> None:
+        """Drop tombstones that have served their purpose (their WAL data
+        is gone) so the set cannot grow without bound."""
+        with self._lock:
+            before = len(self._tombstones)
+            self._tombstones.difference_update(uids)
+            if len(self._tombstones) != before:
+                self._persist()
 
     def where_is(self, name: str) -> Optional[str]:
         """name -> uid (where_is/2 :106-121)."""

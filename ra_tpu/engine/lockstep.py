@@ -24,10 +24,15 @@ every cluster simultaneously:
 
 Rare/divergent transitions (member failure, election, membership change)
 are host-initiated: the host failure detector marks members down and
-requests elections via mask inputs; the election itself is a batched
-kernel (best-log argmax among active voters — the outcome a pre-vote +
-vote round converges to; vote *counting* for the distributed deployment
-is ops.quorum.election_quorum).
+requests elections via mask inputs; the vote round itself runs on-device
+— candidate selection by best durable log, per-voter grant decisions,
+and counted quorum via ops.quorum.election_quorum — so a minority
+partition cannot seat a leader (ra_server.erl:986-1002, 2260-2319).
+Divergent follower tails (a healed deposed leader's uncommitted
+entries) are truncated by an every-step consistency clamp before the
+quorum fold reads them (ra_server.erl:1032-1156), and replication is
+governed by the pipeline_credit flow-control kernel
+(ra_server.erl:1862-1918).
 
 The lane axis is embarrassingly parallel: sharding it over a
 jax.sharding.Mesh scales co-hosted clusters across chips with zero
@@ -43,7 +48,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.machine import JitMachine
-from ..ops.quorum import evaluate_quorum, update_match_next
+from ..ops.quorum import (election_quorum, evaluate_quorum, pipeline_credit,
+                          update_match_next)
 
 Array = jax.Array
 
@@ -97,34 +103,61 @@ def _init_state(n_lanes: int, n_members: int, ring_capacity: int,
 def _step(state: LaneState, n_new: Array, payloads: Array,
           fail_mask: Array, elect_mask: Array, *, machine: JitMachine,
           ring_capacity: int, apply_window: int,
-          pipeline_window: int, write_delay: int,
+          pipeline_window: int, max_append_batch: int, write_delay: int,
           quorum_fn=evaluate_quorum) -> LaneState:
     """One lockstep round for every lane.  Pure; jitted by the engine."""
     N, P = state.last_index.shape
     R = ring_capacity
     lane = jnp.arange(N)
 
-    # -- 0. failures + elections (host-requested, device-evaluated) -------
+    # -- 0. failures, divergence repair, elections ------------------------
     active = state.active & ~fail_mask
-    # election: next term's leader = active voter with the longest written
-    # log (the candidate every voter would grant to, §5.4.1); term += 1 and
-    # a noop opens the term (become-leader, ra_server.erl:845-859)
-    score = jnp.where(active & state.voter, state.last_written, -1)
-    best_slot = jnp.argmax(score, axis=-1).astype(jnp.int32)
-    leader_slot = jnp.where(elect_mask, best_slot, state.leader_slot)
-    term = jnp.where(elect_mask, state.term + 1, state.term)
+
+    # divergence repair (the AER consistency-check outcome,
+    # ra_server.erl:1032-1156): an active non-leader's tail can never
+    # extend past its leader's log — entries beyond it are uncommitted
+    # leftovers of a deposed leader and are truncated before anything
+    # (quorum, apply) can read them.  Runs before the match fold so a
+    # healed ex-leader's stale tail never enters the commit median.
+    leader_arm0 = jax.nn.one_hot(state.leader_slot, P, dtype=jnp.bool_)
+    cur_leader_last = jnp.take_along_axis(
+        state.last_index, state.leader_slot[:, None], axis=-1)[:, 0]
+    clamp = active & ~leader_arm0
+    last_index0 = jnp.where(
+        clamp, jnp.minimum(state.last_index, cur_leader_last[:, None]),
+        state.last_index)
+    last_written0 = jnp.minimum(state.last_written, last_index0)
+
+    # election: the host requests one (elect_mask); the device runs the
+    # vote round.  Candidate = active voter with the longest durable log
+    # (the member a pre-vote round converges on, §5.4.1); each reachable
+    # voter grants iff the candidate's log is up-to-date vs its own
+    # (process_pre_vote/request_vote, ra_server.erl:2260-2319, 1211-1251);
+    # the candidacy succeeds only on a counted quorum of grants
+    # (election_quorum, ra_server.erl:986-1002).  A minority partition
+    # therefore cannot elect: term and leader stay put.
+    score = jnp.where(active & state.voter, last_written0, -1)
+    cand = jnp.argmax(score, axis=-1).astype(jnp.int32)
+    cand_written = jnp.take_along_axis(last_written0, cand[:, None],
+                                       axis=-1)[:, 0]
+    grants = active & state.voter & \
+        (cand_written[:, None] >= last_written0)
+    won = election_quorum(grants, state.voter)
+    elect_ok = elect_mask & won
+
+    leader_slot = jnp.where(elect_ok, cand, state.leader_slot)
+    term = jnp.where(elect_ok, state.term + 1, state.term)
     leader_arm = jax.nn.one_hot(leader_slot, P, dtype=jnp.bool_)
-    leader_last = jnp.take_along_axis(state.last_index, leader_slot[:, None],
+    leader_last = jnp.take_along_axis(last_index0, leader_slot[:, None],
                                       axis=-1)[:, 0]
-    leader_written = jnp.take_along_axis(state.last_written,
+    leader_written = jnp.take_along_axis(last_written0,
                                          leader_slot[:, None], axis=-1)[:, 0]
-    # new leader discards unwritten/unreplicated tail beyond its own log and
-    # opens its term at written+1 (overwrite semantics are host-side for
-    # the distributed path; in lockstep the new leader's log is the lane's)
-    leader_last = jnp.where(elect_mask, leader_written, leader_last)
-    term_start = jnp.where(elect_mask, leader_last + 1, state.term_start)
-    # election appends the noop entry (payload 0)
-    n_noop = jnp.where(elect_mask, 1, 0).astype(jnp.int32)
+    # new leader discards its own unwritten tail and opens its term at
+    # written+1 (overwrite semantics; become-leader ra_server.erl:845-859)
+    leader_last = jnp.where(elect_ok, leader_written, leader_last)
+    term_start = jnp.where(elect_ok, leader_last + 1, state.term_start)
+    # a won election appends the term-opening noop entry (payload 0)
+    n_noop = jnp.where(elect_ok, 1, 0).astype(jnp.int32)
 
     # a lane whose leader is inactive cannot accept commands
     leader_up = jnp.take_along_axis(active, leader_slot[:, None],
@@ -156,46 +189,64 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
     # the host never enqueues commands on an elect step); its payload is
     # the machine's noop encoding (zeros)
     noop_slot = (leader_last + n_acc) % R
-    noop_row = jnp.where(elect_mask[:, None],
+    noop_row = jnp.where(elect_ok[:, None],
                          jnp.zeros((N, ring.shape[-1]), ring.dtype),
                          ring[lane, noop_slot])
     ring = ring.at[lane, noop_slot].set(noop_row)
     new_leader_last = leader_last + total_app
 
-    # -- 2. replication: followers adopt the leader tail ------------------
-    # per-peer pipeline window bounds in-flight entries (ra_server.hrl:7)
-    target = jnp.minimum(new_leader_last[:, None],
-                         state.match + pipeline_window)
-    last_index = jnp.where(active,
-                           jnp.maximum(state.last_index, target),
-                           state.last_index)
+    # -- 2. replication, governed by per-peer pipeline credit --------------
+    # a won election resets peer cursors (initialise_peers,
+    # ra_server.erl:845-859: next := last+1, match := 0)
+    next0 = jnp.where(elect_ok[:, None], new_leader_last[:, None] + 1,
+                      state.next_index)
+    match0 = jnp.where(elect_ok[:, None],
+                       jnp.where(leader_arm, leader_written[:, None], 0),
+                       state.match)
+    # flow control: entries shipped this round are bounded by the in-flight
+    # window and the AER batch size (make_pipelined_rpc_effects,
+    # ra_server.erl:1862-1918; limits ra_server.hrl:7-8)
+    n_send, _needs = pipeline_credit(next0, match0, new_leader_last,
+                                     jnp.zeros((N,), jnp.int32),
+                                     jnp.zeros((N, P), jnp.int32),
+                                     pipeline_window, max_append_batch)
+    send_hi = next0 + n_send - 1
+    # adopt only when entries actually ship (n_send > 0): a truncated
+    # member's stale send cursor must not resurrect its old tail via
+    # send_hi before the cursor itself is repaired below
+    last_index = jnp.where(active & (n_send > 0),
+                           jnp.maximum(last_index0, send_hi),
+                           last_index0)
     last_index = jnp.where(leader_arm,
                            jnp.broadcast_to(new_leader_last[:, None], (N, P)),
                            last_index)
-    # truncation on term change: followers adopt the new leader's log tail
-    # (overwrite semantics, ra_server.erl:1032-1113)
-    last_index = jnp.where(elect_mask[:, None] & active,
+    # on a won election, follower tails cap at the NEW leader's log in the
+    # same round — the step-start clamp ran against the old leader, and
+    # without this a longer follower tail would enter the match fold below
+    # as a phantom replica for one step (§5.4 safety)
+    last_index = jnp.where(elect_ok[:, None] & active,
                            jnp.minimum(last_index,
                                        new_leader_last[:, None]),
                            last_index)
 
     # -- 3. write confirm (async WAL protocol) ----------------------------
     if write_delay == 0:
-        last_written = jnp.where(active, last_index, state.last_written)
+        last_written = jnp.where(active, last_index, last_written0)
     else:
         # confirms lag one step: this step confirms the *previous* tail
         last_written = jnp.where(active,
-                                 jnp.minimum(last_index, state.last_index),
-                                 state.last_written)
+                                 jnp.minimum(last_index, last_index0),
+                                 last_written0)
     last_written = jnp.minimum(last_written, last_index)
 
     # -- 4. reply fold + quorum -------------------------------------------
-    match, next_index = update_match_next(
-        state.match, state.next_index,
-        active, last_written, last_index + 1)
-    # election resets peer state (initialise_peers)
-    match = jnp.where(elect_mask[:, None], jnp.where(leader_arm,
-                                                     last_written, 0), match)
+    match, _ = update_match_next(match0, next0,
+                                 active, last_written, last_index + 1)
+    # lockstep has perfect reply information, so the send cursor tracks the
+    # follower tail directly — in particular it *decreases* after a
+    # divergence truncation, reopening credit (the reference's next_index
+    # decrement on failed AER, ra_server.erl:477-529)
+    next_index = jnp.where(active, last_index + 1, next0)
     leader_commit0 = jnp.take_along_axis(state.commit, leader_slot[:, None],
                                          axis=-1)[:, 0]
     # NB: down members stay in the quorum denominator (their match just
@@ -261,7 +312,8 @@ class LockstepEngine:
     def __init__(self, machine: JitMachine, n_lanes: int, n_members: int = 3,
                  *, ring_capacity: int = 1024, max_step_cmds: int = 64,
                  apply_window: Optional[int] = None,
-                 pipeline_window: int = 4096, write_delay: int = 0,
+                 pipeline_window: int = 4096, max_append_batch: int = 128,
+                 write_delay: int = 0,
                  donate: bool = True, quorum_impl: str = "xla") -> None:
         self.machine = machine
         self.n_lanes = n_lanes
@@ -287,6 +339,7 @@ class LockstepEngine:
                                  ring_capacity=ring_capacity,
                                  apply_window=self.apply_window,
                                  pipeline_window=pipeline_window,
+                                 max_append_batch=max_append_batch,
                                  write_delay=write_delay,
                                  quorum_fn=make_evaluate_quorum(quorum_impl))
         self._step = jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -204,6 +204,16 @@ class DurableLog:
         # holding higher indexes is a stale tail from before an overwrite
         found = []
         for fname in sorted(os.listdir(self.dir)):
+            if fname.endswith(".trunc"):
+                # leftover of a truncate_from interrupted between writing
+                # the fresh copy and the atomic rename — always safe to
+                # delete (the original segment was only ever replaced
+                # atomically, so it is still intact)
+                try:
+                    os.unlink(os.path.join(self.dir, fname))
+                except FileNotFoundError:
+                    pass
+                continue
             if not fname.endswith(".segment"):
                 continue
             seq = int(fname.split(".")[0])
@@ -698,9 +708,15 @@ class DurableLog:
         the log.  While any reader is registered, snapshot truncation
         defers segment deletion (the files move to a pinned list the
         readers can still resolve) — the role the reference fills with
-        deferred ETS/segment deletion for registered readers."""
-        with self._lock:
-            self._readers[name] = self._readers.get(name, 0) + 1
+        deferred ETS/segment deletion for registered readers.
+
+        Registration takes _io_lock so it serialises against an in-flight
+        _truncate_to: without it a truncation could re-read self._readers
+        mid-victims-loop and unlink segments a reader registered between
+        iterations could already see."""
+        with self._io_lock:
+            with self._lock:
+                self._readers[name] = self._readers.get(name, 0) + 1
         return LogReader(self, name)
 
     def close_reader(self, name: str) -> None:
@@ -746,9 +762,14 @@ class DurableLog:
         return []
 
     def close(self) -> None:
-        with self._lock:
-            for seg in self._segments + self._pinned_segments:
-                seg.close()
+        # _io_lock first: a SegmentWriter flush in flight must finish
+        # before fds close, or its pwrites could land on a recycled fd
+        # number belonging to an unrelated file
+        with self._io_lock:
+            with self._lock:
+                self._open_segments.evict_all()
+                for seg in self._segments + self._pinned_segments:
+                    seg.close()
 
     def overview(self) -> dict:
         return {

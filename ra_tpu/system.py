@@ -53,14 +53,26 @@ class RaSystem:
         self.wal = Wal(data_dir, sync_mode=wal_sync_mode,
                        max_size=wal_max_size, max_batch=wal_max_batch,
                        segment_writer=self.segment_writer)
-        # WAL entries recovered for uids absent from the durable directory
-        # belong to force-deleted servers (every live server registers
-        # through log_factory): purge them, or the retirement gate would
-        # wait forever for a registration that never comes and pin every
-        # recovered WAL file
-        for uid in list(self.wal._recovered):
-            if not self.directory.is_registered_uid(uid):
-                self.wal.purge(uid)
+        # Recovered WAL entries are purged at boot ONLY for uids with an
+        # explicit force-delete tombstone.  Absence from the registry is
+        # not proof of deletion (the directory file may predate the
+        # record, or may have failed to load), so unknown uids keep their
+        # fsync-acknowledged data conservatively — their recovered files
+        # stay pinned until the server re-registers, matching the
+        # reference's keep-unresolvable-WAL behaviour.
+        if not self.directory.load_failed:
+            # a tombstone is spent only when NO recovered WAL data exists
+            # for its uid — computed before purging, because wal.purge
+            # only drops in-memory tables: the uid's bytes stay in shared
+            # WAL files and may be re-recovered at the next boot, when the
+            # tombstone must still authorise purging them again
+            spent = {u for u in self.directory.tombstones()
+                     if u not in self.wal._recovered}
+            for uid in list(self.wal._recovered):
+                if not self.directory.is_registered_uid(uid) and \
+                        self.directory.is_tombstoned(uid):
+                    self.wal.purge(uid)
+            self.directory.prune_tombstones(spent)
 
     def _resolve(self, uid: str) -> Optional[DurableLog]:
         with self._lock:
@@ -140,7 +152,9 @@ class RaSystem:
         if log is not None:
             log.close()
         self.wal.purge(uid)
-        self.directory.unregister(uid)
+        # tombstone: authorises a later boot to purge any WAL remnants of
+        # this uid that a crash resurrects (see __init__)
+        self.directory.unregister(uid, tombstone=True)
         target = os.path.join(self.data_dir, uid)
         if os.path.isdir(target):
             shutil.rmtree(target, ignore_errors=True)

@@ -1,0 +1,194 @@
+"""Engine-level partition semantics: on-device vote counting, divergent-log
+truncation repair, and pipeline-credit-governed replication
+(VERDICT round-1 item 3; reference ra_server.erl:986-1002, 1032-1156,
+1862-1918, 2260-2319)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ra_tpu.engine import LockstepEngine
+from ra_tpu.models import RegisterMachine
+
+from test_register_machine import host_fold
+
+N, P, K = 4, 5, 4
+
+
+def puts(rng, n_cmds):
+    """Random put commands, identical across lanes for oracle simplicity."""
+    cmds = [(1, int(rng.integers(0, 8)), int(rng.integers(1, 100)), 0)
+            for _ in range(n_cmds)]
+    pay = np.zeros((N, K, 4), np.int32)
+    for k, c in enumerate(cmds[:K]):
+        pay[:, k] = c
+    return cmds, pay
+
+
+def drain(eng, steps=4):
+    for _ in range(steps):
+        eng.step(jnp.zeros((N,), jnp.int32), jnp.zeros((N, K, 4), jnp.int32))
+    eng.block_until_ready()
+
+
+def test_minority_partition_appends_discarded_on_heal():
+    rng = np.random.default_rng(23)
+    m = RegisterMachine(n_slots=8)
+    eng = LockstepEngine(m, N, P, ring_capacity=128, max_step_cmds=K,
+                         write_delay=1, donate=False)
+    committed_cmds = []
+
+    # 1. healthy commits
+    cmds, pay = puts(rng, K)
+    committed_cmds += cmds
+    eng.step(jnp.full((N,), K, jnp.int32), jnp.asarray(pay))
+    drain(eng)
+    base_committed = eng.committed_total()
+    assert base_committed > 0
+
+    # 2. partition: leader (slot 0) isolated with slot 1 — a minority.
+    # The leader keeps accepting appends but can never commit them.
+    for slot in (2, 3, 4):
+        for lane in range(N):
+            eng.fail_member(lane, slot)
+    minority_cmds, mpay = puts(rng, K)  # never committed: NOT in the oracle
+    for _ in range(3):
+        eng.step(jnp.full((N,), K, jnp.int32), jnp.asarray(mpay))
+    drain(eng, 3)
+    assert eng.committed_total() == base_committed, \
+        "a minority must not commit"
+    old_leader_tail = int(eng.state.last_index[0, 0])
+
+    # 3. the majority side elects: old leader's side goes dark, the three
+    # others come back and run a vote round (3/5 grants = quorum)
+    for lane in range(N):
+        eng.fail_member(lane, 0)
+        eng.fail_member(lane, 1)
+        eng.recover_member(lane, 2)
+        eng.recover_member(lane, 3)
+        eng.recover_member(lane, 4)
+    term_before = int(eng.state.term[0])
+    eng.trigger_election(list(range(N)))
+    assert int(eng.state.term[0]) == term_before + 1
+    new_leader = int(eng.state.leader_slot[0])
+    assert new_leader in (2, 3, 4)
+
+    # 4. new-term commits
+    cmds, pay = puts(rng, K)
+    committed_cmds += cmds
+    eng.step(jnp.full((N,), K, jnp.int32), jnp.asarray(pay))
+    drain(eng)
+
+    # 5. heal: the deposed leader and its peer rejoin; their divergent
+    # tails must be truncated and overwritten, never applied
+    for lane in range(N):
+        eng.recover_member(lane, 0)
+        eng.recover_member(lane, 1)
+    drain(eng, 6)
+
+    want = host_fold(committed_cmds)
+    mac = np.asarray(eng.state.mac)          # [N, P, S]
+    li = np.asarray(eng.state.last_index)
+    for lane in range(N):
+        for member in range(P):
+            assert mac[lane, member].tolist() == want, \
+                (lane, member, mac[lane, member].tolist(), want)
+        # the healed ex-leader's tail equals the lane tail: divergent
+        # entries are gone, replication credit reopened after repair
+        assert li[lane, 0] == li[lane, new_leader]
+        assert li[lane, 1] == li[lane, new_leader]
+    # the minority's inflated tail was actually longer than the final log
+    # only if new-term appends didn't overtake it; either way it is gone
+    assert int(li[0, 0]) != old_leader_tail or \
+        int(eng.state.commit[0, 0]) >= int(eng.state.term_start[0])
+
+
+def test_minority_election_fails():
+    """A partition with only 2 of 5 voters cannot seat a leader: term,
+    leader, and log are all unchanged (pre-vote style: no term bump)."""
+    m = RegisterMachine(n_slots=8)
+    eng = LockstepEngine(m, N, P, ring_capacity=64, max_step_cmds=K,
+                         donate=False)
+    cmds, pay = puts(np.random.default_rng(1), K)
+    eng.step(jnp.full((N,), K, jnp.int32), jnp.asarray(pay))
+    drain(eng)
+    for slot in (0, 1, 2):
+        for lane in range(N):
+            eng.fail_member(lane, slot)
+    term0 = int(eng.state.term[0])
+    leader0 = int(eng.state.leader_slot[0])
+    tail0 = int(eng.state.last_index[0, 3])
+    eng.trigger_election(list(range(N)))
+    drain(eng, 2)
+    assert int(eng.state.term[0]) == term0
+    assert int(eng.state.leader_slot[0]) == leader0
+    assert int(eng.state.last_index[0, 3]) == tail0  # no noop appended
+
+
+def test_election_quorum_counts_only_voters():
+    """Nonvoters neither grant nor count toward the needed quorum
+    ('$ra_join' catch-up members, ra_server.erl:3218-3293)."""
+    m = RegisterMachine(n_slots=8)
+    eng = LockstepEngine(m, N, P, ring_capacity=64, max_step_cmds=K,
+                         donate=False)
+    # demote slots 3,4 to nonvoters: voters = {0,1,2}
+    eng.state = eng.state._replace(
+        voter=eng.state.voter.at[:, 3:].set(False))
+    # fail one voter: remaining voters {1,2} of 3 -> still a quorum (2/3)
+    for lane in range(N):
+        eng.fail_member(lane, 0)
+    term0 = int(eng.state.term[0])
+    eng.trigger_election(list(range(N)))
+    assert int(eng.state.term[0]) == term0 + 1
+    assert int(eng.state.leader_slot[0]) in (1, 2)
+    # now fail another voter: {2} of 3 is a minority even with both
+    # nonvoters reachable
+    for lane in range(N):
+        eng.fail_member(lane, 1)
+    term1 = int(eng.state.term[0])
+    eng.trigger_election(list(range(N)))
+    assert int(eng.state.term[0]) == term1
+
+
+def test_pipeline_credit_bounds_catchup():
+    """A burst append larger than the AER batch bound reaches followers at
+    most max_append_batch entries per round (ra_server.hrl:8)."""
+    from ra_tpu.models import CounterMachine
+    BATCH = 8
+    eng = LockstepEngine(CounterMachine(), 2, 3, ring_capacity=512,
+                         max_step_cmds=32, max_append_batch=BATCH,
+                         donate=False)
+    # one burst: the leader's tail jumps 32 in a single round
+    eng.step(jnp.full((2,), 32, jnp.int32), jnp.ones((2, 32, 1), jnp.int32))
+    leader_tail = int(eng.state.last_index[0, 0])
+    follower = int(eng.state.last_index[0, 1])
+    assert leader_tail - follower >= 32 - BATCH
+    # followers drain the gap at <= BATCH per round
+    steps = 0
+    while int(eng.state.last_index[0, 1]) < leader_tail:
+        before = int(eng.state.last_index[0, 1])
+        eng.step(jnp.zeros((2,), jnp.int32), jnp.zeros((2, 32, 1),
+                                                       jnp.int32))
+        after = int(eng.state.last_index[0, 1])
+        assert 0 < after - before <= BATCH
+        steps += 1
+        assert steps < 16
+    assert steps >= (32 // BATCH) - 1
+
+
+def test_election_caps_follower_tails_same_round():
+    """write_delay=1: member tails can exceed the new leader's durable log
+    at election time; the elect round itself must cap them so no phantom
+    match entry ever enters the commit median (§5.4 safety)."""
+    from ra_tpu.models import CounterMachine
+    eng = LockstepEngine(CounterMachine(), 2, 3, ring_capacity=128,
+                         max_step_cmds=32, write_delay=1, donate=False)
+    # one burst: leader tail 32, leader written still 0
+    eng.step(jnp.full((2,), 32, jnp.int32), jnp.ones((2, 32, 1), jnp.int32))
+    eng.trigger_election([0, 1])
+    st = eng.state
+    tails = np.asarray(st.last_index)
+    leads = np.asarray(st.leader_slot)
+    match = np.asarray(st.match)
+    for lane in range(2):
+        leader_tail = tails[lane, leads[lane]]
+        assert (tails[lane] <= leader_tail).all(), (lane, tails[lane])
+        assert (match[lane] <= leader_tail).all(), (lane, match[lane])

@@ -307,10 +307,11 @@ def test_queued_flush_job_skips_deleted_uid(tmp_path):
 
 
 def test_boot_purges_wal_entries_of_deleted_uids(tmp_path):
-    """WAL-recovered entries for uids absent from the durable directory
-    (force-deleted before their file rotated out) must be purged at boot,
-    or the retirement gate never fires again and every recovered WAL file
-    is pinned across all future restarts."""
+    """WAL-recovered entries for *tombstoned* uids (force-deleted before
+    their file rotated out) must be purged at boot, or the retirement gate
+    never fires again and every recovered WAL file is pinned across all
+    future restarts.  Only a tombstone authorises the purge — see the
+    companion tests for the conservative paths."""
     router = LocalRouter()
     a, b = ServerId("ba", "bn1"), ServerId("bb", "bn1")
     system = RaSystem(str(tmp_path / "bn1"))
@@ -324,10 +325,10 @@ def test_boot_purges_wal_entries_of_deleted_uids(tmp_path):
     ra_tpu.process_command(a, 1, router=router)
     ra_tpu.process_command(b, 2, router=router)
     system.wal.flush()
-    # delete A's directory record only — simulating a force-delete whose
-    # purge didn't cover the on-disk WAL (e.g. crash right after)
+    # delete A's directory record with a tombstone — simulating a
+    # force-delete whose purge didn't cover the on-disk WAL (crash after)
     uid_a = "uid_ba"
-    system.directory.unregister(uid_a)
+    system.directory.unregister(uid_a, tombstone=True)
     node.stop()
     system.close()
 
@@ -355,4 +356,66 @@ def test_boot_purges_wal_entries_of_deleted_uids(tmp_path):
     res = ra_tpu.consistent_query(b, lambda s: s, router=router2)
     assert res.reply == 2
     node2.stop()
+    system2.close()
+
+
+def test_boot_keeps_wal_entries_of_unknown_uids(tmp_path):
+    """A recovered uid that is neither registered nor tombstoned (e.g. a
+    data dir written before its directory record landed) keeps its
+    fsync-acknowledged WAL data: absence from the registry is not proof
+    of deletion (ADVICE r1 medium)."""
+    router = LocalRouter()
+    a = ServerId("ka", "kn1")
+    system = RaSystem(str(tmp_path / "kn1"))
+    node = RaNode("kn1", router=router, log_factory=system.log_factory)
+    node.start_server(mk_cfg(a, [a]))
+    ra_tpu.trigger_election(a, router)
+    await_leader(router, [a])
+    ra_tpu.process_command(a, 5, router=router)
+    system.wal.flush()
+    # drop the record WITHOUT a tombstone (lost registration, not delete)
+    system.directory.unregister("uid_ka")
+    node.stop()
+    system.close()
+
+    system2 = RaSystem(str(tmp_path / "kn1"))
+    assert "uid_ka" in system2.wal._recovered, \
+        "unknown uid's WAL data destroyed at boot"
+    # and once the server re-registers, its state is recoverable
+    router2 = LocalRouter()
+    node2 = RaNode("kn1", router=router2, log_factory=system2.log_factory)
+    node2.start_server(mk_cfg(a, [a]))
+    ra_tpu.trigger_election(a, router2)
+    await_leader(router2, [a])
+    res = ra_tpu.consistent_query(a, lambda s: s, router=router2)
+    assert res.reply == 5
+    node2.stop()
+    system2.close()
+
+
+def test_boot_refuses_purge_when_directory_unreadable(tmp_path):
+    """A corrupt directory file means the registry (and its tombstones)
+    are unknown: the boot purge must not destroy anything on its
+    authority."""
+    router = LocalRouter()
+    a = ServerId("ca", "cn1")
+    system = RaSystem(str(tmp_path / "cn1"))
+    node = RaNode("cn1", router=router, log_factory=system.log_factory)
+    node.start_server(mk_cfg(a, [a]))
+    ra_tpu.trigger_election(a, router)
+    await_leader(router, [a])
+    ra_tpu.process_command(a, 7, router=router)
+    system.wal.flush()
+    node.stop()
+    system.close()
+
+    # corrupt the directory file
+    dir_path = os.path.join(str(tmp_path / "cn1"), "directory")
+    with open(dir_path, "wb") as f:
+        f.write(b"\x80garbage-not-a-pickle")
+
+    system2 = RaSystem(str(tmp_path / "cn1"))
+    assert system2.directory.load_failed
+    assert "uid_ca" in system2.wal._recovered, \
+        "WAL data destroyed despite unreadable registry"
     system2.close()
