@@ -49,6 +49,14 @@ DEFAULT_MAX_BATCH = 8192              # ra.hrl:192
 NotifyFn = Callable[[str, Optional[int], int, int], None]
 
 
+class WalDown(RuntimeError):
+    """The WAL batch thread is dead: writes cannot become durable.  The
+    reference surfaces the same condition as the ``wal_down`` error a
+    server gets calling a crashed ra_log_wal process
+    (ra_server.erl:538-554); cores react by entering await_condition
+    until the supervisor restarts the WAL."""
+
+
 def scan_wal_file(path: str, tables: dict) -> None:
     """Parse one WAL file into per-uid tables (idx -> (term, payload)),
     deduping overwrites; raises on a torn/corrupt tail (callers keep the
@@ -127,12 +135,19 @@ class Wal:
         self._file_ranges: dict[str, list] = {}  # uid -> [lo, hi] this file
         self._registered_in_file: set = set()
         self._stop = False
+        #: bumped by restart(); lets observers detect "new WAL incarnation"
+        #: (the reference's new-wal-pid check, ra_log.erl:778-793)
+        self.generation = 0
         self._recovered: dict[str, dict] = {}
         self._recover()
         self._open_new_file()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ra-wal")
         self._thread.start()
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive() and not self._stop
 
     # -- registration -------------------------------------------------------
 
@@ -190,14 +205,22 @@ class Wal:
               truncate: bool = False) -> None:
         """Async append; confirmation arrives via notify after the batch
         reaches disk.  truncate marks a post-snapshot-install write
-        (wal_truncate_write, ra_log.erl:1033)."""
+        (wal_truncate_write, ra_log.erl:1033).  Raises WalDown when the
+        batch thread is dead (the failed gen-call to a crashed
+        ra_log_wal)."""
+        if not self.alive:
+            raise WalDown("wal batch thread is down")
         self._queue.put((uid, index, term, payload, truncate))
 
     def flush(self, timeout: float = 5.0) -> None:
         """Barrier: wait until everything queued so far is durable."""
+        if not self.alive:
+            raise WalDown("wal batch thread is down")
         done = threading.Event()
         self._queue.put(("__flush__", 0, 0, b"", done))
         if not done.wait(timeout):
+            if not self.alive:
+                raise WalDown("wal died during flush")
             raise TimeoutError("wal flush timed out")
 
     def rollover(self) -> None:
@@ -212,17 +235,60 @@ class Wal:
                 first = self._queue.get(timeout=0.05)
             except queue.Empty:
                 continue
+            if first[0] == "__crash__":
+                # test hook: die like a real batch-thread crash (no
+                # cleanup, fd left open, queued writes abandoned)
+                raise RuntimeError("wal killed")
             batch = [first]
             while len(batch) < self.max_batch:
                 try:
                     batch.append(self._queue.get_nowait())
                 except queue.Empty:
                     break
+            # a hard batch failure (disk error) kills the thread — the
+            # supervisor restarts the WAL and writers resend, the same
+            # let-it-crash shape as the reference's ra_log_wal under
+            # ra_log_wal_sup (ra_log_sup.erl:26-51)
+            self._write_batch(batch)
+
+    def kill(self) -> None:
+        """Simulate a WAL crash (tests / fault injection)."""
+        self._queue.put(("__crash__", 0, 0, b"", None))
+        self._thread.join(timeout=5)
+
+    def restart(self) -> None:
+        """Supervisor hook: revive a crashed WAL.
+
+        The half-written current file keeps everything that was confirmed
+        (notify only follows durability), so its per-writer ranges are
+        handed to the segment writer exactly like a rollover.  Queued but
+        unwritten entries are dropped — they were never confirmed, and
+        writers resend everything above last_written after a restart
+        (DurableLog.wal_restarted, mirroring ra_log.erl:778-793)."""
+        if self.alive or self._stop:
+            return
+        old_fd, old_path = self._fd, self._file_path
+        with self._lock:
+            ranges = {uid: tuple(r) for uid, r in self._file_ranges.items()}
+            self._queue = queue.Queue()  # crash loses the mailbox
+            for w in self._writers.values():
+                w.last_idx = None  # writers resend; fresh sequence check
+        try:
+            IO.close(old_fd)
+        except OSError:
+            pass
+        self._open_new_file()
+        if ranges and self.segment_writer is not None:
+            self.segment_writer.accept_ranges(ranges, old_path)
+        elif not ranges:
             try:
-                self._write_batch(batch)
-            except Exception:  # pragma: no cover - disk failure path
-                import logging
-                logging.getLogger("ra_tpu").exception("wal batch failed")
+                os.unlink(old_path)
+            except OSError:
+                pass
+        self.generation += 1
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ra-wal")
+        self._thread.start()
 
     def _write_batch(self, batch: list) -> None:
         buf = bytearray()
