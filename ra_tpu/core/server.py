@@ -21,6 +21,7 @@ the commit quorum exactly like a follower's reply (ra_server.erl:2977-2993).
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Optional
 
@@ -83,6 +84,7 @@ from .types import (
     TimerEffect,
     TransferLeadershipEvent,
     UserCommand,
+    WalUpEvent,
     WrittenEvent,
 )
 
@@ -109,6 +111,12 @@ class Condition:
     transition_to: RaftState = RaftState.FOLLOWER
     timeout_ms: Optional[int] = None
     timeout_effects: list = field(default_factory=list)
+
+
+#: backlog cap for events postponed during await_condition; beyond it the
+#: oldest postponed event is dropped (the leader resends — same recovery
+#: as the pre-postpone behaviour, just rarer)
+MAX_CONDITION_PENDING = 1024
 
 
 class RaServer:
@@ -144,7 +152,8 @@ class RaServer:
         self.votes: int = 0
         self.pre_vote_token: Any = None
         self.condition: Optional[Condition] = None
-        self.condition_pending: list = []  # events buffered in await_condition
+        #: events postponed while in await_condition, replayed on exit
+        self.condition_pending: deque = deque()
 
         # consistent-query machinery (ra_server.erl:3032-3190)
         self.query_index: int = 0
@@ -238,7 +247,13 @@ class RaServer:
         # instead of leaving the caller to time out
         if (self.raft_state != RaftState.LEADER and
                 isinstance(event, (CommandEvent, ConsistentQueryEvent)) and
-                event.from_ is not None):
+                event.from_ is not None and
+                not (self.raft_state == RaftState.AWAIT_CONDITION and
+                     self.condition is not None and
+                     self.condition.transition_to == RaftState.LEADER)):
+            # a parked leader (wal_down / transfer) postpones client events
+            # instead of bouncing them — on resume they replay in the
+            # leader state (ra_server_proc.erl:946-1010)
             return [Reply(event.from_,
                           ErrorResult("not_leader", self.leader_id))]
         if self.raft_state in (RaftState.STOP,
@@ -938,6 +953,10 @@ class RaServer:
             return []
         if isinstance(event, TickEvent):
             return self._tick_leader()
+        if isinstance(event, WalUpEvent):
+            # resumed from wal_down: push fresh AERs so followers catch up
+            # without waiting for the next tick
+            return self._make_all_rpcs()
         return []
 
     def _leader_aer_reply(self, reply: AppendEntriesReply) -> list:
@@ -1393,22 +1412,59 @@ class RaServer:
 
     # -- await_condition (ra_server.erl:946-1010 in proc; core predicates) -
 
+    def enter_wal_down(self) -> list:
+        """A log write raised WalDown: park in await_condition until the
+        supervisor restarts the WAL and the log surfaces a WalUpEvent,
+        then resume the previous role.  The reference keeps the server
+        alive through exactly this state while ra_log_wal is supervised
+        back up (ra_server.erl:538-554); killing the server instead would
+        convert a recoverable infra fault into data-plane loss.
+
+        The failed entry is NOT lost: DurableLog._put records it in the
+        memtable before submitting to the WAL, and wal_restarted() resends
+        everything above last_written to the new incarnation."""
+        if self.raft_state in (RaftState.STOP,
+                               RaftState.DELETE_AND_TERMINATE,
+                               RaftState.AWAIT_CONDITION):
+            return []
+        back = self.raft_state if self.raft_state in (
+            RaftState.LEADER, RaftState.FOLLOWER) else RaftState.FOLLOWER
+        self.condition = Condition(
+            predicate=_wal_up_predicate,
+            transition_to=back,
+            timeout_ms=self.cfg.await_condition_timeout_ms,
+            timeout_effects=[])
+        self.raft_state = RaftState.AWAIT_CONDITION
+        # bounded stay: if the supervisor never revives the WAL the
+        # timeout path re-enters the previous role (whose next write
+        # re-parks) rather than wedging here forever
+        return [StartElectionTimeout("medium")]
+
     def _handle_await_condition(self, event: Any) -> list:
         if isinstance(event, ElectionTimeout):
-            # condition timed out
             cond = self.condition
+            if cond is not None and cond.predicate is _wal_up_predicate \
+                    and not getattr(self.log, "wal_is_up",
+                                    lambda: True)():
+                # WAL still dead: bouncing out would re-park on the next
+                # write and lose the postponed backlog mid-replay — stay
+                # parked until the supervisor finishes (clients time out
+                # on their own clocks, same as the reference's hold)
+                return [StartElectionTimeout("medium")]
+            # condition timed out
             self.condition = None
             self.raft_state = cond.transition_to if cond else \
                 RaftState.FOLLOWER
             effs = list(cond.timeout_effects) if cond else []
             effs.append(StartElectionTimeout("medium"))
+            effs.extend(self._replay_condition_pending())
             return effs
         if isinstance(event, (RequestVoteRpc, PreVoteRpc)):
             # deny votes while waiting (higher term still adopted)
             if event.term > self.current_term:
                 self.condition = None
                 self.raft_state = RaftState.FOLLOWER
-                return [NextEvent(event)]
+                return [NextEvent(event)] + self._replay_condition_pending()
             cand = event.candidate_id
             if isinstance(event, RequestVoteRpc):
                 return [SendRpc(cand, RequestVoteResult(
@@ -1419,6 +1475,15 @@ class RaServer:
                 vote_granted=False, from_=self.id))]
         if isinstance(event, WrittenEvent):
             self.log.handle_written(event)
+            if self.condition is not None and \
+                    self.condition.transition_to == RaftState.LEADER:
+                # a parked leader (wal_down/transfer) still counts its own
+                # confirms toward quorum — otherwise a confirm consumed
+                # here is never re-evaluated and committed-but-unacked
+                # clients hang until the next unrelated write
+                effs = self._evaluate_quorum()
+                effs.extend(self._process_pending_consistent_queries())
+                return effs
             if self.leader_id is not None and \
                     self.condition is not None and \
                     self.condition.transition_to == RaftState.FOLLOWER:
@@ -1429,10 +1494,31 @@ class RaServer:
         if cond is not None and cond.predicate(event, self):
             self.condition = None
             self.raft_state = cond.transition_to
-            return [NextEvent(event)]
-        # hold the event: in ra the gen_statem postpones; our shell drops
-        # non-matching events (the leader will resend)
+            # the satisfying event re-dispatches in the new state, then the
+            # postponed backlog replays in arrival order (gen_statem's
+            # postpone-retry on state change, ra_server_proc.erl:946-1010)
+            return [NextEvent(event)] + self._replay_condition_pending()
+        # postpone: buffer the event for replay when the condition exits
+        # (ra_server_proc postpones via gen_statem; dropping would force a
+        # leader resend round-trip).  Periodic ticks are not worth keeping.
+        if not isinstance(event, TickEvent):
+            self.condition_pending.append(event)
+            if len(self.condition_pending) > MAX_CONDITION_PENDING:
+                evicted = self.condition_pending.popleft()
+                frm = getattr(evicted, "from_", None)
+                if frm is not None:
+                    # an evicted client call must not hang silently —
+                    # bounce it the way the pre-postpone path did
+                    return [Reply(frm, ErrorResult("not_leader",
+                                                   self.leader_id))]
         return []
+
+    def _replay_condition_pending(self) -> list:
+        """Drain events postponed during await_condition as NextEvents —
+        they re-dispatch in the state the condition exited into."""
+        pending = list(self.condition_pending)
+        self.condition_pending.clear()
+        return [NextEvent(e) for e in pending]
 
     # -- tick (ra_server.erl tick/1 + proc tick handling) ------------------
 
@@ -1561,6 +1647,16 @@ def _transfer_leadership_predicate(event: Any, server: "RaServer") -> bool:
     new leader (an AER/vote in a higher term)."""
     return isinstance(event, (AppendEntriesRpc, RequestVoteRpc, PreVoteRpc,
                               HeartbeatRpc))
+
+
+def _wal_up_predicate(event: Any, server: "RaServer") -> bool:
+    """wal_down condition exits when the restarted WAL announces itself
+    (ra_server.erl:538-554 — the {error, wal_down} hold).  Liveness is
+    re-checked: a stale WalUpEvent from an incarnation that already died
+    again must not unpark the server — the replay would hit WalDown on
+    the first append and drop the rest of the postponed backlog."""
+    return isinstance(event, WalUpEvent) and \
+        getattr(server.log, "wal_is_up", lambda: True)()
 
 
 _FOLLOWER_SAFE_EFFECTS = (ReleaseCursor, Checkpoint, AuxEffect,

@@ -332,6 +332,15 @@ class LogEvent:
 
 
 @dataclass(frozen=True)
+class WalUpEvent:
+    """The WAL was restarted after a crash: cores parked in
+    await_condition(wal_down) may resume (the new-wal-pid signal a
+    reference server observes via ra_log, ra_log.erl:778-793)."""
+
+    generation: int = 0
+
+
+@dataclass(frozen=True)
 class DownEvent:
     """Process-down notification (monitor fired)."""
 

@@ -31,8 +31,8 @@ import struct
 import threading
 from typing import Any, Callable, Iterable, Optional
 
-from ..core.types import (Entry, IdxTerm, SnapshotMeta, WrittenEvent,
-                          strip_local_handles)
+from ..core.types import (Entry, IdxTerm, SnapshotMeta, WalUpEvent,
+                          WrittenEvent, strip_local_handles)
 from ..native import IO
 from ..utils.flru import Flru
 from .segment import DEFAULT_MAX_COUNT, SegmentFile
@@ -298,9 +298,38 @@ class DurableLog:
                 return
             self._events.append(WrittenEvent(lo, hi, term))
 
+    def wal_restarted(self) -> None:
+        """Supervisor hook after Wal.restart(): resend every memtable
+        entry above last_written to the new WAL incarnation, then surface
+        a WalUpEvent so a core parked in await_condition(wal_down)
+        resumes.  This is the writer half of the reference's new-wal-pid
+        resend (ra_log.erl:778-793): everything confirmed durable stays
+        put; everything submitted-but-unconfirmed goes again.
+
+        The whole collect+resend runs under the log lock — _put submits
+        under the same lock, so no live append can reach the new queue
+        ahead of these resends and advance last_written over a hole."""
+        from .wal import WalDown
+        with self._lock:
+            lw = self._last_written.index
+            items = [(i, self._memtable[i][0], self._mem_bytes[i])
+                     for i in sorted(self._mem_bytes)
+                     if lw < i <= self._last_index]
+            try:
+                for idx, term, raw in items:
+                    self.wal.write(self.uid, idx, term, raw)
+            except WalDown:
+                return  # died again mid-resend; the supervisor retries us
+            self._events.append(WalUpEvent(self.wal.generation))
+
     # ------------------------------------------------------------------
     # log contract (same as MemoryLog)
     # ------------------------------------------------------------------
+
+    def wal_is_up(self) -> bool:
+        """Health probe for the core's wal_down await_condition: True when
+        the fan-in batch thread is accepting writes."""
+        return self.wal.alive
 
     def last_index_term(self) -> IdxTerm:
         return IdxTerm(self._last_index, self._last_term)
@@ -353,8 +382,12 @@ class DurableLog:
             self._last_term = entry.term
             truncate = self._truncate_next
             self._truncate_next = False
-        self.wal.write(self.uid, entry.index, entry.term, payload,
-                       truncate=truncate)
+            # submit under the log lock (queue.put only — no blocking):
+            # wal_restarted() holds the same lock across its resend batch,
+            # so a live append can never slip into the restarted WAL's
+            # queue AHEAD of the resends of a durability hole below it
+            self.wal.write(self.uid, entry.index, entry.term, payload,
+                           truncate=truncate)
 
     def set_last_index(self, idx: int) -> None:
         with self._lock:
@@ -380,6 +413,31 @@ class DurableLog:
 
     def handle_written(self, evt: WrittenEvent) -> None:
         with self._lock:
+            if evt.from_index > self._last_written.index + 1 and \
+                    evt.from_index <= self._last_index:
+                # contiguity guard: a confirm above a durability hole
+                # (e.g. an append that raced a post-crash resend) must not
+                # advance last_written past entries no WAL file holds.
+                # An index in (last_written, from_index) that has LEFT the
+                # memtable is already durable — the only exits are a
+                # segment flush or a snapshot truncation — so only
+                # memtable-resident hole entries need a resend; if there
+                # are none, the confirm is safe to accept as-is.
+                first_resident = next(
+                    (i for i in range(self._last_written.index + 1,
+                                      evt.from_index)
+                     if i in self._mem_bytes), None)
+                if first_resident is not None:
+                    # drop the confirm and resend the resident span up to
+                    # to_index so confirms re-arrive contiguously
+                    # (ra_log's written-event ordering invariant,
+                    # ra_log.erl:474-529)
+                    for idx in range(first_resident, evt.to_index + 1):
+                        ent = self._memtable.get(idx)
+                        raw = self._mem_bytes.get(idx)
+                        if ent is not None and raw is not None:
+                            self.wal.write(self.uid, idx, ent[0], raw)
+                    return
             term = self.fetch_term(evt.to_index)
             if term == evt.term:
                 if evt.to_index > self._last_written.index:

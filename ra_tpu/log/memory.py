@@ -46,6 +46,10 @@ class MemoryLog:
         self._snapshot: Optional[tuple] = None
         self._checkpoints: list[tuple] = []  # [(SnapshotMeta, machine_state)]
 
+    def wal_is_up(self) -> bool:
+        """In-memory log has no WAL thread to die."""
+        return True
+
     # -- ranges -------------------------------------------------------------
 
     def last_index_term(self) -> IdxTerm:

@@ -267,24 +267,11 @@ class Wal:
         (DurableLog.wal_restarted, mirroring ra_log.erl:778-793)."""
         if self.alive or self._stop:
             return
-        old_fd, old_path = self._fd, self._file_path
         with self._lock:
-            ranges = {uid: tuple(r) for uid, r in self._file_ranges.items()}
             self._queue = queue.Queue()  # crash loses the mailbox
             for w in self._writers.values():
                 w.last_idx = None  # writers resend; fresh sequence check
-        try:
-            IO.close(old_fd)
-        except OSError:
-            pass
-        self._open_new_file()
-        if ranges and self.segment_writer is not None:
-            self.segment_writer.accept_ranges(ranges, old_path)
-        elif not ranges:
-            try:
-                os.unlink(old_path)
-            except OSError:
-                pass
+        self._retire_current_file()
         self.generation += 1
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ra-wal")
@@ -295,6 +282,8 @@ class Wal:
         flushes = []
         roll = False
         confirms: dict[str, list] = {}  # uid -> [lo, hi, term]
+        pending_last: dict[str, int] = {}  # provisional last_idx this batch
+        new_regs: set = set()
         with self._lock:
             for uid, index, term, payload, extra in batch:
                 if uid == "__flush__":
@@ -307,31 +296,46 @@ class Wal:
                 if w is None:
                     continue
                 truncate = bool(extra)
-                if (w.last_idx is not None and index > w.last_idx + 1
-                        and not truncate):
+                last = pending_last.get(uid, w.last_idx)
+                if last is not None and index > last + 1 and not truncate:
                     # gap: out-of-sequence write — tell the writer to
                     # resend from its last accepted index (:457-481)
-                    w.notify(uid, None, w.last_idx, -1)
+                    w.notify(uid, None, last, -1)
                     continue
-                if w.wid not in self._registered_in_file:
+                if w.wid not in self._registered_in_file and \
+                        w.wid not in new_regs:
                     ub = w.uid.encode()
                     buf += _REG.pack(1, w.wid, len(ub))
                     buf += ub
-                    self._registered_in_file.add(w.wid)
+                    new_regs.add(w.wid)
                 crc = IO.crc32(payload)
                 buf += _ENT.pack(2, w.wid, index, term, len(payload), crc)
                 buf += payload
-                w.last_idx = index
-                r = self._file_ranges.setdefault(uid, [index, index])
-                r[0] = min(r[0], index)
-                r[1] = max(r[1], index)
+                pending_last[uid] = index
                 c = confirms.setdefault(uid, [index, index, term])
                 c[0] = min(c[0], index)
                 c[1] = max(c[1], index)
                 c[2] = term
         if buf:
+            # IO first, bookkeeping after: if the write throws (the
+            # let-it-crash path the supervisor recovers), last_idx and
+            # _file_ranges still describe only bytes the file really
+            # holds — restart() hands _file_ranges to the segment writer,
+            # which flushes and then DELETES the file, so overstating the
+            # ranges would silently drop acknowledged entries
             n = IO.write_batch(self._fd, bytes(buf), self.sync_mode)
             self._file_size += n
+            with self._lock:
+                self._registered_in_file |= new_regs
+                for uid, last in pending_last.items():
+                    w = self._writers.get(uid)
+                    if w is None:
+                        continue  # purged mid-write: no range resurrection
+                    w.last_idx = last
+                    lo = confirms[uid][0]
+                    r = self._file_ranges.setdefault(uid, [lo, last])
+                    r[0] = min(r[0], lo)
+                    r[1] = max(r[1], last)
         # notify AFTER durability (complete_batch, :753-800)
         with self._lock:
             notifiers = [(self._writers[uid].notify, uid, c)
@@ -359,15 +363,28 @@ class Wal:
         self._file_ranges = {}
 
     def _rollover(self) -> None:
+        self._retire_current_file()
+
+    def _retire_current_file(self) -> None:
+        """Close the current file, open a fresh one, and hand the closed
+        file's per-writer ranges to the segment writer (an empty file is
+        unlinked).  Shared by rollover and crash restart — both retire
+        the file the same way."""
         old_fd, old_path = self._fd, self._file_path
         with self._lock:
             ranges = {uid: tuple(r) for uid, r in self._file_ranges.items()}
-        IO.close(old_fd)
+        try:
+            IO.close(old_fd)
+        except OSError:
+            pass
         self._open_new_file()
         if ranges and self.segment_writer is not None:
             self.segment_writer.accept_ranges(ranges, old_path)
         elif not ranges:
-            os.unlink(old_path)
+            try:
+                os.unlink(old_path)
+            except OSError:
+                pass
 
     def _recover(self) -> None:
         files = sorted(f for f in os.listdir(self.dir)

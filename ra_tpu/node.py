@@ -68,6 +68,7 @@ from .core.types import (
     UserCommand,
 )
 from .log.memory import MemoryLog
+from .log.wal import WalDown
 
 logger = logging.getLogger("ra_tpu")
 
@@ -332,6 +333,17 @@ class RaNode:
                     continue
                 try:
                     busy |= self._poll_shell(shell, now)
+                except WalDown:
+                    # infra fault, not a server fault: park the core in
+                    # await_condition(wal_down) and keep the shell alive —
+                    # the system's WAL supervisor restarts the WAL and the
+                    # log surfaces a WalUpEvent to resume
+                    # (ra_server.erl:538-554)
+                    logger.warning(
+                        "ra_tpu node %s: wal down; server %s parked",
+                        self.name, shell.sid)
+                    self._execute(shell, shell.server.enter_wal_down())
+                    busy = True
                 except Exception:
                     logger.exception("ra_tpu node %s: server %s crashed",
                                      self.name, shell.sid)

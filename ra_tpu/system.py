@@ -8,8 +8,12 @@ component threads are owned by this object and restarted by it.
 """
 from __future__ import annotations
 
+import logging
 import os
+import shutil
 import threading
+import time
+from collections import deque
 from typing import Optional
 
 from .core.types import Membership, ServerConfig, ServerId
@@ -36,12 +40,22 @@ def _config_snapshot(cfg: ServerConfig) -> dict:
     }
 
 
+#: WAL supervisor restart intensity: (max restarts, window seconds).
+#: Beyond it the supervisor backs off for the window instead of
+#: hot-looping (OTP's intensity/period shape, ra_log_sup.erl:26-51 — but
+#: where OTP escalates and kills the subtree, a whole-process teardown
+#: here would lose every co-hosted cluster member, so we throttle and
+#: keep trying: a transient fault like a full disk stays recoverable).
+WAL_RESTART_INTENSITY = (10, 5.0)
+
+
 class RaSystem:
     def __init__(self, data_dir: str, *, name: str = "default",
                  wal_sync_mode: int = 1,
                  wal_max_size: int = DEFAULT_MAX_SIZE,
                  wal_max_batch: int = DEFAULT_MAX_BATCH,
-                 segment_max_count: int = 4096) -> None:
+                 segment_max_count: int = 4096,
+                 wal_supervise: bool = True) -> None:
         self.name = name
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
@@ -61,18 +75,78 @@ class RaSystem:
         # stay pinned until the server re-registers, matching the
         # reference's keep-unresolvable-WAL behaviour.
         if not self.directory.load_failed:
-            # a tombstone is spent only when NO recovered WAL data exists
-            # for its uid — computed before purging, because wal.purge
-            # only drops in-memory tables: the uid's bytes stay in shared
-            # WAL files and may be re-recovered at the next boot, when the
-            # tombstone must still authorise purging them again
-            spent = {u for u in self.directory.tombstones()
-                     if u not in self.wal._recovered}
-            for uid in list(self.wal._recovered):
-                if not self.directory.is_registered_uid(uid) and \
-                        self.directory.is_tombstoned(uid):
+            spent = set()
+            for uid in self.directory.tombstones():
+                if self.directory.is_registered_uid(uid):
+                    # the uid was re-registered after the force-delete:
+                    # the tombstone's authorisation is superseded by the
+                    # live server — prune it, or it lingers forever
+                    spent.add(uid)
+                    continue
+                # wal.purge only drops in-memory tables — the uid's bytes
+                # stay in shared WAL files and may be re-recovered at the
+                # next boot, when the tombstone must still authorise
+                # purging them again; capture that BEFORE purging
+                had_wal = uid in self.wal._recovered
+                if had_wal:
                     self.wal.purge(uid)
+                # a crash between wal.purge and rmtree in force_delete can
+                # leave the uid's data dir behind: finish the job here, or
+                # the orphan leaks forever once the tombstone is pruned
+                tomb_dir = os.path.join(data_dir, uid)
+                if os.path.isdir(tomb_dir):
+                    shutil.rmtree(tomb_dir, ignore_errors=True)
+                # spent only when neither WAL data nor an on-disk dir
+                # remains to authorise cleaning at the next boot
+                if not had_wal and not os.path.isdir(tomb_dir):
+                    spent.add(uid)
             self.directory.prune_tombstones(spent)
+        # WAL supervisor: restart a dead batch thread and run the writers'
+        # resend hooks (the ra_log_sup/ra_log_wal_sup role; disabled in
+        # tests that assert raw WalDown behaviour)
+        self._sup_stop = threading.Event()
+        self._wal_restarts: deque = deque()
+        self._sup_thread: Optional[threading.Thread] = None
+        if wal_supervise:
+            self._sup_thread = threading.Thread(
+                target=self._supervise_wal, daemon=True,
+                name=f"ra-wal-sup-{name}")
+            self._sup_thread.start()
+
+    def _supervise_wal(self) -> None:
+        max_r, period = WAL_RESTART_INTENSITY
+        log = logging.getLogger("ra_tpu")
+        while not self._sup_stop.wait(0.02):
+            wal = self.wal
+            if wal._stop or wal.alive:
+                continue
+            now = time.monotonic()
+            while self._wal_restarts and \
+                    now - self._wal_restarts[0] > period:
+                self._wal_restarts.popleft()
+            if len(self._wal_restarts) >= max_r:
+                log.error("wal supervisor (%s): restart intensity "
+                          "exceeded (%d in %.0fs); backing off %.0fs",
+                          self.name, max_r, period, period)
+                if self._sup_stop.wait(period):
+                    return
+                continue
+            self._wal_restarts.append(now)
+            log.warning("wal supervisor (%s): restarting dead WAL",
+                        self.name)
+            # a failing restart (e.g. ENOSPC opening the fresh file) must
+            # not kill the supervisor itself — it already counted against
+            # the intensity window, so the loop retries with backoff once
+            # the window fills
+            try:
+                wal.restart()
+                with self._lock:
+                    logs = list(self._logs.values())
+                for dlog in logs:
+                    dlog.wal_restarted()
+            except Exception:
+                log.exception("wal supervisor (%s): restart attempt "
+                              "failed; will retry", self.name)
 
     def _resolve(self, uid: str) -> Optional[DurableLog]:
         with self._lock:
@@ -145,8 +219,6 @@ class RaSystem:
     def delete_server_data(self, uid: str) -> None:
         """Wipe a server's durable footprint (the data-dir half of
         ra:force_delete_server).  The caller stops the process first."""
-        import shutil
-
         with self._lock:
             log = self._logs.pop(uid, None)
         if log is not None:
@@ -164,6 +236,9 @@ class RaSystem:
             return list(self._logs)
 
     def close(self) -> None:
+        self._sup_stop.set()
+        if self._sup_thread is not None:
+            self._sup_thread.join(timeout=5)
         self.wal.close()
         self.segment_writer.close()
         with self._lock:

@@ -1,0 +1,259 @@
+"""WAL crash elastic recovery — the batch thread dies and the system
+recovers without losing a single acknowledged entry.
+
+Scenario shapes follow the reference's coordination_SUITE
+``segment_writer_or_wal_crash_follower/_leader`` and the ra_log_wal_SUITE
+restart cases: kill the WAL under load, supervisor restarts it, writers
+resend above last_written (ra_log.erl:778-793), servers ride it out in
+await_condition(wal_down) instead of dying (ra_server.erl:538-554)."""
+import time
+
+import pytest
+
+import ra_tpu
+from ra_tpu import LocalRouter, RaNode, RaSystem
+from ra_tpu.core.machine import SimpleMachine
+from ra_tpu.core.types import Entry, RaftState, ServerConfig, ServerId, \
+    UserCommand, WalUpEvent, WrittenEvent
+from ra_tpu.log.wal import WalDown
+
+from nemesis import await_leader
+
+# Wal.kill() makes the batch thread die by an uncaught exception on
+# purpose — that IS the scenario under test
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+
+def counter():
+    return SimpleMachine(lambda c, s: s + c, 0)
+
+
+def mk_cfg(sid, sids, machine=None):
+    return ServerConfig(server_id=sid, uid=f"uid_{sid.name}",
+                        cluster_name="walcrash",
+                        initial_members=tuple(sids),
+                        machine=machine or counter(),
+                        election_timeout_ms=80, tick_interval_ms=50)
+
+
+def mk_log(system, uid="u1"):
+    cfg = ServerConfig(server_id=None, uid=uid, cluster_name="c",
+                       initial_members=(), machine=None)
+    return system.log_factory(cfg)
+
+
+def drain(log, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for e in log.take_events():
+            if isinstance(e, WrittenEvent):
+                log.handle_written(e)
+        if log.last_written().index >= log.last_index_term().index:
+            return
+        time.sleep(0.005)
+    raise TimeoutError("log never confirmed")
+
+
+# ---------------------------------------------------------------------------
+# low level: kill + restart + resend
+# ---------------------------------------------------------------------------
+
+def test_wal_kill_restart_resends_unconfirmed(tmp_path):
+    """Entries appended while the WAL is dead stay in the memtable and are
+    resent by wal_restarted(); nothing acknowledged is lost."""
+    sys_ = RaSystem(str(tmp_path), wal_supervise=False)
+    log = mk_log(sys_)
+    for i in range(1, 51):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    assert log.last_written().index == 50
+
+    sys_.wal.kill()
+    assert not sys_.wal.alive
+    # appends land in the memtable but cannot reach the WAL
+    for i in range(51, 61):
+        with pytest.raises(WalDown):
+            log.append(Entry(i, 1, UserCommand(i)))
+    assert log.last_index_term().index == 60
+    assert log.last_written().index == 50
+
+    gen = sys_.wal.generation
+    sys_.wal.restart()
+    assert sys_.wal.alive
+    assert sys_.wal.generation == gen + 1
+    log.wal_restarted()
+    # the resend makes 51..60 durable and a WalUpEvent surfaces
+    events_seen = []
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and \
+            log.last_written().index < 60:
+        for e in log.take_events():
+            events_seen.append(e)
+            if isinstance(e, WrittenEvent):
+                log.handle_written(e)
+        time.sleep(0.005)
+    assert log.last_written().index == 60
+    assert any(isinstance(e, WalUpEvent) for e in events_seen)
+    sys_.close()
+
+    # full restart from disk: every entry present
+    sys2 = RaSystem(str(tmp_path), wal_supervise=False)
+    log2 = mk_log(sys2)
+    assert log2.last_index_term().index == 60
+    for i in (1, 50, 51, 60):
+        assert log2.fetch(i).command.data == i
+    sys2.close()
+
+
+def test_wal_supervisor_restarts_dead_wal(tmp_path):
+    """The system's supervisor notices a dead batch thread, restarts it,
+    and runs the resend hook — no manual intervention."""
+    sys_ = RaSystem(str(tmp_path))
+    log = mk_log(sys_)
+    for i in range(1, 21):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    sys_.wal.kill()
+    # while the supervisor races us, appends may raise WalDown; the
+    # memtable keeps them either way
+    for i in range(21, 31):
+        try:
+            log.append(Entry(i, 1, UserCommand(i)))
+        except WalDown:
+            pass
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if sys_.wal.alive and sys_.wal.generation >= 1:
+            break
+        time.sleep(0.01)
+    assert sys_.wal.alive
+    drain(log)
+    assert log.last_written().index == 30
+    sys_.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster level: leader / follower WAL crash under load
+# ---------------------------------------------------------------------------
+
+def _start_cluster(tmp_path, sids, router):
+    systems = {s.node: RaSystem(str(tmp_path / s.node)) for s in sids}
+    nodes = {s.node: RaNode(s.node, router=router,
+                            log_factory=systems[s.node].log_factory)
+             for s in sids}
+    for sid in sids:
+        nodes[sid.node].start_server(mk_cfg(sid, sids))
+    return systems, nodes
+
+
+def _commit_with_retry(leader, value, router, deadline=10.0):
+    end = time.monotonic() + deadline
+    while time.monotonic() < end:
+        try:
+            res = ra_tpu.process_command(leader, value, router=router,
+                                         timeout=1.0)
+            return res
+        except TimeoutError:
+            continue
+    raise TimeoutError(f"command {value} never committed")
+
+
+@pytest.mark.parametrize("victim", ["leader", "follower"])
+def test_wal_crash_under_load_no_committed_loss(tmp_path, victim):
+    router = LocalRouter()
+    sids = [ServerId(f"w{i}", f"wn{i}") for i in (1, 2, 3)]
+    systems, nodes = _start_cluster(tmp_path, sids, router)
+    ra_tpu.trigger_election(sids[0], router)
+    leader = await_leader(router, sids)
+
+    acked = 0
+    for v in range(1, 21):
+        _commit_with_retry(leader, v, router)
+        acked += v
+
+    target = leader if victim == "leader" else \
+        next(s for s in sids if s != leader)
+    systems[target.node].wal.kill()
+
+    # keep the load on: every command that returns was acknowledged by
+    # quorum and must survive everything below
+    for v in range(21, 41):
+        leader = await_leader(router, sids)
+        _commit_with_retry(leader, v, router)
+        acked += v
+    assert acked == sum(range(1, 41))
+
+    # the victim's server must still be alive (parked or recovered), not
+    # torn down: WalDown is an infra fault, not a server crash
+    victim_node = nodes[target.node]
+    assert target.name in victim_node.shells
+
+    # and the victim's WAL must have been supervised back up
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and \
+            not systems[target.node].wal.alive:
+        time.sleep(0.01)
+    assert systems[target.node].wal.alive
+
+    leader = await_leader(router, sids)
+    res = ra_tpu.consistent_query(leader, lambda s: s, router=router)
+    assert res.reply == acked
+
+    # cold restart of every node from disk: acknowledged state intact
+    for n in nodes.values():
+        n.stop()
+    for s in systems.values():
+        s.close()
+    router2 = LocalRouter()
+    systems2 = {s.node: RaSystem(str(tmp_path / s.node)) for s in sids}
+    nodes2 = {s.node: RaNode(s.node, router=router2,
+                             log_factory=systems2[s.node].log_factory)
+              for s in sids}
+    for s in sids:
+        systems2[s.node].recover_servers(
+            nodes2[s.node], lambda cluster, name: counter())
+    leader2 = await_leader(router2, sids)
+    res = ra_tpu.consistent_query(leader2, lambda s: s, router=router2)
+    assert res.reply == acked
+    for n in nodes2.values():
+        n.stop()
+    for s in systems2.values():
+        s.close()
+
+
+def test_parked_leader_resumes_leadership_after_wal_restart(tmp_path):
+    """A leader whose WAL dies parks in await_condition and resumes as
+    LEADER (not via re-election) once the supervisor brings the WAL back."""
+    router = LocalRouter()
+    sid = ServerId("solo", "sw1")
+    system = RaSystem(str(tmp_path / "sw1"))
+    node = RaNode("sw1", router=router, log_factory=system.log_factory)
+    node.start_server(mk_cfg(sid, [sid]))
+    ra_tpu.trigger_election(sid, router)
+    await_leader(router, [sid])
+    ra_tpu.process_command(sid, 5, router=router)
+
+    system.wal.kill()
+    # drive a write so the shell hits WalDown and parks
+    deadline = time.monotonic() + 5.0
+    parked_or_recovered = False
+    while time.monotonic() < deadline:
+        try:
+            ra_tpu.process_command(sid, 7, router=router, timeout=0.5)
+            parked_or_recovered = True
+            break
+        except TimeoutError:
+            srv = node.shells[sid.name].server
+            if srv.raft_state == RaftState.AWAIT_CONDITION:
+                parked_or_recovered = True  # observed the parked state
+                break
+    assert parked_or_recovered
+    # supervisor restarts; the parked command (postponed, not bounced)
+    # or a fresh one commits and the server is LEADER again
+    _commit_with_retry(sid, 9, router)
+    assert node.shells[sid.name].server.raft_state == RaftState.LEADER
+    res = ra_tpu.consistent_query(sid, lambda s: s, router=router)
+    assert res.reply >= 5 + 9
+    node.stop()
+    system.close()
