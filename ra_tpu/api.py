@@ -225,6 +225,7 @@ def local_query(server_id: ServerId, query_fn: Callable,
     if shell is None:
         raise RuntimeError(f"no such server {server_id}")
     srv = shell.server
+    node.counters.incr(srv.cfg.uid, "local_queries")
     return CommandResult(srv.last_applied, srv.current_term,
                          query_fn(srv.machine_state), srv.leader_id)
 
@@ -402,6 +403,14 @@ def key_metrics(server_id: ServerId,
     srv = shell.server
     last = srv.log.last_index_term()
     lw = srv.log.last_written()
+    # counters = shell fields + core stats + log-subsystem fields, the
+    # flat union the reference samples from its single counter array
+    counters = dict(node.counters.fetch(srv.cfg.uid) or {})
+    counters.update(srv.stats)
+    log_metrics = getattr(srv.log, "log_metrics", None)
+    if log_metrics is not None:
+        counters.update(log_metrics())
+    checkpoint_index = getattr(srv.log, "checkpoint_index", lambda: 0)()
     return {
         "state": srv.raft_state.value,
         "raft_state": srv.raft_state.value,
@@ -412,9 +421,11 @@ def key_metrics(server_id: ServerId,
         "last_index": last.index,
         "last_written_index": lw.index,
         "snapshot_index": srv.log.snapshot_index_term().index,
+        "checkpoint_index": checkpoint_index,
+        "commit_latency": srv.commit_latency,
         "commit_latency_ms": srv.commit_latency * 1000.0,
         "machine_version": srv.machine_version,
         "effective_machine_version": srv.effective_machine_version,
         "membership": srv.membership.value,
-        "counters": node.counters.fetch(srv.cfg.uid),
+        "counters": counters,
     }

@@ -163,8 +163,13 @@ class RaServer:
         self.machine_state: Any = None
         self.aux_state: Any = self.machine.init_aux(config.uid)
         self.commit_latency: float = 0.0
+        #: core-owned counters (merged into key_metrics by the shell);
+        #: plain dict so the core stays free of registry dependencies
+        self.stats: dict = {"term_and_voted_for_updates": 0}
         self._transfer_target: Optional[ServerId] = None
-        self._accepting_snapshot: Optional[tuple] = None
+        #: SnapshotMeta of an in-flight chunked install (the log owns the
+        #: streamed bytes; the core only tracks which snapshot it is)
+        self._accepting_snapshot: Optional[SnapshotMeta] = None
         self._persisted_last_applied: int = self.last_applied
 
         self._init_state()
@@ -279,6 +284,7 @@ class RaServer:
         if term > self.current_term:
             self.current_term = term
             self.voted_for = None
+            self.stats["term_and_voted_for_updates"] += 1
             self.log.store_meta(current_term=term, voted_for=None)
 
     def _update_term_and_voted_for(self, term: int,
@@ -286,6 +292,7 @@ class RaServer:
         if term != self.current_term or voted_for != self.voted_for:
             self.current_term = term
             self.voted_for = voted_for
+            self.stats["term_and_voted_for_updates"] += 1
             self.log.store_meta(current_term=term, voted_for=voted_for)
 
     def last_idx_term(self) -> IdxTerm:
@@ -668,7 +675,8 @@ class RaServer:
                 and self.machine_version >= rpc.meta.machine_version):
             self._update_term(rpc.term)
             self.leader_id = rpc.leader_id
-            self._accepting_snapshot = (rpc.meta, [])
+            self._accepting_snapshot = rpc.meta
+            self.log.begin_accept(rpc.meta)
             self.raft_state = RaftState.RECEIVE_SNAPSHOT
             return [NextEvent(rpc), StartElectionTimeout("medium")]
         # stale snapshot: confirm our progress so the leader moves on
@@ -687,11 +695,34 @@ class RaServer:
         if isinstance(event, InstallSnapshotRpc):
             if event.term < self.current_term:
                 return []
-            meta, chunks = self._accepting_snapshot
-            chunks.append(event.data)
+            if event.chunk_number == 1 and \
+                    event.meta != self._accepting_snapshot:
+                # the leader restarted the transfer (e.g. it crashed and
+                # a new leader owns a newer snapshot): begin again — the
+                # partial stream is discarded (ra_snapshot.erl:465-508)
+                self._accepting_snapshot = event.meta
+                self.log.begin_accept(event.meta)
+            meta = self._accepting_snapshot
+            ok = self.log.accept_chunk(event.data, event.chunk_number,
+                                       event.chunk_crc)
+            if not ok:
+                # corrupt chunk (or no stream): abort the install; our
+                # unchanged progress report makes the leader restart
+                self.log.abort_accept()
+                self._accepting_snapshot = None
+                self.raft_state = RaftState.FOLLOWER
+                last = self.last_idx_term()
+                return [SendRpc(event.leader_id,
+                                InstallSnapshotResult(
+                                    term=self.current_term,
+                                    last_index=last.index,
+                                    last_term=last.term, from_=self.id)),
+                        StartElectionTimeout("medium")]
             if event.chunk_flag == "last":
-                data = b"".join(chunks)
-                self.log.install_snapshot(meta, data)
+                if not self.log.complete_accept():
+                    self._accepting_snapshot = None
+                    self.raft_state = RaftState.FOLLOWER
+                    return [StartElectionTimeout("medium")]
                 recovered = self.log.recover_snapshot_state()
                 assert recovered is not None
                 old_state = self.machine_state
@@ -722,10 +753,12 @@ class RaServer:
         if isinstance(event, AppendEntriesRpc) and \
                 event.term >= self.current_term:
             # a leader in a newer term interrupts the transfer
+            self.log.abort_accept()
             self._accepting_snapshot = None
             self.raft_state = RaftState.FOLLOWER
             return [NextEvent(event)]
         if isinstance(event, ElectionTimeout):
+            self.log.abort_accept()
             self._accepting_snapshot = None
             self.raft_state = RaftState.FOLLOWER
             return [StartElectionTimeout("medium")]

@@ -258,6 +258,10 @@ class InstallSnapshotRpc:
     chunk_number: int
     chunk_flag: str  # "next" | "last"
     data: bytes
+    #: crc32 of ``data`` — validated per chunk on accept so a corrupt
+    #: transfer aborts early instead of poisoning the assembled snapshot
+    #: (ra_log_snapshot.erl:73-111); -1 = absent (old peers)
+    chunk_crc: int = -1
 
 
 @dataclass(frozen=True)

@@ -29,10 +29,12 @@ import os
 import pickle
 import struct
 import threading
+import zlib
 from typing import Any, Callable, Iterable, Optional
 
 from ..core.types import (Entry, IdxTerm, SnapshotMeta, WalUpEvent,
                           WrittenEvent, strip_local_handles)
+from ..metrics import LOG_FIELDS
 from ..native import IO
 from ..utils.flru import Flru
 from .segment import DEFAULT_MAX_COUNT, SegmentFile
@@ -166,6 +168,11 @@ class DurableLog:
         #: until the LAST registration closes
         self._readers: dict = {}
         self._pinned_segments: list = []
+        #: log-subsystem counters (RA_LOG_COUNTER_FIELDS, ra.hrl:236-268);
+        #: GIL-atomic dict increments, merged into key_metrics
+        self.counters: dict[str, int] = {f: 0 for f in LOG_FIELDS}
+        #: in-flight chunked snapshot accept stream (begin_accept)
+        self._accept: Optional[dict] = None
         self._recover_state()
         wal.register(uid, self._wal_notify)
 
@@ -184,6 +191,11 @@ class DurableLog:
         # newest valid snapshot wins; fall back to older ones
         # (ra_snapshot.erl:183-222)
         snapdir = os.path.join(self.dir, "snapshot")
+        # a stale accept stream from an interrupted install is garbage:
+        # the leader restarts the transfer from chunk 1
+        stale_accept = os.path.join(snapdir, "accept.partial")
+        if os.path.exists(stale_accept):
+            os.unlink(stale_accept)
         cands = sorted(os.listdir(snapdir), reverse=True)
         for fname in cands:
             got = _read_snapshot_file(os.path.join(snapdir, fname))
@@ -294,6 +306,7 @@ class DurableLog:
                     ent = self._memtable.get(idx)
                     raw = self._mem_bytes.get(idx)
                     if ent is not None and raw is not None:
+                        self.counters["write_resends"] += 1
                         self.wal.write(self.uid, idx, ent[0], raw)
                 return
             self._events.append(WrittenEvent(lo, hi, term))
@@ -317,6 +330,7 @@ class DurableLog:
                      if lw < i <= self._last_index]
             try:
                 for idx, term, raw in items:
+                    self.counters["write_resends"] += 1
                     self.wal.write(self.uid, idx, term, raw)
             except WalDown:
                 return  # died again mid-resend; the supervisor retries us
@@ -330,6 +344,13 @@ class DurableLog:
         """Health probe for the core's wal_down await_condition: True when
         the fan-in batch thread is accepting writes."""
         return self.wal.alive
+
+    def log_metrics(self) -> dict:
+        """Counter snapshot for key_metrics (ra.erl:1229-1257);
+        open_segments is sampled live (a gauge, ra.hrl:258)."""
+        out = dict(self.counters)
+        out["open_segments"] = len(self._open_segments)
+        return out
 
     def last_index_term(self) -> IdxTerm:
         return IdxTerm(self._last_index, self._last_term)
@@ -365,6 +386,7 @@ class DurableLog:
         # live reply handles are process-local: stripped from the durable
         # image (the memtable keeps the full command for leader replies)
         payload = pickle.dumps(strip_local_handles(entry.command))
+        self.counters["write_ops"] += 1
         with self._lock:
             if entry.index <= self._last_index:
                 # overwrite: invalidate the stale tail; rewind last_written
@@ -436,6 +458,7 @@ class DurableLog:
                         ent = self._memtable.get(idx)
                         raw = self._mem_bytes.get(idx)
                         if ent is not None and raw is not None:
+                            self.counters["write_resends"] += 1
                             self.wal.write(self.uid, idx, ent[0], raw)
                     return
             term = self.fetch_term(evt.to_index)
@@ -451,6 +474,7 @@ class DurableLog:
     # -- reads --------------------------------------------------------------
 
     def fetch(self, idx: int) -> Optional[Entry]:
+        self.counters["read_ops"] += 1
         with self._lock:
             # entries at/below the snapshot index are truncated even when a
             # partially-covered segment still holds bytes for them
@@ -458,6 +482,7 @@ class DurableLog:
                 return None
             ent = self._memtable.get(idx)
             if ent is not None:
+                self.counters["read_cache"] += 1
                 return Entry(idx, ent[0], ent[1])
         got = self._segment_read(idx)
         if got is None:
@@ -473,10 +498,12 @@ class DurableLog:
                     self._open_segments.touch(seg.path, seg)
                     got = seg.read(idx)
                     if got is not None:
+                        self.counters["read_segment"] += 1
                         return got
         return None
 
     def fetch_term(self, idx: int) -> Optional[int]:
+        self.counters["fetch_term"] += 1
         with self._lock:
             if self._snapshot is not None and \
                     idx == self._snapshot[0].index:
@@ -539,7 +566,10 @@ class DurableLog:
 
     # -- segment flush (called by the SegmentWriter thread) -----------------
 
-    def flush_mem_to_segments(self, up_to: int) -> None:
+    def flush_mem_to_segments(self, up_to: int) -> tuple:
+        """Drain the memtable to segment files; returns
+        ``(entries, bytes, segments_created)`` for the segment writer's
+        counters (ra_log_segment_writer.erl:37-52)."""
         with self._io_lock:
             with self._lock:
                 snap_idx = self._snapshot[0].index if self._snapshot else 0
@@ -547,6 +577,8 @@ class DurableLog:
                                for i in self._mem_bytes
                                if i <= up_to and i > snap_idx
                                and i <= self._last_index)
+                seq_before = self._seg_seq
+            nbytes = 0
             if items:
                 seg = self._current_segment()
                 self._open_segments.touch(seg.path, seg)
@@ -556,6 +588,7 @@ class DurableLog:
                         seg = self._new_segment()
                         self._open_segments.touch(seg.path, seg)
                         seg.append(idx, term, payload)
+                    nbytes += len(payload)
                 seg.flush()
             with self._lock:
                 # ra swaps memtable for segment refs (:534-574): drop both
@@ -563,6 +596,7 @@ class DurableLog:
                 for idx, _, _ in items:
                     self._mem_bytes.pop(idx, None)
                     self._memtable.pop(idx, None)
+                return (len(items), nbytes, self._seg_seq - seq_before)
 
     def _current_segment(self) -> SegmentFile:
         with self._lock:
@@ -587,6 +621,13 @@ class DurableLog:
         m = self._snapshot[0]
         return IdxTerm(m.index, m.term)
 
+    def checkpoint_index(self) -> int:
+        """Newest checkpoint index, 0 if none (the checkpoint_index
+        gauge, ra.hrl:378)."""
+        with self._lock:
+            return self._checkpoints[-1][0].index if self._checkpoints \
+                else 0
+
     def snapshot(self) -> Optional[tuple]:
         """(meta, data_bytes) of the current snapshot, for chunked send."""
         if self._snapshot is None:
@@ -607,7 +648,10 @@ class DurableLog:
                             machine_version=machine_version)
         path = os.path.join(self.dir, "snapshot",
                             f"snap_{idx:016d}_{term:010d}.rtsn")
-        _write_snapshot_file(path, meta, pickle.dumps(machine_state))
+        data = pickle.dumps(machine_state)
+        _write_snapshot_file(path, meta, data)
+        self.counters["snapshots_written"] += 1
+        self.counters["snapshot_bytes_written"] += len(data)
         old = self._snapshot
         with self._lock:
             self._snapshot = (meta, path)
@@ -629,7 +673,10 @@ class DurableLog:
                             machine_version=machine_version)
         path = os.path.join(self.dir, "checkpoints",
                             f"cp_{idx:016d}_{term:010d}.rtsn")
-        _write_snapshot_file(path, meta, pickle.dumps(machine_state))
+        data = pickle.dumps(machine_state)
+        _write_snapshot_file(path, meta, data)
+        self.counters["checkpoints_written"] += 1
+        self.counters["checkpoint_bytes_written"] += len(data)
         with self._lock:
             self._checkpoints.append((meta, path))
             # retention (ra.hrl:234 + take_older_checkpoints)
@@ -658,6 +705,7 @@ class DurableLog:
         snap_path = os.path.join(
             self.dir, "snapshot",
             f"snap_{meta.index:016d}_{meta.term:010d}.rtsn")
+        self.counters["checkpoints_promoted"] += 1
         os.replace(cp_path, snap_path)
         old = self._snapshot
         with self._lock:
@@ -674,6 +722,12 @@ class DurableLog:
         path = os.path.join(self.dir, "snapshot",
                             f"snap_{meta.index:016d}_{meta.term:010d}.rtsn")
         _write_snapshot_file(path, meta, data)
+        self._post_install(meta, path)
+
+    def _post_install(self, meta: SnapshotMeta, path: str) -> None:
+        """Swap in a freshly written snapshot file and truncate the log
+        below it (shared by whole-buffer and streamed installs)."""
+        self.counters["snapshot_installed"] += 1
         old = self._snapshot
         with self._lock:
             self._snapshot = (meta, path)
@@ -690,6 +744,79 @@ class DurableLog:
             try:
                 os.unlink(old[1])
             except FileNotFoundError:
+                pass
+
+    # -- chunk-incremental snapshot accept (ra_snapshot.erl:465-508,
+    # ra_log_snapshot.erl:73-111): chunks stream to a .partial file with
+    # per-chunk crc validation and O(chunk) memory; the assembled body
+    # crc is patched into the header on the last chunk and the file
+    # swapped in atomically --------------------------------------------
+
+    def begin_accept(self, meta: SnapshotMeta) -> None:
+        """Open a fresh accept stream (chunk 1 of an install).  A
+        restarted install simply begins again — the .partial truncates."""
+        self.abort_accept()
+        path = os.path.join(self.dir, "snapshot", "accept.partial")
+        f = open(path, "wb")
+        meta_b = pickle.dumps(meta)
+        prefix = struct.pack("<I", len(meta_b)) + meta_b
+        # crc slot written as 0 now, patched in complete_accept
+        f.write(_SNAP_HDR.pack(SNAP_MAGIC, 1, 0) + prefix)
+        self._accept = {"meta": meta, "path": path, "f": f,
+                        "crc": IO.crc32(prefix), "chunks": 0}
+
+    def accept_chunk(self, data: bytes, chunk_number: int,
+                     chunk_crc: int = -1) -> bool:
+        """Append one chunk; False = validation failure (caller aborts
+        the install and the leader restarts it)."""
+        a = getattr(self, "_accept", None)
+        if a is None:
+            return False
+        if chunk_number == 1 and a["chunks"] > 0:
+            # same-snapshot transfer restarted from the top (sender
+            # retry): truncate the stream rather than double-append
+            self.begin_accept(a["meta"])
+            a = self._accept
+        if chunk_crc >= 0 and IO.crc32(data) != chunk_crc:
+            self.abort_accept()
+            return False
+        a["f"].write(data)
+        a["crc"] = zlib.crc32(data, a["crc"])
+        a["chunks"] += 1
+        return True
+
+    def complete_accept(self) -> bool:
+        """Finalize the stream: patch the body crc into the header, fsync,
+        atomically rename into the snapshot slot, truncate the log."""
+        a = getattr(self, "_accept", None)
+        if a is None:
+            return False
+        self._accept = None
+        f, meta = a["f"], a["meta"]
+        f.seek(8)  # crc field of _SNAP_HDR (<4sII)
+        f.write(struct.pack("<I", a["crc"]))
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        path = os.path.join(self.dir, "snapshot",
+                            f"snap_{meta.index:016d}_{meta.term:010d}.rtsn")
+        os.replace(a["path"], path)
+        self._post_install(meta, path)
+        return True
+
+    def abort_accept(self) -> None:
+        """Drop an in-flight accept stream (leader change / timeout /
+        corrupt chunk)."""
+        a = getattr(self, "_accept", None)
+        self._accept = None
+        if a is not None:
+            try:
+                a["f"].close()
+            except OSError:
+                pass
+            try:
+                os.unlink(a["path"])
+            except OSError:
                 pass
 
     def recover_snapshot_state(self) -> Optional[tuple]:

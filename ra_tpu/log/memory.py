@@ -22,6 +22,7 @@ import pickle
 from typing import Any, Callable, Iterable, Optional
 
 from ..core.types import Entry, IdxTerm, SnapshotMeta, WrittenEvent
+from ..metrics import LOG_FIELDS
 
 
 class IntegrityError(Exception):
@@ -45,6 +46,13 @@ class MemoryLog:
         # snapshot: (SnapshotMeta, machine_state)
         self._snapshot: Optional[tuple] = None
         self._checkpoints: list[tuple] = []  # [(SnapshotMeta, machine_state)]
+        # log-subsystem counters (RA_LOG_COUNTER_FIELDS, ra.hrl:236-268);
+        # segment/WAL-specific fields stay 0 for the in-memory backend
+        self.counters: dict[str, int] = {f: 0 for f in LOG_FIELDS}
+
+    def log_metrics(self) -> dict:
+        """Counter snapshot for key_metrics (ra.erl:1229-1257)."""
+        return dict(self.counters)
 
     def wal_is_up(self) -> bool:
         """In-memory log has no WAL thread to die."""
@@ -72,6 +80,7 @@ class MemoryLog:
         if entry.index != self._last_index + 1:
             raise IntegrityError(
                 f"append gap: {entry.index} != {self._last_index + 1}")
+        self.counters["write_ops"] += 1
         self._entries[entry.index] = entry
         self._last_index = entry.index
         self._last_term = entry.term
@@ -87,6 +96,7 @@ class MemoryLog:
         if first > self._last_index + 1:
             raise IntegrityError(
                 f"write gap: {first} > {self._last_index + 1}")
+        self.counters["write_ops"] += len(entries)
         for e in entries:
             self._entries[e.index] = e
         last = entries[-1]
@@ -148,9 +158,14 @@ class MemoryLog:
     # -- reads --------------------------------------------------------------
 
     def fetch(self, idx: int) -> Optional[Entry]:
-        return self._entries.get(idx)
+        self.counters["read_ops"] += 1
+        e = self._entries.get(idx)
+        if e is not None:
+            self.counters["read_cache"] += 1
+        return e
 
     def fetch_term(self, idx: int) -> Optional[int]:
+        self.counters["fetch_term"] += 1
         if self._snapshot is not None and idx == self._snapshot[0].index:
             return self._snapshot[0].term
         e = self._entries.get(idx)
@@ -191,6 +206,10 @@ class MemoryLog:
         meta = self._snapshot[0]
         return IdxTerm(meta.index, meta.term)
 
+    def checkpoint_index(self) -> int:
+        """Newest checkpoint index, 0 if none (ra.hrl:378)."""
+        return self._checkpoints[-1][0].index if self._checkpoints else 0
+
     def snapshot(self) -> Optional[tuple]:
         return self._snapshot
 
@@ -205,7 +224,10 @@ class MemoryLog:
             return []
         meta = SnapshotMeta(index=idx, term=term, cluster=cluster,
                             machine_version=machine_version)
-        self._snapshot = (meta, pickle.dumps(machine_state))
+        data = pickle.dumps(machine_state)
+        self._snapshot = (meta, data)
+        self.counters["snapshots_written"] += 1
+        self.counters["snapshot_bytes_written"] += len(data)
         self._truncate_to_snapshot(idx)
         return []
 
@@ -216,7 +238,10 @@ class MemoryLog:
             return []
         meta = SnapshotMeta(index=idx, term=term, cluster=cluster,
                             machine_version=machine_version)
-        self._checkpoints.append((meta, pickle.dumps(machine_state)))
+        data = pickle.dumps(machine_state)
+        self._checkpoints.append((meta, data))
+        self.counters["checkpoints_written"] += 1
+        self.counters["checkpoint_bytes_written"] += len(data)
         # retention: keep at most 10 (ra.hrl:234)
         self._checkpoints = self._checkpoints[-10:]
         return []
@@ -229,14 +254,49 @@ class MemoryLog:
         if best is None:
             return False
         self._snapshot = best
+        self.counters["checkpoints_promoted"] += 1
         self._checkpoints = [c for c in self._checkpoints
                              if c[0].index > best[0].index]
         self._truncate_to_snapshot(best[0].index)
         return True
 
+    # -- chunk-incremental accept (same contract as DurableLog) -------------
+
+    def begin_accept(self, meta: SnapshotMeta) -> None:
+        self._accept = (meta, [])
+
+    def accept_chunk(self, data: bytes, chunk_number: int,
+                     chunk_crc: int = -1) -> bool:
+        a = getattr(self, "_accept", None)
+        if a is None:
+            return False
+        if chunk_number == 1 and a[1]:
+            # transfer restarted from the top: drop the partial stream
+            a = (a[0], [])
+            self._accept = a
+        if chunk_crc >= 0:
+            import zlib
+            if zlib.crc32(data) != chunk_crc:
+                self._accept = None
+                return False
+        a[1].append(data)
+        return True
+
+    def complete_accept(self) -> bool:
+        a = getattr(self, "_accept", None)
+        if a is None:
+            return False
+        self._accept = None
+        self.install_snapshot(a[0], b"".join(a[1]))
+        return True
+
+    def abort_accept(self) -> None:
+        self._accept = None
+
     def install_snapshot(self, meta: SnapshotMeta, data: bytes) -> None:
         """Follower side: accept a complete streamed snapshot; truncates the
         whole log below/at the snapshot index (ra_log:install_snapshot)."""
+        self.counters["snapshot_installed"] += 1
         self._snapshot = (meta, data)
         self._entries = {i: e for i, e in self._entries.items()
                          if i > meta.index}

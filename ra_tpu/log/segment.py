@@ -193,6 +193,10 @@ class SegmentWriter:
     def __init__(self, resolve: Optional[Callable] = None) -> None:
         #: resolve(uid) -> DurableLog | None (set by the node/log registry)
         self.resolve = resolve or (lambda uid: None)
+        #: node-wide counters (ra_log_segment_writer.erl:37-52 names)
+        from ..metrics import SEGMENT_WRITER_FIELDS
+        self.counters: dict[str, int] = {f: 0
+                                         for f in SEGMENT_WRITER_FIELDS}
         # force-deleted uids: an unresolvable uid in this set means "skip
         # its entries", not "keep the WAL file for a future restart"
         self._deleted: set = set()
@@ -256,7 +260,7 @@ class SegmentWriter:
                 if uid not in self._deleted:
                     unresolved = True
                 continue
-            log.flush_mem_to_segments(hi)
+            self._count_flush(log.flush_mem_to_segments(hi))
         if not unresolved:
             # all servers flushed: the WAL file is redundant (:206-214)
             try:
@@ -282,12 +286,22 @@ class SegmentWriter:
         for uid in uids:
             log = self.resolve(uid)
             if log is not None:
-                log.flush_mem_to_segments(log.last_written().index)
+                self._count_flush(
+                    log.flush_mem_to_segments(log.last_written().index))
         for path in wal_files:
             try:
                 os.unlink(path)
             except FileNotFoundError:
                 pass
+
+    def _count_flush(self, stats: Optional[tuple]) -> None:
+        if not stats:
+            return
+        entries, nbytes, segs = stats
+        self.counters["mem_tables"] += 1
+        self.counters["entries"] += entries
+        self.counters["bytes_written"] += nbytes
+        self.counters["segments"] += segs
 
     def close(self) -> None:
         self._stop = True

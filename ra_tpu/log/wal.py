@@ -138,6 +138,9 @@ class Wal:
         #: bumped by restart(); lets observers detect "new WAL incarnation"
         #: (the reference's new-wal-pid check, ra_log.erl:778-793)
         self.generation = 0
+        #: node-wide WAL counters (ra_log_wal.erl:32-43 field names)
+        from ..metrics import WAL_FIELDS
+        self.counters: dict[str, int] = {f: 0 for f in WAL_FIELDS}
         self._recovered: dict[str, dict] = {}
         self._recover()
         self._open_new_file()
@@ -284,6 +287,7 @@ class Wal:
         confirms: dict[str, list] = {}  # uid -> [lo, hi, term]
         pending_last: dict[str, int] = {}  # provisional last_idx this batch
         new_regs: set = set()
+        n_entries = 0
         with self._lock:
             for uid, index, term, payload, extra in batch:
                 if uid == "__flush__":
@@ -311,6 +315,7 @@ class Wal:
                 crc = IO.crc32(payload)
                 buf += _ENT.pack(2, w.wid, index, term, len(payload), crc)
                 buf += payload
+                n_entries += 1
                 pending_last[uid] = index
                 c = confirms.setdefault(uid, [index, index, term])
                 c[0] = min(c[0], index)
@@ -325,6 +330,11 @@ class Wal:
             # ranges would silently drop acknowledged entries
             n = IO.write_batch(self._fd, bytes(buf), self.sync_mode)
             self._file_size += n
+            self.counters["batches"] += 1
+            self.counters["writes"] += n_entries
+            self.counters["bytes_written"] += n
+            if self.sync_mode:
+                self.counters["syncs"] += 1
             with self._lock:
                 self._registered_in_file |= new_regs
                 for uid, last in pending_last.items():
@@ -353,6 +363,7 @@ class Wal:
     # -- files / rollover / recovery ---------------------------------------
 
     def _open_new_file(self) -> None:
+        self.counters["wal_files"] += 1
         self._file_seq += 1
         self._file_path = os.path.join(self.dir,
                                        f"{self._file_seq:08d}.wal")

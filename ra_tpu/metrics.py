@@ -13,13 +13,55 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
-#: counter fields kept per server (subset of ra.hrl:236-390, same names)
-SERVER_FIELDS = (
-    "commands", "command_flushes", "aer_received_follower",
-    "aer_replies_success", "aer_replies_failed", "elections",
-    "pre_vote_elections", "snapshots_written", "snapshot_installed",
-    "dropped_sends", "msgs_processed",
+#: per-server LOG subsystem counter fields (RA_LOG_COUNTER_FIELDS,
+#: ra.hrl:236-268 — same names).  Owned by the log facade (DurableLog /
+#: MemoryLog keep a plain dict) and merged into key_metrics.
+#: Deliberate N/A vs the reference: ``reserved_1`` (a placeholder), and
+#: ``read_open_mem_tbl``/``read_closed_mem_tbl`` — the reference's
+#: open/closed WAL ETS tables are merged into the DurableLog memtable
+#: here (wal.py:15-21), so those reads all count as ``read_cache``.
+LOG_FIELDS = (
+    "write_ops", "write_resends", "read_ops", "read_cache",
+    "read_segment", "fetch_term", "snapshots_written",
+    "snapshot_installed", "snapshot_bytes_written", "open_segments",
+    "checkpoints_written", "checkpoint_bytes_written",
+    "checkpoints_promoted",
 )
+
+#: per-server raft/process counter fields (RA_SRV_COUNTER_FIELDS,
+#: ra.hrl:311-357 — same names).  ``reserved_2`` omitted (placeholder);
+#: ``invalid_reply_mode_commands`` stays 0 by construction — reply modes
+#: are a typed enum here, so an invalid one cannot be submitted.
+#: ``msgs_processed`` is ours (no reference equivalent): total events
+#: through the shell, useful for busy-loop diagnostics.
+SERVER_FIELDS = (
+    "aer_received_follower", "aer_replies_success", "aer_replies_fail",
+    "commands", "command_flushes", "aux_commands", "consistent_queries",
+    "rpcs_sent", "msgs_sent", "dropped_sends", "send_msg_effects_sent",
+    "pre_vote_elections", "elections", "forced_gcs", "snapshots_sent",
+    "release_cursors", "aer_received_follower_empty",
+    "term_and_voted_for_updates", "local_queries",
+    "invalid_reply_mode_commands", "checkpoints", "msgs_processed",
+)
+
+#: per-server gauge fields (RA_SRV_METRICS_COUNTER_FIELDS,
+#: ra.hrl:359-383): sampled live from server state at key_metrics time
+#: rather than double-written into counters on every event.
+METRIC_FIELDS = (
+    "last_applied", "commit_index", "snapshot_index", "last_index",
+    "last_written_index", "commit_latency", "term", "checkpoint_index",
+    "effective_machine_version",
+)
+
+#: node-wide WAL counter fields (ra_log_wal.erl:32-43 — same names,
+#: plus ``syncs``: fsync count, the number the reference exposes through
+#: ra_file_handle instead)
+WAL_FIELDS = ("wal_files", "batches", "writes", "bytes_written", "syncs")
+
+#: node-wide segment-writer counter fields (ra_log_segment_writer.erl:
+#: 37-52 — same names)
+SEGMENT_WRITER_FIELDS = ("mem_tables", "segments", "entries",
+                         "bytes_written")
 
 
 class Counters:

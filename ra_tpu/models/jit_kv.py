@@ -59,11 +59,15 @@ class JitKvMachine(JitMachine):
         cur = jnp.take_along_axis(state, key[..., None], axis=-1)[..., 0]
         present = (cur >= 0).astype(_I32)
 
-        # an out-of-range key must not alias onto the boundary cell: the
-        # whole command degrades to a no-op with a distinct error reply
-        put = (op == 1) & key_ok
+        # an out-of-range key must not alias onto the boundary cell, and a
+        # negative value must not smuggle the absent sentinel into a cell
+        # (stored values are >= 0 by contract; cas value -1 is the
+        # intentional delete-on-success, anything below is malformed):
+        # either way the command degrades to a no-op with the error reply
+        val_bad = ((op == 1) & (value < 0)) | ((op == 4) & (value < -1))
+        put = (op == 1) & key_ok & ~val_bad
         dele = (op == 3) & key_ok
-        cas_ok = (op == 4) & key_ok & (cur == expected)
+        cas_ok = (op == 4) & key_ok & ~val_bad & (cur == expected)
         new_val = jnp.where(put, value,
                             jnp.where(dele, -1,
                                       jnp.where(cas_ok, value, cur)))
@@ -75,7 +79,7 @@ class JitKvMachine(JitMachine):
         code = jnp.where(put, 1,
                          jnp.where(op == 4, cas_ok.astype(_I32),
                                    jnp.where((op == 2) | dele, present, 0)))
-        bad = (op > 0) & ~key_ok
+        bad = ((op > 0) & ~key_ok) | val_bad
         code = jnp.where(bad, -2, code)
         reply = jnp.stack([code, jnp.where(bad, -1, cur)], axis=-1)
         return new_state, reply

@@ -26,6 +26,7 @@ import logging
 import random
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any, Callable, Optional
 
@@ -401,13 +402,20 @@ class RaNode:
         elif isinstance(event, CommandsEvent):
             c.incr(key, "command_flushes")
             c.incr(key, "commands", len(event.commands))
+        elif isinstance(event, ConsistentQueryEvent):
+            c.incr(key, "consistent_queries")
         else:
-            from .core.types import AppendEntriesReply, AppendEntriesRpc
+            from .core.types import (AppendEntriesReply, AppendEntriesRpc,
+                                     AuxCommandEvent)
             if isinstance(event, AppendEntriesRpc):
                 c.incr(key, "aer_received_follower")
+                if not event.entries:
+                    c.incr(key, "aer_received_follower_empty")
             elif isinstance(event, AppendEntriesReply):
                 c.incr(key, "aer_replies_success" if event.success
-                       else "aer_replies_failed")
+                       else "aer_replies_fail")
+            elif isinstance(event, AuxCommandEvent):
+                c.incr(key, "aux_commands")
         state_before = server.raft_state
         effects = server.handle(event)
         state_after = server.raft_state
@@ -443,12 +451,17 @@ class RaNode:
         server = shell.server
         for eff in effects:
             if isinstance(eff, SendRpc):
+                self.counters.incr(server.cfg.uid, "rpcs_sent")
+                self.counters.incr(server.cfg.uid, "msgs_sent")
                 ok = self.router.send(self.name, eff.to, eff.msg)
                 if not ok:
                     # dropped send: pipeline catch-up recovers; counted
                     # like the reference (ra.hrl:329-330)
                     self.counters.incr(server.cfg.uid, "dropped_sends")
             elif isinstance(eff, SendVoteRequests):
+                n = len(eff.requests)
+                self.counters.incr(server.cfg.uid, "rpcs_sent", n)
+                self.counters.incr(server.cfg.uid, "msgs_sent", n)
                 for to, msg in eff.requests:
                     self.router.send(self.name, to, msg)
             elif isinstance(eff, Reply):
@@ -474,7 +487,9 @@ class RaNode:
             elif isinstance(eff, (ReleaseCursor, Checkpoint,
                                   PromoteCheckpoint)):
                 if isinstance(eff, ReleaseCursor):
-                    self.counters.incr(server.cfg.uid, "snapshots_written")
+                    self.counters.incr(server.cfg.uid, "release_cursors")
+                elif isinstance(eff, Checkpoint):
+                    self.counters.incr(server.cfg.uid, "checkpoints")
                 self._execute(shell, server.handle_machine_effect(eff))
             elif isinstance(eff, SendSnapshot):
                 self._send_snapshot(shell, eff)
@@ -483,11 +498,13 @@ class RaNode:
                 self.leaderboard_tab.record(eff.cluster_name, eff.leader,
                                             eff.members)
             elif isinstance(eff, SendMsg):
+                self.counters.incr(server.cfg.uid, "send_msg_effects_sent")
                 if isinstance(eff.to, Future):
                     eff.to.set(eff.msg)
                 elif callable(eff.to):
                     eff.to(eff.msg)
                 elif isinstance(eff.to, ServerId):
+                    self.counters.incr(server.cfg.uid, "msgs_sent")
                     self.router.send(self.name, eff.to, eff.msg)
             elif isinstance(eff, ModCall):
                 try:
@@ -509,7 +526,9 @@ class RaNode:
             elif isinstance(eff, Demonitor):
                 if eff.component == "machine" and eff.kind == "process":
                     shell.machine_monitors.discard(eff.target)
-            elif isinstance(eff, (GarbageCollection, TimerEffect)):
+            elif isinstance(eff, GarbageCollection):
+                self.counters.incr(server.cfg.uid, "forced_gcs")
+            elif isinstance(eff, TimerEffect):
                 pass  # machine timers: not yet surfaced to machines
             # unknown machine effects are ignored (forward compat)
 
@@ -526,6 +545,7 @@ class RaNode:
         snap = server.log.snapshot()
         if snap is None:
             return
+        self.counters.incr(server.cfg.uid, "snapshots_sent")
         meta, data = snap
         leader_id, term = eff.id_term
         chunk = server.cfg.snapshot_chunk_size
@@ -533,13 +553,15 @@ class RaNode:
                                                    chunk)] or [b""]
         for i, piece in enumerate(chunks):
             flag = "last" if i == len(chunks) - 1 else "next"
+            self.counters.incr(server.cfg.uid, "msgs_sent")
             self.router.send(self.name, eff.to,
                              InstallSnapshotRpc(term=term,
                                                 leader_id=leader_id,
                                                 meta=meta,
                                                 chunk_number=i + 1,
                                                 chunk_flag=flag,
-                                                data=piece))
+                                                data=piece,
+                                                chunk_crc=zlib.crc32(piece)))
 
     # -- introspection -------------------------------------------------------
 

@@ -246,6 +246,12 @@ class RaSystem:
                 log.close()
             self._logs.clear()
 
+    def counters(self) -> dict:
+        """Node-wide infra counters: the WAL's (ra_log_wal.erl:32-43) and
+        the segment writer's (ra_log_segment_writer.erl:37-52)."""
+        return {"wal": dict(self.wal.counters),
+                "segment_writer": dict(self.segment_writer.counters)}
+
     def overview(self) -> dict:
         with self._lock:
             return {
@@ -254,4 +260,5 @@ class RaSystem:
                 "servers": {uid: log.overview()
                             for uid, log in self._logs.items()},
                 "directory": self.directory.overview(),
+                "counters": self.counters(),
             }

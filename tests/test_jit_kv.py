@@ -48,6 +48,16 @@ def test_scripted_semantics():
         st3, r = m.jit_apply(META, m.encode_command(("put", bad_key, 5)), st)
         assert r.tolist() == [-2, -1]
         assert np.array_equal(np.asarray(st), np.asarray(st3))
+    # a negative put value must not store the absent sentinel: rejected
+    # with -2 like the bad-key path (stored-values >= 0 contract)
+    st, _ = m.jit_apply(META, m.encode_command(("put", 3, 9)), st)
+    for bad_put in (("put", 3, None), ("put", 3, -5)):
+        st4, r = m.jit_apply(META, m.encode_command(bad_put), st)
+        assert r.tolist() == [-2, -1]
+        assert int(st4[3]) == 9  # untouched
+    # cas with a new value below -1 is malformed (only -1 = delete)
+    st5, r = m.jit_apply(META, m.encode_command(("cas", 3, 9, -7)), st)
+    assert r.tolist() == [-2, -1] and int(st5[3]) == 9
 
 
 def test_differential_vs_host_kv_machine():
