@@ -1,1 +1,4 @@
 from .lockstep import LaneState, LockstepEngine
+from .durable import EngineDurability, open_engine
+
+__all__ = ["LaneState", "LockstepEngine", "EngineDurability", "open_engine"]

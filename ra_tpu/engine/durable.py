@@ -1,0 +1,460 @@
+"""Durability bridge between the lockstep lane engine and the fan-in WAL.
+
+This closes the loop the engine docstring describes: in durable mode a
+step's accepted entries are pulled off-device (double-buffered — the aux
+readback of step N overlaps the dispatch of step N+1), encoded as ONE
+WAL record per step, and fed through :class:`ra_tpu.log.wal.Wal`.  The
+WAL's fsync confirm comes back as the ``confirm_upto`` input of a later
+step, so ``last_written`` — and therefore the commit quorum — advances
+only over entries that are really on disk.  This is the engine-scale
+version of the reference's written-event protocol: an entry only counts
+toward the commit median after write(2)+fsync
+(/root/reference/src/ra_log_wal.erl:753-800), and the batch unit is the
+device step — the fan-in batching axis of SURVEY.md §2.4 (one WAL batch
+= one XLA dispatch worth of appends for ALL co-hosted clusters).
+
+Record format (one WAL payload per step, uid ``__engine__``):
+
+  magic "RTB1"(4) | n_lanes:u32 | C:u32 | dtype:8s | n_flat:u32
+  hi:    i32[N]   leader tail after the step (per lane)
+  n_app: i32[N]   entries appended this step (accepted cmds + noop)
+  n_acc: i32[N]   how many of those came from the host batch
+  flat:  [n_flat, C] the accepted host rows, lane-major
+
+``hi - n_app`` is the step's append base; a base below the running tail
+records an election truncation (a deposed leader's unconfirmed suffix),
+exactly the overwrite-invalidates-higher-indexes rule of WAL recovery
+(/root/reference/src/ra_log_wal.erl:871-955) at step granularity.
+Entries between ``n_acc`` and ``n_app`` are the term-opening noop
+(all-zero payload, the machine-noop encoding).
+
+Recovery (:func:`open_engine`) restores the latest checkpoint, scans the
+surviving WAL files, resolves truncations into the final per-lane logs,
+and replays them through the jitted step — machine state is recomputed
+by the same apply fold that produced it.  A crash (kill -9) therefore
+loses nothing that was ever reported committed: commits gate on
+confirms, and confirmed records are on disk by definition.
+
+Checkpointing (:meth:`EngineDurability.checkpoint`) quiesces the WAL,
+snapshots the full lane state via ``engine.save`` (atomic .npz), and
+prunes WAL files whose records the checkpoint covers — the
+release_cursor/snapshot-truncation role of ra_snapshot.erl at the
+engine scale.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..log.wal import Wal, WalDown, scan_wal_file
+
+UID = "__engine__"
+MAGIC = b"RTB1"
+_BLK = struct.Struct("<4sII8sI")
+
+
+def encode_block(hi: np.ndarray, n_app: np.ndarray, n_acc: np.ndarray,
+                 payload_host: np.ndarray) -> bytes:
+    """Encode one step's append outcome as a single WAL payload."""
+    N, K, C = payload_host.shape
+    mask = np.arange(K)[None, :] < n_acc[:, None]
+    flat = np.ascontiguousarray(payload_host[mask])
+    dt = np.dtype(payload_host.dtype).str.encode().ljust(8, b"\x00")
+    head = _BLK.pack(MAGIC, N, C, dt, flat.shape[0])
+    return b"".join((head,
+                     np.ascontiguousarray(hi, "<i4").tobytes(),
+                     np.ascontiguousarray(n_app, "<i4").tobytes(),
+                     np.ascontiguousarray(n_acc, "<i4").tobytes(),
+                     flat.tobytes()))
+
+
+def decode_block(data: bytes):
+    """Inverse of :func:`encode_block` -> (hi, n_app, n_acc, rows) where
+    rows is [N, Kmax, C] with noop rows already zero-filled."""
+    magic, n, c, dt, n_flat = _BLK.unpack_from(data, 0)
+    if magic != MAGIC:
+        raise ValueError("bad engine block magic")
+    dtype = np.dtype(dt.rstrip(b"\x00").decode())
+    off = _BLK.size
+    hi = np.frombuffer(data, "<i4", n, off).astype(np.int32)
+    off += 4 * n
+    n_app = np.frombuffer(data, "<i4", n, off).astype(np.int32)
+    off += 4 * n
+    n_acc = np.frombuffer(data, "<i4", n, off).astype(np.int32)
+    off += 4 * n
+    flat = np.frombuffer(data, dtype, n_flat * c, off).reshape(n_flat, c)
+    kmax = int(n_app.max()) if n else 0
+    rows = np.zeros((n, kmax, c), dtype)
+    if kmax:
+        mask = np.arange(kmax)[None, :] < n_acc[:, None]
+        rows[mask] = flat
+    return hi, n_app, n_acc, rows
+
+
+class _WalFileRetirer:
+    """Duck-typed segment_writer for the engine's Wal: instead of
+    flushing per-server memtables to segments, rolled WAL files are kept
+    until a checkpoint covers their step range, then unlinked — the
+    engine's .npz checkpoint plays the segment role (the WAL-file
+    deletion barrier of ra_log_segment_writer.erl:129-201)."""
+
+    def __init__(self) -> None:
+        self._files: list = []  # (hi_step, path)
+        self._lock = threading.Lock()
+        self.recovered_hi = 0   # step covering files found at recovery
+
+    def accept_ranges(self, ranges: dict, wal_path: str) -> None:
+        hi = max(r[1] for r in ranges.values())
+        with self._lock:
+            self._files.append((hi, wal_path))
+
+    def retire(self, uids: list, wal_files: list) -> None:
+        with self._lock:
+            for path in wal_files:
+                self._files.append((self.recovered_hi, path))
+
+    def mark_deleted(self, uid: str) -> None:  # pragma: no cover
+        pass
+
+    def prune(self, ckpt_step: int) -> None:
+        with self._lock:
+            keep = []
+            for hi, path in self._files:
+                if hi <= ckpt_step:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                else:
+                    keep.append((hi, path))
+            self._files = keep
+
+
+class EngineDurability:
+    """Host-side bridge: owns the engine's Wal, the inflight aux queue,
+    and the confirm feedback arrays."""
+
+    def __init__(self, data_dir: str, n_lanes: int, *, sync_mode: int = 1,
+                 max_pending: int = 8,
+                 wal_max_size: int = 256 * 1024 * 1024) -> None:
+        os.makedirs(data_dir, exist_ok=True)
+        self.dir = data_dir
+        self.n_lanes = n_lanes
+        self.max_pending = max_pending
+        self.retirer = _WalFileRetirer()
+        self.wal = Wal(data_dir, sync_mode=sync_mode,
+                       max_size=wal_max_size, segment_writer=self.retirer)
+        self.step_seq = 0
+        self.confirmed_step = 0
+        self.confirm_upto = np.zeros((n_lanes,), np.int32)
+        self._prev_hi = np.zeros((n_lanes,), np.int32)
+        self._appended: dict = {}     # step -> hi np[N] (until confirmed)
+        self._blocks: dict = {}       # step -> bytes   (until confirmed)
+        self._bases: dict = {}        # step -> base np[N] (until confirmed)
+        self._inflight: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+        self._wal_generation = self.wal.generation
+        self._resend_above: Optional[int] = None
+        self.wal.register(UID, self._notify)
+
+    def seed(self, prev_hi: np.ndarray, step_seq: int) -> None:
+        """Set the post-recovery baseline: everything up to ``prev_hi``
+        is durable and recorded through ``step_seq``."""
+        self._prev_hi = prev_hi.astype(np.int32).copy()
+        self.confirm_upto = prev_hi.astype(np.int32).copy()
+        self.step_seq = step_seq
+        self.confirmed_step = step_seq
+        self.retirer.recovered_hi = step_seq
+
+    # -- WAL confirm path (called from the WAL batch thread) ---------------
+
+    def _notify(self, uid: str, lo: Optional[int], hi: int,
+                term: int) -> None:
+        with self._cond:
+            if lo is None:
+                # out-of-sequence signal: resend everything above hi on
+                # the host thread (ra_log_wal.erl:457-481)
+                self._resend_above = hi
+                self._cond.notify_all()
+                return
+            if hi <= self.confirmed_step:
+                return
+            self.confirmed_step = hi
+            arr = self._appended.get(hi)
+            if arr is not None:
+                # exact durable tail as of step hi — then re-apply the
+                # bases of still-unconfirmed steps: an unconfirmed
+                # truncation means indexes above its base are occupied
+                # by entries not yet on disk
+                confirm = arr.copy()
+                for s, base in self._bases.items():
+                    if s > hi:
+                        confirm = np.minimum(confirm, base)
+                self.confirm_upto = confirm
+            for s in [s for s in self._appended if s <= hi]:
+                del self._appended[s]
+                self._blocks.pop(s, None)
+                self._bases.pop(s, None)
+            self._cond.notify_all()
+
+    # -- submit path (engine host thread) ----------------------------------
+
+    def submit(self, aux: dict, payload_host: np.ndarray) -> None:
+        """Queue step aux for WAL encoding; drains older steps (their
+        device values are ready by now — one step of overlap)."""
+        self._maybe_resend()
+        self._inflight.append((aux, payload_host))
+        while len(self._inflight) > 1:
+            self._drain_one()
+
+    def drain_all(self) -> None:
+        while self._inflight:
+            self._drain_one()
+
+    def _drain_one(self) -> None:
+        aux, ph = self._inflight.popleft()
+        hi = np.asarray(aux["appended_hi"]).astype(np.int32)
+        n_acc = np.asarray(aux["n_acc"]).astype(np.int32)
+        n_app = np.asarray(aux["n_app"]).astype(np.int32)
+        base = hi - n_app
+        blk = encode_block(hi, n_app, n_acc, ph)
+        self._prev_hi = hi
+        self.step_seq += 1
+        with self._cond:
+            self._appended[self.step_seq] = hi
+            self._blocks[self.step_seq] = blk
+            self._bases[self.step_seq] = base
+            # an election truncation reuses indexes: the durable horizon
+            # drops to the step's base until this block itself confirms
+            self.confirm_upto = np.minimum(self.confirm_upto, base)
+        self.wal.write(UID, self.step_seq, 1, blk)
+
+    def _maybe_resend(self) -> None:
+        """After a WAL crash+restart (or an out-of-sequence signal),
+        resend every unconfirmed block above the WAL's durable horizon
+        (the resend_from protocol, ra_log.erl:778-793)."""
+        resend_from = None
+        with self._cond:
+            if self._resend_above is not None:
+                resend_from = self._resend_above
+                self._resend_above = None
+        if self.wal.generation != self._wal_generation and self.wal.alive:
+            self._wal_generation = self.wal.generation
+            resend_from = self.confirmed_step
+        if resend_from is None:
+            return
+        with self._cond:
+            pending = sorted((s, b) for s, b in self._blocks.items()
+                             if s > resend_from)
+        for s, b in pending:
+            self.wal.write(UID, s, 1, b)
+
+    def backpressure(self, timeout: float = 30.0) -> None:
+        """Bound the unconfirmed window: wait for WAL confirms when more
+        than ``max_pending`` steps are in flight (the flow control a
+        gen_batch_server gets from its bounded mailbox)."""
+        self._maybe_resend()
+        while self._inflight and \
+                self.step_seq - self.confirmed_step >= self.max_pending:
+            self._drain_one()
+        if self.step_seq - self.confirmed_step < self.max_pending:
+            return
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self.step_seq - self.confirmed_step <
+                self.max_pending or self._resend_above is not None
+                or not self.wal.alive, timeout)
+        if not self.wal.alive:
+            raise WalDown("engine WAL died under backpressure; call "
+                          "wal.restart() to resume")
+        if not ok:
+            raise TimeoutError("WAL confirms stalled")
+        self._maybe_resend()
+
+    # -- checkpoint / recovery --------------------------------------------
+
+    def checkpoint(self, engine) -> str:
+        while self._inflight:
+            self._drain_one()
+        self._maybe_resend()
+        self.wal.flush()
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self.confirmed_step >= self.step_seq, 30.0)
+        if not ok:
+            raise TimeoutError("checkpoint: WAL confirms stalled")
+        path = os.path.join(self.dir, "ckpt.npz")
+        engine.save(path)
+        meta = {"step": self.step_seq}
+        tmp = path + ".meta.tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.dir, "ckpt.meta.json"))
+        # roll the current WAL file so its (now-covered) records become
+        # prunable, then drop every covered file
+        self.wal.rollover()
+        self.wal.flush()
+        self.retirer.prune(self.step_seq)
+        return path
+
+    def close(self) -> None:
+        while self._inflight:
+            self._drain_one()
+        try:
+            self.wal.flush()
+        except (WalDown, TimeoutError):
+            pass
+        self.wal.close()
+
+
+def _final_logs(blocks: list, ckpt_tail: np.ndarray):
+    """Resolve election truncations across recovered step blocks into the
+    surviving per-step entry counts.
+
+    blocks: [(step, hi, n_app, n_acc, rows)] in step order.  Returns
+    (surv_counts per block [N], trimmed_tail np[N], final_hi np[N]):
+    ``surv_counts[b][i]`` entries of block b survive for lane i (always a
+    prefix — truncation removes a suffix of earlier appends), and
+    ``trimmed_tail`` is where the checkpoint state itself must be cut
+    (a truncation can reach below the checkpoint when unconfirmed
+    leader tail existed at checkpoint time)."""
+    n = ckpt_tail.shape[0]
+    if not blocks:
+        return [], ckpt_tail.copy(), ckpt_tail.copy()
+    bases = np.stack([hi - n_app for _s, hi, n_app, _a, _r in blocks])
+    his = np.stack([hi for _s, hi, _n, _a, _r in blocks])
+    # suffix-min of bases strictly after each block: entries above it die
+    suffix = np.full((len(blocks) + 1, n), np.iinfo(np.int32).max,
+                     np.int32)
+    for b in range(len(blocks) - 1, -1, -1):
+        suffix[b] = np.minimum(suffix[b + 1], bases[b])
+    surv = []
+    for b, (_s, hi, n_app, _n_acc, _rows) in enumerate(blocks):
+        end = np.minimum(his[b], suffix[b + 1])
+        surv.append(np.clip(end - bases[b], 0, n_app).astype(np.int32))
+    trimmed_tail = np.minimum(ckpt_tail, suffix[0])
+    final_hi = his[-1]
+    return surv, trimmed_tail, final_hi
+
+
+def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
+                *, sync_mode: int = 1, max_pending: int = 8,
+                settle_limit: int = 10_000, **engine_kwargs):
+    """Create-or-recover a durable LockstepEngine at ``data_dir``.
+
+    Fresh directory: a new engine wired to a new WAL.  Existing data:
+    restore the checkpoint, replay surviving WAL records through the
+    jitted step (recomputing machine state with the same apply fold),
+    and resume in durable mode.  Matches the recovery contract of
+    SURVEY.md §3.4 at engine scale: recovery = checkpoint + WAL re-read,
+    deduped by the overwrite rule, applied with effects suppressed."""
+    import jax
+    import jax.numpy as jnp
+
+    from .lockstep import LockstepEngine
+
+    os.makedirs(data_dir, exist_ok=True)
+    ckpt = os.path.join(data_dir, "ckpt.npz")
+    meta_path = os.path.join(data_dir, "ckpt.meta.json")
+    wal_dir = os.path.join(data_dir, "wal")
+    base_step = 0
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            base_step = json.load(f).get("step", 0)
+
+    # scan surviving WAL files BEFORE constructing the live Wal (which
+    # opens a fresh file); scan_wal_file dedups per-index overwrites
+    tables: dict = {}
+    if os.path.isdir(wal_dir):
+        for fname in sorted(os.listdir(wal_dir)):
+            if fname.endswith(".wal"):
+                try:
+                    scan_wal_file(os.path.join(wal_dir, fname), tables)
+                except ValueError:
+                    pass  # torn tail: keep the parsed prefix
+    steps = {s: blk for s, (_t, blk) in tables.get(UID, {}).items()
+             if s > base_step}
+
+    blocks = []
+    for s in sorted(steps):
+        hi, n_app, n_acc, rows = decode_block(steps[s])
+        blocks.append((s, hi, n_app, n_acc, rows))
+    kmax = max((r.shape[1] for *_x, r in blocks), default=0)
+    if kmax:
+        # the replay apply window must cover the widest recovered block,
+        # or ring backpressure would silently clip replayed entries
+        engine_kwargs = dict(engine_kwargs)
+        engine_kwargs["apply_window"] = max(
+            engine_kwargs.get("apply_window") or 0, kmax + 2)
+
+    eng = LockstepEngine(machine, n_lanes, n_members, **engine_kwargs)
+    if os.path.exists(ckpt):
+        eng.restore(ckpt)
+        # transient failure masks do not survive a node restart: every
+        # non-removed member recovers with the node (removed members
+        # have voter=False too and stay out)
+        st = eng.state
+        eng.state = st._replace(active=st.active | st.voter)
+
+    lane = np.arange(n_lanes)
+    st = eng.state
+    leader = np.asarray(st.leader_slot)
+    ckpt_tail = np.asarray(st.last_index)[lane, leader].astype(np.int32)
+
+    surv, trimmed_tail, final_hi = _final_logs(blocks, ckpt_tail)
+
+    if (trimmed_tail < ckpt_tail).any():
+        # a post-checkpoint election truncated into the checkpoint's
+        # unconfirmed tail: cut the restored cursors (commit/applied are
+        # always below the cut — commit never truncates)
+        t = jnp.asarray(trimmed_tail)[:, None]
+        st = eng.state
+        eng.state = st._replace(
+            last_index=jnp.minimum(st.last_index, t),
+            last_written=jnp.minimum(st.last_written, t),
+            match=jnp.minimum(st.match, t),
+            next_index=jnp.minimum(st.next_index, t + 1))
+
+    if blocks:
+        kmax = kmax or 1
+        C = eng.payload_width
+        for (s, hi, n_app, n_acc, rows), keep in zip(blocks, surv):
+            pad = np.zeros((n_lanes, kmax, C), rows.dtype)
+            if rows.shape[1]:
+                pad[:, :rows.shape[1]] = rows
+            eng.step(keep, pad)
+        # settle: drain the apply/commit pipeline until every lane's
+        # recovered log is fully committed and applied on every live
+        # member (recovery commits the whole surviving log: it is on
+        # disk, i.e. replicated on every co-hosted member by definition)
+        zero_n = np.zeros((n_lanes,), np.int32)
+        zero_p = np.zeros((n_lanes, 1, C), eng.payload_dtype)
+        for _ in range(settle_limit):
+            stn = eng.state
+            com = np.asarray(stn.commit)[lane, np.asarray(stn.leader_slot)]
+            active = np.asarray(stn.active)
+            app = np.where(active, np.asarray(stn.applied),
+                           np.iinfo(np.int32).max).min(axis=1)
+            if (com >= final_hi).all() and (app >= com).all():
+                break
+            eng.step(zero_n, zero_p)
+        else:
+            raise RuntimeError("recovery settle did not converge")
+
+    dur = EngineDurability(data_dir, n_lanes, sync_mode=sync_mode,
+                           max_pending=max_pending)
+    st = eng.state
+    leader = np.asarray(st.leader_slot)
+    tail = np.asarray(st.last_index)[lane, leader].astype(np.int32)
+    last_step = max(steps) if steps else base_step
+    dur.seed(tail, last_step)
+    eng.attach_durability(dur)
+    return eng

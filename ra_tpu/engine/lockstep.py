@@ -101,11 +101,23 @@ def _init_state(n_lanes: int, n_members: int, ring_capacity: int,
 
 
 def _step(state: LaneState, n_new: Array, payloads: Array,
-          fail_mask: Array, elect_mask: Array, *, machine: JitMachine,
-          ring_capacity: int, apply_window: int,
+          fail_mask: Array, elect_mask: Array, confirm_upto: Array, *,
+          machine: JitMachine, ring_capacity: int, apply_window: int,
           pipeline_window: int, max_append_batch: int, write_delay: int,
-          quorum_fn=evaluate_quorum) -> LaneState:
-    """One lockstep round for every lane.  Pure; jitted by the engine."""
+          durable: bool = False, quorum_fn=evaluate_quorum):
+    """One lockstep round for every lane.  Pure; jitted by the engine.
+
+    Returns ``(new_state, aux)`` where aux carries the per-lane append
+    outcome the host needs to form the step's WAL record in durable mode:
+    ``appended_hi`` (the leader tail after this step) and ``n_acc`` (how
+    many of the host batch were accepted — the rest were clipped by ring
+    backpressure or a down leader).
+
+    ``confirm_upto`` (int32[N]) is the durability horizon fed back from
+    the fan-in WAL: with ``durable=True``, ``last_written`` only advances
+    to it, so the commit quorum counts nothing that has not really been
+    fsynced (the {written,..} notify protocol, ra_log_wal.erl:753-800);
+    the ``write_delay`` emulation is bypassed."""
     N, P = state.last_index.shape
     R = ring_capacity
     lane = jnp.arange(N)
@@ -230,7 +242,22 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
                            last_index)
 
     # -- 3. write confirm (async WAL protocol) ----------------------------
-    if write_delay == 0:
+    if durable:
+        # real confirms: the host feeds back the fan-in WAL's durable
+        # horizon; nothing beyond it enters the quorum median.  On a won
+        # election the horizon is additionally capped at the new leader's
+        # pre-noop written tail: the truncated suffix's indexes are being
+        # REUSED by fresh entries, so a confirm that covered the old
+        # suffix must not vouch for the replacements (the (index,term)
+        # identity of the written-event protocol, ra_log.erl:474+)
+        eff_confirm = jnp.where(elect_ok,
+                                jnp.minimum(confirm_upto, leader_written),
+                                confirm_upto)
+        last_written = jnp.where(active,
+                                 jnp.minimum(last_index,
+                                             eff_confirm[:, None]),
+                                 last_written0)
+    elif write_delay == 0:
         last_written = jnp.where(active, last_index, last_written0)
     else:
         # confirms lag one step: this step confirms the *previous* tail
@@ -297,13 +324,16 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
         (mac, applied), _ = jax.lax.scan(body, (state.mac, applied0),
                                          jnp.arange(A))
 
-    return LaneState(term=term, leader_slot=leader_slot,
-                     term_start=term_start, last_index=last_index,
-                     last_written=last_written, match=match,
-                     next_index=next_index, commit=commit, applied=applied,
-                     voter=state.voter, active=active, ring=ring,
-                     ring_base=ring_base, total_committed=total_committed,
-                     mac=mac)
+    new_state = LaneState(term=term, leader_slot=leader_slot,
+                          term_start=term_start, last_index=last_index,
+                          last_written=last_written, match=match,
+                          next_index=next_index, commit=commit,
+                          applied=applied, voter=state.voter, active=active,
+                          ring=ring, ring_base=ring_base,
+                          total_committed=total_committed, mac=mac)
+    aux = {"appended_hi": new_leader_last, "n_acc": n_acc,
+           "n_app": total_app}
+    return new_state, aux
 
 
 class LockstepEngine:
@@ -335,29 +365,75 @@ class LockstepEngine:
                                  self.payload_width, mac,
                                  self.payload_dtype)
         from ..ops.pallas_quorum import make_evaluate_quorum
-        step = functools.partial(_step, machine=machine,
+        self._step_kwargs = dict(machine=machine,
                                  ring_capacity=ring_capacity,
                                  apply_window=self.apply_window,
                                  pipeline_window=pipeline_window,
                                  max_append_batch=max_append_batch,
                                  write_delay=write_delay,
                                  quorum_fn=make_evaluate_quorum(quorum_impl))
-        self._step = jax.jit(step, donate_argnums=(0,) if donate else ())
+        self._donate = donate
+        self._dur = None
+        self._compile_step(durable=False)
         self._zero_fail = jnp.zeros((n_lanes, n_members), bool)
         self._zero_elect = jnp.zeros((n_lanes,), bool)
+        self._zero_confirm = jnp.zeros((n_lanes,), jnp.int32)
         self._fail_host = np.zeros((n_lanes, n_members), bool)
+
+    def _compile_step(self, durable: bool) -> None:
+        step = functools.partial(_step, durable=durable,
+                                 **self._step_kwargs)
+        self._step = jax.jit(step,
+                             donate_argnums=(0,) if self._donate else ())
+
+    def attach_durability(self, dur) -> None:
+        """Switch the engine into durable mode: ``dur`` (an
+        engine-durability bridge, see ra_tpu.engine.durable) supplies the
+        per-lane WAL-confirm horizon before each step and receives each
+        step's append outcome after dispatch."""
+        self._dur = dur
+        self._compile_step(durable=True)
 
     # -- driving -----------------------------------------------------------
 
     def step(self, n_new, payloads, elect_mask=None) -> None:
         """Advance every lane one round.  n_new: int32[N]; payloads:
-        [N, K, C] with K <= max_step_cmds."""
+        [N, K, C] with K <= max_step_cmds.  In durable mode, pass host
+        (numpy) payloads — the step's accepted entries are fed through
+        the fan-in WAL and commits gate on the fsync confirm."""
         fail = (jnp.asarray(self._fail_host)
                 if self._fail_host.any() else self._zero_fail)
         elect = self._zero_elect if elect_mask is None \
             else jnp.asarray(elect_mask)
-        self.state = self._step(self.state, jnp.asarray(n_new),
-                                jnp.asarray(payloads), fail, elect)
+        if self._dur is None:
+            self.state, _ = self._step(self.state, jnp.asarray(n_new),
+                                       jnp.asarray(payloads), fail, elect,
+                                       self._zero_confirm)
+            return
+        self._dur.backpressure()
+        payload_host = np.asarray(payloads)
+        confirm = jnp.asarray(self._dur.confirm_upto)
+        self.state, aux = self._step(self.state, jnp.asarray(n_new),
+                                     jnp.asarray(payloads), fail, elect,
+                                     confirm)
+        self._dur.submit(aux, payload_host)
+        if elect_mask is not None and np.asarray(elect_mask).any():
+            # elections truncate+reuse indexes: drain now so the next
+            # dispatch reads a confirm horizon clamped at the new base
+            self._dur.drain_all()
+
+    def checkpoint(self) -> str:
+        """Durable mode: quiesce the WAL, snapshot the full lane state,
+        and prune WAL files the snapshot covers (the release_cursor /
+        snapshot-truncation role).  Returns the checkpoint path."""
+        if self._dur is None:
+            raise RuntimeError("checkpoint() requires durable mode")
+        return self._dur.checkpoint(self)
+
+    def close(self) -> None:
+        """Flush and close the durability bridge (no-op when volatile)."""
+        if self._dur is not None:
+            self._dur.close()
 
     def uniform_step(self, cmds_per_lane: int, payload_value=1) -> None:
         """Bench helper: every lane's leader receives the same number of
@@ -398,6 +474,11 @@ class LockstepEngine:
         self.state = st._replace(active=st.active.at[lane, slot].set(True))
 
     # -- membership (per-lane add/remove/promote, SURVEY §2.1 membership) --
+    # NB durable mode: membership and recover_member are host-side state
+    # edits outside the WAL block stream, so they are durable only from
+    # the next checkpoint() on — call checkpoint() after changing
+    # membership (the reference logs '$ra_join'/'$ra_leave' as commands;
+    # the engine trades that for checkpoint-granularity durability).
 
     def add_member(self, lane: int, slot: int,
                    voter: bool = False) -> None:
