@@ -48,11 +48,12 @@ import json
 import os
 import struct
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
-from ..log.wal import Wal, WalDown, scan_wal_file
+from ..log.wal import Wal, WalDown
 
 UID = "__engine__"
 MAGIC = b"RTB1"
@@ -107,7 +108,6 @@ class _WalFileRetirer:
     def __init__(self) -> None:
         self._files: list = []  # (hi_step, path)
         self._lock = threading.Lock()
-        self.recovered_hi = 0   # step covering files found at recovery
 
     def accept_ranges(self, ranges: dict, wal_path: str) -> None:
         hi = max(r[1] for r in ranges.values())
@@ -115,9 +115,11 @@ class _WalFileRetirer:
             self._files.append((hi, wal_path))
 
     def retire(self, uids: list, wal_files: list) -> None:
+        # recovered files: every record in them predates any future
+        # checkpoint, so hi=0 (pruned by the first checkpoint taken)
         with self._lock:
             for path in wal_files:
-                self._files.append((self.recovered_hi, path))
+                self._files.append((0, path))
 
     def mark_deleted(self, uid: str) -> None:  # pragma: no cover
         pass
@@ -170,7 +172,6 @@ class EngineDurability:
         self.confirm_upto = prev_hi.astype(np.int32).copy()
         self.step_seq = step_seq
         self.confirmed_step = step_seq
-        self.retirer.recovered_hi = step_seq
 
     # -- WAL confirm path (called from the WAL batch thread) ---------------
 
@@ -265,30 +266,51 @@ class EngineDurability:
             self._drain_one()
         if self.step_seq - self.confirmed_step < self.max_pending:
             return
-        with self._cond:
-            ok = self._cond.wait_for(
-                lambda: self.step_seq - self.confirmed_step <
-                self.max_pending or self._resend_above is not None
-                or not self.wal.alive, timeout)
-        if not self.wal.alive:
-            raise WalDown("engine WAL died under backpressure; call "
-                          "wal.restart() to resume")
-        if not ok:
-            raise TimeoutError("WAL confirms stalled")
-        self._maybe_resend()
+        deadline = time.monotonic() + timeout
+        while True:
+            # sliced wait: WAL thread death never notifies the condition
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self.step_seq - self.confirmed_step <
+                    self.max_pending or self._resend_above is not None
+                    or not self.wal.alive,
+                    min(0.5, max(0.0, deadline - time.monotonic())))
+                under = self.step_seq - self.confirmed_step < \
+                    self.max_pending
+            if not self.wal.alive:
+                raise WalDown("engine WAL died under backpressure; call "
+                              "wal.restart() to resume")
+            self._maybe_resend()
+            if under:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError("WAL confirms stalled")
 
     # -- checkpoint / recovery --------------------------------------------
 
-    def checkpoint(self, engine) -> str:
+    def checkpoint(self, engine, timeout: float = 30.0) -> str:
         while self._inflight:
             self._drain_one()
-        self._maybe_resend()
-        self.wal.flush()
-        with self._cond:
-            ok = self._cond.wait_for(
-                lambda: self.confirmed_step >= self.step_seq, 30.0)
-        if not ok:
-            raise TimeoutError("checkpoint: WAL confirms stalled")
+        deadline = time.monotonic() + timeout
+        # wait in slices: WAL thread death never notifies the condition,
+        # and an out-of-sequence signal needs a resend, not more waiting
+        while True:
+            self._maybe_resend()
+            self.wal.flush()
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self.confirmed_step >= self.step_seq
+                    or self._resend_above is not None
+                    or not self.wal.alive,
+                    min(0.5, max(0.0, deadline - time.monotonic())))
+                done = self.confirmed_step >= self.step_seq
+            if done:
+                break
+            if not self.wal.alive:
+                raise WalDown("checkpoint: WAL died; wal.restart() and "
+                              "retry")
+            if time.monotonic() > deadline:
+                raise TimeoutError("checkpoint: WAL confirms stalled")
         path = os.path.join(self.dir, "ckpt.npz")
         engine.save(path)
         meta = {"step": self.step_seq}
@@ -306,12 +328,12 @@ class EngineDurability:
         return path
 
     def close(self) -> None:
-        while self._inflight:
-            self._drain_one()
         try:
+            while self._inflight:
+                self._drain_one()
             self.wal.flush()
         except (WalDown, TimeoutError):
-            pass
+            pass  # best-effort: a dead WAL must not block cleanup
         self.wal.close()
 
 
@@ -364,24 +386,19 @@ def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
     os.makedirs(data_dir, exist_ok=True)
     ckpt = os.path.join(data_dir, "ckpt.npz")
     meta_path = os.path.join(data_dir, "ckpt.meta.json")
-    wal_dir = os.path.join(data_dir, "wal")
     base_step = 0
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             base_step = json.load(f).get("step", 0)
 
-    # scan surviving WAL files BEFORE constructing the live Wal (which
-    # opens a fresh file); scan_wal_file dedups per-index overwrites
-    tables: dict = {}
-    if os.path.isdir(wal_dir):
-        for fname in sorted(os.listdir(wal_dir)):
-            if fname.endswith(".wal"):
-                try:
-                    scan_wal_file(os.path.join(wal_dir, fname), tables)
-                except ValueError:
-                    pass  # torn tail: keep the parsed prefix
-    steps = {s: blk for s, (_t, blk) in tables.get(UID, {}).items()
-             if s > base_step}
+    # the bridge's Wal scans surviving files once on construction
+    # (scan_wal_file dedups per-index overwrites); its recovered table
+    # is the step-block source for replay.  No engine writes happen
+    # until attach, so constructing it up front is safe.
+    dur = EngineDurability(data_dir, n_lanes, sync_mode=sync_mode,
+                           max_pending=max_pending)
+    steps = {s: blk for s, (_t, blk)
+             in dur.wal.recovered_table(UID).items() if s > base_step}
 
     blocks = []
     for s in sorted(steps):
@@ -394,14 +411,47 @@ def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
         engine_kwargs = dict(engine_kwargs)
         engine_kwargs["apply_window"] = max(
             engine_kwargs.get("apply_window") or 0, kmax + 2)
+        ring = engine_kwargs.get("ring_capacity", 1024)
+        if ring < kmax + 2:
+            # the ring-write dummy slot must stay clear of the widest
+            # replayed block; reopening with a smaller geometry than
+            # the writer would otherwise silently corrupt the replay
+            raise ValueError(
+                f"ring_capacity {ring} too small to replay recovered "
+                f"blocks of width {kmax}; use >= {kmax + 2} (the "
+                "engine that wrote this WAL had larger max_step_cmds)")
 
     eng = LockstepEngine(machine, n_lanes, n_members, **engine_kwargs)
     if os.path.exists(ckpt):
         eng.restore(ckpt)
         # transient failure masks do not survive a node restart: every
         # non-removed member recovers with the node (removed members
-        # have voter=False too and stay out)
+        # have voter=False too and stay out).  Revival is by SNAPSHOT
+        # INSTALL from the lane leader, vectorized over all revived
+        # members — a bare active-flag flip would leave a frozen
+        # applied cursor that drags the lane-uniform apply window onto
+        # recycled ring slots (silent divergence).
         st = eng.state
+        revive = st.voter & ~st.active
+        if bool(revive.any()):
+            lead = st.leader_slot[:, None]                      # [N,1]
+            snap = jnp.take_along_axis(st.applied, lead, axis=1)
+
+            def from_leader(x):
+                idx = lead.reshape((n_lanes, 1) + (1,) * (x.ndim - 2))
+                idx = jnp.broadcast_to(idx, (n_lanes, 1) + x.shape[2:])
+                lx = jnp.take_along_axis(x, idx, axis=1)
+                rv = revive.reshape(revive.shape + (1,) * (x.ndim - 2))
+                return jnp.where(rv, lx, x)
+
+            st = st._replace(
+                mac=jax.tree.map(from_leader, st.mac),
+                applied=jnp.where(revive, snap, st.applied),
+                commit=jnp.where(revive, snap, st.commit),
+                last_index=jnp.where(revive, snap, st.last_index),
+                last_written=jnp.where(revive, snap, st.last_written),
+                match=jnp.where(revive, 0, st.match),
+                next_index=jnp.where(revive, snap + 1, st.next_index))
         eng.state = st._replace(active=st.active | st.voter)
 
     lane = np.arange(n_lanes)
@@ -449,8 +499,6 @@ def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
         else:
             raise RuntimeError("recovery settle did not converge")
 
-    dur = EngineDurability(data_dir, n_lanes, sync_mode=sync_mode,
-                           max_pending=max_pending)
     st = eng.state
     leader = np.asarray(st.leader_slot)
     tail = np.asarray(st.last_index)[lane, leader].astype(np.int32)

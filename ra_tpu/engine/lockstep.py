@@ -54,6 +54,80 @@ from ..ops.quorum import (election_quorum, evaluate_quorum, pipeline_credit,
 Array = jax.Array
 
 
+def _split16_matmul(onehot_f32: Array, values: Array) -> Array:
+    """Exact int32 gather/scatter-by-matmul: contract a {0,1} one-hot
+    f32 tensor with int32 values split into two 16-bit halves (two f32
+    matmuls, recombined bitwise).  Each one-hot row has exactly one 1,
+    so every product and sum is exact in f32; the int32 recombination
+    (lo | hi<<16) is modular and reproduces the original bit pattern,
+    negatives included.  On TPU this routes the ring's per-lane
+    variable-index IO onto the MXU — the generic per-element
+    gather/scatter lowering costs ~15-25ms per step at 10k lanes, the
+    matmul form ~7ms (measured v5e)."""
+    # Precision.HIGHEST: TPU otherwise lowers f32 matmuls through bf16
+    # passes, which silently rounds the 16-bit halves
+    lo = (values & 0xFFFF).astype(jnp.float32)
+    hi = ((values >> 16) & 0xFFFF).astype(jnp.float32)
+    glo = jnp.einsum("...ar,...rc->...ac", onehot_f32, lo,
+                     precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+    ghi = jnp.einsum("...ar,...rc->...ac", onehot_f32, hi,
+                     precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+    return glo | (ghi << 16)
+
+
+def _ring_write(ring: Array, payloads: Array, leader_last: Array,
+                n_acc: Array, elect_ok: Array, *, impl: str) -> Array:
+    """Append ``n_acc`` payload rows (entries leader_last+1..+n_acc at
+    slots (idx-1) % R) plus, on a won election, the zero-payload
+    term-opening noop — without a generic scatter.
+
+    impl='gather': per-row put_along_axis with masked columns parked on
+    a dummy slot one past the write range (needs R >= K+2).
+    impl='onehot': one-hot matmul over the whole ring (MXU path)."""
+    N, R, C = ring.shape
+    K = payloads.shape[1]
+    vals = jnp.concatenate(
+        [payloads.astype(ring.dtype), jnp.zeros((N, 1, C), ring.dtype)],
+        axis=1)                                              # [N,K+1,C]
+    if impl == "onehot":
+        r_idx = jnp.arange(R)[None, :]
+        rel = (r_idx - leader_last[:, None]) % R             # [N,R]
+        in_rng = (rel < n_acc[:, None]) | \
+            ((rel == n_acc[:, None]) & elect_ok[:, None])
+        # the noop slot (rel == n_acc) takes the zero column K
+        col = jnp.where(rel == n_acc[:, None], K, rel)
+        oh = (col[:, :, None] ==
+              jnp.arange(K + 1)[None, None, :]).astype(jnp.float32)
+        written = _split16_matmul(oh, vals)                  # [N,R,C]
+        return jnp.where(in_rng[..., None], written, ring)
+    k_idx = jnp.arange(K + 1)
+    dest = (leader_last[:, None] + k_idx[None, :]) % R       # [N,K+1]
+    noop_col = k_idx[None, :] == n_acc[:, None]
+    write_mask = (k_idx[None, :] < n_acc[:, None]) | \
+        (noop_col & elect_ok[:, None])
+    dummy = ((leader_last + K + 1) % R)[:, None]
+    dest_s = jnp.where(write_mask, dest, dummy)
+    vals = jnp.where(noop_col[..., None], jnp.zeros((), ring.dtype), vals)
+    dest3 = jnp.broadcast_to(dest_s[..., None], vals.shape)
+    old = jnp.take_along_axis(ring, dest3, axis=1)
+    vals = jnp.where(write_mask[..., None], vals, old)
+    return jnp.put_along_axis(ring, dest3, vals, axis=1, inplace=False)
+
+
+def _ring_read_window(ring: Array, idx_lane: Array, *, impl: str) -> Array:
+    """Read the per-lane entry window ``idx_lane`` (int32[N,A], entry
+    indexes) from the ring: [N,A,C].  Slot mapping (idx-1) % R."""
+    N, R, C = ring.shape
+    slot = (idx_lane - 1) % R
+    if impl == "onehot":
+        oh = (slot[:, :, None] ==
+              jnp.arange(R)[None, None, :]).astype(jnp.float32)
+        return _split16_matmul(oh, ring)
+    return jnp.take_along_axis(
+        ring, jnp.broadcast_to(slot[..., None], slot.shape + (C,)),
+        axis=1)
+
+
 class LaneState(NamedTuple):
     """SoA state for N lanes × P member slots (ra_server_state() flattened —
     the per-lane scalars and per-lane×peer fields listed in SURVEY.md §7.1)."""
@@ -104,7 +178,8 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
           fail_mask: Array, elect_mask: Array, confirm_upto: Array, *,
           machine: JitMachine, ring_capacity: int, apply_window: int,
           pipeline_window: int, max_append_batch: int, write_delay: int,
-          durable: bool = False, quorum_fn=evaluate_quorum):
+          durable: bool = False, ring_io: str = "gather",
+          quorum_fn=evaluate_quorum):
     """One lockstep round for every lane.  Pure; jitted by the engine.
 
     Returns ``(new_state, aux)`` where aux carries the per-lane append
@@ -187,24 +262,14 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
     n_acc = jnp.minimum(n_acc, payloads.shape[1])
     total_app = n_acc + jnp.where(leader_up, n_noop, 0)
 
-    K = payloads.shape[1]
     # entry index i lives at ring slot (i - 1) % R; ring_base only tracks
-    # the reclaim horizon.  scatter payloads at slots for indexes
-    # leader_last+1 .. leader_last+n_acc; masked writes routed OOB + dropped
-    k_idx = jnp.arange(K)
-    dest = (leader_last[:, None] + k_idx[None, :]) % R
-    write_mask = k_idx[None, :] < n_acc[:, None]
-    safe_dest = jnp.where(write_mask, dest, R).reshape(-1)
-    ring = state.ring.at[jnp.repeat(lane, K), safe_dest].set(
-        payloads.reshape(N * K, -1).astype(state.ring.dtype), mode="drop")
-    # an election appends the term-opening noop (after any accepted cmds —
-    # the host never enqueues commands on an elect step); its payload is
-    # the machine's noop encoding (zeros)
-    noop_slot = (leader_last + n_acc) % R
-    noop_row = jnp.where(elect_ok[:, None],
-                         jnp.zeros((N, ring.shape[-1]), ring.dtype),
-                         ring[lane, noop_slot])
-    ring = ring.at[lane, noop_slot].set(noop_row)
+    # the reclaim horizon.  Write payloads at slots for indexes
+    # leader_last+1 .. leader_last+n_acc, plus the term-opening noop
+    # (zeros — the machine-noop encoding) on a won election.  A generic
+    # scatter would serialize on TPU; see _ring_write for the two fast
+    # lowerings.
+    ring = _ring_write(state.ring, payloads, leader_last, n_acc,
+                       elect_ok, impl=ring_io)
     new_leader_last = leader_last + total_app
 
     # -- 2. replication, governed by per-peer pipeline credit --------------
@@ -290,39 +355,65 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
     total_committed = state.total_committed + delta
 
     # -- 5. apply fold over the committed window ---------------------------
+    # The window is LANE-uniform: all active members of a lane share the
+    # same apply frontier (failed members freeze; recover/add re-seed
+    # from the leader's replica), so the committed entries are read from
+    # the ring ONCE per lane with an along-axis gather — the generic
+    # per-(lane,member) gather this replaces lowered to a serialized
+    # scatter-read on TPU and dominated the whole step (~67ms at 10k
+    # lanes; the along-axis form is ~0.02ms).  Per-member progress is
+    # enforced by the `do` mask.
     applied0 = state.applied
     apply_to = jnp.minimum(commit, applied0 + apply_window)
     A = apply_window
+    big = jnp.int32(2 ** 30)
+    base = jnp.min(jnp.where(active, applied0, big), axis=-1)
+    base = jnp.where(jnp.any(active, axis=-1), base, 0)      # [N]
+
+    a_idx = jnp.arange(A)
+    idx_lane = base[:, None] + 1 + a_idx[None, :]            # [N,A]
+    cmds_lane = _ring_read_window(ring, idx_lane, impl=ring_io)  # [N,A,C]
+    idx = idx_lane[:, None, :]                               # [N,1,A]
+    do = (idx > applied0[..., None]) & (idx <= apply_to[..., None]) \
+        & active[..., None]                                  # [N,P,A]
+    idx = jnp.broadcast_to(idx, do.shape)
 
     if machine.supports_batch_apply:
         # one-shot masked window fold (commutative machines): no scan depth
-        a_idx = jnp.arange(A)
-        idx = applied0[..., None] + 1 + a_idx            # [N,P,A]
-        do = idx <= apply_to[..., None]
-        slot = (idx - 1) % R
-        cmds = ring[lane[:, None, None], slot]           # [N,P,A,C]
+        cmds = jnp.broadcast_to(cmds_lane[:, None],
+                                do.shape + cmds_lane.shape[-1:])
         meta = {"index": idx, "term": term[:, None, None]}
         mac = machine.jit_apply_batch(meta, cmds, do, state.mac)
-        applied = apply_to
+        applied = jnp.where(
+            active,
+            jnp.maximum(applied0,
+                        jnp.minimum(apply_to, (base + A)[:, None])),
+            applied0)
     else:
-        def body(carry, a):
+        # sequential machines: scan over the window positions, feeding
+        # each pre-gathered command row as scan xs (zero gather in-body)
+        def body(carry, xs):
             mac, applied = carry
-            idx = applied0 + 1 + a                       # [N,P] candidate
-            do = idx <= apply_to                         # [N,P] mask
-            slot = (idx - 1) % R                         # ring position
-            cmd = ring[lane[:, None], slot]              # [N,P,C]
-            meta = {"index": idx, "term": jnp.broadcast_to(term[:, None],
-                                                           idx.shape)}
+            a, cmd_row = xs                              # [], [N,C]
+            step_idx = base + 1 + a                      # [N]
+            idx_m = jnp.broadcast_to(step_idx[:, None], (N, P))
+            do_m = (idx_m > applied) & (idx_m <= apply_to) & active
+            cmd = jnp.broadcast_to(cmd_row[:, None],
+                                   (N, P, cmd_row.shape[-1]))
+            meta = {"index": idx_m, "term": jnp.broadcast_to(
+                term[:, None], idx_m.shape)}
             new_mac, _reply = machine.jit_apply(meta, cmd, mac)
             mac = jax.tree.map(
                 lambda new, old: jnp.where(
-                    do.reshape(do.shape + (1,) * (new.ndim - 2)), new, old),
+                    do_m.reshape(do_m.shape + (1,) * (new.ndim - 2)),
+                    new, old),
                 new_mac, mac)
-            applied = jnp.where(do, idx, applied)
+            applied = jnp.where(do_m, idx_m, applied)
             return (mac, applied), None
 
-        (mac, applied), _ = jax.lax.scan(body, (state.mac, applied0),
-                                         jnp.arange(A))
+        (mac, applied), _ = jax.lax.scan(
+            body, (state.mac, applied0),
+            (a_idx, jnp.moveaxis(cmds_lane, 1, 0)))
 
     new_state = LaneState(term=term, leader_slot=leader_slot,
                           term_start=term_start, last_index=last_index,
@@ -343,11 +434,20 @@ class LockstepEngine:
                  *, ring_capacity: int = 1024, max_step_cmds: int = 64,
                  apply_window: Optional[int] = None,
                  pipeline_window: int = 4096, max_append_batch: int = 128,
-                 write_delay: int = 0,
-                 donate: bool = True, quorum_impl: str = "xla") -> None:
+                 write_delay: int = 0, ring_io: str = "auto",
+                 donate: bool = False, quorum_impl: str = "xla") -> None:
+        # donate=False by default: buffer donation costs ~35ms/step on
+        # tunneled PJRT backends (a per-step sync), vs ~0.05ms/step
+        # without — XLA's allocator handles the transient double
+        # buffering fine at these state sizes.  Flip on for
+        # memory-constrained local deployments.
         self.machine = machine
         self.n_lanes = n_lanes
         self.n_members = n_members
+        if ring_capacity < max_step_cmds + 3:
+            # the put-along ring write parks masked columns one slot past
+            # the write range (payload + noop + recovery-replay widths)
+            raise ValueError("ring_capacity must be >= max_step_cmds + 3")
         self.ring_capacity = ring_capacity
         self.max_step_cmds = max_step_cmds
         self.apply_window = apply_window or (max_step_cmds + 2)
@@ -365,12 +465,18 @@ class LockstepEngine:
                                  self.payload_width, mac,
                                  self.payload_dtype)
         from ..ops.pallas_quorum import make_evaluate_quorum
+        if ring_io == "auto":
+            # MXU one-hot IO on TPU backends; along-axis gather (fast and
+            # exact) on CPU and friends
+            ring_io = "onehot" if jax.default_backend() in ("tpu", "axon") \
+                else "gather"
+        self.ring_io = ring_io
         self._step_kwargs = dict(machine=machine,
                                  ring_capacity=ring_capacity,
                                  apply_window=self.apply_window,
                                  pipeline_window=pipeline_window,
                                  max_append_batch=max_append_batch,
-                                 write_delay=write_delay,
+                                 write_delay=write_delay, ring_io=ring_io,
                                  quorum_fn=make_evaluate_quorum(quorum_impl))
         self._donate = donate
         self._dur = None
@@ -449,29 +555,35 @@ class LockstepEngine:
         self._fail_host[lane, slot] = True
 
     def recover_member(self, lane: int, slot: int) -> None:
-        """Re-activate a member.  If the ring has reclaimed entries past the
-        member's applied index, replaying from the ring would apply recycled
-        slots — so the member is brought back via *snapshot install* from
-        the lane leader (the same escalation the reference takes when a
-        follower falls behind the log truncation horizon,
-        ra_server.erl:1962-1981): machine state and cursors are copied from
-        the leader's replica."""
+        """Re-activate a member via *snapshot install* from the lane
+        leader (the escalation the reference takes when a follower falls
+        behind the log truncation horizon, ra_server.erl:1962-1981):
+        machine state and cursors are copied from the leader's replica.
+        A failed member's apply frontier freezes while it is down (the
+        apply fold reads a lane-uniform window), so rejoin is always by
+        snapshot rather than ring replay."""
         self._fail_host[lane, slot] = False
+        self.state = self._snapshot_install(lane, slot)
+
+    def _snapshot_install(self, lane: int, slot: int) -> LaneState:
+        """Seed a (re)joining member from the lane leader at the leader's
+        APPLIED index — the snapshot covers exactly the state the copied
+        machine state reflects (snapshot idx <= commit, ra_snapshot
+        semantics).  Seeding at the leader's written tail instead would
+        hand the member a claim to entries it does not hold — a deposed
+        minority leader's uncommitted suffix could then enter the match
+        median as a phantom replica."""
         st = self.state
         leader = int(st.leader_slot[lane])
-        behind = int(st.applied[lane, slot]) < int(st.ring_base[lane])
-        if behind:
-            st = st._replace(
-                mac=jax.tree.map(
-                    lambda x: x.at[lane, slot].set(x[lane, leader]), st.mac),
-                applied=st.applied.at[lane, slot].set(
-                    st.applied[lane, leader]),
-                commit=st.commit.at[lane, slot].set(st.commit[lane, leader]),
-                last_index=st.last_index.at[lane, slot].set(
-                    st.last_written[lane, leader]),
-                last_written=st.last_written.at[lane, slot].set(
-                    st.last_written[lane, leader]))
-        self.state = st._replace(active=st.active.at[lane, slot].set(True))
+        snap_idx = st.applied[lane, leader]
+        return st._replace(
+            mac=jax.tree.map(
+                lambda x: x.at[lane, slot].set(x[lane, leader]), st.mac),
+            applied=st.applied.at[lane, slot].set(snap_idx),
+            commit=st.commit.at[lane, slot].set(snap_idx),
+            last_index=st.last_index.at[lane, slot].set(snap_idx),
+            last_written=st.last_written.at[lane, slot].set(snap_idx),
+            active=st.active.at[lane, slot].set(True))
 
     # -- membership (per-lane add/remove/promote, SURVEY §2.1 membership) --
     # NB durable mode: membership and recover_member are host-side state
@@ -487,20 +599,9 @@ class LockstepEngine:
         ra_server.erl:3218-3293): the new member is seeded from the
         leader's replica (snapshot install) and only counts toward
         quorum once promoted."""
-        st = self.state
-        leader = int(st.leader_slot[lane])
-        st = st._replace(
-            mac=jax.tree.map(
-                lambda x: x.at[lane, slot].set(x[lane, leader]), st.mac),
-            applied=st.applied.at[lane, slot].set(st.applied[lane, leader]),
-            commit=st.commit.at[lane, slot].set(st.commit[lane, leader]),
-            last_index=st.last_index.at[lane, slot].set(
-                st.last_written[lane, leader]),
-            last_written=st.last_written.at[lane, slot].set(
-                st.last_written[lane, leader]),
-            active=st.active.at[lane, slot].set(True),
+        st = self._snapshot_install(lane, slot)
+        self.state = st._replace(
             voter=st.voter.at[lane, slot].set(bool(voter)))
-        self.state = st
         self._fail_host[lane, slot] = False
 
     def promote_member(self, lane: int, slot: int) -> None:
@@ -579,6 +680,22 @@ class LockstepEngine:
 
     def committed_per_lane(self) -> np.ndarray:
         return np.asarray(self.state.total_committed)
+
+    def committed_lanes_async(self):
+        """Non-blocking commit readback: returns a fresh device array of
+        per-lane cumulative committed counts with a host copy already in
+        flight.  Poll ``.is_ready()``; convert with ``np.asarray`` once
+        ready.  The copy (`+ 0`) decouples the readback from buffer
+        donation, so the next ``step`` can be dispatched immediately —
+        this is the async host<->device overlap latency mode is built on
+        (the applied-notification edge of ra_bench.erl:153-190 without a
+        device barrier)."""
+        tc = self.state.total_committed + 0
+        try:
+            tc.copy_to_host_async()
+        except AttributeError:  # pragma: no cover — older jax arrays
+            pass
+        return tc
 
     def machine_states(self) -> Any:
         return jax.tree.map(np.asarray, self.state.mac)

@@ -208,8 +208,6 @@ def test_recover_with_election_truncation(tmp_path):
     st = eng.state
     leader0 = int(np.asarray(st.leader_slot)[0])
     eng.fail_member(0, leader0)
-    mask = np.zeros((N,), bool)
-    mask[0] = True
     eng.trigger_election([0])
     drive(eng, 6)
     settle(eng, 8)
@@ -310,3 +308,40 @@ def test_volatile_mode_unchanged(tmp_path):
     for _ in range(6):
         eng.step(n_new, payloads)
     assert eng.committed_total() > 0
+
+
+def test_recover_revives_failed_member_by_snapshot(tmp_path):
+    """Regression (r04 review): recovery must revive a failed member via
+    snapshot install from its lane leader — a bare active-flag flip
+    leaves a frozen applied cursor that would drag the lane-uniform
+    apply window onto recycled ring slots and silently diverge."""
+    eng = make_engine(tmp_path, ring_capacity=64)
+    drive(eng, 4)
+    settle(eng, 5)
+    eng.fail_member(0, 1)
+    # push far more entries than ring_capacity so the failed member's
+    # frozen cursor falls behind the reclaim horizon
+    drive(eng, 40)
+    settle(eng, 5)
+    eng.checkpoint()
+    lane = np.arange(N)
+    st = eng.state
+    leader_mac = np.asarray(st.mac)[lane, np.asarray(st.leader_slot)]
+    eng.close()
+
+    eng2 = make_engine(tmp_path, ring_capacity=64)
+    st2 = eng2.state
+    assert bool(np.asarray(st2.active)[0, 1])  # revived
+    # the revived member's state equals its leader's (snapshot), and
+    # further traffic keeps every replica converged
+    drive(eng2, 4)
+    settle(eng2, 10)
+    st2 = eng2.state
+    mac = np.asarray(st2.mac)
+    act = np.asarray(st2.active)
+    for i in range(N):
+        vals = mac[i][act[i]]
+        assert (vals == vals[0]).all(), (i, mac[i], act[i])
+    led2 = np.asarray(st2.leader_slot)
+    assert (mac[lane, led2] >= leader_mac).all()
+    eng2.close()
