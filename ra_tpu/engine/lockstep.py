@@ -49,7 +49,7 @@ import numpy as np
 
 from ..core.machine import JitMachine
 from ..ops.quorum import (election_quorum, evaluate_quorum, pipeline_credit,
-                          update_match_next)
+                          query_quorum, update_match_next)
 
 Array = jax.Array
 
@@ -147,6 +147,11 @@ class LaneState(NamedTuple):
     ring_base: Array      # int32[N]   reclaim horizon (entries <= base may
                           #            be recycled; mapping is (idx-1) % R)
     total_committed: Array  # int32[N] cumulative committed entries per lane
+    query_index: Array    # int32[N]   consistent-query counter
+                          #            (ra_server.erl:3035-3071)
+    peer_query: Array     # int32[N,P] per-member confirmed query index
+                          #            (#heartbeat_reply, :3101-3170)
+    query_agreed: Array   # int32[N]   majority-confirmed query index
     mac: Any              # machine state pytree, leading dims [N,P]
 
 
@@ -170,12 +175,16 @@ def _init_state(n_lanes: int, n_members: int, ring_capacity: int,
         ring=jnp.zeros((N, R, C), payload_dtype),
         ring_base=z(N),
         total_committed=jnp.zeros((N,), jnp.int32),
+        query_index=z(N),
+        peer_query=z(N, P),
+        query_agreed=z(N),
         mac=mac_state,
     )
 
 
 def _step(state: LaneState, n_new: Array, payloads: Array,
-          fail_mask: Array, elect_mask: Array, confirm_upto: Array, *,
+          fail_mask: Array, elect_mask: Array, confirm_upto: Array,
+          query_mask: Array, *,
           machine: JitMachine, ring_capacity: int, apply_window: int,
           pipeline_window: int, max_append_batch: int, write_delay: int,
           durable: bool = False, ring_io: str = "gather",
@@ -354,6 +363,22 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
              - leader_commit0)
     total_committed = state.total_committed + delta
 
+    # -- 4b. consistent-query heartbeat quorum -----------------------------
+    # The host registers reads by bumping the lane's query counter
+    # (query_mask); every active member confirms the current counter in
+    # the lockstep round (the #heartbeat_rpc/#heartbeat_reply exchange,
+    # ra_server.erl:3082-3170 collapsed into one step); down voters'
+    # stale confirmations hold the median back, so a leader cut off
+    # from its majority can never certify a read.  A won election wipes
+    # the confirmations of members that are NOT reachable this round
+    # (active members re-ack immediately below): stale acks collected by
+    # a deposed leader can never certify a read under the new one (the
+    # new-leader pending_consistent_queries gate, :3174-3190).
+    query_index = state.query_index + jnp.where(query_mask, 1, 0)
+    peer_q0 = jnp.where(elect_ok[:, None], 0, state.peer_query)
+    peer_query = jnp.where(active, query_index[:, None], peer_q0)
+    query_agreed = query_quorum(peer_query, state.voter)
+
     # -- 5. apply fold over the committed window ---------------------------
     # The window is LANE-uniform: all active members of a lane share the
     # same apply frontier (failed members freeze; recover/add re-seed
@@ -421,7 +446,9 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
                           next_index=next_index, commit=commit,
                           applied=applied, voter=state.voter, active=active,
                           ring=ring, ring_base=ring_base,
-                          total_committed=total_committed, mac=mac)
+                          total_committed=total_committed,
+                          query_index=query_index, peer_query=peer_query,
+                          query_agreed=query_agreed, mac=mac)
     aux = {"appended_hi": new_leader_last, "n_acc": n_acc,
            "n_app": total_app}
     return new_state, aux
@@ -502,7 +529,8 @@ class LockstepEngine:
 
     # -- driving -----------------------------------------------------------
 
-    def step(self, n_new, payloads, elect_mask=None) -> None:
+    def step(self, n_new, payloads, elect_mask=None,
+             query_mask=None) -> None:
         """Advance every lane one round.  n_new: int32[N]; payloads:
         [N, K, C] with K <= max_step_cmds.  In durable mode, pass host
         (numpy) payloads — the step's accepted entries are fed through
@@ -511,17 +539,19 @@ class LockstepEngine:
                 if self._fail_host.any() else self._zero_fail)
         elect = self._zero_elect if elect_mask is None \
             else jnp.asarray(elect_mask)
+        query = self._zero_elect if query_mask is None \
+            else jnp.asarray(query_mask)
         if self._dur is None:
             self.state, _ = self._step(self.state, jnp.asarray(n_new),
                                        jnp.asarray(payloads), fail, elect,
-                                       self._zero_confirm)
+                                       self._zero_confirm, query)
             return
         self._dur.backpressure()
         payload_host = np.asarray(payloads)
         confirm = jnp.asarray(self._dur.confirm_upto)
         self.state, aux = self._step(self.state, jnp.asarray(n_new),
                                      jnp.asarray(payloads), fail, elect,
-                                     confirm)
+                                     confirm, query)
         self._dur.submit(aux, payload_host)
         if elect_mask is not None and np.asarray(elect_mask).any():
             # elections truncate+reuse indexes: drain now so the next
@@ -632,6 +662,52 @@ class LockstepEngine:
         self.step(jnp.zeros((N,), jnp.int32),
                   jnp.zeros((N, K, C), self.payload_dtype),
                   elect_mask=mask)
+
+    # -- consistent (linearizable) reads -----------------------------------
+
+    def consistent_read(self, lanes, fn=None, timeout_steps: int = 256):
+        """Linearizable read of the given lanes' machine state — the
+        engine-path ra:consistent_query (ra_server.erl:3032-3190).
+
+        Registers a query token (bumps the lanes' query counters), then
+        drives empty rounds until (a) a majority of voters have
+        confirmed the token — certifying this leader's authority after
+        registration — and (b) the leader has applied at least its
+        commit index as of registration.  Together these guarantee the
+        returned state reflects every write that completed before this
+        call, including across elections (a new leader must re-collect
+        confirmations and commit its noop first).
+
+        Returns the per-lane leader machine state (a pytree with one
+        leading lane axis), or ``fn(state_pytree)`` if given.  Raises
+        TimeoutError when no quorum certifies within ``timeout_steps``
+        rounds (e.g. the lanes' leaders lost their majority)."""
+        lanes = np.atleast_1d(np.asarray(lanes))
+        qm = np.zeros((self.n_lanes,), bool)
+        qm[lanes] = True
+        zero_n = np.zeros((self.n_lanes,), np.int32)
+        # full payload width: reuses the executable the normal step
+        # loop already compiled (a narrower shape would retrace)
+        zero_p = np.zeros((self.n_lanes, self.max_step_cmds,
+                           self.payload_width), self.payload_dtype)
+        self.step(zero_n, zero_p, query_mask=qm)
+        st = self.state
+        token = np.asarray(st.query_index)[lanes]
+        lead = np.asarray(st.leader_slot)[lanes]
+        commit_reg = np.asarray(st.commit)[lanes, lead]
+        for _ in range(timeout_steps):
+            st = self.state
+            agreed = np.asarray(st.query_agreed)[lanes]
+            lead = np.asarray(st.leader_slot)[lanes]
+            applied = np.asarray(st.applied)[lanes, lead]
+            if (agreed >= token).all() and (applied >= commit_reg).all():
+                mac = jax.tree.map(
+                    lambda x: np.asarray(x)[lanes, lead], st.mac)
+                return fn(mac) if fn is not None else mac
+            self.step(zero_n, zero_p)
+        raise TimeoutError(
+            "consistent_read: no heartbeat quorum within "
+            f"{timeout_steps} rounds (leader lost its majority?)")
 
     # -- checkpoint / resume (device-state snapshot, SURVEY §5) ------------
 

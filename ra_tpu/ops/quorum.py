@@ -103,14 +103,13 @@ def election_quorum(granted_mask: Array, voter_mask: Array) -> Array:
     return votes >= needed
 
 
-def query_quorum(query_index: Array, peer_query_index: Array,
-                 voter_mask: Array) -> Array:
+def query_quorum(peer_query_index: Array, voter_mask: Array) -> Array:
     """Agreed (majority-confirmed) consistent-query index per lane.
 
-    query_index: int32[...] — the leader's own counter; peer_query_index:
-    int32[..., P] with the leader's slot ignored via voter_mask handling in
-    the caller (pass the leader's own value in its slot — it confirms its
-    own heartbeats, query_indexes ra_server.erl:2966-2976).
+    peer_query_index: int32[..., P] — per-member confirmed query index,
+    with the leader's own value in its slot (it confirms its own
+    heartbeats, query_indexes ra_server.erl:2966-2976).  The quorum is
+    the same masked median as the commit index.
     """
     return agreed_commit(peer_query_index, voter_mask)
 
