@@ -28,6 +28,7 @@ from typing import Any, Callable, Optional
 from .machine import ApplyMeta, Machine
 from .types import (
     RA_PROTO_VERSION,
+    AppendEffect,
     AppendEntriesReply,
     AppendEntriesRpc,
     AuxCommandEvent,
@@ -234,15 +235,55 @@ class RaServer:
         are the core's own re-injections, ra_server_proc's next_event), so
         callers only ever see external effects."""
         effects = self._dispatch(event)
+        effects = self._convert_append_effects(effects)
         return self._resolve_next_events(effects)
 
-    def _resolve_next_events(self, effects: list) -> list:
+    def _convert_append_effects(self, effects: list) -> list:
+        """{append, Cmd} machine effects re-enter the command path on the
+        leader (ra_server_proc.erl:1377-1382) — from ANY machine callback
+        (apply, tick, state_enter, version bump).  A WAL-parked leader
+        (await_condition -> leader) converts too: the command event is
+        then postponed/replayed by the condition machinery like any other
+        client command.  Non-leaders drop the effect
+        (filter_follower_effects: only the leader originates the append;
+        members receive it through replication)."""
+        if not any(isinstance(e, AppendEffect) for e in effects):
+            return effects
+        is_leader = self.raft_state == RaftState.LEADER or \
+            (self.raft_state == RaftState.AWAIT_CONDITION and
+             self.condition is not None and
+             self.condition.transition_to == RaftState.LEADER)
         out: list = []
         for e in effects:
-            if isinstance(e, NextEvent):
-                out.extend(self.handle(e.event))
+            if isinstance(e, AppendEffect):
+                if is_leader:
+                    mode = e.reply_mode or ReplyMode.NOREPLY
+                    follow = UserCommand(data=e.data, reply_mode=mode,
+                                         correlation=e.correlation,
+                                         notify_to=e.notify_to)
+                    out.append(NextEvent(CommandEvent(follow)))
             else:
                 out.append(e)
+        return out
+
+    def _resolve_next_events(self, effects: list) -> list:
+        """NextEvents expand AFTER the current effects, mirroring
+        gen_statem semantics: send effects are executed immediately
+        during handle_effects while {next_event,..} actions are deferred
+        to after the callback (ra_server_proc.erl:1317+).  Expanding
+        inline instead would reorder the message stream — e.g. a
+        commit-update AER built before a machine-appended follow-up
+        would reach followers AFTER the follow-up's AER, and its stale
+        prev index would look like a leader-log truncation."""
+        out: list = []
+        nexts: list = []
+        for e in effects:
+            if isinstance(e, NextEvent):
+                nexts.append(e)
+            else:
+                out.append(e)
+        for e in nexts:
+            out.extend(self.handle(e.event))
         return out
 
     def _dispatch(self, event: Any) -> list:

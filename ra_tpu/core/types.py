@@ -441,6 +441,21 @@ class SendMsg:
 
 
 @dataclass(frozen=True)
+class AppendEffect:
+    """{append, Cmd} / {append, Cmd, ReplyMode} machine effect
+    (ra_machine.erl:128-130): the machine asks the LEADER to append a
+    follow-up user command from apply/3.  Executed by re-entering the
+    command path (ra_server_proc.erl:1377-1382); followers drop it
+    (filter_follower_effects — only the leader originates the append,
+    every member then applies it through normal replication)."""
+
+    data: Any
+    reply_mode: "ReplyMode" = None  # None -> noreply
+    correlation: Any = None         # for ReplyMode.NOTIFY
+    notify_to: Any = None
+
+
+@dataclass(frozen=True)
 class ModCall:
     fn: Any
     args: tuple = ()

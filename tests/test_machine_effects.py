@@ -1,0 +1,163 @@
+"""Machine effect vocabulary: the {append, Cmd} effect and the
+completeness audit against the reference's effect() type
+(/root/reference/src/ra_machine.erl:121-142).
+"""
+import pytest
+
+from harness import SimCluster, mk_ids
+from ra_tpu.core.machine import Machine
+from ra_tpu.core.types import (AppendEffect, CommandEvent, ElectionTimeout,
+                               ReplyMode, UserCommand)
+
+
+class ChainMachine(Machine):
+    """Counter that, on an ('add_twice', n) command, appends a follow-up
+    ('add', n) command from apply/3 — the ra_fifo-class use of the
+    append effect (e.g. dead-letter / requeue follow-ups)."""
+
+    def init(self, config):
+        return 0
+
+    def apply(self, meta, command, state):
+        op = command[0]
+        if op == "add":
+            return state + command[1], state + command[1]
+        if op == "add_twice":
+            return (state + command[1], state + command[1],
+                    [AppendEffect(("add", command[1]))])
+        return state, state
+
+
+def pump(c: SimCluster, rounds: int = 12):
+    for _ in range(rounds):
+        for sid in c.ids:
+            while c.queues[sid]:
+                c.handle(sid, c.queues[sid].popleft())
+
+
+def test_append_effect_applied_on_all_members():
+    c = SimCluster(3, machine_factory=ChainMachine)
+    c.handle(c.ids[0], ElectionTimeout())
+    pump(c)
+    leader = c.ids[0]
+    assert c.servers[leader].raft_state.value == "leader"
+    c.handle(leader, CommandEvent(UserCommand(("add_twice", 5))))
+    pump(c)
+    # 5 applied twice (the original and the machine-appended follow-up),
+    # replicated to every member
+    for sid in c.ids:
+        assert c.servers[sid].machine_state == 10, \
+            (sid, c.servers[sid].machine_state)
+
+
+def test_append_effect_chain_depth():
+    """Chained appends: each follow-up may itself append (bounded)."""
+
+    class Deep(Machine):
+        def init(self, config):
+            return []
+
+        def apply(self, meta, command, state):
+            tag, depth = command
+            new_state = state + [depth]
+            if depth > 0:
+                return new_state, None, [AppendEffect(("c", depth - 1))]
+            return new_state, None
+
+    c = SimCluster(3, machine_factory=Deep)
+    c.handle(c.ids[0], ElectionTimeout())
+    pump(c)
+    c.handle(c.ids[0], CommandEvent(UserCommand(("c", 3))))
+    pump(c)
+    for sid in c.ids:
+        assert c.servers[sid].machine_state == [3, 2, 1, 0], \
+            c.servers[sid].machine_state
+
+
+def test_append_effect_not_executed_by_followers():
+    """Only the leader originates the follow-up append — otherwise every
+    member would append a duplicate (filter_follower_effects drops it,
+    ra_server.erl:1817-1860)."""
+    c = SimCluster(3, machine_factory=ChainMachine)
+    c.handle(c.ids[0], ElectionTimeout())
+    pump(c)
+    c.handle(c.ids[0], CommandEvent(UserCommand(("add_twice", 7))))
+    pump(c)
+    leader_log = c.servers[c.ids[0]].log.last_index_term().index
+    for sid in c.ids:
+        assert c.servers[sid].log.last_index_term().index == leader_log
+        assert c.servers[sid].machine_state == 14
+
+
+def test_append_effect_with_notify_reply_mode():
+    c = SimCluster(3, machine_factory=ChainMachine)
+    c.handle(c.ids[0], ElectionTimeout())
+    pump(c)
+
+    class Chain2(ChainMachine):
+        def apply(self, meta, command, state):
+            if command[0] == "spawn_notify":
+                return (state, state,
+                        [AppendEffect(("add", 1),
+                                      reply_mode=ReplyMode.NOTIFY,
+                                      correlation="c1",
+                                      notify_to="client9")])
+            return super().apply(meta, command, state)
+
+    for srv in c.servers.values():
+        srv.cfg.machine.__class__ = Chain2
+    c.handle(c.ids[0], CommandEvent(UserCommand(("spawn_notify", 0))))
+    pump(c)
+    assert any(n.to == "client9" and ("c1", 1) in tuple(n.correlations)
+               for _sid, n in c.notifies), c.notifies
+
+
+def test_append_effect_from_tick():
+    """Appends emitted from machine callbacks OTHER than apply (tick
+    here) are executed by the leader too — the conversion lives in the
+    effect layer, not one apply call site."""
+    from ra_tpu.core.types import TickEvent
+
+    class Ticker(Machine):
+        def init(self, config):
+            return 0
+
+        def apply(self, meta, command, state):
+            return state + command[1], state + command[1]
+
+        def tick(self, time_ms, state):
+            return [AppendEffect(("add", 100))]
+
+    c = SimCluster(3, machine_factory=Ticker)
+    c.handle(c.ids[0], ElectionTimeout())
+    pump(c)
+    c.handle(c.ids[0], TickEvent())
+    pump(c)
+    for sid in c.ids:
+        assert c.servers[sid].machine_state == 100, \
+            (sid, c.servers[sid].machine_state)
+
+
+def test_effect_vocabulary_parity():
+    """Every effect in ra_machine.erl:121-142 has a counterpart class
+    (the completeness audit VERDICT r03 item 4 asks for)."""
+    import ra_tpu.core.types as T
+    vocabulary = {
+        "send_msg": "SendMsg",               # :121-125
+        "mod_call": "ModCall",               # :126
+        "append": "AppendEffect",            # :128-130
+        "monitor": "Monitor",                # :131-132 (process|node)
+        "demonitor": "Demonitor",            # :133-134
+        "timer": "TimerEffect",              # :135
+        "log": "LogReadEffect",              # :136-137
+        "release_cursor": "ReleaseCursor",   # :138-139
+        "checkpoint": "Checkpoint",          # :140
+        "aux": "AuxEffect",                  # :141
+        "garbage_collection": "GarbageCollection",  # :142
+    }
+    for ref_name, cls_name in vocabulary.items():
+        assert hasattr(T, cls_name), (ref_name, cls_name)
+    # monitor/demonitor must support both process and node targets
+    import inspect
+    assert "component" in inspect.signature(T.Monitor).parameters or \
+        hasattr(T.Monitor, "component")
