@@ -1359,6 +1359,13 @@ class RaServer:
     def _make_rpc_for_peer(self, pid: ServerId, peer: Peer,
                            batch: int) -> Optional[Any]:
         prev_idx = peer.next_index - 1
+        if prev_idx == 0 and self.log.snapshot_index_term().index > 0:
+            # peer wants the log from the very start but our prefix is
+            # compacted behind a snapshot: entries 1..snap are gone, so
+            # prev=0 would ship a gapped batch (fetch_term(PrevIdx)
+            # undefined ∧ PrevIdx < snapshot idx, ra_server.erl:1962-1981)
+            peer.status = PeerStatus.SENDING_SNAPSHOT
+            return SendSnapshot(pid, (self.id, self.current_term))
         prev_term = self.log.fetch_term(prev_idx) if prev_idx > 0 else 0
         if prev_term is None:
             snap = self.log.snapshot_index_term()

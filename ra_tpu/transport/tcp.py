@@ -91,6 +91,12 @@ class TcpRouter(LocalRouter):
         self.dropped_sends = 0
         self.last_heard: dict[str, float] = {}
         self.node_status: dict[str, str] = {}
+        #: nemesis hook: nodes whose traffic is blocked at the socket
+        #: level (the inet_tcp_proxy role the reference's
+        #: partitions_SUITE uses, partitions_SUITE.erl:29-57) — sends
+        #: drop+count, inbound frames are ignored, the failure detector
+        #: sees silence and rules the node down
+        self.blocked_nodes: set = set()
         self._stop = False
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -128,7 +134,28 @@ class TcpRouter(LocalRouter):
     # send path
     # ------------------------------------------------------------------
 
+    def block_node(self, node: str) -> None:
+        """Partition this host from ``node``: drop the live connection,
+        purge already-queued frames, and refuse traffic both ways until
+        :meth:`unblock_node`."""
+        self.blocked_nodes.add(node)
+        peer = self.peers.get(node)
+        if peer is not None:
+            self._close_peer(peer)
+            while True:  # frames queued pre-partition must not flush out
+                try:
+                    peer.queue.get_nowait()
+                    self.dropped_sends += 1
+                except queue.Empty:
+                    break
+
+    def unblock_node(self, node: str) -> None:
+        self.blocked_nodes.discard(node)
+
     def send(self, src_node: str, to: ServerId, msg) -> bool:
+        if to.node in self.blocked_nodes:
+            self.dropped_sends += 1
+            return False
         if to.node in self.nodes or (src_node, to.node) in self.blocked:
             return super().send(src_node, to, msg)
         peer = self._peer_for(to.node)
@@ -210,6 +237,8 @@ class TcpRouter(LocalRouter):
                 self.dropped_sends += 1
 
     def _send_item(self, peer: _Peer, item) -> bool:
+        if peer.name in self.blocked_nodes:
+            return False  # partitioned: no redial, no flush
         sock = self._peer_sock(peer)
         if sock is None:
             return False
@@ -299,6 +328,16 @@ class TcpRouter(LocalRouter):
                 if f is fut:
                     del self._calls[cid]
 
+    def _addr_blocked(self, origin: tuple) -> bool:
+        """True when the node listening at ``origin`` is partitioned off
+        (replies/notifies must not tunnel through a blocked link)."""
+        if not self.blocked_nodes:
+            return False
+        for node, addr in self.address_book.items():
+            if tuple(addr) == origin:
+                return node in self.blocked_nodes
+        return False
+
     def reply_remote(self, handle: tuple, msg) -> None:
         _tag, origin, call_id = handle
         origin = tuple(origin)
@@ -307,6 +346,9 @@ class TcpRouter(LocalRouter):
                 fut = self._calls.pop(call_id, None)
             if fut is not None:
                 fut.set(msg)
+            return
+        if self._addr_blocked(origin):
+            self.dropped_sends += 1
             return
         peer = self._addr_peers.get(origin)
         if peer is None:
@@ -328,6 +370,9 @@ class TcpRouter(LocalRouter):
             fn = self._notify_handles.get(nid)
             if fn is not None:
                 fn(correlations)
+            return
+        if self._addr_blocked(origin):
+            self.dropped_sends += 1
             return
         peer = self._addr_peers.get(origin)
         if peer is None:
@@ -369,6 +414,16 @@ class TcpRouter(LocalRouter):
                 if frame is None:
                     break
                 kind = frame[0]
+                if kind == FRAME_HELLO:
+                    remote_names = frame[1:].decode().split(",")
+                    if not all(n in self.blocked_nodes
+                               for n in remote_names):
+                        for name in remote_names:
+                            self._mark_heard(name)
+                    continue
+                if remote_names and \
+                        all(n in self.blocked_nodes for n in remote_names):
+                    continue  # partitioned: total inbound silence
                 if kind == FRAME_MSG:
                     to, msg = pickle.loads(frame[1:])
                     for name in remote_names:
@@ -388,10 +443,6 @@ class TcpRouter(LocalRouter):
                     if fn is not None:
                         fn(correlations)
                 elif kind == FRAME_PING:
-                    for name in remote_names:
-                        self._mark_heard(name)
-                elif kind == FRAME_HELLO:
-                    remote_names = frame[1:].decode().split(",")
                     for name in remote_names:
                         self._mark_heard(name)
         except (OSError, pickle.UnpicklingError, EOFError):
@@ -430,6 +481,8 @@ class TcpRouter(LocalRouter):
             now = time.monotonic()
             # ping every peer we have a live connection to
             for peer in list(self.peers.values()):
+                if peer.name in self.blocked_nodes:
+                    continue
                 sock = peer.sock
                 if sock is not None:
                     try:

@@ -1,0 +1,131 @@
+"""Shared OS-process worker for the TCP fabric tests (importable so the
+spawn context can pickle the entrypoint).  One process = one RaNode
+behind a TcpRouter = one cluster member — the erlang_node_helpers /
+inet_tcp_proxy role of the reference's coordination/partitions suites.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_machine(kind: str):
+    from ra_tpu.core.machine import Machine, SimpleMachine
+    from ra_tpu.core.types import ReleaseCursor
+
+    if kind == "counter":
+        return SimpleMachine(lambda c, s: s + c, 0)
+    if kind == "list":
+        # append-only list: no-loss/no-dup is directly assertable
+        return SimpleMachine(lambda c, s: s + [c], [])
+    if kind == "snapcounter":
+        class SnapCounter(Machine):
+            """Counter that releases its cursor every 32 applies (the
+            ra_bench release_cursor pattern, ra_bench.erl:43-49) so the
+            log truncates and laggards need a snapshot."""
+
+            def init(self, config):
+                return 0
+
+            def apply(self, meta, command, state):
+                new = state + command
+                if meta.index % 32 == 0:
+                    return new, new, [ReleaseCursor(meta.index, new)]
+                return new, new
+        return SnapCounter()
+    raise ValueError(kind)
+
+
+def worker_main(node_name, port_map, cmd_q, res_q, machine_kind="counter",
+                data_dir=None, election_timeout_ms=500,
+                extra_members=()):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ra_tpu
+    from ra_tpu.core.types import Membership, ServerConfig, ServerId
+    from ra_tpu.node import RaNode
+    from ra_tpu.transport.tcp import TcpRouter
+
+    my_addr = ("127.0.0.1", port_map[node_name])
+    book = {n: ("127.0.0.1", p) for n, p in port_map.items()
+            if n != node_name}
+    router = TcpRouter(my_addr, book)
+    if data_dir:
+        from ra_tpu.system import RaSystem
+        system = RaSystem(data_dir)
+        node = RaNode(node_name, router=router,
+                      log_factory=system.log_factory)
+    else:
+        node = RaNode(node_name, router=router)
+    member_names = sorted(set(port_map) - set(extra_members))
+    sids = [ServerId(f"m_{n}", n) for n in member_names]
+    me = ServerId(f"m_{node_name}", node_name)
+    log_args = {"data_dir": data_dir} if data_dir else {}
+    cfg = ServerConfig(
+        server_id=me, uid=f"uid_{node_name}", cluster_name="tcp",
+        initial_members=tuple(sids), machine=make_machine(machine_kind),
+        election_timeout_ms=election_timeout_ms, tick_interval_ms=200,
+        log_init_args=log_args)
+    if node_name not in extra_members:
+        node.start_server(cfg)
+
+    while True:
+        cmd = cmd_q.get()
+        op = cmd[0]
+        try:
+            if op == "stop":
+                node.stop()
+                router.stop()
+                res_q.put(("stopped", node_name))
+                return
+            elif op == "elect":
+                ra_tpu.trigger_election(me, router)
+                res_q.put(("ok",))
+            elif op == "command":
+                r = ra_tpu.process_command(me, cmd[1], router=router,
+                                           timeout=cmd[2] if len(cmd) > 2
+                                           else 15.0)
+                res_q.put(("ok", r.reply, str(r.leader)))
+            elif op == "state":
+                sh = node.shells.get(me.name)
+                if sh is None:
+                    res_q.put(("ok", "noproc", None, 0))
+                else:
+                    res_q.put(("ok", sh.server.raft_state.value,
+                               sh.server.machine_state,
+                               sh.server.current_term))
+            elif op == "members":
+                sh = node.shells.get(me.name)
+                res_q.put(("ok", sorted(s.name for s in
+                                        sh.server.cluster)))
+            elif op == "metrics":
+                res_q.put(("ok", ra_tpu.key_metrics(me, router=router)))
+            elif op == "overview":
+                res_q.put(("ok", router.overview()))
+            elif op == "partition":
+                for n in cmd[1]:
+                    router.block_node(n)
+                res_q.put(("ok",))
+            elif op == "heal":
+                for n in list(router.blocked_nodes):
+                    router.unblock_node(n)
+                res_q.put(("ok",))
+            elif op == "start_member":
+                # start this node's member late (join flow)
+                node.start_server(cfg)
+                res_q.put(("ok",))
+            elif op == "add_member":
+                target = ServerId(f"m_{cmd[1]}", cmd[1])
+                r = ra_tpu.add_member(me, target, router=router,
+                                      membership=Membership.PROMOTABLE)
+                res_q.put(("ok", str(r)))
+            elif op == "remove_member":
+                target = ServerId(f"m_{cmd[1]}", cmd[1])
+                r = ra_tpu.remove_member(me, target, router=router)
+                res_q.put(("ok", str(r)))
+            elif op == "restart_server":
+                ra_tpu.restart_server(me, router=router)
+                res_q.put(("ok",))
+            else:
+                res_q.put(("err", f"unknown op {op}"))
+        except Exception as e:  # noqa: BLE001 — report to the test
+            res_q.put(("err", repr(e)))

@@ -1,5 +1,11 @@
-"""Cross-process TCP transport tests — the coordination_SUITE role: real OS
-processes as nodes, real sockets, leader kill, failure detection."""
+"""Cross-process TCP fabric tests — the coordination_SUITE +
+partitions_SUITE roles over real OS processes and real sockets
+(/root/reference/test/coordination_SUITE.erl,
+/root/reference/test/partitions_SUITE.erl:29-57): cluster lifecycle,
+leader kill, socket-level partitions with no-loss/no-dup assertions,
+snapshot install across processes, membership change, node restart over
+a durable log, and drop-counter accounting.
+"""
 import multiprocessing as mp
 import os
 import sys
@@ -8,57 +14,13 @@ import time
 import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+sys.path.insert(0, os.path.dirname(__file__))
+
+from tcp_worker import worker_main  # noqa: E402
 
 
-def _worker(node_name, port_map, cmd_q, res_q):
-    """One OS process hosting one RaNode behind a TcpRouter."""
-    import ra_tpu
-    from ra_tpu.core.machine import SimpleMachine
-    from ra_tpu.core.types import ServerConfig, ServerId
-    from ra_tpu.node import RaNode
-    from ra_tpu.transport.tcp import TcpRouter
-
-    my_addr = ("127.0.0.1", port_map[node_name])
-    book = {n: ("127.0.0.1", p) for n, p in port_map.items()
-            if n != node_name}
-    router = TcpRouter(my_addr, book)
-    node = RaNode(node_name, router=router)
-    sids = [ServerId(f"m_{n}", n) for n in sorted(port_map)]
-    me = ServerId(f"m_{node_name}", node_name)
-    node.start_server(ServerConfig(
-        server_id=me, uid=f"uid_{node_name}", cluster_name="tcp",
-        initial_members=tuple(sids),
-        machine=SimpleMachine(lambda c, s: s + c, 0),
-        election_timeout_ms=150, tick_interval_ms=150))
-    while True:
-        cmd = cmd_q.get()
-        if cmd[0] == "stop":
-            res_q.put(("stopped", node_name))
-            return
-        if cmd[0] == "elect":
-            ra_tpu.trigger_election(me, router)
-            res_q.put(("ok",))
-        elif cmd[0] == "command":
-            try:
-                r = ra_tpu.process_command(me, cmd[1], router=router,
-                                           timeout=10.0)
-                res_q.put(("ok", r.reply, str(r.leader)))
-            except Exception as e:
-                res_q.put(("err", repr(e)))
-        elif cmd[0] == "state":
-            sh = node.shells.get(me.name)
-            res_q.put(("ok", sh.server.raft_state.value,
-                       sh.server.machine_state,
-                       sh.server.current_term))
-        elif cmd[0] == "metrics":
-            res_q.put(("ok", ra_tpu.key_metrics(me, router=router)))
-
-
-@pytest.fixture
-def procs():
+def _free_ports(names):
     import socket
-    ctx = mp.get_context("spawn")
-    names = ["tn1", "tn2", "tn3"]
     ports = {}
     socks = []
     for n in names:
@@ -68,75 +30,282 @@ def procs():
         socks.append(s)
     for s in socks:
         s.close()
-    chans = {}
-    workers = {}
-    for n in names:
+    return ports
+
+
+class Fabric:
+    """Spawned-process cluster driver."""
+
+    def __init__(self, names, machine="counter", data_root=None,
+                 extra_members=()):
+        ctx = mp.get_context("spawn")
+        self.names = names
+        self.ports = _free_ports(names)
+        self.chans = {}
+        self.workers = {}
+        self.machine = machine
+        self.data_root = data_root
+        self.extra = tuple(extra_members)
+        for n in names:
+            self._spawn(ctx, n)
+        time.sleep(0.5)
+
+    def _spawn(self, ctx, n):
         cq, rq = ctx.Queue(), ctx.Queue()
-        p = ctx.Process(target=_worker, args=(n, ports, cq, rq),
+        data_dir = os.path.join(self.data_root, n) if self.data_root else None
+        p = ctx.Process(target=worker_main,
+                        args=(n, self.ports, cq, rq, self.machine,
+                              data_dir, 500, self.extra),
                         daemon=True)
         p.start()
-        chans[n] = (cq, rq)
-        workers[n] = p
-    time.sleep(0.5)  # listeners up
-    yield names, chans, workers
-    for n, p in workers.items():
-        if p.is_alive():
-            chans[n][0].put(("stop",))
-    time.sleep(0.3)
-    for p in workers.values():
-        if p.is_alive():
-            p.terminate()
+        self.chans[n] = (cq, rq)
+        self.workers[n] = p
+
+    def respawn(self, n):
+        """Restart a (possibly killed) worker process over its data."""
+        ctx = mp.get_context("spawn")
+        self._spawn(ctx, n)
+        time.sleep(0.5)
+
+    def ask(self, n, *cmd, timeout=30):
+        cq, rq = self.chans[n]
+        cq.put(cmd)
+        return rq.get(timeout=timeout)
+
+    def stop(self):
+        for n, p in self.workers.items():
+            if p.is_alive():
+                try:
+                    self.chans[n][0].put(("stop",))
+                except Exception:
+                    pass
+        time.sleep(0.3)
+        for p in self.workers.values():
+            if p.is_alive():
+                p.terminate()
+
+    # helpers ------------------------------------------------------------
+
+    def await_leader(self, timeout=30):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            states = {}
+            for n in self.names:
+                if not self.workers[n].is_alive():
+                    continue
+                r = self.ask(n, "state")
+                states[n] = r
+                if r[1] == "leader":
+                    return n
+            time.sleep(0.2)
+        raise TimeoutError(f"no leader: {states}")
+
+    def await_converged(self, want, nodes=None, timeout=30):
+        nodes = nodes or self.names
+        deadline = time.monotonic() + timeout
+        states = {}
+        while time.monotonic() < deadline:
+            states = {n: self.ask(n, "state") for n in nodes}
+            if all(s[2] == want for s in states.values()):
+                return states
+            time.sleep(0.2)
+        raise AssertionError(f"no convergence to {want!r}: {states}")
 
 
-def _ask(chans, n, *cmd, timeout=15):
-    cq, rq = chans[n]
-    cq.put(cmd)
-    return rq.get(timeout=timeout)
+@pytest.fixture
+def fabric3():
+    f = Fabric(["tn1", "tn2", "tn3"])
+    f.ask("tn1", "elect")
+    yield f
+    f.stop()
 
 
-def test_cross_process_cluster(procs):
-    names, chans, workers = procs
-    _ask(chans, "tn1", "elect")
-    # the election is fire-and-forget: wait for a leader FIRST, then send
-    # the (non-idempotent) command exactly once — retrying a counter
-    # command after a lost reply would double-apply it
-    deadline = time.monotonic() + 20
-    while time.monotonic() < deadline:
-        states = [_ask(chans, n, "state") for n in names]
-        if any(s[1] == "leader" for s in states):
-            break
-        time.sleep(0.2)
-    assert any(s[1] == "leader" for s in states), states
-    r = _ask(chans, "tn1", "command", 5, timeout=20)
+def test_cross_process_cluster(fabric3):
+    f = fabric3
+    f.await_leader()
+    r = f.ask("tn1", "command", 5)
     assert r[0] == "ok" and r[1] == 5, r
-    r = _ask(chans, "tn2", "command", 7)  # redirect over TCP
+    r = f.ask("tn2", "command", 7)  # redirect over TCP
     assert r[0] == "ok" and r[1] == 12, r
-    # replicas converge
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline:
-        states = [_ask(chans, n, "state") for n in names]
-        if all(s[2] == 12 for s in states):
-            break
-        time.sleep(0.1)
-    assert all(s[2] == 12 for s in states), states
+    f.await_converged(12)
 
 
-def test_leader_process_kill_failover(procs):
-    names, chans, workers = procs
-    _ask(chans, "tn1", "elect")
-    r = _ask(chans, "tn1", "command", 1)
+def test_leader_process_kill_failover(fabric3):
+    f = fabric3
+    f.await_leader()
+    r = f.ask("tn1", "command", 1)
     assert r[0] == "ok"
     leader_node = r[2].split("@")[1]
-    # SIGKILL the leader's OS process: detector + election timers recover
-    workers[leader_node].terminate()
-    rest = [n for n in names if n != leader_node]
-    deadline = time.monotonic() + 20
+    f.workers[leader_node].terminate()
+    rest = [n for n in f.names if n != leader_node]
+    deadline = time.monotonic() + 30
     got = None
     while time.monotonic() < deadline:
-        r = _ask(chans, rest[0], "command", 2, timeout=20)
+        r = f.ask(rest[0], "command", 2, timeout=30)
         if r[0] == "ok":
             got = r
             break
-        time.sleep(0.2)
+        time.sleep(0.3)
     assert got is not None and got[1] == 3, got
     assert got[2].split("@")[1] != leader_node
+
+
+def test_partition_no_loss_no_dup():
+    """Socket-level partition + heal with an append-only list machine:
+    every acknowledged value survives exactly once, nothing duplicates
+    (the partitions_SUITE no-loss workload over real sockets)."""
+    f = Fabric(["tn1", "tn2", "tn3"], machine="list")
+    try:
+        f.ask("tn1", "elect")
+        leader = f.await_leader()
+        acked = []
+        for v in range(10):
+            r = f.ask(leader, "command", v)
+            assert r[0] == "ok"
+            acked.append(v)
+        # partition one follower at the socket level (both directions)
+        victim = [n for n in f.names if n != leader][0]
+        f.ask(victim, "partition", [n for n in f.names if n != victim])
+        for n in f.names:
+            if n != victim:
+                f.ask(n, "partition", [victim])
+        # majority keeps committing
+        for v in range(10, 20):
+            r = f.ask(leader, "command", v, timeout=30)
+            assert r[0] == "ok", r
+            acked.append(v)
+        # the victim's detector rules the others down meanwhile
+        deadline = time.monotonic() + 10
+        seen_down = False
+        while time.monotonic() < deadline and not seen_down:
+            ov = f.ask(victim, "overview")[1]
+            seen_down = any(s == "down" for s in ov["node_status"].values())
+            time.sleep(0.2)
+        assert seen_down, ov
+        # heal and converge
+        for n in f.names:
+            f.ask(n, "heal")
+        states = f.await_converged(acked, timeout=40)
+        for n, s in states.items():
+            assert s[2] == acked, (n, s[2])           # no loss
+            assert len(s[2]) == len(set(s[2])), n     # no dup
+    finally:
+        f.stop()
+
+
+def test_drop_counters_during_partition():
+    f = Fabric(["tn1", "tn2", "tn3"])
+    try:
+        f.ask("tn1", "elect")
+        leader = f.await_leader()
+        victim = [n for n in f.names if n != leader][0]
+        f.ask(leader, "partition", [victim])
+        for v in range(5):
+            assert f.ask(leader, "command", v + 1, timeout=30)[0] == "ok"
+        time.sleep(1.0)
+        ov = f.ask(leader, "overview")[1]
+        assert ov["dropped_sends"] > 0, ov  # [noconnect,nosuspend] drops
+    finally:
+        f.stop()
+
+
+def test_snapshot_install_over_tcp(tmp_path):
+    """A member cut off while the leader truncates its log behind a
+    snapshot must catch up via the chunked install_snapshot path over
+    real sockets (SURVEY §3.3)."""
+    f = Fabric(["tn1", "tn2", "tn3"], machine="snapcounter",
+               data_root=str(tmp_path))
+    try:
+        f.ask("tn1", "elect")
+        leader = f.await_leader()
+        victim = [n for n in f.names if n != leader][0]
+        f.ask(victim, "partition", [n for n in f.names if n != victim])
+        for n in f.names:
+            if n != victim:
+                f.ask(n, "partition", [victim])
+        # push far past several release_cursor points (every 32 applies)
+        total = 0
+        for v in range(120):
+            r = f.ask(leader, "command", 1, timeout=30)
+            assert r[0] == "ok", r
+            total += 1
+        # leader must have snapshotted
+        deadline = time.monotonic() + 15
+        snap_idx = 0
+        while time.monotonic() < deadline and snap_idx == 0:
+            m = f.ask(leader, "metrics")[1]
+            snap_idx = m.get("snapshot_index", 0) or 0
+            time.sleep(0.2)
+        assert snap_idx > 0, m
+        for n in f.names:
+            f.ask(n, "heal")
+        states = f.await_converged(total, timeout=60)
+        # the victim caught up via snapshot: its own snapshot index is
+        # at least the leader's truncation point
+        m = f.ask(victim, "metrics")[1]
+        assert (m.get("snapshot_index", 0) or 0) >= snap_idx, m
+    finally:
+        f.stop()
+
+
+def test_membership_change_over_tcp():
+    """Join a 4th OS-process member as promotable nonvoter, watch it
+    catch up and get promoted, then remove it ('$ra_join'/'$ra_leave'
+    over real sockets)."""
+    f = Fabric(["tn1", "tn2", "tn3", "tn4"], extra_members=("tn4",))
+    try:
+        f.ask("tn1", "elect")
+        leader = f.await_leader()
+        for v in (1, 2, 3):
+            assert f.ask(leader, "command", v)[0] == "ok"
+        # start the new member's server, then join it
+        assert f.ask("tn4", "start_member")[0] == "ok"
+        r = f.ask(leader, "add_member", "tn4", timeout=30)
+        assert r[0] == "ok", r
+        # the new member catches up and (promotable) becomes a voter
+        deadline = time.monotonic() + 30
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            s = f.ask("tn4", "state")
+            members = f.ask(leader, "members")
+            ok = s[2] == 6 and "m_tn4" in members[1]
+            time.sleep(0.2)
+        assert ok, (s, members)
+        # remove it again
+        r = f.ask(leader, "remove_member", "tn4", timeout=30)
+        assert r[0] == "ok", r
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            members = f.ask(leader, "members")[1]
+            if "m_tn4" not in members:
+                break
+            time.sleep(0.2)
+        assert "m_tn4" not in members, members
+    finally:
+        f.stop()
+
+
+def test_node_restart_over_tcp(tmp_path):
+    """Stop a member's whole OS process, restart it over its durable
+    log directory: it recovers its state and rejoins the cluster
+    (coordination_SUITE restart flow over sockets)."""
+    f = Fabric(["tn1", "tn2", "tn3"], data_root=str(tmp_path))
+    try:
+        f.ask("tn1", "elect")
+        leader = f.await_leader()
+        for v in (10, 20, 30):
+            assert f.ask(leader, "command", v)[0] == "ok"
+        f.await_converged(60)
+        victim = [n for n in f.names if n != leader][0]
+        f.ask(victim, "stop")
+        time.sleep(0.3)
+        if f.workers[victim].is_alive():
+            f.workers[victim].terminate()
+        # majority continues
+        assert f.ask(leader, "command", 5, timeout=30)[0] == "ok"
+        # restart the process over the same data dir
+        f.respawn(victim)
+        states = f.await_converged(65, timeout=40)
+        assert states[victim][2] == 65
+    finally:
+        f.stop()
