@@ -91,8 +91,11 @@ def _child_main() -> None:
         from ra_tpu.engine import open_engine
         dur_dir = tempfile.mkdtemp(prefix="ra_tpu_bench_wal_")
         sync_mode = int(os.environ.get("RA_TPU_BENCH_SYNC_MODE", "1"))
+        wal_strategy = os.environ.get("RA_TPU_BENCH_WAL_STRATEGY",
+                                      "default")
         eng = open_engine(machine, dur_dir, n_lanes, n_members,
-                          sync_mode=sync_mode, ring_capacity=1024,
+                          sync_mode=sync_mode,
+                          write_strategy=wal_strategy, ring_capacity=1024,
                           max_step_cmds=cmds, apply_window=cmds + 2,
                           quorum_impl=quorum_impl)
         import atexit
@@ -185,7 +188,8 @@ def _child_main() -> None:
         "quorum_impl": quorum_impl, "machine": machine_name,
         "lanes": n_lanes, "members": n_members, "cmds_per_step": cmds,
         "durable": durable,
-        **({"sync_mode": sync_mode} if durable else {}),
+        **({"sync_mode": sync_mode,
+            "wal_strategy": wal_strategy} if durable else {}),
     }))
 
 

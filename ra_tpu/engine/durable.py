@@ -143,7 +143,7 @@ class EngineDurability:
     and the confirm feedback arrays."""
 
     def __init__(self, data_dir: str, n_lanes: int, *, sync_mode: int = 1,
-                 max_pending: int = 8,
+                 write_strategy: str = "default", max_pending: int = 8,
                  wal_max_size: int = 256 * 1024 * 1024) -> None:
         os.makedirs(data_dir, exist_ok=True)
         self.dir = data_dir
@@ -151,6 +151,7 @@ class EngineDurability:
         self.max_pending = max_pending
         self.retirer = _WalFileRetirer()
         self.wal = Wal(data_dir, sync_mode=sync_mode,
+                       write_strategy=write_strategy,
                        max_size=wal_max_size, segment_writer=self.retirer)
         self.step_seq = 0
         self.confirmed_step = 0
@@ -368,7 +369,8 @@ def _final_logs(blocks: list, ckpt_tail: np.ndarray):
 
 
 def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
-                *, sync_mode: int = 1, max_pending: int = 8,
+                *, sync_mode: int = 1, write_strategy: str = "default",
+                max_pending: int = 8,
                 settle_limit: int = 10_000, **engine_kwargs):
     """Create-or-recover a durable LockstepEngine at ``data_dir``.
 
@@ -396,6 +398,7 @@ def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
     # is the step-block source for replay.  No engine writes happen
     # until attach, so constructing it up front is safe.
     dur = EngineDurability(data_dir, n_lanes, sync_mode=sync_mode,
+                           write_strategy=write_strategy,
                            max_pending=max_pending)
     steps = {s: blk for s, (_t, blk)
              in dur.wal.recovered_table(UID).items() if s > base_step}

@@ -115,12 +115,29 @@ class Wal:
     """Node-wide fan-in WAL with a background batch thread."""
 
     def __init__(self, data_dir: str, *, sync_mode: int = 1,
+                 write_strategy: str = "default",
                  max_size: int = DEFAULT_MAX_SIZE,
                  max_batch: int = DEFAULT_MAX_BATCH,
                  segment_writer=None) -> None:
+        """write_strategy (ra_log_wal.erl:66-96):
+
+        * ``default`` — one write(2) for the batch, then the sync_mode
+          syscall, then notify (durability before confirmation)
+        * ``o_sync`` — the file is opened O_SYNC so the write itself is
+          durable; no separate sync syscall (trades batch-write speed
+          for no sync latency)
+        * ``sync_after_notify`` — write, notify, THEN sync: lowest
+          confirm latency, with the documented weaker window (a crash
+          between notify and sync can lose confirmed-but-unsynced
+          entries of that batch — same contract as the reference)
+        """
+        if write_strategy not in ("default", "o_sync",
+                                  "sync_after_notify"):
+            raise ValueError(f"unknown write_strategy {write_strategy!r}")
         self.dir = os.path.join(data_dir, "wal")
         os.makedirs(self.dir, exist_ok=True)
         self.sync_mode = sync_mode
+        self.write_strategy = write_strategy
         self.max_size = max_size
         self.max_batch = max_batch
         self.segment_writer = segment_writer
@@ -321,6 +338,7 @@ class Wal:
                 c[0] = min(c[0], index)
                 c[1] = max(c[1], index)
                 c[2] = term
+        deferred_sync = False
         if buf:
             # IO first, bookkeeping after: if the write throws (the
             # let-it-crash path the supervisor recovers), last_idx and
@@ -328,13 +346,20 @@ class Wal:
             # holds — restart() hands _file_ranges to the segment writer,
             # which flushes and then DELETES the file, so overstating the
             # ranges would silently drop acknowledged entries
-            n = IO.write_batch(self._fd, bytes(buf), self.sync_mode)
+            if self.write_strategy == "o_sync":
+                # O_SYNC fd: the write IS the durability point
+                n = IO.write_batch(self._fd, bytes(buf), 0)
+            elif self.write_strategy == "sync_after_notify":
+                n = IO.write_batch(self._fd, bytes(buf), 0)
+                deferred_sync = self.sync_mode != 0
+            else:
+                n = IO.write_batch(self._fd, bytes(buf), self.sync_mode)
             self._file_size += n
             self.counters["batches"] += 1
             self.counters["writes"] += n_entries
             self.counters["bytes_written"] += n
-            if self.sync_mode:
-                self.counters["syncs"] += 1
+            if self.sync_mode and self.write_strategy == "default":
+                self.counters["syncs"] += 1  # o_sync: no sync syscall
             with self._lock:
                 self._registered_in_file |= new_regs
                 for uid, last in pending_last.items():
@@ -353,6 +378,11 @@ class Wal:
                          if uid in self._writers]
         for notify, uid, (lo, hi, term) in notifiers:
             notify(uid, lo, hi, term)
+        if deferred_sync:
+            # sync_after_notify: durability syscall AFTER the confirms
+            # (complete_batch with post-notify sync, ra_log_wal.erl:66-96)
+            IO.sync(self._fd, self.sync_mode)
+            self.counters["syncs"] += 1
         if roll or self._file_size >= self.max_size:
             self._rollover()
         # flush barriers release only after any requested rollover has been
@@ -367,7 +397,8 @@ class Wal:
         self._file_seq += 1
         self._file_path = os.path.join(self.dir,
                                        f"{self._file_seq:08d}.wal")
-        self._fd = IO.wal_open(self._file_path, truncate=True)
+        self._fd = IO.wal_open(self._file_path, truncate=True,
+                               o_sync=self.write_strategy == "o_sync")
         IO.write_batch(self._fd, MAGIC, 0)
         self._file_size = len(MAGIC)
         self._registered_in_file = set()

@@ -39,6 +39,10 @@ def _load():
         lib = ctypes.CDLL(_SO)
         lib.ra_wal_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lib.ra_wal_open.restype = ctypes.c_int
+        lib.ra_wal_open_sync.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.ra_wal_open_sync.restype = ctypes.c_int
+        lib.ra_wal_sync.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.ra_wal_sync.restype = ctypes.c_int
         lib.ra_wal_write_batch.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                            ctypes.c_size_t, ctypes.c_int]
         lib.ra_wal_write_batch.restype = ctypes.c_long
@@ -85,11 +89,18 @@ class NativeIO:
         return os.open(path, flags, 0o644)
 
     # sync_mode: 0=none, 1=fdatasync, 2=fsync
-    def wal_open(self, path: str, truncate: bool = False) -> int:
+    def wal_open(self, path: str, truncate: bool = False,
+                 o_sync: bool = False) -> int:
+        """o_sync opens the fd with O_SYNC: every write(2) is durable on
+        return (the reference's `o_sync` write strategy)."""
         if self.native:
-            fd = self.lib.ra_wal_open(path.encode(), 1 if truncate else 0)
+            fn = self.lib.ra_wal_open_sync if o_sync else \
+                self.lib.ra_wal_open
+            fd = fn(path.encode(), 1 if truncate else 0)
         else:
             flags = os.O_CREAT | os.O_RDWR | os.O_APPEND
+            if o_sync:
+                flags |= os.O_SYNC
             if truncate:
                 flags |= os.O_TRUNC
             fd = os.open(path, flags, 0o644)
@@ -97,6 +108,24 @@ class NativeIO:
             raise OSError(f"wal_open failed for {path}: {fd}")
         self._stats["opens"] += 1
         return fd
+
+    def sync(self, fd: int, mode: int = 1) -> None:
+        """Standalone durability syscall (sync_after_notify strategy)."""
+        if mode == 0:
+            return
+        self._stats["syncs"] += 1
+        if self.native:
+            r = self.lib.ra_wal_sync(fd, mode)
+            if r < 0:
+                raise OSError(f"wal sync failed: errno {-r}")
+            return
+        if mode == 1:
+            try:
+                os.fdatasync(fd)
+            except AttributeError:
+                os.fsync(fd)
+        else:
+            os.fsync(fd)
 
     def write_batch(self, fd: int, buf: bytes, sync_mode: int = 1) -> int:
         self._stats["writes"] += 1

@@ -70,6 +70,24 @@ int ra_wal_open(const char *path, int truncate) {
   return open(path, flags, 0644);
 }
 
+// o_sync variant: the file descriptor itself is synchronous, so write(2)
+// returns only after the data is durable — the reference's `o_sync`
+// write strategy (ra_log_wal.erl:66-96) where no separate fsync happens.
+int ra_wal_open_sync(const char *path, int truncate) {
+  int flags = O_CREAT | O_RDWR | O_APPEND | O_SYNC;
+  if (truncate) flags |= O_TRUNC;
+  return open(path, flags, 0644);
+}
+
+// standalone durability syscall for the `sync_after_notify` strategy
+// (write -> notify -> sync): 1=fdatasync 2=fsync
+int ra_wal_sync(int fd, int mode) {
+  int r = 0;
+  if (mode == 1) r = fdatasync(fd);
+  else if (mode == 2) r = fsync(fd);
+  return r == 0 ? 0 : -errno;
+}
+
 long ra_wal_write_batch(int fd, const uint8_t *buf, size_t len,
                         int sync_mode) {
   size_t done = 0;

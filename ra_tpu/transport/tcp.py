@@ -139,8 +139,13 @@ class TcpRouter(LocalRouter):
         purge already-queued frames, and refuse traffic both ways until
         :meth:`unblock_node`."""
         self.blocked_nodes.add(node)
-        peer = self.peers.get(node)
-        if peer is not None:
+        victims = [self.peers.get(node)]
+        addr = self.address_book.get(node)
+        if addr is not None:  # reply/notify links to the same host too
+            victims.append(self._addr_peers.get(tuple(addr)))
+        for peer in victims:
+            if peer is None:
+                continue
             self._close_peer(peer)
             while True:  # frames queued pre-partition must not flush out
                 try:
@@ -163,7 +168,8 @@ class TcpRouter(LocalRouter):
             self.dropped_sends += 1
             return False
         try:
-            peer.queue.put_nowait((to, self._rewrite_for_wire(msg)))
+            peer.queue.put_nowait((to, self._rewrite_for_wire(msg),
+                                   src_node))
         except queue.Full:
             # nosuspend: never block the Raft loop on a slow connection
             self.dropped_sends += 1
@@ -237,12 +243,13 @@ class TcpRouter(LocalRouter):
                 self.dropped_sends += 1
 
     def _send_item(self, peer: _Peer, item) -> bool:
-        if peer.name in self.blocked_nodes:
+        if peer.name in self.blocked_nodes or \
+                self._addr_blocked(tuple(peer.addr)):
             return False  # partitioned: no redial, no flush
         sock = self._peer_sock(peer)
         if sock is None:
             return False
-        to, msg = item
+        to, msg, src = (item if len(item) == 3 else (*item, None))
         try:
             if to == "__reply__":
                 frame = bytes([FRAME_REPLY]) + pickle.dumps(
@@ -251,7 +258,7 @@ class TcpRouter(LocalRouter):
                 frame = bytes([FRAME_NOTIFY]) + pickle.dumps(
                     msg, protocol=pickle.HIGHEST_PROTOCOL)
             else:
-                payload = pickle.dumps((to, strip_msg_handles(msg)),
+                payload = pickle.dumps((to, src, strip_msg_handles(msg)),
                                        protocol=pickle.HIGHEST_PROTOCOL)
                 frame = bytes([FRAME_MSG]) + payload
         except (pickle.PicklingError, TypeError, AttributeError):
@@ -416,18 +423,20 @@ class TcpRouter(LocalRouter):
                 kind = frame[0]
                 if kind == FRAME_HELLO:
                     remote_names = frame[1:].decode().split(",")
-                    if not all(n in self.blocked_nodes
-                               for n in remote_names):
-                        for name in remote_names:
+                    for name in remote_names:
+                        if name not in self.blocked_nodes:
                             self._mark_heard(name)
                     continue
                 if remote_names and \
                         all(n in self.blocked_nodes for n in remote_names):
                     continue  # partitioned: total inbound silence
                 if kind == FRAME_MSG:
-                    to, msg = pickle.loads(frame[1:])
+                    to, src, msg = pickle.loads(frame[1:])
+                    if src in self.blocked_nodes:
+                        continue  # per-source drop (co-hosted routers)
                     for name in remote_names:
-                        self._mark_heard(name)
+                        if name not in self.blocked_nodes:
+                            self._mark_heard(name)
                     node = self.nodes.get(to.node)
                     if node is not None:
                         node.deliver(to, msg)
@@ -444,7 +453,8 @@ class TcpRouter(LocalRouter):
                         fn(correlations)
                 elif kind == FRAME_PING:
                     for name in remote_names:
-                        self._mark_heard(name)
+                        if name not in self.blocked_nodes:
+                            self._mark_heard(name)
         except (OSError, pickle.UnpicklingError, EOFError):
             pass
         finally:

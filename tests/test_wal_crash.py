@@ -257,3 +257,67 @@ def test_parked_leader_resumes_leadership_after_wal_restart(tmp_path):
     assert res.reply >= 5 + 9
     node.stop()
     system.close()
+
+
+# -- write strategies (ra_log_wal.erl:66-96) --------------------------------
+
+def _strategy_roundtrip(tmp_path, strategy):
+    from ra_tpu.log.wal import Wal, scan_wal_file
+
+    confirms = []
+    wal = Wal(str(tmp_path), sync_mode=1, write_strategy=strategy)
+    wal.register("u1", lambda uid, lo, hi, term: confirms.append((lo, hi)))
+    for i in range(1, 21):
+        wal.write("u1", i, 1, f"payload-{i}".encode())
+    wal.flush()
+    assert confirms and confirms[-1][1] == 20, confirms
+    wal.close()
+    tables = {}
+    import os as _os
+    wdir = str(tmp_path / "wal")
+    for f in sorted(_os.listdir(wdir)):
+        if f.endswith(".wal"):
+            scan_wal_file(_os.path.join(wdir, f), tables)
+    got = tables.get("u1", {})
+    assert sorted(got) == list(range(1, 21)), sorted(got)
+    assert got[20][1] == b"payload-20"
+
+
+def test_wal_strategy_default(tmp_path):
+    _strategy_roundtrip(tmp_path, "default")
+
+
+def test_wal_strategy_o_sync(tmp_path):
+    _strategy_roundtrip(tmp_path, "o_sync")
+
+
+def test_wal_strategy_sync_after_notify(tmp_path):
+    _strategy_roundtrip(tmp_path, "sync_after_notify")
+
+
+def test_wal_strategy_unknown_rejected(tmp_path):
+    from ra_tpu.log.wal import Wal
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        Wal(str(tmp_path), write_strategy="bogus")
+
+
+def test_engine_durable_o_sync_strategy(tmp_path):
+    """The engine durability bridge runs over every strategy."""
+    import numpy as np
+    from ra_tpu.engine import open_engine
+    from ra_tpu.models import CounterMachine
+
+    eng = open_engine(CounterMachine(), str(tmp_path), 4, 3,
+                      sync_mode=1, write_strategy="o_sync",
+                      ring_capacity=64, max_step_cmds=4)
+    n_new = np.full((4,), 2, np.int32)
+    pay = np.ones((4, 4, 1), np.int32)
+    for _ in range(8):
+        eng.step(n_new, pay)
+    for _ in range(8):
+        eng.step(np.zeros((4,), np.int32), np.zeros_like(pay))
+        eng._dur.drain_all()
+        eng._dur.wal.flush()
+    assert eng.committed_total() > 0
+    eng.close()
