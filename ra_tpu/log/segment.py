@@ -187,10 +187,61 @@ class SegmentFile:
             self.fd = None
 
 
-class SegmentWriter:
-    """Node-wide background flusher: WAL rollover ranges -> segment files."""
+class _DaemonFuture:
+    __slots__ = ("_done", "_result", "_exc")
 
-    def __init__(self, resolve: Optional[Callable] = None) -> None:
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("flush worker stalled")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _DaemonPool:
+    """Minimal daemon-thread worker pool (submit -> future)."""
+
+    def __init__(self, workers: int, name: str) -> None:
+        self._queue: "queue.Queue" = queue.Queue()
+        for i in range(workers):
+            t = threading.Thread(target=self._work, daemon=True,
+                                 name=f"{name}-{i}")
+            t.start()
+
+    def _work(self) -> None:
+        while True:
+            fn, args, fut = self._queue.get()
+            try:
+                fut._result = fn(*args)
+            except BaseException as exc:  # noqa: BLE001 — carried to result()
+                fut._exc = exc
+            fut._done.set()
+
+    def submit(self, fn, *args) -> _DaemonFuture:
+        fut = _DaemonFuture()
+        self._queue.put((fn, args, fut))
+        return fut
+
+
+class SegmentWriter:
+    """Node-wide background flusher: WAL rollover ranges -> segment files.
+
+    Flushes within one job run on a small worker pool — the
+    ``partition_parallel`` over schedulers of the reference
+    (ra_log_segment_writer.erl:129-147): per-uid flushes touch disjoint
+    DurableLogs and segment files, so at the co-hosted-thousands design
+    point one Python thread would serialize the node's entire flush
+    bandwidth.  Jobs themselves stay ordered (two jobs may carry the
+    same uid); the WAL-file deletion barrier is preserved — a file is
+    unlinked only after every uid's flush in its job completed."""
+
+    def __init__(self, resolve: Optional[Callable] = None,
+                 flush_workers: int = 4) -> None:
         #: resolve(uid) -> DurableLog | None (set by the node/log registry)
         self.resolve = resolve or (lambda uid: None)
         #: node-wide counters (ra_log_segment_writer.erl:37-52 names)
@@ -202,6 +253,12 @@ class SegmentWriter:
         self._deleted: set = set()
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = False
+        # daemon worker pool (NOT concurrent.futures: its atexit hook
+        # joins workers, so a flush stuck in fsync on a dying disk would
+        # hang process exit — the writer thread itself is daemon for the
+        # same reason)
+        self._pool = _DaemonPool(max(1, flush_workers),
+                                 "ra-segment-flush")
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ra-segment-writer")
         self._thread.start()
@@ -250,6 +307,7 @@ class SegmentWriter:
 
     def _flush_job(self, ranges: dict, wal_path: str) -> None:
         unresolved = False
+        jobs = []
         for uid, (lo, hi) in ranges.items():
             log = self.resolve(uid)
             if log is None:
@@ -260,7 +318,18 @@ class SegmentWriter:
                 if uid not in self._deleted:
                     unresolved = True
                 continue
-            self._count_flush(log.flush_mem_to_segments(hi))
+            jobs.append((uid, log, hi))
+        # fan the per-uid flushes over the pool (partition_parallel role)
+        futures = [(uid, self._pool.submit(log.flush_mem_to_segments, hi))
+                   for uid, log, hi in jobs]
+        for uid, fut in futures:
+            try:
+                self._count_flush(fut.result())
+            except Exception:
+                import logging
+                logging.getLogger("ra_tpu").exception(
+                    "segment flush failed for %s", uid)
+                unresolved = True  # keep the WAL file: entries recoverable
         if not unresolved:
             # all servers flushed: the WAL file is redundant (:206-214)
             try:
@@ -283,11 +352,24 @@ class SegmentWriter:
                     t.daemon = True
                     t.start()
                 return
+        futures = []
         for uid in uids:
             log = self.resolve(uid)
             if log is not None:
-                self._count_flush(
-                    log.flush_mem_to_segments(log.last_written().index))
+                futures.append(self._pool.submit(
+                    lambda lg=log: lg.flush_mem_to_segments(
+                        lg.last_written().index)))
+        failed = False
+        for fut in futures:
+            try:
+                self._count_flush(fut.result())
+            except Exception:
+                import logging
+                logging.getLogger("ra_tpu").exception(
+                    "segment retire flush failed")
+                failed = True
+        if failed:
+            return  # keep the recovered files: entries still needed
         for path in wal_files:
             try:
                 os.unlink(path)
@@ -295,6 +377,8 @@ class SegmentWriter:
                 pass
 
     def _count_flush(self, stats: Optional[tuple]) -> None:
+        # counting stays on the single writer thread (futures are
+        # resolved there), so no lock is needed
         if not stats:
             return
         entries, nbytes, segs = stats
