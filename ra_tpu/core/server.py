@@ -128,6 +128,12 @@ class RaServer:
         self.log = log
         self.id: ServerId = config.server_id
         self.machine: Machine = config.machine
+        # machine-selected snapshot format (snapshot_module/0 override,
+        # ra_machine.erl:435-437; behaviour ra_snapshot.erl:98-168)
+        if config.machine is not None:
+            mod = config.machine.snapshot_module()
+            if mod is not None:
+                log.snapshot_module = mod
 
         # persisted via the log's meta store (ra_log_meta)
         self.current_term: int = log.fetch_meta("current_term", 0)

@@ -12,11 +12,12 @@ from typing import Any, Callable, Optional
 from .core.machine import ApplyMeta, Machine
 from .core.types import Entry, NoopCommand, UserCommand
 from .log.durable import _read_snapshot_file
+from .log.snapshot import DEFAULT_SNAPSHOT_MODULE
 from .log.segment import SegmentFile
 from .log.wal import scan_wal_file
 
 
-def read_log(data_dir: str, uid: str) -> tuple:
+def read_log(data_dir: str, uid: str, snapshot_module=None) -> tuple:
     """Collect (snapshot, ordered entries) for a server from its on-disk
     state: snapshot + segments + surviving WAL files."""
     server_dir = os.path.join(data_dir, uid)
@@ -26,7 +27,8 @@ def read_log(data_dir: str, uid: str) -> tuple:
         for fname in sorted(os.listdir(snapdir), reverse=True):
             got = _read_snapshot_file(os.path.join(snapdir, fname))
             if got is not None:
-                snapshot = (got[0], pickle.loads(got[1]))
+                mod = snapshot_module or DEFAULT_SNAPSHOT_MODULE
+                snapshot = (got[0], mod.decode(got[1]))
                 break
     entries: dict[int, tuple] = {}
     if os.path.isdir(server_dir):
@@ -62,7 +64,10 @@ def replay_log(data_dir: str, uid: str, machine: Machine,
                on_entry: Optional[Callable] = None) -> Any:
     """Replay a server's committed-on-disk log through ``machine`` and
     return the final machine state (replay_log/3, ra_dbg.erl:26-55)."""
-    snapshot, entries = read_log(data_dir, uid)
+    # the machine's snapshot module decodes its own state format
+    # (snapshot_module/0 override, ra_machine.erl:435-437)
+    snapshot, entries = read_log(data_dir, uid,
+                                 snapshot_module=machine.snapshot_module())
     if snapshot is not None:
         state = snapshot[1]
     else:

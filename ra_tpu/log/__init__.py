@@ -1,4 +1,5 @@
 from .durable import DurableLog
 from .memory import MemoryLog
 from .segment import SegmentFile, SegmentWriter
+from .snapshot import DEFAULT_SNAPSHOT_MODULE, SnapshotModule
 from .wal import Wal

@@ -38,6 +38,7 @@ from ..metrics import LOG_FIELDS
 from ..native import IO
 from ..utils.flru import Flru
 from .segment import DEFAULT_MAX_COUNT, SegmentFile
+from .snapshot import DEFAULT_SNAPSHOT_MODULE
 
 #: open segment fds per server (ra_flru's open_segments cap,
 #: ra_log_reader.erl:45-49)
@@ -128,6 +129,10 @@ class LogReader:
 
 
 class DurableLog:
+    #: pluggable state serializer (Machine.snapshot_module override,
+    #: ra_machine.erl:435-437); container format is module-agnostic
+    snapshot_module = DEFAULT_SNAPSHOT_MODULE
+
     def __init__(self, uid: str, data_dir: str, wal, *,
                  segment_max_count: int = DEFAULT_MAX_COUNT) -> None:
         self.uid = uid
@@ -648,7 +653,7 @@ class DurableLog:
                             machine_version=machine_version)
         path = os.path.join(self.dir, "snapshot",
                             f"snap_{idx:016d}_{term:010d}.rtsn")
-        data = pickle.dumps(machine_state)
+        data = self.snapshot_module.encode(machine_state)
         _write_snapshot_file(path, meta, data)
         self.counters["snapshots_written"] += 1
         self.counters["snapshot_bytes_written"] += len(data)
@@ -673,7 +678,7 @@ class DurableLog:
                             machine_version=machine_version)
         path = os.path.join(self.dir, "checkpoints",
                             f"cp_{idx:016d}_{term:010d}.rtsn")
-        data = pickle.dumps(machine_state)
+        data = self.snapshot_module.encode(machine_state)
         _write_snapshot_file(path, meta, data)
         self.counters["checkpoints_written"] += 1
         self.counters["checkpoint_bytes_written"] += len(data)
@@ -825,8 +830,16 @@ class DurableLog:
         meta, path = self._snapshot
         got = _read_snapshot_file(path)
         if got is None:
-            return None
-        return meta, pickle.loads(got[1])
+            return None  # torn/corrupt container: fall back to older
+        if not self.snapshot_module.validate(got[1]):
+            # a crc-valid container the selected module rejects is a
+            # FORMAT mismatch (e.g. module changed without migration):
+            # re-initializing machine state over a truncated log would
+            # be silent divergence — fail loudly instead
+            raise ValueError(
+                f"snapshot {path} rejected by snapshot module "
+                f"{self.snapshot_module.name!r} (format mismatch?)")
+        return meta, self.snapshot_module.decode(got[1])
 
     def snapshot_data(self) -> bytes:
         got = self.snapshot()

@@ -18,11 +18,11 @@ of the real facade contract the core observes (/root/reference/src/ra_log.erl):
 """
 from __future__ import annotations
 
-import pickle
 from typing import Any, Callable, Iterable, Optional
 
 from ..core.types import Entry, IdxTerm, SnapshotMeta, WrittenEvent
 from ..metrics import LOG_FIELDS
+from .snapshot import DEFAULT_SNAPSHOT_MODULE
 
 
 class IntegrityError(Exception):
@@ -30,6 +30,10 @@ class IntegrityError(Exception):
 
 
 class MemoryLog:
+    #: pluggable state serializer (Machine.snapshot_module override,
+    #: ra_machine.erl:435-437); container format is module-agnostic
+    snapshot_module = DEFAULT_SNAPSHOT_MODULE
+
     def __init__(self, *, auto_written: bool = True,
                  first_index: int = 1) -> None:
         # idx -> Entry
@@ -224,7 +228,7 @@ class MemoryLog:
             return []
         meta = SnapshotMeta(index=idx, term=term, cluster=cluster,
                             machine_version=machine_version)
-        data = pickle.dumps(machine_state)
+        data = self.snapshot_module.encode(machine_state)
         self._snapshot = (meta, data)
         self.counters["snapshots_written"] += 1
         self.counters["snapshot_bytes_written"] += len(data)
@@ -238,7 +242,7 @@ class MemoryLog:
             return []
         meta = SnapshotMeta(index=idx, term=term, cluster=cluster,
                             machine_version=machine_version)
-        data = pickle.dumps(machine_state)
+        data = self.snapshot_module.encode(machine_state)
         self._checkpoints.append((meta, data))
         self.counters["checkpoints_written"] += 1
         self.counters["checkpoint_bytes_written"] += len(data)
@@ -314,7 +318,11 @@ class MemoryLog:
         if self._snapshot is None:
             return None
         meta, data = self._snapshot
-        return meta, pickle.loads(data)
+        if not self.snapshot_module.validate(data):
+            raise ValueError(
+                "snapshot rejected by snapshot module "
+                f"{self.snapshot_module.name!r} (format mismatch?)")
+        return meta, self.snapshot_module.decode(data)
 
     def snapshot_data(self) -> bytes:
         assert self._snapshot is not None

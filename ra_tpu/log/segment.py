@@ -208,14 +208,22 @@ class _DaemonPool:
 
     def __init__(self, workers: int, name: str) -> None:
         self._queue: "queue.Queue" = queue.Queue()
+        self._workers = workers
         for i in range(workers):
             t = threading.Thread(target=self._work, daemon=True,
                                  name=f"{name}-{i}")
             t.start()
 
+    def stop(self) -> None:
+        for _ in range(self._workers):
+            self._queue.put(None)
+
     def _work(self) -> None:
         while True:
-            fn, args, fut = self._queue.get()
+            item = self._queue.get()
+            if item is None:
+                return
+            fn, args, fut = item
             try:
                 fut._result = fn(*args)
             except BaseException as exc:  # noqa: BLE001 — carried to result()
@@ -390,3 +398,4 @@ class SegmentWriter:
     def close(self) -> None:
         self._stop = True
         self._thread.join(timeout=5)
+        self._pool.stop()

@@ -548,9 +548,10 @@ class RaNode:
         self.counters.incr(server.cfg.uid, "snapshots_sent")
         meta, data = snap
         leader_id, term = eff.id_term
-        chunk = server.cfg.snapshot_chunk_size
-        chunks = [data[i:i + chunk] for i in range(0, max(len(data), 1),
-                                                   chunk)] or [b""]
+        # chunk boundaries come from the machine's snapshot module
+        # (begin_read/read_chunk role, ra_snapshot.erl:129-143)
+        chunks = list(server.log.snapshot_module.chunks(
+            data, server.cfg.snapshot_chunk_size)) or [b""]
         for i, piece in enumerate(chunks):
             flag = "last" if i == len(chunks) - 1 else "next"
             self.counters.incr(server.cfg.uid, "msgs_sent")

@@ -123,9 +123,8 @@ class SimCluster:
             return
         meta, data = snap
         leader_id, term = eff.id_term
-        chunks = [data[i:i + self.snapshot_chunk_size]
-                  for i in range(0, max(len(data), 1),
-                                 self.snapshot_chunk_size)] or [b""]
+        chunks = list(srv.log.snapshot_module.chunks(
+            data, self.snapshot_chunk_size)) or [b""]
         for i, chunk in enumerate(chunks):
             flag = "last" if i == len(chunks) - 1 else "next"
             self._send(src, eff.to,

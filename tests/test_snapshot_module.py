@@ -1,0 +1,181 @@
+"""Pluggable snapshot modules (ra_snapshot behaviour, ra_snapshot.erl:
+98-168 + the Machine.snapshot_module/0 override, ra_machine.erl:435-437):
+a machine-selected format must round-trip release_cursor -> restart
+recovery AND the chunked follower install, with the default (pickle)
+unchanged."""
+import os
+import struct
+
+import pytest
+
+from harness import SimCluster
+from ra_tpu.core.machine import Machine
+from ra_tpu.core.types import (CommandEvent, ElectionTimeout, Entry,
+                               ReleaseCursor, ServerConfig, ServerId,
+                               UserCommand)
+from ra_tpu.log.snapshot import SnapshotModule
+from ra_tpu.system import RaSystem
+
+MAGIC = b"CNT1"
+
+
+class CounterSnapshotModule(SnapshotModule):
+    """Custom fixed-width binary format for an int-counter machine —
+    the 'machine with huge state streams a custom format' case."""
+
+    name = "cnt1"
+
+    def encode(self, machine_state):
+        return MAGIC + struct.pack("<q", int(machine_state))
+
+    def decode(self, data):
+        assert data[:4] == MAGIC, data[:8]
+        return struct.unpack_from("<q", data, 4)[0]
+
+    def validate(self, data):
+        return data[:4] == MAGIC and len(data) == 12
+
+
+class SnapCounter(Machine):
+    """Counter releasing its cursor every 16 applies, with the custom
+    snapshot format."""
+
+    def init(self, config):
+        return 0
+
+    def apply(self, meta, command, state):
+        new = state + command
+        if meta.index % 16 == 0:
+            return new, new, [ReleaseCursor(meta.index, new)]
+        return new, new
+
+    def snapshot_module(self):
+        return CounterSnapshotModule()
+
+
+def pump(c, rounds=12):
+    for _ in range(rounds):
+        for sid in c.ids:
+            while c.queues[sid]:
+                c.handle(sid, c.queues[sid].popleft())
+
+
+def test_custom_module_snapshot_and_recovery(tmp_path):
+    """release_cursor writes the custom format; a restarted server over
+    the same dir recovers through the custom decode."""
+    sys_ = RaSystem(str(tmp_path))
+    sid = ServerId("s1", "n1")
+    cfg = ServerConfig(server_id=sid, uid="u_s1", cluster_name="c",
+                       initial_members=(sid,), machine=SnapCounter())
+    from ra_tpu.core.server import RaServer
+    log = sys_.log_factory(cfg)
+    srv = RaServer(cfg, log)
+    srv.recover()
+
+    from ra_tpu.core.types import Checkpoint, PromoteCheckpoint
+
+    def execute(effects):
+        # minimal shell: snapshot-lifecycle machine effects only
+        for eff in effects:
+            if isinstance(eff, (ReleaseCursor, Checkpoint,
+                                PromoteCheckpoint)):
+                execute(srv.handle_machine_effect(eff))
+
+    def drain():
+        for _ in range(20):
+            evts = log.take_events()
+            if not evts:
+                import time as _t
+                _t.sleep(0.01)
+                evts = log.take_events()
+                if not evts:
+                    break
+            for evt in evts:
+                execute(srv.handle(evt))
+
+    execute(srv.handle(ElectionTimeout()))
+    drain()
+    assert srv.raft_state.value == "leader", srv.raft_state
+    for _ in range(40):
+        execute(srv.handle(CommandEvent(UserCommand(2))))
+        drain()
+    assert srv.machine_state > 0
+    snap = log.snapshot_index_term()
+    assert snap.index >= 16, snap
+    # on disk: the data section is OUR format, not a pickle
+    snapdir = [f for f in os.listdir(str(tmp_path / "u_s1" / "snapshot"))]
+    assert snapdir, "no snapshot file written"
+    state_now = srv.machine_state
+    sys_.close()
+
+    sys2 = RaSystem(str(tmp_path))
+    log2 = sys2.log_factory(cfg)
+    srv2 = RaServer(cfg, log2)
+    srv2.recover()
+    # recovery applies through the persisted last_applied; the custom
+    # decode must have seeded at least the snapshot state
+    assert srv2.machine_state >= snap.index * 2 - 2, srv2.machine_state
+    assert srv2.log.snapshot_index_term().index == snap.index
+    # after re-election the tail re-commits and state fully catches up
+    def drain2():
+        for _ in range(20):
+            evts = log2.take_events()
+            if not evts:
+                import time as _t
+                _t.sleep(0.01)
+                evts = log2.take_events()
+                if not evts:
+                    break
+            for evt in evts:
+                srv2.handle(evt)
+    srv2.handle(ElectionTimeout())
+    drain2()
+    assert srv2.machine_state == state_now, srv2.machine_state
+    sys2.close()
+
+
+def test_custom_module_chunked_install():
+    """A lagging follower receives the snapshot in chunks and recovers
+    the machine state through the custom decode (SURVEY §3.3)."""
+    c = SimCluster(3, machine_factory=SnapCounter, snapshot_chunk_size=5)
+    c.handle(c.ids[0], ElectionTimeout())
+    pump(c)
+    # partition s3, drive past a release point, heal -> snapshot install
+    victim = c.ids[2]
+    for other in (c.ids[0], c.ids[1]):
+        c.dropped.add((other, victim))
+        c.dropped.add((victim, other))
+    for _ in range(40):
+        c.handle(c.ids[0], CommandEvent(UserCommand(3)))
+        pump(c, 2)
+    leader_srv = c.servers[c.ids[0]]
+    assert leader_srv.log.snapshot_index_term().index > 0
+    c.dropped.clear()
+    # no real timers in the sim: a tick makes the leader re-probe the
+    # healed peer, whose rewind forces the snapshot fallback
+    from ra_tpu.core.types import TickEvent
+    for _ in range(6):
+        c.handle(c.ids[0], TickEvent())
+        pump(c, 6)
+    v = c.servers[victim]
+    assert v.machine_state == leader_srv.machine_state
+    assert v.log.snapshot_index_term().index > 0
+    assert v.log.counters["snapshot_installed"] >= 1
+
+
+def test_default_module_unchanged():
+    """Machines without an override keep the pickle default."""
+    from ra_tpu.log.memory import MemoryLog
+    from ra_tpu.log.snapshot import DEFAULT_SNAPSHOT_MODULE
+    log = MemoryLog()
+    assert log.snapshot_module is DEFAULT_SNAPSHOT_MODULE
+    st = {"a": [1, 2, 3]}
+    assert log.snapshot_module.decode(log.snapshot_module.encode(st)) == st
+
+
+def test_module_chunks_roundtrip():
+    m = CounterSnapshotModule()
+    data = m.encode(12345)
+    parts = list(m.chunks(data, 4))
+    assert b"".join(parts) == data
+    assert m.decode(b"".join(parts)) == 12345
