@@ -415,30 +415,78 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
                         jnp.minimum(apply_to, (base + A)[:, None])),
             applied0)
     else:
-        # sequential machines: scan over the window positions, feeding
-        # each pre-gathered command row as scan xs (zero gather in-body)
-        def body(carry, xs):
-            mac, applied = carry
-            a, cmd_row = xs                              # [], [N,C]
-            step_idx = base + 1 + a                      # [N]
-            idx_m = jnp.broadcast_to(step_idx[:, None], (N, P))
-            do_m = (idx_m > applied) & (idx_m <= apply_to) & active
-            cmd = jnp.broadcast_to(cmd_row[:, None],
-                                   (N, P, cmd_row.shape[-1]))
-            meta = {"index": idx_m, "term": jnp.broadcast_to(
-                term[:, None], idx_m.shape)}
-            new_mac, _reply = machine.jit_apply(meta, cmd, mac)
-            mac = jax.tree.map(
-                lambda new, old: jnp.where(
-                    do_m.reshape(do_m.shape + (1,) * (new.ndim - 2)),
-                    new, old),
-                new_mac, mac)
-            applied = jnp.where(do_m, idx_m, applied)
-            return (mac, applied), None
+        # Sequential machines: ONE lane-representative scan instead of a
+        # per-member one.  Every active member of a lane applies the
+        # same committed commands in the same order, so the per-member
+        # scan did the machine fold P times over; instead the scan runs
+        # on the representative state (the active member at the lane
+        # apply frontier), records the trajectory, and each member's
+        # final state is SELECTED from it at offset
+        # (its own apply_to - base) via an exact one-hot matmul —
+        # members that may not apply the full window (commit lag,
+        # frozen failures) land on the right intermediate state.
+        sel = jnp.argmax(active & (applied0 == base[:, None]),
+                         axis=-1)                        # [N]
 
-        (mac, applied), _ = jax.lax.scan(
-            body, (state.mac, applied0),
-            (a_idx, jnp.moveaxis(cmds_lane, 1, 0)))
+        def pick(x):
+            idx = sel[:, None].reshape((N, 1) + (1,) * (x.ndim - 2))
+            idx = jnp.broadcast_to(idx, (N, 1) + x.shape[2:])
+            return jnp.take_along_axis(x, idx, axis=1)[:, 0]
+
+        mac_lane = jax.tree.map(pick, state.mac)
+
+        def body(mac0, xs):
+            a, cmd_row = xs                              # [], [N,C]
+            meta = {"index": base + 1 + a, "term": term}
+            new_mac, _reply = machine.jit_apply(meta, cmd_row, mac0)
+            return new_mac, new_mac
+
+        _, traj = jax.lax.scan(body, mac_lane,
+                               (a_idx, jnp.moveaxis(cmds_lane, 1, 0)))
+        # trajectory offsets 0..A (0 = nothing applied this step)
+        stacked = jax.tree.map(
+            lambda init, tr: jnp.concatenate([init[None], tr], axis=0),
+            mac_lane, traj)                              # [A+1, N, ...]
+        off = jnp.clip(apply_to - base[:, None], 0, A)   # [N,P]
+        oh = (off[..., None] ==
+              jnp.arange(A + 1)[None, None, :]).astype(jnp.float32)
+
+        def select(stk, old):
+            # NB memory: the trajectory holds A+1 state snapshots per
+            # lane (vs P replicas before) — an (A+1)/P multiplier on
+            # apply-path peak memory, the price of the P-fold compute
+            # cut.  Machines with very large per-lane state at large
+            # apply windows should size ring/window accordingly.
+            tail_shape = stk.shape[2:]
+            S = 1
+            for d in tail_shape:
+                S *= d
+            flat = jnp.moveaxis(stk, 0, 1).reshape(N, A + 1, S)
+            if old.dtype in (jnp.int32, jnp.int16, jnp.int8,
+                             jnp.uint8, jnp.uint16, jnp.bool_):
+                # exact one-hot matmul (MXU path): <=32-bit ints
+                # round-trip through the 16-bit split losslessly
+                picked = _split16_matmul(
+                    oh, flat.astype(jnp.int32)).astype(old.dtype)
+            else:
+                # floats / 64-bit: gather (a matmul select would mix
+                # unselected offsets — 0*Inf=NaN — and wider types
+                # truncate); slower but exact and poison-free
+                idx = off[..., None]
+                idx3 = jnp.broadcast_to(idx, (N, P, S))
+                picked = jnp.take_along_axis(
+                    jnp.broadcast_to(flat[:, None], (N, P, A + 1, S)),
+                    idx3[:, :, None, :], axis=2)[:, :, 0]
+            picked = picked.reshape((N, P) + tail_shape)
+            m = active.reshape(active.shape + (1,) * (picked.ndim - 2))
+            return jnp.where(m, picked, old)
+
+        mac = jax.tree.map(select, stacked, state.mac)
+        applied = jnp.where(
+            active,
+            jnp.maximum(applied0,
+                        jnp.minimum(apply_to, (base + A)[:, None])),
+            applied0)
 
     new_state = LaneState(term=term, leader_slot=leader_slot,
                           term_start=term_start, last_index=last_index,

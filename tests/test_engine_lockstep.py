@@ -304,3 +304,41 @@ def test_engine_runs_with_onehot_ring_io():
                      np.asarray(eng.state.mac).copy())
     assert res["gather"][0] == res["onehot"][0]
     np.testing.assert_array_equal(res["gather"][1], res["onehot"][1])
+
+
+def test_scan_machine_float_state_exact():
+    """The lane-scan trajectory select must be exact for float machine
+    state (gather path — a matmul select would 0*Inf-poison)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ra_tpu.core.machine import JitMachine
+    from ra_tpu.engine import LockstepEngine
+
+    class FloatAcc(JitMachine):
+        command_spec = ("int32", (1,))
+        supports_batch_apply = False
+
+        def jit_init(self, n_lanes):
+            return jnp.zeros((n_lanes,), jnp.float32)
+
+        def jit_apply(self, meta, command, state):
+            new = state + command[..., 0].astype(jnp.float32) * 0.5
+            return new, new
+
+    eng = LockstepEngine(FloatAcc(), 4, 3, ring_capacity=64,
+                         max_step_cmds=4, write_delay=1)
+    n_new = np.full((4,), 3, np.int32)
+    pay = np.ones((4, 4, 1), np.int32)
+    for _ in range(8):
+        eng.step(n_new, pay)
+    st = eng.state
+    lane = np.arange(4)
+    applied = np.asarray(st.applied)
+    mac = np.asarray(st.mac)
+    act = np.asarray(st.active)
+    for i in range(4):
+        for p in range(3):
+            if act[i, p]:
+                # counter noop entries contribute 0; commands 0.5 each
+                assert abs(mac[i, p] - 0.5 * applied[i, p]) < 1e-5, \
+                    (i, p, mac[i, p], applied[i, p])
