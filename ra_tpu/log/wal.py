@@ -41,6 +41,17 @@ from ..native import IO
 MAGIC = b"RTW1"
 _REG = struct.Struct("<BIH")        # type, wid, uid_len
 _ENT = struct.Struct("<BIQQII")     # type, wid, idx, term, len, crc
+_ENT_HDR = struct.Struct("<BIQQI")  # the crc-covered prefix of _ENT
+
+
+def _entry_crc(wid: int, idx: int, term: int, payload: bytes) -> int:
+    """Record crc covers the HEADER FIELDS as well as the payload: a
+    flipped wid/idx/term must fail the check and stop recovery at the
+    damage point, not silently skip or mis-file the entry (the tail
+    discipline of ra_log_wal.erl:871-955)."""
+    return IO.crc32(payload,
+                    IO.crc32(_ENT_HDR.pack(2, wid, idx, term,
+                                           len(payload))))
 
 DEFAULT_MAX_SIZE = 64 * 1024 * 1024   # ra.hrl:191 uses 256MB; scaled down
 DEFAULT_MAX_BATCH = 8192              # ra.hrl:192
@@ -85,7 +96,8 @@ def scan_wal_file(path: str, tables: dict) -> None:
             pos += _ENT.size
             payload = data[pos:pos + plen]
             pos += plen
-            if len(payload) < plen or IO.crc32(payload) != crc:
+            if len(payload) < plen or \
+                    _entry_crc(wid, idx, term, payload) != crc:
                 raise ValueError("crc mismatch")  # torn tail: stop
             uid = wid_to_uid.get(wid)
             if uid is None:
@@ -329,7 +341,7 @@ class Wal:
                     buf += _REG.pack(1, w.wid, len(ub))
                     buf += ub
                     new_regs.add(w.wid)
-                crc = IO.crc32(payload)
+                crc = _entry_crc(w.wid, index, term, payload)
                 buf += _ENT.pack(2, w.wid, index, term, len(payload), crc)
                 buf += payload
                 n_entries += 1
