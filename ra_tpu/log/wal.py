@@ -38,20 +38,21 @@ from typing import Callable, Optional
 
 from ..native import IO
 
-MAGIC = b"RTW1"
+MAGIC = b"RTW2"
+MAGIC_V1 = b"RTW1"   # payload-only entry crc (read-compatible)
 _REG = struct.Struct("<BIH")        # type, wid, uid_len
 _ENT = struct.Struct("<BIQQII")     # type, wid, idx, term, len, crc
 _ENT_HDR = struct.Struct("<BIQQI")  # the crc-covered prefix of _ENT
+_CRC = struct.Struct("<I")
 
 
-def _entry_crc(wid: int, idx: int, term: int, payload: bytes) -> int:
-    """Record crc covers the HEADER FIELDS as well as the payload: a
-    flipped wid/idx/term must fail the check and stop recovery at the
+def _entry_crc(header: bytes, payload: bytes) -> int:
+    """RTW2 record crc covers the HEADER FIELDS as well as the payload:
+    a flipped wid/idx/term must fail the check and stop recovery at the
     damage point, not silently skip or mis-file the entry (the tail
-    discipline of ra_log_wal.erl:871-955)."""
-    return IO.crc32(payload,
-                    IO.crc32(_ENT_HDR.pack(2, wid, idx, term,
-                                           len(payload))))
+    discipline of ra_log_wal.erl:871-955).  RTW1 files (payload-only
+    crc) remain readable — the format version rides the file magic."""
+    return IO.crc32(payload, IO.crc32(header))
 
 DEFAULT_MAX_SIZE = 64 * 1024 * 1024   # ra.hrl:191 uses 256MB; scaled down
 DEFAULT_MAX_BATCH = 8192              # ra.hrl:192
@@ -75,8 +76,9 @@ def scan_wal_file(path: str, tables: dict) -> None:
     (ra_dbg)."""
     with open(path, "rb") as f:
         data = f.read()
-    if data[:4] != MAGIC:
+    if data[:4] not in (MAGIC, MAGIC_V1):
         return
+    header_crc = data[:4] == MAGIC
     pos = 4
     wid_to_uid: dict[int, str] = {}
     while pos + 1 <= len(data):
@@ -96,8 +98,9 @@ def scan_wal_file(path: str, tables: dict) -> None:
             pos += _ENT.size
             payload = data[pos:pos + plen]
             pos += plen
-            if len(payload) < plen or \
-                    _entry_crc(wid, idx, term, payload) != crc:
+            want = _entry_crc(_ENT_HDR.pack(2, wid, idx, term, plen),
+                              payload) if header_crc else IO.crc32(payload)
+            if len(payload) < plen or want != crc:
                 raise ValueError("crc mismatch")  # torn tail: stop
             uid = wid_to_uid.get(wid)
             if uid is None:
@@ -341,8 +344,9 @@ class Wal:
                     buf += _REG.pack(1, w.wid, len(ub))
                     buf += ub
                     new_regs.add(w.wid)
-                crc = _entry_crc(w.wid, index, term, payload)
-                buf += _ENT.pack(2, w.wid, index, term, len(payload), crc)
+                hdr = _ENT_HDR.pack(2, w.wid, index, term, len(payload))
+                buf += hdr
+                buf += _CRC.pack(_entry_crc(hdr, payload))
                 buf += payload
                 n_entries += 1
                 pending_last[uid] = index
