@@ -131,6 +131,10 @@ class LogReader:
 class DurableLog:
     #: pluggable state serializer (Machine.snapshot_module override,
     #: ra_machine.erl:435-437); container format is module-agnostic
+    #: True when term/voted_for/entries survive a process restart —
+    #: gates supervised auto-restart (amnesia double-vote hazard)
+    durable = True
+
     snapshot_module = DEFAULT_SNAPSHOT_MODULE
 
     def __init__(self, uid: str, data_dir: str, wal, *,

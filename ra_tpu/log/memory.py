@@ -32,6 +32,10 @@ class IntegrityError(Exception):
 class MemoryLog:
     #: pluggable state serializer (Machine.snapshot_module override,
     #: ra_machine.erl:435-437); container format is module-agnostic
+    #: True when term/voted_for/entries survive a process restart —
+    #: gates supervised auto-restart (amnesia double-vote hazard)
+    durable = False
+
     snapshot_module = DEFAULT_SNAPSHOT_MODULE
 
     def __init__(self, *, auto_written: bool = True,

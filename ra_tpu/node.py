@@ -193,6 +193,7 @@ class RaNode:
         self.shells: dict[str, ServerShell] = {}   # by server name
         self.directory: dict[str, ServerConfig] = {}  # uid -> config
         self.leaderboard: dict[str, tuple] = {}    # cluster -> (leader, members)
+        self._crash_times: dict[str, list] = {}    # supervised restarts
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -228,14 +229,63 @@ class RaNode:
         if shell is not None:
             shell.stopped = True
 
-    def restart_server(self, name: str) -> ServerId:
-        """Restart from the persisted log (ra:restart_server, §3.4)."""
+    #: supervised-restart intensity: allow this many crashes within the
+    #: period before giving up (the ra_server_sup transient strategy —
+    #: intensity 2, period 5s; ra_server_sup.erl)
+    RESTART_INTENSITY = 2
+    RESTART_PERIOD_S = 5.0
+
+    def _maybe_restart(self, sid: ServerId) -> bool:
+        """Supervised restart of a crashed member over its surviving log
+        (storage identity outlives the process, ra_log_wal.erl:44-51).
+        Returns False once the crash intensity is exceeded — the member
+        stays down and peers get the DOWN signal, exactly like an OTP
+        supervisor giving up on a child."""
+        now = time.monotonic()
+        times = self._crash_times.setdefault(sid.name, [])
+        times[:] = [t for t in times if now - t < self.RESTART_PERIOD_S]
+        times.append(now)
+        if len(times) > self.RESTART_INTENSITY:
+            logger.error(
+                "ra_tpu node %s: server %s exceeded restart intensity "
+                "(%d in %.0fs); giving up", self.name, sid,
+                self.RESTART_INTENSITY, self.RESTART_PERIOD_S)
+            return False
+        cfg = self._config_for(sid.name)
+        if cfg is None:
+            return False
+        # only restart over a log with DURABLE identity: a fresh
+        # in-memory log forgets term/voted_for, and a restarted member
+        # could then double-vote in a term it already voted in (the
+        # amnesia hazard forget_server documents)
+        probe = self.log_factory(cfg)
+        if not getattr(probe, "durable", False):
+            logger.warning(
+                "ra_tpu node %s: not auto-restarting %s — its log "
+                "factory has no durable identity", self.name, sid)
+            return False
+        try:
+            self.start_server(cfg)
+        except Exception:
+            logger.exception("ra_tpu node %s: restart of %s failed",
+                             self.name, sid)
+            return False
+        logger.warning("ra_tpu node %s: server %s restarted after crash",
+                       self.name, sid)
+        return True
+
+    def _config_for(self, name: str):
         with self._lock:
             cfg = None
             for c in self.directory.values():
                 if c.server_id.name == name:
                     cfg = c
-            assert cfg is not None, f"unknown server {name}"
+            return cfg
+
+    def restart_server(self, name: str) -> ServerId:
+        """Restart from the persisted log (ra:restart_server, §3.4)."""
+        cfg = self._config_for(name)
+        assert cfg is not None, f"unknown server {name}"
         self.stop_server(name)
         return self.start_server(cfg)
 
@@ -353,7 +403,12 @@ class RaNode:
                     # blocking on a dead inbox / stale leader state
                     with self._lock:
                         self.shells.pop(shell.sid.name, None)
+                    # peers always learn about the dead incarnation
+                    # (monitors fire even when a supervisor restarts
+                    # the child, ra_server_proc.erl:760-788)
                     self._notify_down(shell.sid)
+                    if self._maybe_restart(shell.sid):
+                        busy = True
             if not busy:
                 self._wake.wait(timeout=0.005)
                 self._wake.clear()

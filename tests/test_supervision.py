@@ -1,0 +1,148 @@
+"""Supervised server restart — the ra_server_sup transient-child role:
+a crashed member restarts over its surviving DURABLE log (bounded by
+restart intensity); in-memory members stay down (restarting them over
+an empty log would forget term/voted_for — the amnesia double-vote
+hazard); peers get the DOWN signal for the dead incarnation either way.
+"""
+import time
+
+import pytest
+
+import ra_tpu
+from ra_tpu import RaSystem
+from ra_tpu.core.machine import SimpleMachine
+from ra_tpu.core.types import ServerId
+from ra_tpu.node import LocalRouter, RaNode
+
+from nemesis import await_leader
+
+
+def counter():
+    return SimpleMachine(lambda c, s: s + c, 0)
+
+
+def ids(n=3):
+    return [ServerId(f"v{i+1}", f"vn{i+1}") for i in range(n)]
+
+
+@pytest.fixture
+def fabric(tmp_path):
+    router = LocalRouter()
+    systems = {f"vn{i}": RaSystem(str(tmp_path / f"vn{i}"))
+               for i in (1, 2, 3)}
+    nodes = {n: RaNode(n, router=router, log_factory=systems[n].log_factory)
+             for n in systems}
+    yield router, nodes
+    for n in nodes.values():
+        n.stop()
+    for s in systems.values():
+        s.close()
+
+
+def _poison_once(shell):
+    """Instance-level poison on the shell's (shared, durable) log that
+    removes itself after firing once — the restarted incarnation reuses
+    the same DurableLog object, so a sticky patch would crash-loop."""
+    log = shell.server.log
+
+    def boom(*a, **k):
+        try:
+            del log.write
+        except AttributeError:
+            pass
+        raise RuntimeError("injected write crash")
+
+    log.write = boom
+
+
+def test_crashed_server_is_restarted_over_durable_log(fabric):
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("sup1", counter, sids, router=router)
+    leader = await_leader(router, sids)
+    for v in (1, 2, 3):
+        ra_tpu.process_command(leader, v, router=router)
+    victim = [s for s in sids if s != leader][0]
+    vnode = nodes[victim.node]
+    sh = vnode.shells[victim.name]
+    _poison_once(sh)
+    # traffic drives an AER into the poisoned log -> crash -> restart
+    ra_tpu.process_command(leader, 10, router=router)
+    deadline = time.monotonic() + 10
+    restarted = None
+    while time.monotonic() < deadline:
+        cur = vnode.shells.get(victim.name)
+        if cur is not None and cur is not sh and not cur.stopped:
+            restarted = cur
+            break
+        time.sleep(0.05)
+    assert restarted is not None, "supervisor did not restart the member"
+    # the restarted incarnation kept its durable identity and catches up
+    assert restarted.server.current_term >= sh.server.current_term
+    ra_tpu.process_command(leader, 100, router=router)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if restarted.server.machine_state == 116:
+            break
+        time.sleep(0.05)
+    assert restarted.server.machine_state == 116
+
+
+def test_restart_intensity_gives_up(fabric):
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("sup2", counter, sids, router=router)
+    leader = await_leader(router, sids)
+    ra_tpu.process_command(leader, 1, router=router)
+    victim = [s for s in sids if s != leader][0]
+    vnode = nodes[victim.node]
+    sh = vnode.shells[victim.name]
+    # sticky poison on the shared durable log: every incarnation crashes
+    sh.server.log.write = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("sticky crash"))
+    ra_tpu.process_command(leader, 2, router=router)
+    deadline = time.monotonic() + 15
+    gone = False
+    while time.monotonic() < deadline:
+        if vnode.shells.get(victim.name) is None:
+            # no further restart within the window => gave up
+            time.sleep(0.6)
+            gone = vnode.shells.get(victim.name) is None
+            if gone:
+                break
+        time.sleep(0.1)
+    assert gone, "crash loop was not stopped by restart intensity"
+    # the rest of the cluster keeps operating
+    r = ra_tpu.process_command(leader, 5, router=router)
+    assert r.reply == 8
+
+
+def test_memory_log_member_is_not_auto_restarted():
+    """Without durable identity there is no safe restart: the member
+    stays down and peers see it as such."""
+    router = LocalRouter()
+    nodes = {f"mn{i}": RaNode(f"mn{i}", router=router) for i in (1, 2, 3)}
+    try:
+        sids = [ServerId(f"w{i}", f"mn{i}") for i in (1, 2, 3)]
+        ra_tpu.start_cluster("sup3", counter, sids, router=router)
+        leader = await_leader(router, sids)
+        ra_tpu.process_command(leader, 1, router=router)
+        victim = [s for s in sids if s != leader][0]
+        vnode = nodes[victim.node]
+        sh = vnode.shells[victim.name]
+        sh.server.log.write = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("memory crash"))
+        ra_tpu.process_command(leader, 2, router=router)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if vnode.shells.get(victim.name) is None:
+                break
+            time.sleep(0.05)
+        time.sleep(0.5)  # would-be restart window
+        assert vnode.shells.get(victim.name) is None
+        # majority continues
+        r = ra_tpu.process_command(leader, 5, router=router)
+        assert r.reply == 8
+    finally:
+        for n in nodes.values():
+            n.stop()
