@@ -102,6 +102,7 @@ class Peer:
     membership: Membership = Membership.VOTER
     promote_target: int = 0  # promotable non-voter: target index
     snapshot_sender: Any = None  # token of in-flight snapshot send
+    snapshot_started: float = 0.0  # when SENDING_SNAPSHOT was entered
 
 
 @dataclass
@@ -717,7 +718,8 @@ class RaServer:
                             InstallSnapshotResult(
                                 term=self.current_term,
                                 last_index=rpc.meta.index,
-                                last_term=rpc.meta.term, from_=self.id))]
+                                last_term=rpc.meta.term, from_=self.id,
+                                token=rpc.token))]
         if (rpc.chunk_number == 1 and rpc.meta.index > self.last_applied
                 and self.machine_version >= rpc.meta.machine_version):
             self._update_term(rpc.term)
@@ -732,7 +734,8 @@ class RaServer:
                         InstallSnapshotResult(term=self.current_term,
                                               last_index=last.index,
                                               last_term=last.term,
-                                              from_=self.id))]
+                                              from_=self.id,
+                                              token=rpc.token))]
 
     # ------------------------------------------------------------------
     # receive_snapshot state (ra_server.erl:1333-1413)
@@ -763,7 +766,8 @@ class RaServer:
                                 InstallSnapshotResult(
                                     term=self.current_term,
                                     last_index=last.index,
-                                    last_term=last.term, from_=self.id)),
+                                    last_term=last.term, from_=self.id,
+                                    token=event.token)),
                         StartElectionTimeout("medium")]
             if event.chunk_flag == "last":
                 if not self.log.complete_accept():
@@ -789,14 +793,16 @@ class RaServer:
                                     InstallSnapshotResult(
                                         term=self.current_term,
                                         last_index=meta.index,
-                                        last_term=meta.term, from_=self.id)))
+                                        last_term=meta.term, from_=self.id,
+                                        token=event.token)))
                 effs.append(StartElectionTimeout("medium"))
                 return effs
             return [SendRpc(event.leader_id,
                             InstallSnapshotResult(term=self.current_term,
                                                   last_index=meta.index,
                                                   last_term=meta.term,
-                                                  from_=self.id))]
+                                                  from_=self.id,
+                                                  token=event.token))]
         if isinstance(event, AppendEntriesRpc) and \
                 event.term >= self.current_term:
             # a leader in a newer term interrupts the transfer
@@ -955,6 +961,11 @@ class RaServer:
                 return self._become_follower(event.term)
             peer = self.cluster.get(event.from_)
             if peer is None:
+                return []
+            if peer.snapshot_sender is not None and \
+                    event.token != peer.snapshot_sender:
+                # straggler result from an abandoned (timed-out)
+                # transfer: must not regress the live transfer's state
                 return []
             peer.status = PeerStatus.NORMAL
             peer.snapshot_sender = None
@@ -1371,7 +1382,10 @@ class RaServer:
             # prev=0 would ship a gapped batch (fetch_term(PrevIdx)
             # undefined ∧ PrevIdx < snapshot idx, ra_server.erl:1962-1981)
             peer.status = PeerStatus.SENDING_SNAPSHOT
-            return SendSnapshot(pid, (self.id, self.current_term))
+            peer.snapshot_started = time.monotonic()
+            peer.snapshot_sender = self._next_snapshot_token()
+            return SendSnapshot(pid, (self.id, self.current_term),
+                                token=peer.snapshot_sender)
         prev_term = self.log.fetch_term(prev_idx) if prev_idx > 0 else 0
         if prev_term is None:
             snap = self.log.snapshot_index_term()
@@ -1381,7 +1395,10 @@ class RaServer:
                 # entry compacted away: peer needs a snapshot
                 # (ra_server.erl:1962-1981)
                 peer.status = PeerStatus.SENDING_SNAPSHOT
-                return SendSnapshot(pid, (self.id, self.current_term))
+                peer.snapshot_started = time.monotonic()
+                peer.snapshot_sender = self._next_snapshot_token()
+                return SendSnapshot(pid, (self.id, self.current_term),
+                                    token=peer.snapshot_sender)
         last_idx = self.log.last_index_term().index
         to = min(last_idx, prev_idx + batch)
         entries = tuple(self.log.read_range(prev_idx + 1, to)) \
@@ -1620,8 +1637,25 @@ class RaServer:
         return _filter_follower_effects(effects) \
             if self.raft_state != RaftState.LEADER else effects
 
+    def _next_snapshot_token(self) -> int:
+        self._snapshot_token = getattr(self, "_snapshot_token", 0) + 1
+        return self._snapshot_token
+
+    #: give up on an unacknowledged snapshot transfer after this long —
+    #: the functional stand-in for the reference's snapshot_sender DOWN
+    #: (peer_snapshot_process_exited, ra_server.erl handle_down): resets
+    #: the peer so the pipeline retries (possibly re-sending)
+    SNAPSHOT_SEND_TIMEOUT_S = 5.0
+
     def _tick_leader(self) -> list:
         effects = self._tick()
+        now = time.monotonic()
+        for peer in self.cluster.values():
+            if peer.status == PeerStatus.SENDING_SNAPSHOT and \
+                    now - peer.snapshot_started > \
+                    self.SNAPSHOT_SEND_TIMEOUT_S:
+                peer.status = PeerStatus.NORMAL
+                peer.snapshot_sender = None
         # refresh peers (periodic empty AERs stand in for ra's aten-driven
         # liveness; ra sends no idle heartbeats, INTERNALS.md:291-328)
         effects.extend(self._make_all_rpcs())

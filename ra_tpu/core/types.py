@@ -262,6 +262,9 @@ class InstallSnapshotRpc:
     #: transfer aborts early instead of poisoning the assembled snapshot
     #: (ra_log_snapshot.erl:73-111); -1 = absent (old peers)
     chunk_crc: int = -1
+    #: transfer identity, echoed in the result so the leader can reject
+    #: stragglers from an abandoned (timed-out) transfer
+    token: Any = None
 
 
 @dataclass(frozen=True)
@@ -270,6 +273,7 @@ class InstallSnapshotResult:
     last_index: int
     last_term: int
     from_: ServerId = None
+    token: Any = None  # echoes InstallSnapshotRpc.token
 
 
 @dataclass(frozen=True)
@@ -548,6 +552,7 @@ class SendSnapshot:
 
     to: ServerId
     id_term: tuple  # (leader_id, term)
+    token: Any = None  # transfer identity (stamped on the peer)
 
 
 @dataclass(frozen=True)

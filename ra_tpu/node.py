@@ -33,6 +33,7 @@ from typing import Any, Callable, Optional
 from .core.machine import Machine
 from .core.server import RaServer
 from .core.types import (
+    AuxCommandEvent,
     AuxEffect,
     CancelElectionTimeout,
     Checkpoint,
@@ -167,8 +168,10 @@ class ServerShell:
         self.node = node
         self.inbox: deque = deque()
         self.low_queue: deque = deque()  # low-priority commands awaiting flush
-        # pids the machine asked to monitor (ra_monitors component=machine)
+        # pids the machine/aux asked to monitor, by component
+        # (ra_monitors.erl per-component multiplexing)
         self.machine_monitors: set = set()
+        self.aux_monitors: set = set()
         self.election_deadline: Optional[float] = None
         self.tick_deadline: float = time.monotonic() + \
             server.cfg.tick_interval_ms / 1000.0
@@ -326,10 +329,19 @@ class RaNode:
         Monitor effects and a demoted leader clears its set — so exactly
         one member appends the command."""
         for shell in list(self.shells.values()):
-            if not shell.stopped and pid in shell.machine_monitors:
+            if shell.stopped:
+                continue
+            if pid in shell.machine_monitors:
                 shell.machine_monitors.discard(pid)
                 shell.inbox.append(CommandEvent(
                     UserCommand(("down", pid, reason)), from_=None))
+            if pid in shell.aux_monitors:
+                # aux branch of handle_down (ra_server.erl): the aux
+                # handler sees the down directly, no log entry.  Routed
+                # through the inbox so the (unsynchronized) RaServer is
+                # only ever touched by the event-loop thread.
+                shell.aux_monitors.discard(pid)
+                shell.inbox.append(AuxCommandEvent(("down", pid, reason)))
         self._wake.set()
 
     def stop(self) -> None:
@@ -575,12 +587,21 @@ class RaNode:
             elif isinstance(eff, AuxEffect):
                 self._execute(shell, server.handle_aux("eval", eff.msg))
             elif isinstance(eff, Monitor):
-                if eff.component == "machine" and eff.kind == "process":
-                    shell.machine_monitors.add(eff.target)
-                # node/peer monitoring is subsumed by the failure detector
+                # per-component multiplexing (ra_monitors.erl:34-56):
+                # machine monitors feed the machine a {down,..} command,
+                # aux monitors feed handle_aux; node/peer monitoring is
+                # subsumed by the transport failure detector
+                if eff.kind == "process":
+                    if eff.component == "machine":
+                        shell.machine_monitors.add(eff.target)
+                    elif eff.component == "aux":
+                        shell.aux_monitors.add(eff.target)
             elif isinstance(eff, Demonitor):
-                if eff.component == "machine" and eff.kind == "process":
-                    shell.machine_monitors.discard(eff.target)
+                if eff.kind == "process":
+                    if eff.component == "machine":
+                        shell.machine_monitors.discard(eff.target)
+                    elif eff.component == "aux":
+                        shell.aux_monitors.discard(eff.target)
             elif isinstance(eff, GarbageCollection):
                 self.counters.incr(server.cfg.uid, "forced_gcs")
             elif isinstance(eff, TimerEffect):
@@ -617,7 +638,8 @@ class RaNode:
                                                 chunk_number=i + 1,
                                                 chunk_flag=flag,
                                                 data=piece,
-                                                chunk_crc=zlib.crc32(piece)))
+                                                chunk_crc=zlib.crc32(piece),
+                                                token=eff.token))
 
     # -- introspection -------------------------------------------------------
 

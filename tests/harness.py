@@ -130,7 +130,8 @@ class SimCluster:
             self._send(src, eff.to,
                        InstallSnapshotRpc(term=term, leader_id=leader_id,
                                           meta=meta, chunk_number=i + 1,
-                                          chunk_flag=flag, data=chunk))
+                                          chunk_flag=flag, data=chunk,
+                                          token=eff.token))
 
     def step(self) -> bool:
         """Deliver one pending message (round-robin across servers)."""

@@ -216,3 +216,82 @@ def test_partition_and_heal(fabric):
         time.sleep(0.02)
     assert st.reply == 3
     assert ra_tpu.key_metrics(leader, router=router)["state"] == "follower"
+
+
+def test_stuck_snapshot_send_retries_after_timeout(fabric):
+    """A lost install_snapshot result must not wedge the peer in
+    SENDING_SNAPSHOT forever: the leader's tick resets stale transfers
+    (the snapshot_sender DOWN recovery, ra_server.erl handle_down)."""
+    import time as _t
+
+    from ra_tpu.core.server import RaServer
+    from ra_tpu.core.types import PeerStatus
+
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("snapstuck", counter_factory, sids, router=router)
+    leader = await_leader(router, sids)
+    lnode = router.nodes[leader.node]
+    srv = lnode.shells[leader.name].server
+    victim = [s for s in sids if s != leader][0]
+    peer = srv.cluster[victim]
+    # wedge the peer as if a snapshot send's ack was lost long ago
+    peer.status = PeerStatus.SENDING_SNAPSHOT
+    peer.snapshot_started = _t.monotonic() - RaServer.SNAPSHOT_SEND_TIMEOUT_S - 1
+    before = ra_tpu.process_command(leader, 4, router=router)
+    deadline = _t.monotonic() + 10
+    while _t.monotonic() < deadline:
+        if peer.status != PeerStatus.SENDING_SNAPSHOT:
+            break
+        _t.sleep(0.05)
+    assert peer.status != PeerStatus.SENDING_SNAPSHOT
+    # and the previously wedged member converges again
+    vshell = router.nodes[victim.node].shells[victim.name]
+    deadline = _t.monotonic() + 10
+    while _t.monotonic() < deadline:
+        if vshell.server.machine_state == before.reply:
+            break
+        _t.sleep(0.05)
+    assert vshell.server.machine_state == before.reply
+
+
+def test_aux_monitor_down_routes_to_handle_aux(fabric):
+    """ra_monitors component multiplexing: an aux-component monitor's
+    DOWN goes to handle_aux, not the machine command path."""
+    from ra_tpu.core.machine import Machine
+    from ra_tpu.core.types import Monitor
+
+    downs = []
+
+    class AuxMon(Machine):
+        def init(self, config):
+            return 0
+
+        def apply(self, meta, command, state):
+            if command == "watch":
+                return state, "ok", [Monitor("process", "extproc",
+                                             component="aux")]
+            return state + command, state + command
+
+        def handle_aux(self, raft_state, kind, msg, aux, internal):
+            if isinstance(msg, tuple) and msg and msg[0] == "down":
+                downs.append(msg)
+            return aux, []
+
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("auxmon", AuxMon, sids, router=router)
+    leader = await_leader(router, sids)
+    ra_tpu.process_command(leader, "watch", router=router)
+    lnode = router.nodes[leader.node]
+    import time as _t
+    deadline = _t.monotonic() + 5
+    while _t.monotonic() < deadline:
+        if "extproc" in lnode.shells[leader.name].aux_monitors:
+            break
+        _t.sleep(0.02)
+    lnode.process_down("extproc", "killed")
+    deadline = _t.monotonic() + 5
+    while _t.monotonic() < deadline and not downs:
+        _t.sleep(0.02)
+    assert downs and downs[0] == ("down", "extproc", "killed"), downs
