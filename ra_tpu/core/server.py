@@ -1782,8 +1782,12 @@ def _wal_up_predicate(event: Any, server: "RaServer") -> bool:
 
 _FOLLOWER_SAFE_EFFECTS = (ReleaseCursor, Checkpoint, AuxEffect,
                           GarbageCollection, SendMsg, LogReadEffect, Monitor,
-                          TimerEffect, Reply, SendRpc, StartElectionTimeout,
+                          Reply, SendRpc, StartElectionTimeout,
                           NextEvent, Notify)
+# NB: TimerEffect is NOT follower-safe — machine timers are armed by the
+# leader only (they are absent from the keep-list of
+# filter_follower_effects, ra_server.erl:1817-1860); the shell
+# additionally drops an expiry that races a leadership loss.
 
 
 def _filter_follower_effects(effects: list) -> list:

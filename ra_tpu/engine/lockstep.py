@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import trace
 from ..core.machine import JitMachine
 from ..ops.quorum import (election_quorum, evaluate_quorum, pipeline_credit,
                           query_quorum, update_match_next)
@@ -590,17 +591,21 @@ class LockstepEngine:
         query = self._zero_elect if query_mask is None \
             else jnp.asarray(query_mask)
         if self._dur is None:
-            self.state, _ = self._step(self.state, jnp.asarray(n_new),
-                                       jnp.asarray(payloads), fail, elect,
-                                       self._zero_confirm, query)
+            with trace.span("engine.step", "engine"):
+                self.state, _ = self._step(self.state, jnp.asarray(n_new),
+                                           jnp.asarray(payloads), fail,
+                                           elect, self._zero_confirm, query)
             return
-        self._dur.backpressure()
+        with trace.span("engine.backpressure", "engine"):
+            self._dur.backpressure()
         payload_host = np.asarray(payloads)
         confirm = jnp.asarray(self._dur.confirm_upto)
-        self.state, aux = self._step(self.state, jnp.asarray(n_new),
-                                     jnp.asarray(payloads), fail, elect,
-                                     confirm, query)
-        self._dur.submit(aux, payload_host)
+        with trace.span("engine.step", "engine", durable=True):
+            self.state, aux = self._step(self.state, jnp.asarray(n_new),
+                                         jnp.asarray(payloads), fail, elect,
+                                         confirm, query)
+        with trace.span("engine.wal_submit", "engine"):
+            self._dur.submit(aux, payload_host)
         if elect_mask is not None and np.asarray(elect_mask).any():
             # elections truncate+reuse indexes: drain now so the next
             # dispatch reads a confirm horizon clamped at the new base
@@ -639,7 +644,19 @@ class LockstepEngine:
         machine state and cursors are copied from the leader's replica.
         A failed member's apply frontier freezes while it is down (the
         apply fold reads a lane-uniform window), so rejoin is always by
-        snapshot rather than ring replay."""
+        snapshot rather than ring replay.
+
+        Recovering the lane's CURRENT leader slot is refused: the install
+        would seed the leader from its own stale applied frontier,
+        truncating its durable tail — including entries the rest of the
+        lane committed while it was down (a §5.4 violation).  Revive the
+        other members first, ``trigger_election`` (the longest durable
+        log wins, as a restarting reference leader would), then recover
+        the deposed slot from the new leader."""
+        if int(self.state.leader_slot[lane]) == slot:
+            raise ValueError(
+                f"slot {slot} is lane {lane}'s leader; recover the other "
+                "members, trigger_election, then recover this slot")
         self._fail_host[lane, slot] = False
         self.state = self._snapshot_install(lane, slot)
 

@@ -470,12 +470,22 @@ class DurableLog:
                             self.counters["write_resends"] += 1
                             self.wal.write(self.uid, idx, ent[0], raw)
                     return
-            term = self.fetch_term(evt.to_index)
+            if evt.from_index > self._last_index:
+                # reverted below the whole range (explicit reset or
+                # snapshot install raced the WAL): stale, drop
+                # (ra_log.erl:474-481)
+                return
+            # clamp the confirm to the current tail BEFORE the term
+            # check (ra_log.erl:495 ToIdx = min(ToIdx0, LastIdx)): a
+            # coalesced batch confirm can cover an overwritten suffix
+            # while its surviving prefix is genuinely durable
+            to = min(evt.to_index, self._last_index)
+            term = self.fetch_term(to)
             if term == evt.term:
-                if evt.to_index > self._last_written.index:
-                    self._last_written = IdxTerm(evt.to_index, evt.term)
+                if to > self._last_written.index:
+                    self._last_written = IdxTerm(to, term)
             elif term is None and self._snapshot is not None and \
-                    self._snapshot[0].index >= evt.to_index:
+                    self._snapshot[0].index >= to:
                 pass  # truncated by snapshot: subsumed
             # else: stale confirm for an overwritten term — ignored; the
             # rewrite is already queued to the WAL

@@ -36,6 +36,7 @@ import struct
 import threading
 from typing import Callable, Optional
 
+from .. import trace
 from ..native import IO
 
 MAGIC = b"RTW2"
@@ -284,7 +285,8 @@ class Wal:
             # supervisor restarts the WAL and writers resend, the same
             # let-it-crash shape as the reference's ra_log_wal under
             # ra_log_wal_sup (ra_log_sup.erl:26-51)
-            self._write_batch(batch)
+            with trace.span("wal.batch", "wal", n=len(batch)):
+                self._write_batch(batch)
 
     def kill(self) -> None:
         """Simulate a WAL crash (tests / fault injection)."""

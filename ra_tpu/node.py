@@ -57,6 +57,7 @@ from .core.types import (
     RecordLeader,
     ReleaseCursor,
     Reply,
+    ReplyMode,
     SendMsg,
     SendRpc,
     SendSnapshot,
@@ -172,6 +173,10 @@ class ServerShell:
         # (ra_monitors.erl per-component multiplexing)
         self.machine_monitors: set = set()
         self.aux_monitors: set = set()
+        #: machine {timer, Name, T} effects: name -> (deadline, msg)
+        #: (ra_server_proc.erl:1549-1550; expiry appends a '{timeout,
+        #: Name}' command on the leader, :556-560)
+        self.machine_timers: dict = {}
         self.election_deadline: Optional[float] = None
         self.tick_deadline: float = time.monotonic() + \
             server.cfg.tick_interval_ms / 1000.0
@@ -440,6 +445,20 @@ class RaNode:
             shell.election_deadline = None
             self._handle(shell, ElectionTimeout())
             busy = True
+        # machine timers: on expiry the LEADER routes a '{timeout, Name}'
+        # command through consensus so every replica's machine sees it
+        # (ra_server_proc.erl:556-560); non-leaders drop the expiry — the
+        # lane leader owns machine time
+        if shell.machine_timers:
+            due = [n for n, (dl, _m) in shell.machine_timers.items()
+                   if now >= dl]
+            for name in due:
+                _dl, msg = shell.machine_timers.pop(name)
+                if shell.server.raft_state == RaftState.LEADER:
+                    data = msg if msg is not None else ("timeout", name)
+                    self._handle(shell, CommandEvent(
+                        UserCommand(data, reply_mode=ReplyMode.NOREPLY)))
+                    busy = True
         if now >= shell.tick_deadline:
             shell.tick_deadline = now + \
                 shell.server.cfg.tick_interval_ms / 1000.0
@@ -605,7 +624,13 @@ class RaNode:
             elif isinstance(eff, GarbageCollection):
                 self.counters.incr(server.cfg.uid, "forced_gcs")
             elif isinstance(eff, TimerEffect):
-                pass  # machine timers: not yet surfaced to machines
+                # {timer, Name, T}: arm/cancel a named machine timer
+                # (ra_server_proc.erl:1549-1550); ms=None cancels
+                if eff.ms is None:
+                    shell.machine_timers.pop(eff.name, None)
+                else:
+                    shell.machine_timers[eff.name] = (
+                        time.monotonic() + eff.ms / 1000.0, eff.msg)
             # unknown machine effects are ignored (forward compat)
 
     def _arm_election(self, shell: ServerShell, kind: str) -> None:

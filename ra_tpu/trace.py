@@ -1,0 +1,166 @@
+"""Tracing / profiling hooks (SURVEY.md §5 "tracing/profiling").
+
+The reference keeps profiling out of the hot path: a swappable logger in
+persistent_term (ra.hrl:206-228) plus commented-out looking_glass flame
+hooks in ra_bench (ra_bench.erl:199-212).  This module is the tpu-native
+equivalent, with the same always-off-by-default contract:
+
+* a process-wide swappable :class:`Tracer` (``set_tracer`` /
+  ``get_tracer``) — the persistent_term '$ra_logger' pattern;
+* span recording into a bounded in-memory buffer, dumped as Chrome
+  trace-event JSON (chrome://tracing / perfetto load it directly) —
+  the flame-graph role of the lg hooks;
+* :func:`jax_profile`, wrapping ``jax.profiler.trace`` so a bench run
+  can capture an XLA/TPU timeline (the device-side callgrind);
+* when no tracer is installed the instrumentation cost is one module
+  attribute read + an ``is None`` test per site.
+
+Instrumented sites: the lane engine's step dispatch / durability bridge
+(ra_tpu.engine), the WAL batch loop (ra_tpu.log.wal), and anything user
+code wraps via ``trace.span``.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+#: the installed tracer, or None (tracing disabled).  Module attribute on
+#: purpose: instrumented call sites read it once per operation.
+_tracer: Optional["Tracer"] = None
+
+
+def set_tracer(tracer: Optional["Tracer"]) -> None:
+    """Install (or, with None, remove) the process-wide tracer."""
+    global _tracer
+    _tracer = tracer
+
+
+def get_tracer() -> Optional["Tracer"]:
+    return _tracer
+
+
+class Tracer:
+    """Bounded in-memory span/counter recorder.
+
+    Spans nest freely across threads (thread id becomes the Chrome
+    ``tid``); the buffer is a ring of ``capacity`` events — tracing a
+    long bench keeps the newest events instead of growing unboundedly.
+    """
+
+    def __init__(self, capacity: int = 200_000) -> None:
+        self.capacity = capacity
+        self._events: list = []
+        self._head = 0          # ring cursor once the buffer is full
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    # -- recording ---------------------------------------------------------
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _push(self, evt: dict) -> None:
+        with self._lock:
+            if len(self._events) < self.capacity:
+                self._events.append(evt)
+            else:
+                self._events[self._head] = evt
+                self._head = (self._head + 1) % self.capacity
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "ra", **args: Any) -> Iterator[None]:
+        """Record a complete ("ph":"X") span around the with-body."""
+        start = self._now_us()
+        try:
+            yield
+        finally:
+            self._push({"name": name, "cat": cat, "ph": "X",
+                        "ts": start, "dur": self._now_us() - start,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() & 0xFFFF,
+                        **({"args": args} if args else {})})
+
+    def instant(self, name: str, cat: str = "ra", **args: Any) -> None:
+        self._push({"name": name, "cat": cat, "ph": "i", "s": "t",
+                    "ts": self._now_us(), "pid": os.getpid(),
+                    "tid": threading.get_ident() & 0xFFFF,
+                    **({"args": args} if args else {})})
+
+    def counter(self, name: str, **values: float) -> None:
+        self._push({"name": name, "ph": "C", "ts": self._now_us(),
+                    "pid": os.getpid(), "tid": 0, "args": values})
+
+    # -- readout -----------------------------------------------------------
+
+    def events(self) -> list:
+        with self._lock:
+            if len(self._events) < self.capacity:
+                return list(self._events)
+            return (self._events[self._head:] + self._events[:self._head])
+
+    def dump_chrome_trace(self, path: str) -> str:
+        """Write the buffer as Chrome trace-event JSON (atomic replace);
+        load in chrome://tracing or ui.perfetto.dev."""
+        payload = {"traceEvents": self.events(),
+                   "displayTimeUnit": "ms"}
+        tmp = path + ".partial"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    def summary(self) -> dict:
+        """Per-span-name {count, total_us, max_us} rollup — the quick
+        console profile when a full timeline is overkill."""
+        out: dict[str, dict] = {}
+        for e in self.events():
+            if e.get("ph") != "X":
+                continue
+            s = out.setdefault(e["name"],
+                               {"count": 0, "total_us": 0.0, "max_us": 0.0})
+            s["count"] += 1
+            s["total_us"] += e["dur"]
+            s["max_us"] = max(s["max_us"], e["dur"])
+        return out
+
+
+# -- zero-overhead instrumentation helper -----------------------------------
+
+#: shared no-op context (nullcontext is documented reentrant+reusable):
+#: the disabled path allocates nothing per call
+_NULL = contextlib.nullcontext()
+
+
+def span(name: str, cat: str = "ra", **args: Any):
+    """Span against the installed tracer, or a shared no-op context when
+    tracing is off (one attribute read + None test + the call itself)."""
+    t = _tracer
+    if t is None:
+        return _NULL
+    return t.span(name, cat, **args)
+
+
+def instant(name: str, cat: str = "ra", **args: Any) -> None:
+    t = _tracer
+    if t is not None:
+        t.instant(name, cat, **args)
+
+
+# -- device-side profiling ---------------------------------------------------
+
+@contextlib.contextmanager
+def jax_profile(log_dir: str) -> Iterator[None]:
+    """Capture an XLA profiler trace (TensorBoard/XProf format) around
+    the with-body — the device-timeline analogue of the reference's
+    looking_glass hooks (ra_bench.erl:199-212).  Requires a live jax
+    backend; safe to nest around engine steps."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

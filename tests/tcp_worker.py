@@ -68,6 +68,11 @@ def worker_main(node_name, port_map, cmd_q, res_q, machine_kind="counter",
     if node_name not in extra_members:
         node.start_server(cfg)
 
+    # readiness handshake: jax import + router bind + server recovery can
+    # take tens of seconds on a loaded single-core box — the driver must
+    # not start asking (or electing) until every worker is actually up
+    res_q.put(("ready", node_name))
+
     while True:
         cmd = cmd_q.get()
         op = cmd[0]

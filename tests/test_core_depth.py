@@ -1,0 +1,366 @@
+"""Core-server depth suite — the ra_server_SUITE cases not yet covered
+by test_core_elections / test_core_replication
+(/root/reference/test/ra_server_SUITE.erl): unknown-peer hygiene, stale
+reply handling, candidate/leader RPC edge cases, snapshot-install
+interruptions and stale installs, membership (leave/rejoin/promote,
+leader removal), recovery of cluster changes, and the heartbeat state
+matrix across raft states.
+"""
+from harness import SimCluster
+
+from ra_tpu.core.server import RaServer
+from ra_tpu.core.types import (
+    AppendEntriesReply,
+    AppendEntriesRpc,
+    CommandEvent,
+    ElectionTimeout,
+    Entry,
+    HeartbeatReply,
+    HeartbeatRpc,
+    InstallSnapshotResult,
+    InstallSnapshotRpc,
+    JoinCommand,
+    LeaveCommand,
+    Membership,
+    PreVoteRpc,
+    RequestVoteRpc,
+    RequestVoteResult,
+    SendRpc,
+    ServerConfig,
+    ServerId,
+    SnapshotMeta,
+    UserCommand,
+)
+
+UNKNOWN = ServerId("ghost", "nodeX")
+
+
+# -- unknown-peer hygiene ---------------------------------------------------
+
+def test_aer_reply_from_unknown_peer_ignored():
+    """append_entries_reply_no_success_from_unknown_peer: replies from
+    peers outside the cluster must not touch any state."""
+    c = SimCluster(3)
+    s1 = c.ids[0]
+    c.elect(s1)
+    leader = c.servers[s1]
+    commit0 = leader.commit_index
+    matches0 = {pid: p.match_index for pid, p in leader.cluster.items()}
+    for success in (True, False):
+        effs = leader.handle(AppendEntriesReply(
+            term=leader.current_term, success=success, next_index=99,
+            last_index=98, last_term=leader.current_term, from_=UNKNOWN))
+        assert effs == []
+    assert leader.commit_index == commit0
+    assert {pid: p.match_index
+            for pid, p in leader.cluster.items()} == matches0
+
+
+def test_leader_does_not_abdicate_to_unknown_peer():
+    """A higher-term vote request from outside the cluster is dropped:
+    the leader neither adopts the term nor steps down."""
+    c = SimCluster(3)
+    s1 = c.ids[0]
+    c.elect(s1)
+    leader = c.servers[s1]
+    term0 = leader.current_term
+    effs = leader.handle(RequestVoteRpc(
+        term=term0 + 5, candidate_id=UNKNOWN,
+        last_log_index=100, last_log_term=term0 + 5))
+    assert effs == []
+    assert leader.raft_state.value == "leader"
+    assert leader.current_term == term0
+    effs = leader.handle(PreVoteRpc(
+        term=term0 + 5, token=object(), candidate_id=UNKNOWN, version=1,
+        machine_version=0, last_log_index=100, last_log_term=term0 + 5))
+    assert effs == []
+    assert leader.raft_state.value == "leader"
+
+
+def test_leader_denies_same_term_vote_and_reasserts_on_pre_vote():
+    """request_vote_rpc_with_lower_term + leader_receives_pre_vote: a
+    known peer's same-term vote request is denied; a same-term pre-vote
+    makes the leader re-assert leadership with fresh AERs."""
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    leader = c.servers[s1]
+    effs = leader.handle(RequestVoteRpc(
+        term=leader.current_term, candidate_id=s2,
+        last_log_index=0, last_log_term=0))
+    denies = [e.msg for e in effs if isinstance(e, SendRpc)]
+    assert denies and isinstance(denies[0], RequestVoteResult)
+    assert not denies[0].vote_granted
+    effs = leader.handle(PreVoteRpc(
+        term=leader.current_term, token=object(), candidate_id=s2,
+        version=1, machine_version=0, last_log_index=0, last_log_term=0))
+    aers = [e.msg for e in effs if isinstance(e, SendRpc)
+            and isinstance(e.msg, AppendEntriesRpc)]
+    assert len(aers) == 2  # leadership enforced toward both peers
+    assert leader.raft_state.value == "leader"
+
+
+# -- stale replies ----------------------------------------------------------
+
+def test_stale_success_reply_does_not_regress_match():
+    """leader_received_append_entries_reply_with_stale_last_index: a
+    success reply older than the peer's recorded match is a no-regress
+    max() merge."""
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    for v in (1, 2, 3):
+        c.command(s1, v)
+    leader = c.servers[s1]
+    match0 = leader.cluster[s2].match_index
+    assert match0 >= 4
+    leader.handle(AppendEntriesReply(
+        term=leader.current_term, success=True, next_index=2,
+        last_index=1, last_term=1, from_=s2))
+    assert leader.cluster[s2].match_index == match0
+    assert leader.cluster[s2].next_index >= match0 + 1
+
+
+def test_candidate_steps_down_on_current_term_aer():
+    """candidate_handles_append_entries_rpc: an AER at the candidate's
+    own term proves a leader exists — revert to follower, process it."""
+    from ra_tpu.core.types import RaftState
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    leader = c.servers[s1]
+    srv2 = c.servers[s2]
+    term = leader.current_term
+    srv2.current_term = term
+    srv2.raft_state = RaftState.CANDIDATE
+    effs = srv2.handle(AppendEntriesRpc(
+        term=term, leader_id=s1, prev_log_index=0, prev_log_term=0,
+        leader_commit=0, entries=()))
+    assert srv2.raft_state.value in ("follower", "await_condition")
+    assert srv2.current_term == term
+
+
+# -- snapshot installs ------------------------------------------------------
+
+def snap_meta(idx, term, cluster_ids, mv=0):
+    return SnapshotMeta(index=idx, term=term,
+                        cluster=tuple((sid, Membership.VOTER)
+                                      for sid in cluster_ids),
+                        machine_version=mv)
+
+
+def test_follower_stale_snapshot_confirms_progress():
+    """follower_receives_stale_snapshot: an install at or below the
+    follower's applied index is answered with its own progress, no state
+    change."""
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    for v in (1, 2, 3):
+        c.command(s1, v)
+    srv2 = c.servers[s2]
+    last = srv2.log.last_index_term()
+    effs = srv2.handle(InstallSnapshotRpc(
+        term=srv2.current_term, leader_id=s1,
+        meta=snap_meta(1, 1, c.ids), chunk_number=1, chunk_flag="last",
+        data=b"", token="tkn"))
+    results = [e.msg for e in effs if isinstance(e, SendRpc)]
+    assert results and isinstance(results[0], InstallSnapshotResult)
+    assert results[0].last_index == last.index
+    assert results[0].token == "tkn"
+    assert srv2.raft_state.value == "follower"
+    assert srv2.log.last_index_term() == last
+
+
+def test_receive_snapshot_interrupted_by_aer():
+    """receive_snapshot_new_leader_aer: an AER at >= term aborts the
+    in-flight chunk stream and the entries are processed as follower."""
+    c = SimCluster(3, snapshot_chunk_size=4)
+    s1, s2, s3 = c.ids
+    srv3 = c.servers[s3]
+    meta = snap_meta(10, 1, c.ids)
+    effs = srv3.handle(InstallSnapshotRpc(
+        term=1, leader_id=s1, meta=meta, chunk_number=1,
+        chunk_flag="next", data=b"abcd", token="t1"))
+    # NextEvent(rpc) re-enters in receive_snapshot and acks the chunk
+    assert srv3.raft_state.value == "receive_snapshot" or any(
+        hasattr(e, "event") for e in effs)
+    c._process_effects(s3, effs)
+    assert srv3.raft_state.value == "receive_snapshot"
+    entries = tuple(Entry(i, 2, UserCommand(i)) for i in range(1, 4))
+    effs = srv3.handle(AppendEntriesRpc(
+        term=2, leader_id=s2, prev_log_index=0, prev_log_term=0,
+        leader_commit=3, entries=entries))
+    c._process_effects(s3, effs)
+    assert srv3.raft_state.value == "follower"
+    assert srv3.log.last_index_term().index == 3
+    assert srv3._accepting_snapshot is None
+
+
+def test_snapshotted_follower_accepts_following_appends():
+    """snapshotted_follower_received_append_entries: after a completed
+    install, an AER whose prev point is the snapshot index appends."""
+    c = SimCluster(3)
+    s1, s2, s3 = c.ids
+    srv3 = c.servers[s3]
+    meta = snap_meta(10, 1, c.ids)
+    data = srv3.log.snapshot_module.encode(55)
+    effs = srv3.handle(InstallSnapshotRpc(
+        term=1, leader_id=s1, meta=meta, chunk_number=1,
+        chunk_flag="last", data=data, token="t2"))
+    c._process_effects(s3, effs)
+    assert srv3.raft_state.value == "follower"
+    assert srv3.last_applied == 10
+    assert srv3.machine_state == 55
+    effs = srv3.handle(AppendEntriesRpc(
+        term=1, leader_id=s1, prev_log_index=10, prev_log_term=1,
+        leader_commit=10, entries=(Entry(11, 1, UserCommand(7)),)))
+    assert srv3.log.last_index_term().index == 11
+
+
+# -- membership -------------------------------------------------------------
+
+def test_leader_steps_down_when_removed():
+    """leader_is_removed: committing its own '$ra_leave' terminates the
+    leader once the rest of the cluster has the change."""
+    c = SimCluster(3)
+    s1 = c.ids[0]
+    c.elect(s1)
+    leader = c.servers[s1]
+    c.handle(s1, CommandEvent(LeaveCommand(s1)))
+    c.run()
+    assert s1 not in leader.cluster
+    assert leader.raft_state.value in ("stop", "terminating_leader")
+
+
+def test_rejoined_promotable_member_is_auto_promoted():
+    """append_entries_reply_success_promotes_nonvoter +
+    leader_server_join_nonvoter: a promotable nonvoter counts toward no
+    quorum until its match reaches the promote target, then the leader
+    appends the promotion cluster change."""
+    c = SimCluster(4)
+    s1, s2, s3, s4 = c.ids
+    c.elect(s1)
+    leader = c.servers[s1]
+    c.handle(s1, CommandEvent(LeaveCommand(s4)))
+    c.run()
+    assert s4 not in leader.cluster
+    for v in (1, 2):
+        c.command(s1, v)
+    # feed the join directly (no pump yet): the cluster change takes
+    # effect on append, so the nonvoter state is observable here
+    effs = leader.handle(CommandEvent(JoinCommand(
+        s4, membership=Membership.PROMOTABLE)))
+    assert leader.cluster[s4].membership == Membership.PROMOTABLE
+    assert leader.cluster[s4].promote_target > 0
+    c._process_effects(s1, effs)
+    c._drain_log_events(s1)
+    c.run()   # deliveries catch s4 up; the auto-promotion change lands
+    for v in (3, 4):
+        c.command(s1, v)
+    c.run()
+    assert leader.cluster[s4].membership == Membership.VOTER
+    states = c.machine_states()
+    assert states[s4] == states[s1] == 1 + 2 + 3 + 4
+
+
+def test_recover_restores_cluster_changes():
+    """recover_restores_cluster_changes: a restarted server replays the
+    log and ends with the changed membership, not the seed config."""
+    c = SimCluster(3)
+    s1, s2, s3 = c.ids
+    c.elect(s1)
+    c.handle(s1, CommandEvent(LeaveCommand(s3)))
+    c.run()
+    leader = c.servers[s1]
+    assert set(leader.cluster) == {s1, s2}
+    # rebuild the leader's server over the SAME log object
+    cfg = ServerConfig(server_id=s1, uid="uid_s1_rebuilt",
+                      cluster_name="simcluster",
+                      initial_members=tuple(c.ids),
+                      machine=leader.cfg.machine)
+    srv = RaServer(cfg, leader.log)
+    srv.recover()
+    assert set(srv.cluster) == {s1, s2}, \
+        "recovery must re-apply the committed '$ra_leave'"
+
+
+# -- heartbeat state matrix -------------------------------------------------
+
+def test_follower_heartbeat_updates_query_index_and_replies():
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    srv2 = c.servers[s2]
+    term = srv2.current_term
+    effs = srv2.handle(HeartbeatRpc(query_index=7, term=term,
+                                    leader_id=s1))
+    assert srv2.query_index >= 7
+    replies = [e.msg for e in effs if isinstance(e, SendRpc)]
+    assert replies and isinstance(replies[0], HeartbeatReply)
+    assert replies[0].query_index >= 7
+    assert replies[0].term == term
+
+
+def test_follower_heartbeat_lower_term_still_replies_current():
+    """A stale leader's heartbeat gets a reply carrying OUR term so it
+    steps down (leader_heartbeat_reply_higher_term on its side)."""
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    srv2 = c.servers[s2]
+    term = srv2.current_term
+    qi0 = srv2.query_index
+    effs = srv2.handle(HeartbeatRpc(query_index=99, term=term - 1,
+                                    leader_id=s1))
+    assert srv2.query_index == qi0          # stale rpc: no qidx adoption
+    replies = [e.msg for e in effs if isinstance(e, SendRpc)]
+    assert replies and replies[0].term == term
+
+
+def test_leader_steps_down_on_higher_term_heartbeat_reply():
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    leader = c.servers[s1]
+    term = leader.current_term
+    leader.handle(HeartbeatReply(query_index=0, term=term + 3, from_=s2))
+    assert leader.raft_state.value == "follower"
+    assert leader.current_term == term + 3
+
+
+def test_candidate_heartbeat_same_term_steps_down():
+    """candidate_heartbeat: a heartbeat at the candidate's term proves a
+    live leader; the candidate reverts and answers it."""
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    leader = c.servers[s1]
+    srv2 = c.servers[s2]
+    srv2.current_term = leader.current_term
+    srv2.raft_state = type(srv2.raft_state).CANDIDATE
+    effs = srv2.handle(HeartbeatRpc(query_index=3,
+                                    term=leader.current_term,
+                                    leader_id=s1))
+    c._process_effects(s2, effs)
+    assert srv2.raft_state.value == "follower"
+    assert srv2.query_index >= 3
+
+
+def test_pre_vote_state_heartbeat_steps_back_to_follower():
+    """pre_vote_heartbeat: same-or-higher-term heartbeat during a
+    pre-vote round cancels the candidacy."""
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    leader = c.servers[s1]
+    srv2 = c.servers[s2]
+    c.isolate(s2)
+    srv2.handle(ElectionTimeout())      # enters pre_vote
+    assert srv2.raft_state.value == "pre_vote"
+    c.heal()
+    effs = srv2.handle(HeartbeatRpc(query_index=1,
+                                    term=leader.current_term,
+                                    leader_id=s1))
+    c._process_effects(s2, effs)
+    assert srv2.raft_state.value == "follower"

@@ -1,0 +1,281 @@
+"""Second durable-log depth suite — the ra_log_2_SUITE scenarios not yet
+covered by test_durable_log.py (/root/reference/test/ra_log_2_SUITE.erl):
+sparse reads incl. out-of-range, overlapped-write read validation,
+last_index resets before/after written confirms, writes below the
+snapshot index, reads across segment updates, WAL-down read
+availability, recovery with missing auxiliary directories, and snapshot
+metadata round-trips (machine_version through release_cursor).
+"""
+import os
+import pickle
+import shutil
+import time
+
+import pytest
+
+from ra_tpu.core.types import Entry, SnapshotMeta, UserCommand
+from ra_tpu.system import RaSystem
+
+from test_durable_log import drain, mk_log, mk_system
+
+
+def put(log, lo, hi, term, val=None):
+    for i in range(lo, hi + 1):
+        log.append(Entry(i, term, UserCommand(val if val is not None
+                                              else i)))
+
+
+def overwrite(log, lo, hi, term, val=None):
+    """Truncating write — the follower AER path (ra_log:write)."""
+    log.write([Entry(i, term, UserCommand(val if val is not None else i))
+               for i in range(lo, hi + 1)])
+
+
+# -- sparse reads -----------------------------------------------------------
+
+def test_sparse_read_across_tiers(tmp_path):
+    """sparse_read resolves each index through memtable/segments alike
+    (ra_log_2_SUITE:sparse_read), preserving request order."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 100, 1)
+    drain(log)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()          # 1..100 now in segments
+    put(log, 101, 120, 1)
+    drain(log)                                 # 101..120 in the memtable
+    idxs = [3, 115, 42, 101, 100, 7]
+    got = log.sparse_read(idxs)
+    assert [e.index for e in got] == idxs
+    assert [e.command.data for e in got] == idxs
+    sys_.close()
+
+
+def test_sparse_read_out_of_range(tmp_path):
+    """Out-of-range indexes are skipped, in-range ones still returned
+    (sparse_read_out_of_range, sparse_read_out_of_range_2)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 20, 1)
+    drain(log)
+    got = log.sparse_read([0, 5, 21, 10, 9999])
+    assert [e.index for e in got] == [5, 10]
+    # truncate the front behind a snapshot, then ask for dropped indexes
+    meta = SnapshotMeta(index=10, term=1, cluster=(), machine_version=0)
+    log.install_snapshot(meta, pickle.dumps({"s": 10}))
+    got = log.sparse_read([5, 10, 15])
+    assert [e.index for e in got] == [15]
+    sys_.close()
+
+
+# -- overwrite / reset semantics -------------------------------------------
+
+def test_reads_for_overlapped_writes(tmp_path):
+    """Write 1..10@t1, overwrite 5..8@t2, extend 9..12@t2: reads and
+    terms must reflect the final log, memtable and recovery alike
+    (validate_reads_for_overlapped_writes)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 10, 1)
+    drain(log)
+    overwrite(log, 5, 8, 2, val=1000)
+    put(log, 9, 12, 2, val=2000)
+    drain(log)
+    assert log.last_index_term() == (12, 2)
+    for i in range(1, 5):
+        assert log.fetch(i).term == 1
+        assert log.fetch(i).command.data == i
+    for i in range(5, 9):
+        assert (log.fetch(i).term, log.fetch(i).command.data) == (2, 1000)
+    for i in range(9, 13):
+        assert (log.fetch(i).term, log.fetch(i).command.data) == (2, 2000)
+    sys_.close()
+    # identical view after recovery (WAL overwrite rule)
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    assert log2.last_index_term() == (12, 2)
+    assert log2.fetch(4).term == 1
+    assert log2.fetch(5).command.data == 1000
+    assert log2.fetch(12).command.data == 2000
+    sys2.close()
+
+
+def test_last_index_reset_after_written(tmp_path):
+    """set_last_index truncates confirmed tail state: last_written falls
+    with it and the next append reuses the indexes (last_index_reset)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 10, 1)
+    drain(log)
+    log.set_last_index(6)
+    assert log.last_index_term() == (6, 1)
+    assert log.last_written().index == 6
+    put(log, 7, 9, 2)
+    drain(log)
+    assert log.last_index_term() == (9, 2)
+    assert log.fetch(8).term == 2
+    sys_.close()
+
+
+def test_last_index_reset_before_written(tmp_path):
+    """Resetting below a not-yet-confirmed tail must not let the stale
+    confirm resurrect it (last_index_reset_before_written)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 10, 1)
+    drain(log)
+    put(log, 11, 20, 1)          # in flight, possibly unconfirmed
+    log.set_last_index(10)       # follower-style revert before confirm
+    for e in log.take_events():
+        log.handle_written(e)    # late confirms for 11..20 arrive now
+    assert log.last_index_term().index == 10
+    assert log.last_written().index <= 10
+    put(log, 11, 12, 3)
+    drain(log)
+    assert log.last_index_term() == (12, 3)
+    assert log.fetch(11).term == 3
+    sys_.close()
+
+
+# -- snapshot interactions --------------------------------------------------
+
+def test_writes_below_snapshot_index_dropped(tmp_path):
+    """After a snapshot install, writes at or below the snapshot index
+    are obsolete — they must not resurface in reads or after recovery
+    (writes_lower_than_snapshot_index_are_dropped)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 30, 1)
+    drain(log)
+    meta = SnapshotMeta(index=20, term=1, cluster=(), machine_version=0)
+    log.install_snapshot(meta, pickle.dumps({"s": 20}))
+    assert log.first_index() == 21
+    # a straggler AER delivers pre-snapshot entries again
+    overwrite(log, 21, 25, 1, val=5555)  # legitimate: above the snapshot
+    drain(log)
+    assert log.fetch(25).command.data == 5555
+    assert log.fetch(20) is None
+    sys_.close()
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    assert log2.first_index() == 21
+    assert log2.fetch(15) is None
+    assert log2.snapshot_index_term() == (20, 1)
+    sys2.close()
+
+
+def test_release_cursor_roundtrips_machine_version(tmp_path):
+    """update_release_cursor persists cluster + machine_version in the
+    snapshot meta; recovery hands both back
+    (update_release_cursor_with_machine_version)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 50, 1)
+    drain(log)
+    cluster = (("s1", "n1"), ("s2", "n2"))
+    log.update_release_cursor(40, cluster, 3, {"acc": 40})
+    assert log.snapshot_index_term() == (40, 1)
+    sys_.close()
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    rec = log2.recover_snapshot_state()
+    assert rec is not None
+    meta, state = rec
+    assert meta.index == 40 and meta.term == 1
+    assert meta.machine_version == 3
+    assert tuple(meta.cluster) == cluster
+    assert state == {"acc": 40}
+    sys2.close()
+
+
+# -- WAL-down availability --------------------------------------------------
+
+def test_wal_down_reads_still_serve(tmp_path):
+    """A dead WAL blocks writes, not reads: everything already written
+    stays readable from memtable and segments
+    (wal_down_read_availability)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 60, 1)
+    drain(log)
+    sys_.wal.kill()
+    assert not log.wal_is_up()
+    assert log.fetch(30).command.data == 30
+    assert [e.index for e in log.sparse_read([1, 59])] == [1, 59]
+    assert log.fold(1, 60, lambda e, acc: acc + 1, 0) == 60
+    sys_.close()
+
+
+# -- recovery robustness ----------------------------------------------------
+
+def test_recovery_with_missing_checkpoints_directory(tmp_path):
+    """Deleting the checkpoints dir offline must not break recovery
+    (recovery_with_missing_checkpoints_directory)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 40, 1)
+    drain(log)
+    log.checkpoint(30, (), 0, {"c": 30})
+    assert log.checkpoint_index() == 30
+    sys_.close()
+    ckpt_dir = None
+    for root, dirs, _files in os.walk(str(tmp_path)):
+        for d in dirs:
+            if d == "checkpoints":
+                ckpt_dir = os.path.join(root, d)
+    assert ckpt_dir is not None
+    shutil.rmtree(ckpt_dir)
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    assert log2.last_index_term().index == 40
+    assert log2.checkpoint_index() == 0
+    assert log2.fetch(40).command.data == 40
+    sys2.close()
+
+
+def test_recovery_with_missing_wal_directory(tmp_path):
+    """Once every entry reached segments, the WAL directory itself is
+    disposable: recovery from segments alone serves the full log
+    (recovery_with_missing_* family — a registered dir may vanish
+    without breaking boot)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 25, 1)
+    drain(log)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    sys_.close()
+    shutil.rmtree(os.path.join(str(tmp_path), "wal"))
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    assert log2.last_index_term().index == 25
+    assert log2.fetch(25).command.data == 25
+    assert log2.fetch(1).command.data == 1
+    sys2.close()
+
+
+def test_updated_segment_can_be_read(tmp_path):
+    """Append, flush, append more into the SAME segment file, flush
+    again: both flush generations stay readable
+    (updated_segment_can_be_read)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 10, 1)
+    drain(log)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    n_seg1 = log.overview()["num_segments"]
+    put(log, 11, 20, 1)
+    drain(log)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    assert log.overview()["num_mem_entries"] == 0
+    for i in (1, 10, 11, 20):
+        assert log.fetch(i).command.data == i
+    # both flushes may share a segment file (append-optimized format)
+    assert log.overview()["num_segments"] >= n_seg1
+    sys_.close()

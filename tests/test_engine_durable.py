@@ -233,6 +233,8 @@ import os, sys, json
 import numpy as np
 sys.path.insert(0, {repo!r})
 os.environ["JAX_PLATFORMS"] = "cpu"
+from ra_tpu.utils import force_platform_from_env
+force_platform_from_env()  # a hung TPU tunnel must not block jax init
 from ra_tpu.engine import open_engine
 from ra_tpu.models import CounterMachine
 
@@ -268,14 +270,33 @@ def test_kill9_recovers_all_reported_commits(tmp_path):
     child = subprocess.Popen(
         [sys.executable, "-c", _CHILD.format(repo=repo), data, report],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env={**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""})
-    # wait for a few reports, then SIGKILL with no warning
-    deadline = time.time() + 120
+        # PYTHONPATH= : the axon site hook must not register a PJRT
+        # plugin whose discovery blocks on a dead tunnel (same guard as
+        # bench.py's CPU fallback)
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+             "PYTHONPATH": ""})
+    # wait for a few reports, then SIGKILL with no warning (generous
+    # deadline: the child pays a fresh jax import + jit compile, minutes
+    # on a loaded single-core box; success path exits long before).
+    # Read the RAW fd: readline alone would block past the deadline, and
+    # select() on the buffered stream misses lines the BufferedReader
+    # already slurped.
+    import select
+    deadline = time.time() + 360
     reports = 0
+    fd = child.stdout.fileno()
+    buf = b""
     while time.time() < deadline and reports < 4:
-        line = child.stdout.readline()
-        if line.startswith("REPORTED"):
-            reports += 1
+        ready, _, _ = select.select([fd], [], [],
+                                    max(0.0, deadline - time.time()))
+        if not ready:
+            break
+        chunk = os.read(fd, 65536)
+        if not chunk:  # EOF: child died early — stderr tells why
+            break
+        buf += chunk
+        reports = sum(1 for line in buf.split(b"\n")[:-1]
+                      if line.startswith(b"REPORTED"))
     child.send_signal(signal.SIGKILL)
     child.wait(timeout=30)
     assert reports >= 4, child.stderr.read()

@@ -138,6 +138,88 @@ def test_append_effect_from_tick():
             (sid, c.servers[sid].machine_state)
 
 
+class TimerMachine(Machine):
+    """Machine that arms a timer on ('arm', ms), cancels on ('cancel',),
+    and counts delivered timeouts — the {timer, Name, T} contract
+    (ra_machine.erl:135, executed ra_server_proc.erl:1549-1550 with the
+    expiry appended as a '{timeout, Name}' command, :556-560)."""
+
+    def init(self, config):
+        return {"timeouts": 0}
+
+    def apply(self, meta, command, state):
+        from ra_tpu.core.types import TimerEffect
+        op = command[0]
+        if op == "arm":
+            return state, "armed", [TimerEffect("t1", command[1])]
+        if op == "cancel":
+            return state, "cancelled", [TimerEffect("t1", None)]
+        if op == "timeout":
+            new = {"timeouts": state["timeouts"] + 1}
+            return new, new
+        return state, state
+
+
+def _timer_cluster(router):
+    import ra_tpu
+    from ra_tpu.core.types import ServerId
+    from nemesis import await_leader
+    sids = [ServerId(f"tm{i}", f"n{i}") for i in (1, 2, 3)]
+    ra_tpu.start_cluster("timers", TimerMachine, sids, router=router)
+    return sids, await_leader(router, sids)
+
+
+def test_machine_timer_fires_and_replicates():
+    import time
+
+    import ra_tpu
+    from ra_tpu.node import LocalRouter, RaNode
+
+    router = LocalRouter()
+    nodes = [RaNode(f"n{i}", router=router) for i in (1, 2, 3)]
+    try:
+        sids, leader = _timer_cluster(router)
+        ra_tpu.process_command(leader, ("arm", 50), router=router)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            got = ra_tpu.local_query(
+                leader, lambda s: s["timeouts"], router=router)
+            if got.reply >= 1:
+                break
+            time.sleep(0.02)
+        assert got.reply == 1, got
+        # the timeout command went through consensus: every member's
+        # machine saw it
+        for sid in sids:
+            r = ra_tpu.local_query(sid, lambda s: s["timeouts"],
+                                   router=router)
+            assert r.reply == 1, (sid, r)
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_machine_timer_cancel_suppresses_fire():
+    import time
+
+    import ra_tpu
+    from ra_tpu.node import LocalRouter, RaNode
+
+    router = LocalRouter()
+    nodes = [RaNode(f"n{i}", router=router) for i in (1, 2, 3)]
+    try:
+        sids, leader = _timer_cluster(router)
+        ra_tpu.process_command(leader, ("arm", 300), router=router)
+        ra_tpu.process_command(leader, ("cancel",), router=router)
+        time.sleep(0.7)
+        got = ra_tpu.local_query(leader, lambda s: s["timeouts"],
+                                 router=router)
+        assert got.reply == 0, got
+    finally:
+        for n in nodes:
+            n.stop()
+
+
 def test_effect_vocabulary_parity():
     """Every effect in ra_machine.erl:121-142 has a counterpart class
     (the completeness audit VERDICT r03 item 4 asks for)."""

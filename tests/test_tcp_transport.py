@@ -48,7 +48,7 @@ class Fabric:
         self.extra = tuple(extra_members)
         for n in names:
             self._spawn(ctx, n)
-        time.sleep(0.5)
+        self._await_ready(names)
 
     def _spawn(self, ctx, n):
         cq, rq = ctx.Queue(), ctx.Queue()
@@ -65,9 +65,19 @@ class Fabric:
         """Restart a (possibly killed) worker process over its data."""
         ctx = mp.get_context("spawn")
         self._spawn(ctx, n)
-        time.sleep(0.5)
+        self._await_ready([n])
 
-    def ask(self, n, *cmd, timeout=30):
+    def _await_ready(self, names, timeout=240):
+        """Block until each named worker reports ready.  Worker startup
+        (a fresh jax import per spawned process) can take tens of
+        seconds on a loaded box; starting the test before every peer is
+        up loses the initial election trigger and blows per-ask
+        timeouts (the round-3 test_cross_process_cluster flake)."""
+        for n in names:
+            r = self.chans[n][1].get(timeout=timeout)
+            assert r[0] == "ready", r
+
+    def ask(self, n, *cmd, timeout=60):
         cq, rq = self.chans[n]
         cq.put(cmd)
         return rq.get(timeout=timeout)
@@ -86,10 +96,10 @@ class Fabric:
 
     # helpers ------------------------------------------------------------
 
-    def await_leader(self, timeout=30):
+    def await_leader(self, timeout=60):
         deadline = time.monotonic() + timeout
+        states = {}
         while time.monotonic() < deadline:
-            states = {}
             for n in self.names:
                 if not self.workers[n].is_alive():
                     continue
