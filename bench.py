@@ -473,17 +473,33 @@ def main() -> None:
     res = _run_child(smoke_env, CHILD_TIMEOUT_S) or \
         _run_child(smoke_env, CHILD_TIMEOUT_S)
     if res is not None:
+        detail = {
+            "note": "TPU backend unreachable; value is a CPU smoke "
+                    "datapoint at 512 lanes (not the headline config)",
+            "cpu_smoke": res,
+        }
+        # protocol-complete evidence even off-hardware: fsync-backed
+        # commits and the sequential-machine (fifo) apply path.  Tight
+        # per-row timeout: these are supplementary — they must never
+        # push the (already measured) primary line past an outer
+        # harness deadline.
+        for row, extra in (
+            ("cpu_smoke_durable", {"RA_TPU_BENCH_DURABLE": "1",
+                                   "RA_TPU_BENCH_SECONDS": "2.0"}),
+            ("cpu_smoke_fifo", {"RA_TPU_BENCH_MACHINE": "fifo",
+                                "RA_TPU_BENCH_LANES": "256",
+                                "RA_TPU_BENCH_SECONDS": "2.0"}),
+        ):
+            r = _run_child({**smoke_env, **extra}, PROBE_TIMEOUT_S)
+            if r is not None:
+                detail[row] = r
         print(json.dumps({
             "metric": "committed_cmds_per_sec_10k_clusters_5_members",
             "value": res["value"],
             "unit": "cmds/s",
             "error": "tpu_unavailable",
             "vs_baseline": round(res["value"] / BASELINE, 4),
-            "detail": {
-                "note": "TPU backend unreachable; value is a CPU smoke "
-                        "datapoint at 512 lanes (not the headline config)",
-                "cpu_smoke": res,
-            },
+            "detail": detail,
         }))
     else:
         print(json.dumps({

@@ -191,7 +191,10 @@ class RaServer:
         # known-committed; the machine state itself is rebuilt from the
         # snapshot base by re-applying them with effects suppressed
         persisted_la = self.last_applied
-        snap = self.log.recover_snapshot_state()
+        # machine-state base: the newest valid of {snapshot, checkpoints}
+        # (ra_snapshot:init, ra_snapshot.erl:183-222) — checkpoints cut
+        # the replay span without truncating the log
+        snap = self.log.recover_machine_base()
         if snap is not None:
             meta, mac_state = snap
             self.machine_state = mac_state

@@ -855,6 +855,32 @@ class DurableLog:
                 f"{self.snapshot_module.name!r} (format mismatch?)")
         return meta, self.snapshot_module.decode(got[1])
 
+    def recover_machine_base(self) -> Optional[tuple]:
+        """Newest valid machine-state base among the snapshot and the
+        retained checkpoints (ra_snapshot:init picks the latest valid
+        image, ra_snapshot.erl:183-222; the recover_from_checkpoint_*
+        cases of ra_checkpoint_SUITE).  Checkpoints do not truncate the
+        log, so recovering from one is purely a replay shortcut; corrupt
+        or undecodable checkpoints fall back to the next older image."""
+        with self._lock:
+            cps = list(self._checkpoints)
+            snap_idx = self._snapshot[0].index if self._snapshot else -1
+        for meta, path in reversed(cps):        # newest first
+            if meta.index <= snap_idx:
+                break  # snapshot is newer: no need to read checkpoints
+            got = _read_snapshot_file(path)
+            if got is None or not self.snapshot_module.validate(got[1]):
+                continue  # torn/corrupt container: try the next older
+            try:
+                state = self.snapshot_module.decode(got[1])
+            except Exception:
+                continue
+            return meta, state
+        # no usable checkpoint above the snapshot: decode the snapshot
+        # (deferred until here — a superseding checkpoint must not pay a
+        # full snapshot read+decode)
+        return self.recover_snapshot_state()
+
     def snapshot_data(self) -> bytes:
         got = self.snapshot()
         assert got is not None

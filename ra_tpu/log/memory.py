@@ -328,6 +328,10 @@ class MemoryLog:
                 f"{self.snapshot_module.name!r} (format mismatch?)")
         return meta, self.snapshot_module.decode(data)
 
+    # the mock log keeps no checkpoints: the snapshot is the only
+    # machine-state base (uniform log interface for server recovery)
+    recover_machine_base = recover_snapshot_state
+
     def snapshot_data(self) -> bytes:
         assert self._snapshot is not None
         return self._snapshot[1]

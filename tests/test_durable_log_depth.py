@@ -256,6 +256,162 @@ def test_recovery_with_missing_wal_directory(tmp_path):
     sys2.close()
 
 
+# -- checkpoint recovery (ra_checkpoint_SUITE) ------------------------------
+
+def test_recover_from_checkpoint_only(tmp_path):
+    """With no snapshot, the newest checkpoint is the machine-state base
+    (recover_from_checkpoint_only) — the log below it stays intact."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 40, 1)
+    drain(log)
+    log.checkpoint(25, (), 0, {"acc": 25})
+    sys_.close()
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    base = log2.recover_machine_base()
+    assert base is not None
+    meta, state = base
+    assert meta.index == 25 and state == {"acc": 25}
+    assert log2.recover_snapshot_state() is None   # no snapshot exists
+    assert log2.first_index() == 1                 # no truncation
+    sys2.close()
+
+
+def test_recover_from_checkpoint_and_snapshot(tmp_path):
+    """A checkpoint newer than the snapshot wins as the base
+    (recover_from_checkpoint_and_snapshot)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 60, 1)
+    drain(log)
+    log.update_release_cursor(20, (), 0, {"acc": 20})
+    log.checkpoint(45, (), 0, {"acc": 45})
+    sys_.close()
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    meta, state = log2.recover_machine_base()
+    assert meta.index == 45 and state == {"acc": 45}
+    # and the snapshot alone still answers with 20 (install path)
+    smeta, _ = log2.recover_snapshot_state()
+    assert smeta.index == 20
+    sys2.close()
+
+
+def test_newer_snapshot_deletes_older_checkpoints(tmp_path):
+    """A release_cursor drops checkpoints at or below its index
+    (newer_snapshot_deletes_older_checkpoints)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 60, 1)
+    drain(log)
+    log.checkpoint(10, (), 0, {"acc": 10})
+    log.checkpoint(30, (), 0, {"acc": 30})
+    log.checkpoint(50, (), 0, {"acc": 50})
+    log.update_release_cursor(40, (), 0, {"acc": 40})
+    assert log.checkpoint_index() == 50            # the survivor
+    assert log.overview()["num_checkpoints"] == 1
+    meta, state = log.recover_machine_base()
+    assert meta.index == 50
+    sys_.close()
+
+
+def test_corrupt_checkpoint_falls_back_to_older(tmp_path):
+    """init_recover_corrupt: a torn newest checkpoint is skipped in
+    favor of the next older one."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 40, 1)
+    drain(log)
+    log.checkpoint(20, (), 0, {"acc": 20})
+    log.checkpoint(35, (), 0, {"acc": 35})
+    sys_.close()
+    cpdir = os.path.join(str(tmp_path), "u1", "checkpoints")
+    newest = sorted(os.listdir(cpdir))[-1]
+    with open(os.path.join(cpdir, newest), "r+b") as f:
+        f.seek(18)
+        f.write(b"\xde\xad\xbe\xef")
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    meta, state = log2.recover_machine_base()
+    assert meta.index == 20 and state == {"acc": 20}
+    sys2.close()
+
+
+def test_multi_corrupt_checkpoints_fall_back_to_snapshot(tmp_path):
+    """init_recover_multi_corrupt: every checkpoint torn -> the snapshot
+    is the base; no garbage load."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    put(log, 1, 40, 1)
+    drain(log)
+    log.update_release_cursor(10, (), 0, {"acc": 10})
+    log.checkpoint(20, (), 0, {"acc": 20})
+    log.checkpoint(35, (), 0, {"acc": 35})
+    sys_.close()
+    cpdir = os.path.join(str(tmp_path), "u1", "checkpoints")
+    for fname in os.listdir(cpdir):
+        with open(os.path.join(cpdir, fname), "r+b") as f:
+            f.seek(18)
+            f.write(b"\xde\xad\xbe\xef")
+    sys2 = mk_system(tmp_path)
+    log2 = mk_log(sys2)
+    meta, state = log2.recover_machine_base()
+    assert meta.index == 10 and state == {"acc": 10}
+    sys2.close()
+
+
+def test_server_restart_resumes_from_checkpoint_base(tmp_path):
+    """End-to-end: a node restart recovers machine state from the
+    checkpoint and replays only the tail above it."""
+    import ra_tpu
+    from ra_tpu.core.machine import SimpleMachine
+    from ra_tpu.core.types import ServerConfig, ServerId
+    from ra_tpu.node import LocalRouter, RaNode
+
+    router = LocalRouter()
+    sys_ = mk_system(tmp_path)
+    node = RaNode("ck1", router=router, log_factory=sys_.log_factory)
+    sid = ServerId("c1", "ck1")
+    applied = []
+
+    def mk_machine():
+        def fn(cmd, st):
+            applied.append(cmd)
+            return st + cmd
+        return SimpleMachine(fn, 0)
+
+    node.start_server(ServerConfig(
+        server_id=sid, uid="uid_ck", cluster_name="ck",
+        initial_members=(sid,), machine=mk_machine(),
+        election_timeout_ms=200, tick_interval_ms=100))
+    ra_tpu.trigger_election(sid, router)
+    total = 0
+    for v in range(1, 31):
+        ra_tpu.process_command(sid, v, router=router)
+        total += v
+    # checkpoint at the current applied index via the machine-effect path
+    sh = node.shells[sid.name]
+    sh.server.log.checkpoint(sh.server.last_applied, (), 0,
+                             sh.server.machine_state)
+    node.stop()
+    sys_.close()
+
+    applied.clear()
+    sys2 = mk_system(tmp_path)
+    node2 = RaNode("ck1", router=LocalRouter(),
+                   log_factory=sys2.log_factory)
+    node2.start_server(ServerConfig(
+        server_id=sid, uid="uid_ck", cluster_name="ck",
+        initial_members=(sid,), machine=mk_machine(),
+        election_timeout_ms=200, tick_interval_ms=100))
+    sh2 = node2.shells[sid.name]
+    assert sh2.server.machine_state == total
+    assert applied == []  # nothing re-applied: the checkpoint was the base
+    node2.stop()
+    sys2.close()
+
+
 def test_updated_segment_can_be_read(tmp_path):
     """Append, flush, append more into the SAME segment file, flush
     again: both flush generations stay readable
