@@ -44,6 +44,7 @@ class SegmentFile:
         self.index: dict[int, tuple] = {}  # idx -> (term, offset, len, crc)
         self._pending: list = []           # [(idx, term, payload)]
         self._count = 0
+        self._max_idx = 0  # highest live-or-pending index (0 = empty)
         if create:
             self.fd = IO.random_open(path, truncate=True)
             hdr = _HDR.pack(MAGIC, 1, max_count, 0)
@@ -86,21 +87,38 @@ class SegmentFile:
             # lower index is an overwrite: it invalidates every entry above
             # it written earlier (same dedup as WAL recovery — a stale
             # tail must not survive a reload)
-            if self.index:
-                for k in [k for k in self.index if k >= idx]:
-                    del self.index[k]
+            self._invalidate_from(idx)
             self.index[idx] = (term, off, ln, crc)
+            self._max_idx = max(self._max_idx, idx)
             self._count += 1
             self._next_off = max(self._next_off, off + ln)
 
     # -- write side ---------------------------------------------------------
 
+    def _invalidate_from(self, idx: int) -> None:
+        """Drop every live/pending entry at/above ``idx`` — the single
+        slot-order dedup shared by live appends and reload (_load), so
+        the live index can never disagree with what a reload would
+        reconstruct.  Fast path: a strictly-ascending append (the flush
+        hot path) skips the sweep entirely via the max-index watermark."""
+        if idx > self._max_idx:
+            return
+        for k in [k for k in self.index if k >= idx]:
+            del self.index[k]
+        self._pending = [p for p in self._pending if p[0] < idx]
+        self._max_idx = max(max(self.index, default=0),
+                            max((p[0] for p in self._pending), default=0))
+
     def append(self, idx: int, term: int, payload: bytes) -> bool:
         """Buffer an entry; False when the segment is full
-        ({error, full} in the reference)."""
+        ({error, full} in the reference).  Appending at-or-below an
+        existing index is an overwrite: it invalidates every LIVE entry
+        at/above it immediately (see _invalidate_from)."""
         if self._count + len(self._pending) >= self.max_count:
             return False
+        self._invalidate_from(idx)
         self._pending.append((idx, term, payload))
+        self._max_idx = max(self._max_idx, idx)
         return True
 
     def flush(self) -> None:
@@ -156,6 +174,7 @@ class SegmentFile:
         self.index = {}
         self._pending = []
         self._count = 0
+        self._max_idx = 0
         self._load()
 
     # -- read side ----------------------------------------------------------

@@ -1,0 +1,275 @@
+"""Segment-file depth suite — the ra_log_segment_SUITE scenarios
+(/root/reference/test/ra_log_segment_SUITE.erl): header persistence,
+write/close/open/write cycles, full-file refusal, missing reads,
+overwrite tail invalidation (live AND across reload), large payloads,
+invalid/corrupted files, and truncate_from durability.
+"""
+import os
+
+import pytest
+
+from ra_tpu.log.segment import SegmentFile
+
+
+def fill(seg, lo, hi, term=1, payload=None):
+    for i in range(lo, hi + 1):
+        assert seg.append(i, term, payload or f"e{i}".encode())
+    seg.flush()
+
+
+def test_open_close_persists_max_count(tmp_path):
+    p = str(tmp_path / "a.segment")
+    seg = SegmentFile(p, max_count=64, create=True)
+    seg.close()
+    seg2 = SegmentFile(p, max_count=4096)   # arg ignored on open
+    assert seg2.max_count == 64
+    seg2.close()
+
+
+def test_write_close_open_write(tmp_path):
+    p = str(tmp_path / "a.segment")
+    seg = SegmentFile(p, max_count=128, create=True)
+    fill(seg, 1, 10)
+    seg.close()
+    seg2 = SegmentFile(p)
+    assert seg2.range() == (1, 10)
+    fill(seg2, 11, 20)
+    seg2.close()
+    seg3 = SegmentFile(p)
+    assert seg3.range() == (1, 20)
+    for i in (1, 10, 11, 20):
+        term, payload = seg3.read(i)
+        assert (term, payload) == (1, f"e{i}".encode())
+    seg3.close()
+
+
+def test_full_file_refuses_and_reports(tmp_path):
+    p = str(tmp_path / "a.segment")
+    seg = SegmentFile(p, max_count=8, create=True)
+    for i in range(1, 9):
+        assert seg.append(i, 1, b"x")
+    assert seg.full
+    assert not seg.append(9, 1, b"y")       # {error, full}
+    seg.flush()
+    assert seg.range() == (1, 8)
+    seg.close()
+
+
+def test_try_read_missing(tmp_path):
+    p = str(tmp_path / "a.segment")
+    seg = SegmentFile(p, max_count=16, create=True)
+    fill(seg, 5, 8)
+    assert seg.read(1) is None
+    assert seg.read(9) is None
+    assert seg.read(999) is None
+    seg.close()
+
+
+def test_overwrite_invalidates_live_tail(tmp_path):
+    """Rewriting a lower index drops every live entry at/above it —
+    without waiting for a reload (the overwrite case)."""
+    p = str(tmp_path / "a.segment")
+    seg = SegmentFile(p, max_count=32, create=True)
+    fill(seg, 1, 5)
+    assert seg.append(3, 2, b"new3")
+    seg.flush()
+    assert seg.read(3) == (2, b"new3")
+    assert seg.read(4) is None
+    assert seg.read(5) is None
+    assert seg.range() == (1, 3)
+    seg.close()
+    # reload reconstructs the same view from slot order
+    seg2 = SegmentFile(p)
+    assert seg2.range() == (1, 3)
+    assert seg2.read(3) == (2, b"new3")
+    assert seg2.read(5) is None
+    seg2.close()
+
+
+def test_overwrite_pending_before_flush(tmp_path):
+    """An overwrite within the same unflushed batch drops the pending
+    stale tail too."""
+    p = str(tmp_path / "a.segment")
+    seg = SegmentFile(p, max_count=32, create=True)
+    for i in range(1, 6):
+        seg.append(i, 1, f"e{i}".encode())
+    seg.append(2, 3, b"new2")   # invalidates pending 2..5
+    seg.flush()
+    assert seg.range() == (1, 2)
+    assert seg.read(2) == (3, b"new2")
+    assert seg.read(3) is None
+    seg.close()
+
+
+def test_write_many_large_payloads(tmp_path):
+    p = str(tmp_path / "a.segment")
+    seg = SegmentFile(p, max_count=600, create=True)
+    big = os.urandom(256 * 1024)
+    for i in range(1, 501):
+        payload = big if i % 100 == 0 else f"v{i}".encode()
+        assert seg.append(i, 1, payload)
+    seg.flush()
+    seg.close()
+    seg2 = SegmentFile(p)
+    assert seg2.range() == (1, 500)
+    assert seg2.read(100)[1] == big
+    assert seg2.read(499)[1] == b"v499"
+    seg2.close()
+
+
+def test_open_invalid_magic(tmp_path):
+    p = str(tmp_path / "bad.segment")
+    with open(p, "wb") as f:
+        f.write(b"NOTASEGMENTFILE" + b"\x00" * 100)
+    with pytest.raises(ValueError, match="magic"):
+        SegmentFile(p)
+
+
+def test_corrupted_data_region_detected_by_crc(tmp_path):
+    p = str(tmp_path / "a.segment")
+    seg = SegmentFile(p, max_count=16, create=True)
+    fill(seg, 1, 8, payload=b"payload-payload")
+    seg.close()
+    # flip bytes in the data region (past header + slot table)
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.seek(size - 10)
+        f.write(b"\xff\xff\xff")
+    seg2 = SegmentFile(p)
+    with pytest.raises(ValueError, match="crc"):
+        for i in range(1, 9):
+            seg2.read(i)
+    seg2.close()
+
+
+def test_truncate_from_durable_across_reload(tmp_path):
+    p = str(tmp_path / "a.segment")
+    seg = SegmentFile(p, max_count=32, create=True)
+    fill(seg, 1, 10)
+    seg.truncate_from(6)
+    assert seg.range() == (1, 5)
+    seg.close()
+    seg2 = SegmentFile(p)
+    assert seg2.range() == (1, 5)
+    assert seg2.read(6) is None
+    assert seg2.read(5) == (1, b"e5")
+    # truncated indexes are appendable again
+    fill(seg2, 6, 8, term=2)
+    seg2.close()
+    seg3 = SegmentFile(p)
+    assert seg3.range() == (1, 8)
+    assert seg3.read(6) == (2, b"e6")
+    seg3.close()
+
+
+# -- segment-writer barrier semantics (ra_log_segment_writer_SUITE) ---------
+
+class _StubLog:
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.flushed = []
+
+    def flush_mem_to_segments(self, hi):
+        if self.fail:
+            raise OSError("disk gone")
+        self.flushed.append(hi)
+        return (1, 10, 0)
+
+
+def _writer(resolve):
+    from ra_tpu.log.segment import SegmentWriter
+    return SegmentWriter(resolve=resolve, flush_workers=2)
+
+
+def test_wal_file_deleted_only_after_every_flush(tmp_path):
+    """accept_mem_tables: the WAL file is unlinked once every uid's
+    range reached segments (the deletion barrier)."""
+    wal = tmp_path / "00000001.wal"
+    wal.write_bytes(b"x")
+    logs = {"u1": _StubLog(), "u2": _StubLog()}
+    w = _writer(lambda uid: logs.get(uid))
+    w.accept_ranges({"u1": (1, 5), "u2": (1, 9)}, str(wal))
+    w.await_idle()
+    assert not wal.exists()
+    assert logs["u1"].flushed == [5] and logs["u2"].flushed == [9]
+
+
+def test_wal_file_kept_when_a_flush_fails(tmp_path):
+    """A failed per-uid flush keeps the WAL file: its entries remain
+    recoverable (accept_mem_tables_with_corrupt_segment shape)."""
+    wal = tmp_path / "00000002.wal"
+    wal.write_bytes(b"x")
+    logs = {"u1": _StubLog(), "u2": _StubLog(fail=True)}
+    w = _writer(lambda uid: logs.get(uid))
+    w.accept_ranges({"u1": (1, 5), "u2": (1, 9)}, str(wal))
+    w.await_idle()
+    assert wal.exists()                      # barrier held
+    assert logs["u1"].flushed == [5]         # the healthy uid still flushed
+
+
+def test_wal_file_kept_for_stopped_server(tmp_path):
+    """accept_mem_tables_for_down_server: an unresolvable (stopped, not
+    deleted) uid pins the file for restart recovery."""
+    wal = tmp_path / "00000003.wal"
+    wal.write_bytes(b"x")
+    w = _writer(lambda uid: None)
+    w.accept_ranges({"ghost": (1, 5)}, str(wal))
+    w.await_idle()
+    assert wal.exists()
+
+
+def test_deleted_uid_does_not_pin_wal(tmp_path):
+    """accept_mem_tables_with_delete_server: a force-deleted uid's
+    entries are garbage — the file must not be pinned."""
+    wal = tmp_path / "00000004.wal"
+    wal.write_bytes(b"x")
+    w = _writer(lambda uid: None)
+    w.mark_deleted("gone")
+    w.accept_ranges({"gone": (1, 5)}, str(wal))
+    w.await_idle()
+    assert not wal.exists()
+
+
+def test_flush_skips_entries_below_snapshot_index(tmp_path):
+    """skip_entries_lower_than_snapshot_index: a snapshot taken before
+    the rollover means only post-snapshot entries reach segments."""
+    from test_durable_log import drain, mk_log, mk_system
+    from ra_tpu.core.types import Entry, UserCommand
+
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 61):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    log.update_release_cursor(50, (), 0, {"acc": 50})
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    ov = log.overview()
+    assert ov["num_mem_entries"] == 0
+    # segment files hold only 51..60
+    segs = [f for f in os.listdir(os.path.join(str(tmp_path), "u1"))
+            if f.endswith(".segment")]
+    lo = 10**9
+    for f in segs:
+        seg = SegmentFile(os.path.join(str(tmp_path), "u1", f))
+        r = seg.range()
+        if r:
+            lo = min(lo, r[0])
+        seg.close()
+    assert lo >= 51, lo
+    assert log.fetch(55).command.data == 55
+    sys_.close()
+
+
+def test_fd_eviction_reopens_transparently(tmp_path):
+    """close_fd (the FLRU eviction) keeps the index; the next read
+    reopens the descriptor."""
+    p = str(tmp_path / "a.segment")
+    seg = SegmentFile(p, max_count=16, create=True)
+    fill(seg, 1, 4)
+    seg.close_fd()
+    assert seg.fd is None
+    assert seg.read(3) == (1, b"e3")
+    assert seg.fd is not None
+    seg.close()
