@@ -16,6 +16,7 @@ from .api import (  # noqa: E402,F401
     consistent_query,
     delete_cluster,
     force_delete_server,
+    force_shrink_members_to_current_member,
     key_metrics,
     leader_query,
     local_query,

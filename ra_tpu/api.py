@@ -23,6 +23,7 @@ from .core.types import (
     ConsistentQueryEvent,
     ErrorResult,
     ForceElectionEvent,
+    ForceMemberChangeEvent,
     JoinCommand,
     LeaveCommand,
     ClusterDeleteCommand,
@@ -306,6 +307,19 @@ def trigger_election(server_id: ServerId,
     router = router or DEFAULT_ROUTER
     node = _node_of(server_id, router)
     node.submit(server_id.name, ForceElectionEvent())
+
+
+def force_shrink_members_to_current_member(
+        server_id: ServerId,
+        router: Optional[LocalRouter] = None) -> None:
+    """Disaster recovery: shrink ``server_id``'s cluster to itself and
+    self-elect (ra_server_proc:force_shrink_members_to_current_member,
+    :234-236).  For permanent majority loss ONLY — the surviving member
+    unilaterally rewrites membership, so using it while the others are
+    merely partitioned manufactures split-brain."""
+    router = router or DEFAULT_ROUTER
+    node = _node_of(server_id, router)
+    node.submit(server_id.name, ForceMemberChangeEvent())
 
 
 def transfer_leadership(server_id: ServerId, target: ServerId,

@@ -384,6 +384,17 @@ class TransferLeadershipEvent:
 
 
 @dataclass(frozen=True)
+class ForceMemberChangeEvent:
+    """Disaster-recovery escape hatch: shrink the cluster to THIS member
+    only, then self-elect — used when a permanent majority outage makes
+    normal membership changes impossible
+    (force_shrink_members_to_current_member,
+    ra_server_proc.erl:234-236, ra_server.erl:1320-1328)."""
+
+    from_: Any = None
+
+
+@dataclass(frozen=True)
 class ForceElectionEvent:
     """trigger_election — skip pre-vote, go straight to candidate."""
 

@@ -218,6 +218,100 @@ def test_snapshotted_follower_accepts_following_appends():
     assert srv3.log.last_index_term().index == 11
 
 
+def test_corrupt_chunk_aborts_accept(tmp_path):
+    """abort_accept: a chunk failing its crc aborts the stream — back to
+    follower, own progress confirmed, partial state discarded."""
+    import zlib
+
+    c = SimCluster(3, snapshot_chunk_size=4)
+    s1, _s2, s3 = c.ids
+    srv3 = c.servers[s3]
+    meta = snap_meta(10, 1, c.ids)
+    effs = srv3.handle(InstallSnapshotRpc(
+        term=1, leader_id=s1, meta=meta, chunk_number=1,
+        chunk_flag="next", data=b"abcd",
+        chunk_crc=zlib.crc32(b"abcd"), token="t9"))
+    c._process_effects(s3, effs)
+    assert srv3.raft_state.value == "receive_snapshot"
+    effs = srv3.handle(InstallSnapshotRpc(
+        term=1, leader_id=s1, meta=meta, chunk_number=2,
+        chunk_flag="last", data=b"efgh",
+        chunk_crc=zlib.crc32(b"CORRUPT"), token="t9"))
+    assert srv3.raft_state.value == "follower"
+    assert srv3._accepting_snapshot is None
+    assert srv3.log.snapshot_index_term().index == 0
+    results = [e.msg for e in effs if isinstance(e, SendRpc)
+               and isinstance(e.msg, InstallSnapshotResult)]
+    assert results and results[0].last_index == \
+        srv3.log.last_index_term().index
+
+
+def test_snapshot_install_recovers_voter_status():
+    """init_recover_voter_status: the installed snapshot's cluster
+    carries membership — a member listed as nonvoter must behave as one
+    (no election timeouts granted to itself)."""
+    c = SimCluster(3)
+    s1, s2, s3 = c.ids
+    srv3 = c.servers[s3]
+    cluster = ((s1, Membership.VOTER), (s2, Membership.VOTER),
+               (s3, Membership.NON_VOTER))
+    meta = SnapshotMeta(index=10, term=1, cluster=cluster,
+                        machine_version=0)
+    data = srv3.log.snapshot_module.encode(99)
+    effs = srv3.handle(InstallSnapshotRpc(
+        term=1, leader_id=s1, meta=meta, chunk_number=1,
+        chunk_flag="last", data=data, token="tv"))
+    c._process_effects(s3, effs)
+    assert srv3.raft_state.value == "follower"
+    assert not srv3.is_voter()
+    # a nonvoter ignores its election timeout (ra_server.erl:1307-1315)
+    effs = srv3.handle(ElectionTimeout())
+    assert effs == []
+    assert srv3.raft_state.value == "follower"
+
+
+def test_force_shrink_aborts_inflight_snapshot_accept():
+    """ForceMemberChange out of RECEIVE_SNAPSHOT must run the state's
+    teardown: the partial accept stream is aborted before the shrink."""
+    from ra_tpu.core.types import ForceMemberChangeEvent
+
+    c = SimCluster(3, snapshot_chunk_size=4)
+    s1, _s2, s3 = c.ids
+    srv3 = c.servers[s3]
+    effs = srv3.handle(InstallSnapshotRpc(
+        term=1, leader_id=s1, meta=snap_meta(10, 1, c.ids),
+        chunk_number=1, chunk_flag="next", data=b"abcd", token="tf"))
+    c._process_effects(s3, effs)
+    assert srv3.raft_state.value == "receive_snapshot"
+    c.handle(s3, ForceMemberChangeEvent())
+    c.run()
+    assert srv3._accepting_snapshot is None
+    assert srv3.raft_state.value == "leader"        # cluster of one
+    assert set(srv3.cluster) == {s3}
+
+
+def test_force_shrink_replays_await_condition_backlog():
+    """ForceMemberChange out of AWAIT_CONDITION clears the condition and
+    re-dispatches the postponed backlog instead of abandoning it."""
+    from ra_tpu.core.types import ForceMemberChangeEvent
+
+    c = SimCluster(3)
+    s1, _s2, s3 = c.ids
+    srv3 = c.servers[s3]
+    # gap AER parks the follower in await_condition
+    srv3.handle(AppendEntriesRpc(
+        term=1, leader_id=s1, prev_log_index=10, prev_log_term=1,
+        leader_commit=10, entries=(Entry(11, 1, UserCommand(1)),)))
+    assert srv3.raft_state.value == "await_condition"
+    assert srv3.condition is not None
+    c.handle(s3, ForceMemberChangeEvent())
+    c.run()
+    assert srv3.condition is None
+    assert len(srv3.condition_pending) == 0
+    assert srv3.raft_state.value == "leader"
+    assert set(srv3.cluster) == {s3}
+
+
 # -- membership -------------------------------------------------------------
 
 def test_leader_steps_down_when_removed():

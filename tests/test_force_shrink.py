@@ -1,0 +1,145 @@
+"""Disaster-recovery membership ops — the ra_2_SUITE
+force_start_follower_as_single_member scenarios
+(/root/reference/test/ra_2_SUITE.erl:652-737): after permanent majority
+loss, the survivor shrinks to a single-member cluster, keeps serving,
+survives a restart, and can grow back; plus the minority guard rails
+(cluster delete and membership changes cannot commit without quorum).
+"""
+import os
+import time
+
+import pytest
+
+import ra_tpu
+from ra_tpu.core.machine import SimpleMachine
+from ra_tpu.core.types import Membership, ServerConfig, ServerId
+from ra_tpu.node import LocalRouter, RaNode
+from ra_tpu.system import RaSystem
+
+
+def counter():
+    return SimpleMachine(lambda c, s: s + c, 0)
+
+
+def await_(fn, timeout=25):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            r = fn()
+            if r is not None:
+                return r
+        except Exception as e:  # noqa: BLE001 — retried probe
+            last = e
+        time.sleep(0.1)
+    raise TimeoutError(last)
+
+
+def test_force_shrink_after_majority_loss(tmp_path):
+    router = LocalRouter()
+    sids = [ServerId(f"m{i}", f"fs{i}") for i in (1, 2, 3)]
+    systems = {s.node: RaSystem(os.path.join(str(tmp_path), s.node))
+               for s in sids}
+    nodes = {s.node: RaNode(s.node, router=router,
+                            log_factory=systems[s.node].log_factory)
+             for s in sids}
+    try:
+        for s in sids:
+            nodes[s.node].start_server(ServerConfig(
+                server_id=s, uid=f"uid_{s.name}", cluster_name="fs",
+                initial_members=tuple(sids), machine=counter(),
+                election_timeout_ms=250, tick_interval_ms=100))
+        ra_tpu.trigger_election(sids[0], router)
+        leader = await_(lambda: ra_tpu.process_command(
+            sids[0], 1, router=router).leader)
+        ra_tpu.process_command(leader, 2, router=router)
+
+        # permanent outage of a majority
+        survivor = [s for s in sids if s != leader][0] \
+            if leader == sids[0] else sids[0]
+        for s in sids:
+            if s != survivor:
+                nodes[s.node].stop()
+
+        # the survivor cannot commit ...
+        with pytest.raises(Exception):
+            ra_tpu.process_command(survivor, 99, router=router, timeout=1.5)
+        # ... until it force-shrinks to a single-member cluster
+        ra_tpu.force_shrink_members_to_current_member(survivor, router)
+        r = await_(lambda: ra_tpu.process_command(survivor, 10,
+                                                  router=router))
+        assert r.reply == 13
+        mem = ra_tpu.members(survivor, router=router)
+        assert [m for m in mem] == [survivor], mem
+
+        # restart the survivor: the forced membership is durable
+        nodes[survivor.node].stop()
+        systems[survivor.node].close()
+        systems[survivor.node] = RaSystem(
+            os.path.join(str(tmp_path), survivor.node))
+        nodes[survivor.node] = RaNode(
+            survivor.node, router=router,
+            log_factory=systems[survivor.node].log_factory)
+        rec = systems[survivor.node].recover_servers(
+            nodes[survivor.node], lambda c, n: counter())
+        assert len(rec) == 1
+        ra_tpu.trigger_election(survivor, router)
+        r = await_(lambda: ra_tpu.process_command(survivor, 5,
+                                                  router=router))
+        assert r.reply == 18
+        assert list(ra_tpu.members(survivor, router=router)) == [survivor]
+
+        # grow back: add a fresh member on a fresh node
+        s4 = ServerId("m4", "fs4")
+        systems[s4.node] = RaSystem(os.path.join(str(tmp_path), s4.node))
+        nodes[s4.node] = RaNode(s4.node, router=router,
+                                log_factory=systems[s4.node].log_factory)
+        nodes[s4.node].start_server(ServerConfig(
+            server_id=s4, uid="uid_m4", cluster_name="fs",
+            initial_members=(survivor,), machine=counter(),
+            election_timeout_ms=250, tick_interval_ms=100))
+        ra_tpu.add_member(survivor, s4, router=router,
+                          membership=Membership.PROMOTABLE)
+        def caught_up():
+            st = ra_tpu.local_query(s4, lambda x: x, router=router).reply
+            return st if st == 18 else None
+        assert await_(caught_up) == 18
+        r = ra_tpu.process_command(survivor, 1, router=router)
+        assert r.reply == 19
+    finally:
+        for n in nodes.values():
+            n.stop()
+        for s_ in systems.values():
+            s_.close()
+
+
+def test_minority_cannot_delete_cluster_or_change_membership():
+    """cluster_cannot_be_deleted_in_minority + add_member_without_quorum:
+    without a quorum neither a '$ra_cluster' delete nor a membership
+    change can complete — the cluster survives intact."""
+    router = LocalRouter()
+    nodes = [RaNode(f"mc{i}", router=router) for i in (1, 2, 3)]
+    sids = [ServerId(f"m{i}", f"mc{i}") for i in (1, 2, 3)]
+    try:
+        ra_tpu.start_cluster("mc", counter, sids, router=router)
+        ra_tpu.trigger_election(sids[0], router)
+        leader = await_(lambda: ra_tpu.process_command(
+            sids[0], 1, router=router).leader)
+        # cut the leader off from both followers
+        for s in sids:
+            if s != leader:
+                router.block(leader.node, s.node)
+        with pytest.raises(Exception):
+            ra_tpu.delete_cluster(leader, router=router, timeout=1.5)
+        s4 = ServerId("m4", "mc1")
+        with pytest.raises(Exception):
+            ra_tpu.add_member(leader, s4, router=router, timeout=1.5)
+        router.heal()
+        # the cluster is alive and consistent
+        r = await_(lambda: ra_tpu.process_command(leader, 1,
+                                                  router=router,
+                                                  timeout=5))
+        assert r.reply >= 2
+    finally:
+        for n in nodes:
+            n.stop()

@@ -144,6 +144,45 @@ def test_snapshot_meta_carries_machine_version():
     assert meta.index == idx
 
 
+def test_unversioned_can_change_to_versioned():
+    """unversioned_can_change_to_versioned: a cluster born on an
+    unversioned (v0) machine restarts onto a versioned one; the new
+    leader's noop carries the bump and the upgrade pseudo-command runs
+    exactly once."""
+    from ra_tpu.core.server import RaServer
+    from ra_tpu.core.types import ServerConfig
+
+    c = SimCluster(3, machine_factory=CounterV0)
+    s1 = c.ids[0]
+    c.elect(s1)
+    for v in (3, 4):
+        c.command(s1, v)
+    assert c.servers[s1].machine_state == 7
+    # rolling restart: same logs, versioned machine
+    for sid in c.ids:
+        old = c.servers[sid]
+        cfg = ServerConfig(server_id=sid, uid=old.cfg.uid,
+                           cluster_name="simcluster",
+                           initial_members=tuple(c.ids),
+                           machine=CounterV1())
+        srv = RaServer(cfg, old.log)
+        srv.recover()
+        c.servers[sid] = srv
+        c.queues[sid].clear()
+    c.elect(s1)
+    srv1 = c.servers[s1]
+    assert srv1.effective_machine_version == 1
+    # recovery replayed the OLD entries through the v0 module (+3, +4),
+    # then the bump pseudo-command ran through the v1 module
+    assert srv1.machine_state[0] == "v1"
+    assert srv1.machine_state[1] == 7
+    # v1 semantics in force from here on: +5 adds 10
+    c.command(s1, 5)
+    assert srv1.machine_state[1] == 17
+    for sid in c.ids:
+        assert c.servers[sid].machine_state[1] == 17, sid
+
+
 def test_snapshot_install_rejected_by_stale_member():
     """A follower whose machine cannot run the snapshot's version must
     refuse the install (the version gate on the receive path,
