@@ -317,26 +317,28 @@ class RaServer:
                                RaftState.DELETE_AND_TERMINATE):
             return []  # terminal: the shell tears this server down
         if isinstance(event, ForceMemberChangeEvent):
-            # disaster-recovery escape hatch: from ANY state, revert to
-            # follower, append a cluster change shrinking membership to
-            # self only, then self-elect via pre-vote (quorum of one)
+            # disaster-recovery escape hatch: shrink membership to self
+            # only, then self-elect via pre-vote (quorum of one)
             # (ra_server.erl:830-831, :943-944, :1023-1024, :1320-1328).
-            # Per-state exits run their normal teardown first: a partial
-            # snapshot accept is aborted, and events postponed behind an
-            # await_condition are re-dispatched (they replay against the
-            # post-shrink state instead of hanging their callers).
-            effects: list = []
+            if self.raft_state == RaftState.AWAIT_CONDITION:
+                # refused while parked — the reference's await_condition
+                # state has no force_member_change clause (unsupported
+                # call).  Exiting here would race the parked condition:
+                # under a wal_down park the forced cluster-change append
+                # itself fails mid-mutation (memtable advanced, cluster
+                # not), and the postponed client backlog would be lost.
+                if event.from_ is not None:
+                    return [Reply(event.from_,
+                                  ErrorResult("unsupported_call",
+                                              self.leader_id))]
+                return []
             if self.raft_state == RaftState.RECEIVE_SNAPSHOT:
+                # a partial accept stream must not leak (the state's
+                # normal exit teardown)
                 self.log.abort_accept()
                 self._accepting_snapshot = None
-            elif self.raft_state == RaftState.AWAIT_CONDITION:
-                self.condition = None
-                effects.extend(self._replay_condition_pending())
-            if self.raft_state != RaftState.FOLLOWER:
-                self.raft_state = RaftState.FOLLOWER
-                self.votes = 0
-            effects.extend(self._append_cluster_change(
-                {self.id: (Membership.VOTER, 0)}, None, None, []))
+            effects = self._append_cluster_change(
+                {self.id: (Membership.VOTER, 0)}, None, None, [])
             if event.from_ is not None:
                 effects.append(Reply(event.from_, "ok"))
             effects.extend(self._call_for_election_pre_vote())

@@ -1,0 +1,77 @@
+"""Bench child-mode contract tests — the driver runs bench.py unattended
+on real hardware at round end, so every measurement mode must be
+exercised continuously off-hardware: a mode that crashes or prints a
+malformed line would silently cost the round its benchmark evidence.
+
+Each child runs in a subprocess exactly as the bench parent launches it
+(PYTHONPATH stripped so a dead TPU tunnel's site hook cannot hang jax
+init), at tiny configs sized for a loaded single-core box.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+BASE_ENV = {
+    **os.environ,
+    "RA_TPU_BENCH_CHILD": "1",
+    "PYTHONPATH": "",
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "",
+    "RA_TPU_BENCH_LANES": "64",
+    "RA_TPU_BENCH_MEMBERS": "3",
+    "RA_TPU_BENCH_CMDS": "8",
+    "RA_TPU_BENCH_SECONDS": "0.5",
+}
+
+
+def run_child(extra, timeout=240):
+    r = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, timeout=timeout,
+                       env={**BASE_ENV, **extra}, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, r.stdout
+    return json.loads(lines[-1])
+
+
+def test_child_throughput_mode_contract():
+    doc = run_child({})
+    assert doc["value"] > 0
+    assert doc["p50_commit_latency_ms"] > 0
+    assert doc["machine"] == "counter" and doc["durable"] is False
+    assert doc["latency_samples"] > 0
+
+
+def test_child_durable_mode_contract():
+    doc = run_child({"RA_TPU_BENCH_DURABLE": "1"})
+    assert doc["value"] > 0
+    assert doc["durable"] is True
+    assert "sync_mode" in doc and "wal_strategy" in doc
+
+
+def test_child_fifo_machine_contract():
+    doc = run_child({"RA_TPU_BENCH_MACHINE": "fifo"})
+    assert doc["value"] > 0
+    assert doc["machine"] == "fifo"
+
+
+def test_child_frontier_mode_contract():
+    doc = run_child({"RA_TPU_BENCH_MODE": "frontier",
+                     "RA_TPU_BENCH_SIZES": "1,8",
+                     "RA_TPU_BENCH_WINDOW": "2",
+                     "RA_TPU_BENCH_SECONDS": "0.5"})
+    assert doc["value"] > 0
+    assert len(doc["points"]) == 2
+    for p in doc["points"]:
+        assert p["cmds_per_step"] in (1, 8)
+        assert p["value"] > 0
+        assert p["batches_measured"] > 0
+    assert doc["sync_rtt_ms"] > 0
+    assert doc["best_point"] in doc["points"]

@@ -290,10 +290,13 @@ def test_force_shrink_aborts_inflight_snapshot_accept():
     assert set(srv3.cluster) == {s3}
 
 
-def test_force_shrink_replays_await_condition_backlog():
-    """ForceMemberChange out of AWAIT_CONDITION clears the condition and
-    re-dispatches the postponed backlog instead of abandoning it."""
-    from ra_tpu.core.types import ForceMemberChangeEvent
+def test_force_shrink_refused_while_parked_in_await_condition():
+    """ForceMemberChange in AWAIT_CONDITION is refused (the reference
+    has no clause for it there): exiting a park would race the parked
+    condition — under wal_down the forced append itself would fail
+    mid-mutation — so the caller gets unsupported_call and state is
+    untouched."""
+    from ra_tpu.core.types import ErrorResult, ForceMemberChangeEvent, Reply
 
     c = SimCluster(3)
     s1, _s2, s3 = c.ids
@@ -303,13 +306,12 @@ def test_force_shrink_replays_await_condition_backlog():
         term=1, leader_id=s1, prev_log_index=10, prev_log_term=1,
         leader_commit=10, entries=(Entry(11, 1, UserCommand(1)),)))
     assert srv3.raft_state.value == "await_condition"
-    assert srv3.condition is not None
-    c.handle(s3, ForceMemberChangeEvent())
-    c.run()
-    assert srv3.condition is None
-    assert len(srv3.condition_pending) == 0
-    assert srv3.raft_state.value == "leader"
-    assert set(srv3.cluster) == {s3}
+    effs = srv3.handle(ForceMemberChangeEvent(from_="op1"))
+    replies = [e for e in effs if isinstance(e, Reply)]
+    assert replies and isinstance(replies[0].msg, ErrorResult)
+    assert replies[0].msg.reason == "unsupported_call"
+    assert srv3.raft_state.value == "await_condition"
+    assert set(srv3.cluster) == {s1, _s2, s3}
 
 
 # -- membership -------------------------------------------------------------

@@ -110,3 +110,106 @@ def test_single_member_restart_preserves_term_and_vote(tmp_path):
         n.stop()
     for s in systems.values():
         s.close()
+
+
+def test_restart_does_not_reissue_side_effects(tmp_path):
+    """restarted_server_does_not_reissue_side_effects (ra_2_SUITE):
+    machine effects for entries at or below the persisted apply
+    watermark are suppressed during recovery replay — a subscriber must
+    not see duplicate notifications after a restart."""
+    from ra_tpu.core.machine import Machine
+    from ra_tpu.core.types import SendMsg
+
+    class Notifier(Machine):
+        def __init__(self, sink):
+            self.sink = sink
+
+        def init(self, config):
+            return 0
+
+        def apply(self, meta, command, state):
+            new = state + command
+            return new, new, [SendMsg(self.sink, ("applied", command))]
+
+    router = LocalRouter()
+    sid = ServerId("fx1", "fxn1")
+    system = RaSystem(str(tmp_path))
+    node = RaNode(sid.node, router=router, log_factory=system.log_factory)
+    seen: list = []
+    node.start_server(ServerConfig(
+        server_id=sid, uid="uid_fx", cluster_name="fx",
+        initial_members=(sid,), machine=Notifier(seen.append),
+        election_timeout_ms=80, tick_interval_ms=50))
+    ra_tpu.trigger_election(sid, router)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(seen) < 3:
+        try:
+            for v in (1, 2, 3)[len(seen):]:
+                ra_tpu.process_command(sid, v, router=router)
+        except Exception:
+            time.sleep(0.05)
+    assert [m for m in seen] == [("applied", 1), ("applied", 2),
+                                 ("applied", 3)]
+    # let a tick persist the apply watermark (lazy last_applied)
+    time.sleep(0.3)
+    node.stop()
+    system.close()
+
+    seen2: list = []
+    system2 = RaSystem(str(tmp_path))
+    node2 = RaNode(sid.node, router=LocalRouter(),
+                   log_factory=system2.log_factory)
+    node2.start_server(ServerConfig(
+        server_id=sid, uid="uid_fx", cluster_name="fx",
+        initial_members=(sid,), machine=Notifier(seen2.append),
+        election_timeout_ms=80, tick_interval_ms=50))
+    sh = node2.shells[sid.name]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and sh.server.machine_state != 6:
+        time.sleep(0.05)
+    assert sh.server.machine_state == 6
+    assert seen2 == [], seen2   # recovery replay suppressed every effect
+    node2.stop()
+    system2.close()
+
+
+def test_config_modification_at_restart(tmp_path):
+    """config_modification_at_restart (ra_2_SUITE): restarting a member
+    over its durable log with modified tunables (election timeout, tick)
+    honors the new values while preserving the recovered state."""
+    router = LocalRouter()
+    sid = ServerId("cm1", "cmn1")
+    system = RaSystem(str(tmp_path))
+    node = RaNode(sid.node, router=router, log_factory=system.log_factory)
+    node.start_server(ServerConfig(
+        server_id=sid, uid="uid_cm", cluster_name="cm",
+        initial_members=(sid,), machine=counter(),
+        election_timeout_ms=80, tick_interval_ms=50))
+    ra_tpu.trigger_election(sid, router)
+    deadline = time.monotonic() + 10
+    ok = False
+    while time.monotonic() < deadline and not ok:
+        try:
+            ok = ra_tpu.process_command(sid, 5, router=router).reply == 5
+        except Exception:
+            time.sleep(0.05)
+    assert ok
+    node.stop()
+    system.close()
+
+    system2 = RaSystem(str(tmp_path))
+    node2 = RaNode(sid.node, router=LocalRouter(),
+                   log_factory=system2.log_factory)
+    node2.start_server(ServerConfig(
+        server_id=sid, uid="uid_cm", cluster_name="cm",
+        initial_members=(sid,), machine=counter(),
+        election_timeout_ms=555, tick_interval_ms=200))
+    sh = node2.shells[sid.name]
+    assert sh.server.cfg.election_timeout_ms == 555
+    assert sh.server.cfg.tick_interval_ms == 200
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and sh.server.machine_state != 5:
+        time.sleep(0.05)
+    assert sh.server.machine_state == 5    # durable state preserved
+    node2.stop()
+    system2.close()
