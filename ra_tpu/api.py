@@ -311,15 +311,25 @@ def trigger_election(server_id: ServerId,
 
 def force_shrink_members_to_current_member(
         server_id: ServerId,
-        router: Optional[LocalRouter] = None) -> None:
+        router: Optional[LocalRouter] = None,
+        timeout: float = 5.0) -> Any:
     """Disaster recovery: shrink ``server_id``'s cluster to itself and
     self-elect (ra_server_proc:force_shrink_members_to_current_member,
     :234-236).  For permanent majority loss ONLY — the surviving member
     unilaterally rewrites membership, so using it while the others are
-    merely partitioned manufactures split-brain."""
+    merely partitioned manufactures split-brain.  Raises if the member
+    refuses (e.g. it is parked in await_condition behind a dead WAL —
+    an operator must never mistake a refused escape hatch for a
+    successful one)."""
     router = router or DEFAULT_ROUTER
     node = _node_of(server_id, router)
-    node.submit(server_id.name, ForceMemberChangeEvent())
+    fut = Future()
+    node.submit(server_id.name, ForceMemberChangeEvent(from_=fut))
+    result = fut.wait(timeout)
+    if isinstance(result, ErrorResult):
+        raise RuntimeError(
+            f"force_shrink refused by {server_id}: {result.reason}")
+    return result
 
 
 def transfer_leadership(server_id: ServerId, target: ServerId,

@@ -49,6 +49,7 @@ def worker_main(node_name, port_map, cmd_q, res_q, machine_kind="counter",
     book = {n: ("127.0.0.1", p) for n, p in port_map.items()
             if n != node_name}
     router = TcpRouter(my_addr, book)
+    system = None
     if data_dir:
         from ra_tpu.system import RaSystem
         system = RaSystem(data_dir)
@@ -130,6 +131,14 @@ def worker_main(node_name, port_map, cmd_q, res_q, machine_kind="counter",
             elif op == "restart_server":
                 ra_tpu.restart_server(me, router=router)
                 res_q.put(("ok",))
+            elif op == "kill_wal":
+                # fault injection: crash this node's fan-in WAL thread
+                # (the coordination_SUITE segment_writer_or_wal_crash_*
+                # scenarios); the system supervisor restarts it
+                system.wal.kill()
+                res_q.put(("ok",))
+            elif op == "wal_alive":
+                res_q.put(("ok", bool(system.wal.alive)))
             else:
                 res_q.put(("err", f"unknown op {op}"))
         except Exception as e:  # noqa: BLE001 — report to the test
