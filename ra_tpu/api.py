@@ -39,8 +39,13 @@ from .node import DEFAULT_ROUTER, Future, LocalRouter, RaNode
 
 
 def new_uid(prefix: str = "") -> str:
-    """Unique, filesystem-safe server UID (ra:new_uid/1 :735)."""
-    return f"{prefix}{uuid.uuid4().hex[:12]}"
+    """Unique, filesystem-safe server UID (ra:new_uid/1 :735).  The
+    caller-supplied prefix (typically a server name) is sanitized to the
+    base64url alphabet the storage layer enforces — uids name on-disk
+    directories (RaSystem.validate_uid)."""
+    import re
+    safe = re.sub(r"[^A-Za-z0-9_\-=]", "_", prefix)
+    return f"{safe}{uuid.uuid4().hex[:12]}"
 
 
 def start_cluster(cluster_name: str, machine_factory: Callable[[], Machine],
@@ -324,7 +329,9 @@ def force_shrink_members_to_current_member(
     router = router or DEFAULT_ROUTER
     node = _node_of(server_id, router)
     fut = Future()
-    node.submit(server_id.name, ForceMemberChangeEvent(from_=fut))
+    if not node.submit(server_id.name, ForceMemberChangeEvent(from_=fut)):
+        raise RuntimeError(f"force_shrink: no such server {server_id} "
+                           "(noproc)")
     result = fut.wait(timeout)
     if isinstance(result, ErrorResult):
         raise RuntimeError(

@@ -590,13 +590,19 @@ class RaServer:
             self.log.handle_written(event)
             effects: list = []
             # replicate-then-confirm: reply to the leader once our WAL
-            # confirms (ra_server.erl:1183-1192)
+            # confirms (ra_server.erl:1183-1192).  NB: the commit index
+            # is NOT evaluated here — commit_index is optimistically set
+            # to leader_commit BEFORE the AER consistency check (both
+            # here and in the reference, :1047-1048), so it may cover a
+            # stale uncommitted suffix of a previous term that a failed
+            # check left in place.  Applying is only safe from the AER
+            # entry_ok path, where the prefix up to the leader's tail
+            # has been validated (or reset) — exactly the reference's
+            # shape, whose follower written-event clause only replies.
             if self.leader_id is not None:
                 effects.append(SendRpc(self.leader_id,
                                        self._aer_reply(self.current_term,
                                                        True)))
-            # commit index may already cover these entries
-            effects.extend(self._evaluate_commit_index_follower())
             return effects
         if isinstance(event, PreVoteRpc):
             if not self.is_voter():

@@ -152,12 +152,26 @@ class RaSystem:
         with self._lock:
             return self._logs.get(uid)
 
+    @staticmethod
+    def validate_uid(uid: str) -> bool:
+        """UIDs name on-disk directories and WAL records: restrict to
+        base64url-safe characters, non-empty (ra_lib:validate_base64uri,
+        ra_lib.erl:254-268; start_server refuses invalid UIDs the same
+        way, ra_2_SUITE:start_server_uid_validation)."""
+        import re
+        return bool(uid) and re.fullmatch(r"[A-Za-z0-9_\-=]+", uid) \
+            is not None
+
     def log_factory(self, cfg: ServerConfig) -> DurableLog:
         """Factory handed to RaNode: per-server durable log over the shared
         WAL/segment-writer.  The log is the server's *storage identity* and
         survives server crashes within a running system — a restarted
         server reuses it (the ra_log_ets role: memtables outlive the
         processes that fill them)."""
+        if not self.validate_uid(cfg.uid):
+            raise ValueError(
+                f"invalid uid {cfg.uid!r}: must be non-empty base64url "
+                "(it names a data directory)")
         # every uid that owns a log MUST be in the durable directory — the
         # boot purge treats absence as "force-deleted".  Log-only configs
         # (no server_id; tests/tools) register under their uid with an

@@ -218,6 +218,56 @@ def test_snapshotted_follower_accepts_following_appends():
     assert srv3.log.last_index_term().index == 11
 
 
+def test_written_event_never_applies_stale_suffix():
+    """Apply safety (found by the interleaving fuzzer): commit_index is
+    optimistically set to leader_commit BEFORE the AER consistency check
+    (ra_server.erl:1047-1048), so after a FAILED check it can cover a
+    stale uncommitted suffix of an older term.  A later WAL confirm for
+    that suffix must not trigger an apply — applying is only safe from
+    the validated AER path (the reference's follower written-event
+    clause only replies, :1183-1192)."""
+    from ra_tpu.core.types import WrittenEvent
+
+    c = SimCluster(3)
+    s1, s2, s3 = c.ids
+    srv3 = c.servers[s3]
+    # term-1 leader s2 replicates 1..3 but only 1..2 commit
+    srv3.handle(AppendEntriesRpc(
+        term=1, leader_id=s2, prev_log_index=0, prev_log_term=0,
+        leader_commit=2,
+        entries=(Entry(1, 1, UserCommand(10)),
+                 Entry(2, 1, UserCommand(20)),
+                 Entry(3, 1, UserCommand(999)))))
+    assert srv3.last_applied == 2
+    assert srv3.machine_state == 30
+    # new term-2 leader s1 (its own log: 1..2@t1 then 3..4@t2, commit 4)
+    # sends an AER whose prev point exposes the conflict: the check
+    # fails, but commit_index has already been bumped to 4
+    srv3.handle(AppendEntriesRpc(
+        term=2, leader_id=s1, prev_log_index=3, prev_log_term=2,
+        leader_commit=4, entries=()))
+    assert srv3.raft_state.value == "await_condition"
+    assert srv3.commit_index == 4          # the optimistic bump
+    # the catch-up condition times out; back to follower, stale tail
+    # still in place (repair has not arrived yet)
+    srv3.handle(ElectionTimeout())
+    assert srv3.raft_state.value == "follower"
+    assert srv3.log.last_index_term() == (3, 1)
+    # a late WAL confirm for the stale suffix arrives: it must NOT be
+    # applied — entry 3@t1 was never committed by anyone
+    srv3.handle(WrittenEvent(1, 3, 1))
+    assert srv3.last_applied == 2, "stale uncommitted suffix applied!"
+    assert srv3.machine_state == 30
+    # the repair AER overwrites the suffix; only then does apply resume
+    srv3.handle(AppendEntriesRpc(
+        term=2, leader_id=s1, prev_log_index=2, prev_log_term=1,
+        leader_commit=4,
+        entries=(Entry(3, 2, UserCommand(300)),
+                 Entry(4, 2, UserCommand(400)))))
+    assert srv3.last_applied == 4
+    assert srv3.machine_state == 30 + 300 + 400
+
+
 def test_corrupt_chunk_aborts_accept(tmp_path):
     """abort_accept: a chunk failing its crc aborts the stream — back to
     follower, own progress confirmed, partial state discarded."""

@@ -419,3 +419,26 @@ def test_boot_refuses_purge_when_directory_unreadable(tmp_path):
     assert "uid_ca" in system2.wal._recovered, \
         "WAL data destroyed despite unreadable registry"
     system2.close()
+
+
+def test_start_server_uid_validation(tmp_path):
+    """start_server_uid_validation (ra_2_SUITE): uids name on-disk
+    directories — unsafe ones are refused before any state is created."""
+    import pytest
+
+    from ra_tpu.core.types import ServerConfig, ServerId
+    from ra_tpu.system import RaSystem
+
+    system = RaSystem(str(tmp_path))
+    try:
+        assert RaSystem.validate_uid("abc_DEF-123=")
+        for bad in ("", "a/b", "a b", "a\x00b", "../etc", "a.b"):
+            assert not RaSystem.validate_uid(bad), bad
+            cfg = ServerConfig(server_id=ServerId("s1", "n1"), uid=bad,
+                               cluster_name="c", initial_members=(),
+                               machine=None)
+            with pytest.raises(ValueError):
+                system.log_factory(cfg)
+        assert not (tmp_path / "a").exists()
+    finally:
+        system.close()
