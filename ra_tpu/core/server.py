@@ -1098,6 +1098,41 @@ class RaServer:
         if reply.success and reply.term == self.current_term:
             if peer.status == PeerStatus.DISCONNECTED:
                 peer.status = PeerStatus.NORMAL  # hearing from it = alive
+            # the confirmed tail must be OUR entry before it can count
+            # toward quorum: a follower that adopted this term while
+            # still holding a stale suffix of a deposed leader confirms
+            # (last_index, last_term) of that suffix via the written-
+            # event reply path — advancing match on it would let a
+            # divergent entry enter the commit median (the reference
+            # checks reply terms only on the failure path,
+            # ra_server.erl:477-532; the success path takes last_index
+            # unchecked, :430-433 — this is deliberately stricter)
+            my_last = self.log.last_index_term().index
+            # indexes compacted behind our snapshot are unverifiable, not
+            # divergent — trust them like the failure-path repair does
+            # (a confirm at/below the snapshot index is always safe to
+            # count: the snapshot itself covers it)
+            verifiable = reply.last_index >= self.log.first_index()
+            if reply.last_index > 0 and verifiable and \
+                    self.log.fetch_term(reply.last_index) != reply.last_term:
+                # stale-suffix success reply: never advance match on an
+                # unverified tail.  Two repair shapes, both of which must
+                # generate traffic or the exchange livelocks on repeated
+                # identical confirms:
+                if reply.last_index > my_last:
+                    # follower's durable tail extends past our log (a
+                    # deposed leader's surplus): only an EMPTY AER at our
+                    # tail truncates it (the follower's reset branch —
+                    # resent entries would just be duplicate-dropped)
+                    peer.next_index = my_last + 1
+                    eff = self._make_rpc_for_peer(reply.from_, peer, 1)
+                    return [eff] if eff is not None else []
+                # divergence within our range: rewind to the last
+                # VERIFIED point; the resend overwrites the follower's
+                # conflicting region (its write path truncates from the
+                # first conflicting index)
+                peer.next_index = peer.match_index + 1
+                return self._make_pipelined_rpcs()
             peer.match_index = max(peer.match_index, reply.last_index)
             peer.next_index = max(peer.next_index, reply.next_index)
             effects = self._maybe_promote_peer(reply.from_)

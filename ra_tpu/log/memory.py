@@ -105,6 +105,14 @@ class MemoryLog:
             raise IntegrityError(
                 f"write gap: {first} > {self._last_index + 1}")
         self.counters["write_ops"] += len(entries)
+        # an overwrite invalidates previous confirms over the rewritten
+        # range: rewind last_written to the real predecessor BEFORE the
+        # batch lands, so AER replies stay truthful (DurableLog._put does
+        # the same; a stale (index, old-term) confirm here livelocks the
+        # leader's stale-suffix repair)
+        if self._last_written.index >= first:
+            prev = first - 1
+            self._last_written = IdxTerm(prev, self.fetch_term(prev) or 0)
         for e in entries:
             self._entries[e.index] = e
         last = entries[-1]
@@ -113,8 +121,6 @@ class MemoryLog:
             self._entries.pop(idx, None)
         self._last_index = last.index
         self._last_term = last.term
-        if self._last_written.index > last.index:
-            self._last_written = IdxTerm(last.index, last.term)
         self._queue_written(first, last.index, last.term)
 
     def set_last_index(self, idx: int) -> None:

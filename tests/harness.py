@@ -46,7 +46,11 @@ class SimCluster:
 
     def __init__(self, n: int = 3, machine_factory: Optional[Callable] = None,
                  auto_written: bool = True,
-                 snapshot_chunk_size: int = 64) -> None:
+                 snapshot_chunk_size: int = 64,
+                 log_factory: Optional[Callable] = None) -> None:
+        """``log_factory(cfg) -> log`` swaps the in-memory mock for a
+        real log (e.g. RaSystem.log_factory) so core scenarios can run
+        against durable storage; default stays MemoryLog."""
         self.ids = mk_ids(n)
         if machine_factory is None:
             machine_factory = lambda: SimpleMachine(  # noqa: E731
@@ -58,12 +62,14 @@ class SimCluster:
         self.timer_kinds: dict[ServerId, Optional[str]] = {}
         self.dropped: set = set()       # partitioned links (src, dst)
         self.snapshot_chunk_size = snapshot_chunk_size
+        self._log_factory = log_factory
         for sid in self.ids:
-            log = MemoryLog(auto_written=auto_written)
             cfg = ServerConfig(server_id=sid, uid=f"uid_{sid.name}",
                                cluster_name="simcluster",
                                initial_members=tuple(self.ids),
                                machine=machine_factory())
+            log = (self._log_factory(cfg) if self._log_factory
+                   else MemoryLog(auto_written=auto_written))
             srv = RaServer(cfg, log)
             srv.recover()
             self.servers[sid] = srv

@@ -268,6 +268,34 @@ def test_written_event_never_applies_stale_suffix():
     assert srv3.machine_state == 30 + 300 + 400
 
 
+def test_leader_ignores_success_reply_with_mismatched_term():
+    """Companion to the stale-suffix apply fix: a success reply whose
+    confirmed (last_index, last_term) is NOT the leader's own entry —
+    the written-event reply of a follower still holding a deposed
+    leader's suffix — must never advance match, or a divergent entry
+    enters the commit median."""
+    c = SimCluster(3)
+    s1, s2, _s3 = c.ids
+    c.elect(s1)
+    leader = c.servers[s1]
+    term = leader.current_term
+    c.isolate(s2)
+    c.command(s1, 5)                       # entry 2@term, s2 cut off
+    match0 = leader.cluster[s2].match_index
+    assert match0 < 2
+    # forged/stale confirm: s2 claims a durable entry 2 at a WRONG term
+    leader.handle(AppendEntriesReply(
+        term=term, success=True, next_index=3, last_index=2,
+        last_term=term + 7, from_=s2))
+    assert leader.cluster[s2].match_index == match0, \
+        "unverified tail entered the match fold"
+    # a truthful confirm for the same index advances normally
+    leader.handle(AppendEntriesReply(
+        term=term, success=True, next_index=3, last_index=2,
+        last_term=term, from_=s2))
+    assert leader.cluster[s2].match_index == 2
+
+
 def test_corrupt_chunk_aborts_accept(tmp_path):
     """abort_accept: a chunk failing its crc aborts the stream — back to
     follower, own progress confirmed, partial state discarded."""
@@ -510,3 +538,56 @@ def test_pre_vote_state_heartbeat_steps_back_to_follower():
                                     leader_id=s1))
     c._process_effects(s2, effs)
     assert srv2.raft_state.value == "follower"
+
+
+# -- consistent queries (ra_SUITE consistent_query_* family) ----------------
+
+def test_consistent_query_blocked_in_minority():
+    """consistent_query_minority: a leader cut off from its majority
+    must never answer a consistent query — the heartbeat quorum cannot
+    certify its authority."""
+    from ra_tpu.core.types import ConsistentQueryEvent, TickEvent
+
+    c = SimCluster(3)
+    s1 = c.ids[0]
+    c.elect(s1)
+    c.command(s1, 5)
+    c.isolate(s1)
+    c.handle(s1, ConsistentQueryEvent(lambda st: st, from_="qminor"))
+    for _ in range(4):
+        c.handle(s1, TickEvent())
+        c.run()
+    assert not any(r.to == "qminor" for _sid, r in c.replies), \
+        "a minority leader answered a linearizable read"
+
+
+def test_consistent_query_waits_for_new_leader_noop():
+    """consistent_query_leader_change: a query registered with a brand
+    new leader is held until its term-opening noop commits
+    (pending_consistent_queries, ra_server.erl:3174-3190)."""
+    from ra_tpu.core.types import (ConsistentQueryEvent, ElectionTimeout,
+                                   TickEvent)
+
+    c = SimCluster(3, auto_written=False)
+    s1 = c.ids[0]
+    c.handle(s1, ElectionTimeout())
+    c.run()
+    srv = c.servers[s1]
+    assert srv.raft_state.value == "leader"
+    assert not srv.cluster_change_permitted   # noop not yet committed
+    c.handle(s1, ConsistentQueryEvent(lambda st: st, from_="qnoop"))
+    c.run()
+    assert not any(r.to == "qnoop" for _sid, r in c.replies)
+    # the noop commits once WALs confirm; the pending query then fires
+    for sid in c.ids:
+        log = c.servers[sid].log
+        last = log.last_index_term()
+        log.release_written(1, last.index, last.term)
+        c._drain_log_events(sid)
+    c.run()
+    for _ in range(3):
+        c.handle(s1, TickEvent())
+        c.run()
+    got = [r for _sid, r in c.replies if r.to == "qnoop"]
+    assert got, "query never answered after the noop committed"
+    assert got[0].msg.reply == 0

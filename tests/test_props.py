@@ -431,3 +431,132 @@ def test_election_safety_and_log_matching_fuzz(seed):
         c.run()
     states = c.machine_states()
     assert len(set(states.values())) == 1, states
+
+
+# ---------------------------------------------------------------------------
+# property 5: safety fuzz over REAL durable logs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [101, 137])
+def test_safety_fuzz_over_durable_logs(tmp_path, seed):
+    """The interleaving safety fuzz with RaSystem-backed DurableLogs
+    instead of the in-memory mock: WAL confirms arrive asynchronously
+    from a real batch/fsync thread, exercising the written-event
+    protocol (clamping, gaps, stale confirms) under adversarial
+    schedules.  Same invariants: one leader per term, applied prefixes
+    agree, post-heal convergence — plus a final restart proving the
+    durable state recovers."""
+    import time as _time
+
+    from ra_tpu.core.types import TickEvent
+    from ra_tpu.system import RaSystem
+
+    rng = random.Random(seed)
+    system = RaSystem(str(tmp_path), wal_sync_mode=0)
+    c = SimCluster(3, log_factory=system.log_factory)
+    sids = c.ids
+    leaders_by_term: dict = {}
+
+    def observe():
+        for sid in sids:
+            srv = c.servers[sid]
+            if srv.raft_state.value == "leader":
+                prev = leaders_by_term.setdefault(srv.current_term, sid)
+                assert prev == sid, (srv.current_term, prev, sid)
+        for i, a in enumerate(sids):
+            for b in sids[i + 1:]:
+                sa, sb = c.servers[a], c.servers[b]
+                upto = min(sa.last_applied, sb.last_applied)
+                if upto >= 1:
+                    ea, eb = sa.log.fetch(upto), sb.log.fetch(upto)
+                    if ea is not None and eb is not None:
+                        assert ea.term == eb.term, (a, b, upto)
+
+    def pump_confirms():
+        # real WAL: confirms land on the batch thread; surface them
+        for sid in sids:
+            c._drain_log_events(sid)
+
+    c.elect(sids[0])
+    for step in range(200):
+        roll = rng.random()
+        if roll < 0.4:
+            c.step()
+        elif roll < 0.5:
+            sid = rng.choice(sids)
+            if c.queues[sid]:
+                c.queues[sid].popleft()
+        elif roll < 0.6:
+            a, b = rng.sample(sids, 2)
+            if (a, b) in c.dropped:
+                c.dropped.discard((a, b))
+                c.dropped.discard((b, a))
+            else:
+                c.partition(a, b)
+        elif roll < 0.72:
+            sid = rng.choice(sids)
+            if c.servers[sid].raft_state.value in (
+                    "follower", "pre_vote", "candidate",
+                    "await_condition"):
+                c.handle(sid, ElectionTimeout())
+        elif roll < 0.78:
+            system.wal.flush()          # force a confirm boundary
+            pump_confirms()
+        else:
+            lead = c.leader()
+            if lead is not None:
+                c.handle(lead, CommandEvent(
+                    UserCommand(rng.randrange(1, 9))))
+        pump_confirms()
+        observe()
+
+    c.heal()
+    deadline = _time.monotonic() + 30
+    converged = False
+    while _time.monotonic() < deadline and not converged:
+        c.run()
+        system.wal.flush()
+        pump_confirms()
+        for sid in sids:
+            c.handle(sid, TickEvent())
+            if c.servers[sid].raft_state.value == "await_condition":
+                c.handle(sid, ElectionTimeout())
+        c.run()
+        lead = c.leader()
+        if lead is None:
+            c.handle(rng.choice(sids), ElectionTimeout())
+            continue
+        states = c.machine_states()
+        converged = len(set(states.values())) == 1 and \
+            all(c.servers[s].last_applied ==
+                c.servers[lead].last_applied for s in sids)
+    observe()
+    assert converged, c.machine_states()
+    final_state = c.machine_states()[sids[0]]
+    final_applied = c.servers[sids[0]].last_applied
+    system.close()
+
+    # durable recovery: reopen the system, rebuild a server over each
+    # log, and check the applied prefix survived (commit re-establishes
+    # only after an election, so compare against persisted meta)
+    system2 = RaSystem(str(tmp_path), wal_sync_mode=0)
+    c2 = SimCluster(3, log_factory=system2.log_factory,
+                    machine_factory=lambda: SimpleMachine(
+                        lambda cmd, st: st + cmd, 0))
+    c2.elect(c2.ids[0])
+    deadline = _time.monotonic() + 30
+    ok = False
+    while _time.monotonic() < deadline and not ok:
+        c2.run()
+        system2.wal.flush()
+        for sid in c2.ids:
+            c2._drain_log_events(sid)
+            c2.handle(sid, TickEvent())
+        c2.run()
+        lead2 = c2.leader()
+        ok = lead2 is not None and \
+            c2.servers[lead2].last_applied >= final_applied
+    assert ok
+    lead2 = c2.leader()
+    assert c2.machine_states()[lead2] == final_state
+    system2.close()
