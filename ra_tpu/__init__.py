@@ -24,6 +24,7 @@ from .api import (  # noqa: E402,F401
     members,
     new_uid,
     overview,
+    ping,
     pipeline_command,
     process_command,
     remove_member,

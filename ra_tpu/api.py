@@ -222,6 +222,18 @@ def pipeline_command(server_id: ServerId, data: Any, correlation: Any = None,
     node.submit_command(server_id.name, cmd, None, priority=priority)
 
 
+def ping(server_id: ServerId,
+         router: Optional[LocalRouter] = None) -> tuple:
+    """Liveness probe: ("pong", raft_state) for a running member
+    (ra_server_proc:ping, :238-240)."""
+    router = router or DEFAULT_ROUTER
+    node = _node_of(server_id, router)
+    shell = node.shells.get(server_id.name)
+    if shell is None:
+        raise RuntimeError(f"no such server {server_id}")
+    return ("pong", shell.server.raft_state.value)
+
+
 def local_query(server_id: ServerId, query_fn: Callable,
                 router: Optional[LocalRouter] = None) -> Any:
     """Query this member's machine state directly (ra:local_query :962)."""

@@ -295,3 +295,15 @@ def test_aux_monitor_down_routes_to_handle_aux(fabric):
     while _t.monotonic() < deadline and not downs:
         _t.sleep(0.02)
     assert downs and downs[0] == ("down", "extproc", "killed"), downs
+
+
+def test_ping(fabric):
+    router, _ = fabric
+    sids = ids()
+    ra_tpu.start_cluster("png", counter_factory, sids, router=router)
+    leader = await_leader(router, sids)
+    assert ra_tpu.ping(leader, router=router) == ("pong", "leader")
+    follower = next(s for s in sids if s != leader)
+    assert ra_tpu.ping(follower, router=router)[0] == "pong"
+    with pytest.raises(RuntimeError):
+        ra_tpu.ping(ServerId("ghost", sids[0].node), router=router)
