@@ -224,8 +224,11 @@ def pipeline_command(server_id: ServerId, data: Any, correlation: Any = None,
 
 def ping(server_id: ServerId,
          router: Optional[LocalRouter] = None) -> tuple:
-    """Liveness probe: ("pong", raft_state) for a running member
-    (ra_server_proc:ping, :238-240)."""
+    """Local liveness probe: ("pong", raft_state) for a member hosted
+    on THIS process's router (the ra_server_proc:ping role, :238-240).
+    Like local_query/key_metrics, this reads the shell directly and
+    does not reach members on remote nodes — probe those from their own
+    node (the per-node ops model the TCP workers use)."""
     router = router or DEFAULT_ROUTER
     node = _node_of(server_id, router)
     shell = node.shells.get(server_id.name)

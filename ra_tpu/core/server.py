@@ -674,8 +674,20 @@ class RaServer:
                 last_idx = self.log.last_index_term().index
                 if not rpc.entries and last_idx > rpc.prev_log_index:
                     # leader's log is shorter: reset ours to match
-                    # (ra_server.erl:1056-1066)
-                    self.log.set_last_index(rpc.prev_log_index)
+                    # (ra_server.erl:1056-1066) — but NEVER below our
+                    # APPLIED index: applied entries are immutable, and
+                    # a stale/pipelined empty AER can carry a prev point
+                    # under them (found by the snapshot fuzz: the
+                    # unclamped reset left applied > tail, wedging the
+                    # member in an install-refusal livelock).  NB the
+                    # clamp bound is last_applied, NOT commit_index —
+                    # commit_index is adopted optimistically before the
+                    # consistency check, so clamping there could retain
+                    # (and then apply) never-validated stale entries in
+                    # (prev, commit]; unapplied entries are always safe
+                    # to truncate and re-receive.
+                    self.log.set_last_index(max(rpc.prev_log_index,
+                                                self.last_applied))
                 effects.extend(self._evaluate_commit_index_follower())
                 effects.append(SendRpc(rpc.leader_id,
                                        self._aer_reply(rpc.term, True)))
@@ -757,7 +769,16 @@ class RaServer:
                                 last_index=rpc.meta.index,
                                 last_term=rpc.meta.term, from_=self.id,
                                 token=rpc.token))]
-        if (rpc.chunk_number == 1 and rpc.meta.index > self.last_applied
+        # restorative install: a member whose durable tail fell behind
+        # its own applied index (e.g. a crash reverted the log while
+        # meta.last_applied survived) must accept a snapshot AT its
+        # applied index — refusing it as "stale" wedges the member
+        # forever once the leader has compacted those entries
+        restores_log = (rpc.meta.index >= self.last_applied and
+                        rpc.meta.index >
+                        self.log.last_index_term().index)
+        if (rpc.chunk_number == 1
+                and (rpc.meta.index > self.last_applied or restores_log)
                 and self.machine_version >= rpc.meta.machine_version):
             self._update_term(rpc.term)
             self.leader_id = rpc.leader_id

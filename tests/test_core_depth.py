@@ -591,3 +591,66 @@ def test_consistent_query_waits_for_new_leader_noop():
     got = [r for _sid, r in c.replies if r.to == "qnoop"]
     assert got, "query never answered after the noop committed"
     assert got[0].msg.reply == 0
+
+
+def test_empty_aer_reset_never_truncates_committed_entries():
+    """Found by the snapshot fuzz: a stale/pipelined empty AER can carry
+    a prev point below the follower's commit index; the 'leader's log is
+    shorter' reset must clamp at commit — committed entries are
+    immutable."""
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    for v in (1, 2, 3, 4):
+        c.command(s1, v)
+    srv2 = c.servers[s2]
+    assert srv2.commit_index >= 5
+    tail0 = srv2.log.last_index_term().index
+    srv2.handle(AppendEntriesRpc(
+        term=srv2.current_term, leader_id=s1, prev_log_index=2,
+        prev_log_term=srv2.log.fetch_term(2), leader_commit=5,
+        entries=()))
+    assert srv2.log.last_index_term().index >= srv2.commit_index
+    assert srv2.log.last_index_term().index >= min(tail0,
+                                                   srv2.commit_index)
+    # entries at/below commit still present
+    for i in range(1, srv2.commit_index + 1):
+        assert srv2.log.fetch(i) is not None, i
+
+
+def test_restorative_snapshot_install_accepted_at_applied_index():
+    """A member whose durable tail fell behind its own applied index
+    (crash-reverted log, surviving apply watermark) must accept an
+    install AT its applied index instead of refusing it as stale —
+    otherwise it wedges forever once the leader compacted the range."""
+    c = SimCluster(3)
+    s1, s2, _ = c.ids
+    c.elect(s1)
+    for v in (1, 2, 3, 4):
+        c.command(s1, v)
+    srv2 = c.servers[s2]
+    la = srv2.last_applied
+    assert la >= 5
+    # crash-revert the durable tail below the applied watermark
+    srv2.log._last_index = 2
+    srv2.log._last_term = 1
+    for k in [k for k in srv2.log._entries if k > 2]:
+        del srv2.log._entries[k]
+    assert srv2.log.last_index_term().index < la
+    meta = snap_meta(la, srv2.current_term, c.ids)
+    data = srv2.log.snapshot_module.encode(srv2.machine_state)
+    effs = srv2.handle(InstallSnapshotRpc(
+        term=srv2.current_term, leader_id=s1, meta=meta,
+        chunk_number=1, chunk_flag="last", data=data, token="tr"))
+    c._process_effects(s2, effs)
+    assert srv2.raft_state.value == "follower"
+    assert srv2.log.snapshot_index_term().index == la
+    assert srv2.log.last_index_term().index == la   # tail restored
+    assert srv2.last_applied == la
+    # replication resumes above the snapshot
+    nxt = la + 1
+    effs = srv2.handle(AppendEntriesRpc(
+        term=srv2.current_term, leader_id=s1, prev_log_index=la,
+        prev_log_term=srv2.current_term, leader_commit=la,
+        entries=(Entry(nxt, srv2.current_term, UserCommand(9)),)))
+    assert srv2.log.last_index_term().index == nxt
