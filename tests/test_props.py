@@ -329,8 +329,9 @@ def test_stale_retained_wal_file_does_not_rewind_tail(tmp_path):
 # property 4: Raft safety under fuzzed interleavings
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed", [11, 23, 37, 59])
-def test_election_safety_and_log_matching_fuzz(seed):
+@pytest.mark.parametrize("seed,n_members", [(11, 3), (23, 3), (37, 3),
+                                             (59, 3), (71, 5), (83, 5)])
+def test_election_safety_and_log_matching_fuzz(seed, n_members):
     """Figure-3 safety properties under a random schedule of message
     deliveries, drops, partitions, election timeouts, and client
     commands:
@@ -341,7 +342,7 @@ def test_election_safety_and_log_matching_fuzz(seed):
     * Liveness (after quiescence) — healed cluster converges.
     """
     rng = random.Random(seed)
-    c = SimCluster(3)
+    c = SimCluster(n_members)
     sids = c.ids
     leaders_by_term: dict = {}
 
@@ -437,8 +438,9 @@ def test_election_safety_and_log_matching_fuzz(seed):
 # property 5: safety fuzz over REAL durable logs
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed", [101, 137])
-def test_safety_fuzz_over_durable_logs(tmp_path, seed):
+@pytest.mark.parametrize("seed,n_members", [(101, 3), (137, 3),
+                                             (151, 5)])
+def test_safety_fuzz_over_durable_logs(tmp_path, seed, n_members):
     """The interleaving safety fuzz with RaSystem-backed DurableLogs
     instead of the in-memory mock: WAL confirms arrive asynchronously
     from a real batch/fsync thread, exercising the written-event
@@ -453,7 +455,7 @@ def test_safety_fuzz_over_durable_logs(tmp_path, seed):
 
     rng = random.Random(seed)
     system = RaSystem(str(tmp_path), wal_sync_mode=0)
-    c = SimCluster(3, log_factory=system.log_factory)
+    c = SimCluster(n_members, log_factory=system.log_factory)
     sids = c.ids
     leaders_by_term: dict = {}
 
@@ -540,7 +542,7 @@ def test_safety_fuzz_over_durable_logs(tmp_path, seed):
     # log, and check the applied prefix survived (commit re-establishes
     # only after an election, so compare against persisted meta)
     system2 = RaSystem(str(tmp_path), wal_sync_mode=0)
-    c2 = SimCluster(3, log_factory=system2.log_factory,
+    c2 = SimCluster(n_members, log_factory=system2.log_factory,
                     machine_factory=lambda: SimpleMachine(
                         lambda cmd, st: st + cmd, 0))
     c2.elect(c2.ids[0])
@@ -558,7 +560,18 @@ def test_safety_fuzz_over_durable_logs(tmp_path, seed):
             c2.servers[lead2].last_applied >= final_applied
     assert ok
     lead2 = c2.leader()
-    assert c2.machine_states()[lead2] == final_state
+    # the recovered log may legitimately run AHEAD of the pre-shutdown
+    # applied frontier: entries accepted-but-uncommitted at close sit on
+    # a durable quorum and commit after the restart election.  The
+    # invariant is prefix consistency: folding the recovered log up to
+    # the old frontier reproduces the old state exactly.
+    srv2 = c2.servers[lead2]
+    assert srv2.last_applied >= final_applied
+    prefix = 0
+    for e in srv2.log.read_range(1, final_applied):
+        if isinstance(e.command, UserCommand):
+            prefix += e.command.data
+    assert prefix == final_state, (prefix, final_state)
     system2.close()
 
 
@@ -566,8 +579,9 @@ def test_safety_fuzz_over_durable_logs(tmp_path, seed):
 # property 6: safety fuzz with snapshots/truncation in the schedule
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed", [7, 19, 43])
-def test_safety_fuzz_with_snapshots(seed):
+@pytest.mark.parametrize("seed,n_members", [(7, 3), (19, 3), (43, 3),
+                                             (61, 5)])
+def test_safety_fuzz_with_snapshots(seed, n_members):
     """The interleaving fuzz with snapshot actions mixed in: leaders
     release their cursor at the applied index (truncating the log), so
     laggards must catch up via chunked snapshot installs racing
@@ -577,7 +591,7 @@ def test_safety_fuzz_with_snapshots(seed):
     from ra_tpu.core.types import ReleaseCursor, TickEvent
 
     rng = random.Random(seed)
-    c = SimCluster(3, snapshot_chunk_size=8)
+    c = SimCluster(n_members, snapshot_chunk_size=8)
     sids = c.ids
     leaders_by_term: dict = {}
 
