@@ -394,7 +394,25 @@ class RaServer:
 
     def _get_membership(self) -> Membership:
         peer = self.cluster.get(self.id)
-        return peer.membership if peer is not None else Membership.UNKNOWN
+        if peer is not None:
+            return peer.membership
+        if self.cluster_index_term.index == 0:
+            # not in our own view and NO cluster change ever seen: a
+            # freshly-started member whose '$ra_join' has not reached
+            # it.  Voter-ness comes from the server CONFIG
+            # (ra_server.erl:349-350 falls back to the config
+            # membership) — without this a joined voter with an empty
+            # log ignores vote requests and can veto elections forever
+            # (found by the membership fuzz).
+            return self.cfg.membership
+        # absent from a view SHAPED BY a cluster change: we were
+        # removed.  The config fallback must not apply — a removed
+        # server that considered itself a voter would self-elect
+        # against a quorum computed over a config that excludes it
+        # (also found by the membership fuzz: a one-peer view makes
+        # required_quorum 1, so the stale self-vote alone would seat a
+        # bogus leader)
+        return Membership.UNKNOWN
 
     def _set_cluster(self, new_cluster: dict[ServerId, Peer]) -> None:
         # preserve replication state of peers we already track
@@ -605,6 +623,11 @@ class RaServer:
                                                        True)))
             return effects
         if isinstance(event, PreVoteRpc):
+            # non-voters ignore vote requests (ra_server.erl:1197-1210);
+            # a fresh member's voter-ness comes from its CONFIG when it
+            # is not yet in its own cluster view (_get_membership
+            # fallback, :349-350) — without that fallback a joined-but-
+            # never-caught-up voter would veto elections forever
             if not self.is_voter():
                 return []
             return self._process_pre_vote(event)
@@ -692,6 +715,7 @@ class RaServer:
                 effects.append(SendRpc(rpc.leader_id,
                                        self._aer_reply(rpc.term, True)))
                 return effects
+            self._adopt_cluster_changes(entries)
             self.log.write(entries)
             effects.extend(self._evaluate_commit_index_follower())
             # success reply is sent when the WrittenEvent arrives
@@ -748,6 +772,43 @@ class RaServer:
                                                    entries[i].term):
             i += 1
         return entries[i:]
+
+    def _adopt_cluster_changes(self, entries: list) -> None:
+        """Followers adopt cluster changes when the entry is ADDED to
+        the log, not when it applies (pre_append_log_follower,
+        ra_server.erl:2865-2889): membership must be current for
+        elections even while the apply frontier lags — e.g. the sole
+        surviving member after the leader's own removal commits must
+        know the new cluster to elect itself.
+
+        ``entries`` is the post-drop_existing batch the caller is about
+        to write, so every entry genuinely lands (new or conflicting).
+        A batch starting at or below the recorded change index
+        overwrites/TRUNCATES that change, so the view reverts to the
+        prior configuration first — regardless of what the batch itself
+        carries — and only then adopts any change in the batch (with
+        the reverted config as its ``previous``, never the deposed
+        leader's phantom one).  cluster_index_term is updated BEFORE
+        _set_cluster so the cached membership (whose config fallback
+        keys on cit==0) is computed against the new index."""
+        if not entries:
+            return
+        cit = self.cluster_index_term
+        if cit.index > 0 and entries[0].index <= cit.index and \
+                self.previous_cluster is not None:
+            prev_it, prev_spec = self.previous_cluster
+            self.previous_cluster = None
+            self.cluster_index_term = prev_it
+            self._set_cluster(dict_from_cluster_spec(prev_spec))
+        for e in entries:
+            if isinstance(e.command, ClusterChangeCommand):
+                self.previous_cluster = (
+                    self.cluster_index_term,
+                    tuple((sid, p.membership)
+                          for sid, p in self.cluster.items()))
+                self.cluster_index_term = IdxTerm(e.index, e.term)
+                self._set_cluster(
+                    dict_from_cluster_spec(e.command.cluster))
 
     def _evaluate_commit_index_follower(self) -> list:
         """Apply up to min(last_index, commit_index) — may apply entries not
@@ -882,12 +943,27 @@ class RaServer:
     # candidate (ra_server.erl:745-950)
     # ------------------------------------------------------------------
 
+    def _count_grant(self, from_: Any) -> bool:
+        """A grant counts toward quorum only when the granter is a VOTER
+        of the candidate's OWN configuration (dissertation §4.2.2 vote
+        tallying).  A fresh member's config-fallback voter-ness lets it
+        grant before its cluster view catches up; an old-config
+        candidate must not count such a grant against its (smaller)
+        voter quorum — two leaders in one term otherwise (found by the
+        membership fuzz).  Self-grants count while the candidate is not
+        yet in its own view (single-member bootstrap/force-shrink)."""
+        if from_ == self.id:
+            return True
+        peer = self.cluster.get(from_)
+        return peer is not None and peer.membership == Membership.VOTER
+
     def _handle_candidate(self, event: Any) -> list:
         if isinstance(event, RequestVoteResult):
             if event.term > self.current_term:
                 self._update_term_and_voted_for(event.term, None)
                 return self._become_follower(event.term)
-            if not event.vote_granted or event.term != self.current_term:
+            if not event.vote_granted or event.term != self.current_term \
+                    or not self._count_grant(event.from_):
                 return []
             self.votes += 1
             if self.votes == self.required_quorum():
@@ -949,7 +1025,8 @@ class RaServer:
             if event.term > self.current_term:
                 return self._become_follower(event.term)
             if (event.vote_granted and event.token == self.pre_vote_token
-                    and event.term == self.current_term):
+                    and event.term == self.current_term
+                    and self._count_grant(event.from_)):
                 self.votes += 1
                 if self.votes == self.required_quorum():
                     return self._call_for_election_candidate()
@@ -1221,6 +1298,17 @@ class RaServer:
             new_cluster = {sid: (p.membership, p.promote_target)
                            for sid, p in self.cluster.items()
                            if sid != cmd.server_id}
+            if not any(ms == Membership.VOTER
+                       for ms, _t in new_cluster.values()):
+                # refusing is stricter than the reference but saves the
+                # cluster: a voterless config is permanently dead — no
+                # member can stand for election, so no later change can
+                # ever repair it (found by the membership fuzz: leave of
+                # the last voter while the rest were still promotable)
+                if from_ is not None:
+                    effects.append(Reply(from_, ErrorResult(
+                        "last_voter", self.id)))
+                return effects
             return self._append_cluster_change(new_cluster, cmd, from_,
                                                effects)
         # plain commands: attach from_ for the consensus reply
@@ -1656,20 +1744,26 @@ class RaServer:
             effs.append(StartElectionTimeout("medium"))
             effs.extend(self._replay_condition_pending())
             return effs
-        if isinstance(event, (RequestVoteRpc, PreVoteRpc)):
-            # deny votes while waiting (higher term still adopted)
-            if event.term > self.current_term:
-                self.condition = None
-                self.raft_state = RaftState.FOLLOWER
-                return [NextEvent(event)] + self._replay_condition_pending()
-            cand = event.candidate_id
-            if isinstance(event, RequestVoteRpc):
-                return [SendRpc(cand, RequestVoteResult(
-                    term=self.current_term, vote_granted=False,
-                    from_=self.id))]
-            return [SendRpc(cand, PreVoteResult(
-                term=self.current_term, token=event.token,
-                vote_granted=False, from_=self.id))]
+        if isinstance(event, RequestVoteRpc):
+            # a vote request exits the wait: revert to follower and
+            # re-dispatch it there (ra_server.erl:1453-1454).  Denying
+            # while parked starves elections — e.g. after a leader's
+            # self-removal commits, the survivors parked on its log gap
+            # would veto every candidacy forever (found by the
+            # membership fuzz).
+            self.condition = None
+            self.raft_state = RaftState.FOLLOWER
+            return [NextEvent(event)] + self._replay_condition_pending()
+        if isinstance(event, PreVoteRpc):
+            # pre-votes are answered IN PLACE — granting one does not
+            # exit the wait (ra_server.erl:1455-1456); the same
+            # non-voter gate as the follower path applies (:1197-1202),
+            # else a parked promotable grants pre-votes it would refuse
+            # as a follower and candidates burn terms on elections the
+            # real vote round then loses
+            if not self.is_voter():
+                return []
+            return self._process_pre_vote(event)
         if isinstance(event, WrittenEvent):
             self.log.handle_written(event)
             if self.condition is not None and \

@@ -47,10 +47,14 @@ class SimCluster:
     def __init__(self, n: int = 3, machine_factory: Optional[Callable] = None,
                  auto_written: bool = True,
                  snapshot_chunk_size: int = 64,
-                 log_factory: Optional[Callable] = None) -> None:
+                 log_factory: Optional[Callable] = None,
+                 initial_count: Optional[int] = None) -> None:
         """``log_factory(cfg) -> log`` swaps the in-memory mock for a
         real log (e.g. RaSystem.log_factory) so core scenarios can run
-        against durable storage; default stays MemoryLog."""
+        against durable storage; default stays MemoryLog.
+        ``initial_count`` starts only the first K ids as cluster members
+        — the rest run as standby servers awaiting a '$ra_join' (the
+        start_server-then-add_member flow)."""
         self.ids = mk_ids(n)
         if machine_factory is None:
             machine_factory = lambda: SimpleMachine(  # noqa: E731
@@ -63,10 +67,12 @@ class SimCluster:
         self.dropped: set = set()       # partitioned links (src, dst)
         self.snapshot_chunk_size = snapshot_chunk_size
         self._log_factory = log_factory
+        initial = tuple(self.ids[:initial_count]
+                        if initial_count else self.ids)
         for sid in self.ids:
             cfg = ServerConfig(server_id=sid, uid=f"uid_{sid.name}",
                                cluster_name="simcluster",
-                               initial_members=tuple(self.ids),
+                               initial_members=initial,
                                machine=machine_factory())
             log = (self._log_factory(cfg) if self._log_factory
                    else MemoryLog(auto_written=auto_written))
