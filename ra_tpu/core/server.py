@@ -206,6 +206,11 @@ class RaServer:
             self.machine_versions = [(meta.index, meta.machine_version)]
             self.cluster = {sid: Peer(membership=m)
                             for sid, m in meta.cluster}
+            # the recovered config is as-of the snapshot point (same
+            # rationale as the install path: cit must not stay 0 or the
+            # config-voter fallback misfires for servers absent from
+            # the snapshot's cluster)
+            self.cluster_index_term = IdxTerm(meta.index, meta.term)
         else:
             self.machine_state = self.machine.init(
                 {"id": self.id, "uid": self.cfg.uid,
@@ -232,8 +237,16 @@ class RaServer:
         for entry in self.log.read_range(self.last_applied + 1, last_idx):
             cmd = entry.command
             if isinstance(cmd, ClusterChangeCommand):
-                self._set_cluster(dict_from_cluster_spec(cmd.cluster))
+                # record the revert baseline (an overwrite of this
+                # uncommitted change after restart must restore it) and
+                # order cit before _set_cluster so the cached membership
+                # sees the new index (its config fallback keys on cit==0)
+                self.previous_cluster = (
+                    self.cluster_index_term,
+                    tuple((sid, p.membership)
+                          for sid, p in self.cluster.items()))
                 self.cluster_index_term = IdxTerm(entry.index, entry.term)
+                self._set_cluster(dict_from_cluster_spec(cmd.cluster))
         self.raft_state = RaftState.FOLLOWER
         return []
 
@@ -623,17 +636,19 @@ class RaServer:
                                                        True)))
             return effects
         if isinstance(event, PreVoteRpc):
-            # non-voters ignore vote requests (ra_server.erl:1197-1210);
-            # a fresh member's voter-ness comes from its CONFIG when it
-            # is not yet in its own cluster view (_get_membership
-            # fallback, :349-350) — without that fallback a joined-but-
-            # never-caught-up voter would veto elections forever
-            if not self.is_voter():
-                return []
+            # DESIGN DIVERGENCE from the reference: every server grants
+            # (pre-)votes based on term/votedFor/log alone — canonical
+            # Raft.  The reference gates granting on the granter's OWN
+            # membership (ra_server.erl:1197-1210), but a granter's
+            # self-view can be arbitrarily stale in BOTH directions
+            # (promoted-but-unaware, joined-but-uncaught-up), and the
+            # fuzzers showed each one deadlocking elections that need
+            # that vote.  Safety lives on the COUNTING side instead
+            # (_count_grant: a candidate tallies only voters of its own
+            # configuration), which the reference lacks.  Membership
+            # still gates STANDING for election (the timeout below).
             return self._process_pre_vote(event)
         if isinstance(event, RequestVoteRpc):
-            if not self.is_voter():
-                return []
             return self._process_request_vote(event)
         if isinstance(event, InstallSnapshotRpc):
             return self._follower_install_snapshot(event)
@@ -643,7 +658,7 @@ class RaServer:
         if isinstance(event, (RequestVoteResult, PreVoteResult)):
             return []
         if isinstance(event, ElectionTimeout):
-            if not self.is_voter():
+            if not (self.is_voter() or self._removed_but_uncommitted()):
                 return []
             return self._call_for_election_pre_vote()
         if isinstance(event, ForceElectionEvent):
@@ -902,6 +917,13 @@ class RaServer:
                 self.effective_machine_version = meta.machine_version
                 self.effective_machine = self.machine.which_module(
                     meta.machine_version)
+                # the installed config is as-of the snapshot point: the
+                # change index MUST move with it, or it stays 0 and the
+                # config-voter fallback re-arms — a server absent from
+                # the installed cluster would then self-elect against a
+                # quorum that excludes it (found by the combined fuzz)
+                self.cluster_index_term = IdxTerm(meta.index, meta.term)
+                self.previous_cluster = None
                 self._set_cluster({sid: Peer(membership=m)
                                    for sid, m in meta.cluster})
                 self._accepting_snapshot = None
@@ -950,12 +972,28 @@ class RaServer:
         grant before its cluster view catches up; an old-config
         candidate must not count such a grant against its (smaller)
         voter quorum — two leaders in one term otherwise (found by the
-        membership fuzz).  Self-grants count while the candidate is not
-        yet in its own view (single-member bootstrap/force-shrink)."""
+        membership fuzz).  The SELF-vote follows the same rule: a
+        candidate absent from its own configuration (removed by an
+        uncommitted change — see _removed_but_uncommitted) does not
+        count itself; it needs a full quorum of the new config's
+        voters.  Before any cluster change is known (bootstrap), the
+        self-vote counts."""
         if from_ == self.id:
-            return True
+            peer = self.cluster.get(self.id)
+            if peer is not None:
+                return peer.membership == Membership.VOTER
+            return self.cluster_index_term.index == 0
         peer = self.cluster.get(from_)
         return peer is not None and peer.membership == Membership.VOTER
+
+    def _removed_but_uncommitted(self) -> bool:
+        """Dissertation §4.2.2: a server absent from its own latest
+        configuration keeps standing for election until the removing
+        change COMMITS — it may still be needed, e.g. when it holds the
+        longest log (containing that very change) and no new-config
+        member can win without first obtaining it."""
+        return (self.id not in self.cluster and
+                self.cluster_index_term.index > self.commit_index)
 
     def _handle_candidate(self, event: Any) -> list:
         if isinstance(event, RequestVoteResult):
@@ -1104,6 +1142,24 @@ class RaServer:
                 return []
             peer.status = PeerStatus.NORMAL
             peer.snapshot_sender = None
+            # a REFUSED install reports the follower's own (possibly
+            # stale) tail — verify it like an AER success confirm
+            # before it may touch match (the combined fuzz found the
+            # unchecked form looping forever: match poisoned beyond our
+            # log -> prev unverifiable -> another snapshot send -> the
+            # follower refuses again with the same stale tail)
+            my_last = self.log.last_index_term().index
+            verifiable = event.last_index >= self.log.first_index()
+            if event.last_index > 0 and verifiable and \
+                    self.log.fetch_term(event.last_index) != \
+                    event.last_term:
+                if event.last_index > my_last:
+                    # stale surplus: only the empty-AER reset truncates
+                    peer.next_index = my_last + 1
+                    eff = self._make_rpc_for_peer(event.from_, peer, 1)
+                    return [eff] if eff is not None else []
+                peer.next_index = peer.match_index + 1
+                return self._make_pipelined_rpcs()
             peer.match_index = max(peer.match_index, event.last_index)
             peer.commit_index_sent = event.last_index
             peer.next_index = event.last_index + 1
@@ -1458,9 +1514,11 @@ class RaServer:
         if isinstance(cmd, ClusterChangeCommand):
             if (idx > self.cluster_index_term.index and
                     term >= self.cluster_index_term.term):
-                # recovery path: actually apply the change
-                self._set_cluster(dict_from_cluster_spec(cmd.cluster))
+                # recovery path: actually apply the change (cit before
+                # _set_cluster — the membership cache's config fallback
+                # keys on cit==0)
                 self.cluster_index_term = IdxTerm(idx, term)
+                self._set_cluster(dict_from_cluster_spec(cmd.cluster))
             self.cluster_change_permitted = True
             self.last_applied = idx
             if not suppress:
@@ -1755,14 +1813,19 @@ class RaServer:
             self.raft_state = RaftState.FOLLOWER
             return [NextEvent(event)] + self._replay_condition_pending()
         if isinstance(event, PreVoteRpc):
-            # pre-votes are answered IN PLACE — granting one does not
-            # exit the wait (ra_server.erl:1455-1456); the same
-            # non-voter gate as the follower path applies (:1197-1202),
-            # else a parked promotable grants pre-votes it would refuse
-            # as a follower and candidates burn terms on elections the
-            # real vote round then loses
-            if not self.is_voter():
-                return []
+            # a HIGHER-term pre-vote exits the wait like a vote request
+            # does: a parked LEADER that merely adopted the term in
+            # place would later resume as leader of a term it never won
+            # (two leaders in one term)
+            if event.term > self.current_term:
+                self.condition = None
+                self.raft_state = RaftState.FOLLOWER
+                return [NextEvent(event)] + self._replay_condition_pending()
+            # same-term pre-votes are answered IN PLACE — granting one
+            # does not exit the wait (ra_server.erl:1455-1456).  Like
+            # the follower path, no granter-side membership gate: real
+            # votes are equally permissive, so a pre-vote grant here
+            # cannot lure a candidate into an election it then loses.
             return self._process_pre_vote(event)
         if isinstance(event, WrittenEvent):
             self.log.handle_written(event)

@@ -411,7 +411,9 @@ def test_election_safety_and_log_matching_fuzz(seed, n_members):
             c.handle(sid, TickEvent())
             # a parked await_condition only exits on its timeout (the
             # deterministic harness has no real timers)
-            if c.servers[sid].raft_state.value == "await_condition":
+            if c.servers[sid].raft_state.value in (
+                    "await_condition", "pre_vote", "candidate") and \
+                    rng.random() < 0.4:
                 c.handle(sid, ElectionTimeout())
         c.run()
         lead = c.leader()
@@ -777,7 +779,7 @@ def test_safety_fuzz_with_membership_changes(seed):
     # heal + converge on the FINAL committed membership
     c.heal()
     final_members = None
-    for _ in range(80):
+    for _ in range(300):
         c.run()
         for sid in sids:
             srv = c.servers[sid]
@@ -785,11 +787,20 @@ def test_safety_fuzz_with_membership_changes(seed):
                 if p.status == PeerStatus.SENDING_SNAPSHOT:
                     p.snapshot_started = 0.0
             c.handle(sid, TickEvent())
-            # timer stand-ins: parked members exit their condition and
-            # electors whose vote requests the fuzz dropped retry — the
-            # runtime's election timers would fire here
-            if srv.raft_state.value in ("await_condition", "pre_vote",
-                                        "candidate"):
+            # randomized stand-ins for election timers: parked members
+            # exit their condition and stuck electors retry — but NOT
+            # in lockstep, or a hopeless candidate's term churn forever
+            # outruns the viable candidate's pre-vote window (real
+            # timers are randomized for exactly this reason)
+            st = srv.raft_state.value
+            # condition timeouts fire fast (each cycle consumes one
+            # stale postponed event before re-parking, so a member
+            # needs ~backlog-length kicks before it can stand);
+            # elector retries stay slow so rival candidacies cannot
+            # run in lockstep
+            if (st == "await_condition" and rng.random() < 0.9) or \
+                    (st in ("pre_vote", "candidate") and
+                     rng.random() < 0.3):
                 c.handle(sid, ElectionTimeout())
         c.run()
         lds = live_leaders()
@@ -810,8 +821,11 @@ def test_safety_fuzz_with_membership_changes(seed):
         if lead not in members:
             continue  # leader's own removal still committing
         la = srv.last_applied
+        tail = srv.log.last_index_term()
         if la > 0 and all(
-                c.servers[m].last_applied == la for m in members):
+                c.servers[m].last_applied == la and
+                c.servers[m].log.last_index_term() == tail
+                for m in members):
             states = {m: c.servers[m].machine_state for m in members}
             if len(set(states.values())) == 1:
                 final_members = members
@@ -820,6 +834,156 @@ def test_safety_fuzz_with_membership_changes(seed):
     assert final_members is not None, "membership fuzz did not converge"
     lead = max(live_leaders(), key=lambda s: c.servers[s].current_term)
     # every final LIVE member agrees on the full committed composition
+    lead_cluster = set(c.servers[lead].cluster)
+    for m in final_members:
+        assert set(c.servers[m].cluster) == lead_cluster, \
+            (m, set(c.servers[m].cluster), lead_cluster)
+
+
+# ---------------------------------------------------------------------------
+# property 8: combined chaos — membership + snapshots + partitions
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [3, 17, 31, 53])
+def test_safety_fuzz_membership_and_snapshots(seed):
+    """The two hardest schedules combined: cluster changes (effective on
+    append, carried in snapshot metas, install-restored on laggards)
+    interleaved with release_cursor truncation, partitions, drops, and
+    elections.  Laggards may now learn MEMBERSHIP through a chunked
+    snapshot install whose meta cluster is newer than anything in their
+    log.  Invariants as before, plus final cluster-view agreement."""
+    from ra_tpu.core.types import (JoinCommand, LeaveCommand, Membership,
+                                   PeerStatus, ReleaseCursor, TickEvent)
+
+    rng = random.Random(seed)
+    c = SimCluster(5, initial_count=3, snapshot_chunk_size=8)
+    sids = c.ids
+    leaders_by_term: dict = {}
+
+    def live_leaders():
+        return [sid for sid in sids
+                if c.servers[sid].raft_state.value == "leader"]
+
+    def observe():
+        for sid in live_leaders():
+            srv = c.servers[sid]
+            prev = leaders_by_term.setdefault(srv.current_term, sid)
+            assert prev == sid, (srv.current_term, prev, sid)
+        for i, a in enumerate(sids):
+            for b in sids[i + 1:]:
+                sa, sb = c.servers[a], c.servers[b]
+                upto = min(sa.last_applied, sb.last_applied)
+                if upto >= 1:
+                    ea, eb = sa.log.fetch(upto), sb.log.fetch(upto)
+                    if ea is not None and eb is not None:
+                        assert ea.term == eb.term, (a, b, upto)
+
+    c.elect(sids[0])
+    for step in range(400):
+        roll = rng.random()
+        if roll < 0.38:
+            c.step()
+        elif roll < 0.46:
+            sid = rng.choice(sids)
+            if c.queues[sid]:
+                c.queues[sid].popleft()
+        elif roll < 0.54:
+            a, b = rng.sample(sids, 2)
+            if (a, b) in c.dropped:
+                c.dropped.discard((a, b))
+                c.dropped.discard((b, a))
+            else:
+                c.partition(a, b)
+        elif roll < 0.62:
+            sid = rng.choice(sids)
+            if c.servers[sid].raft_state.value in (
+                    "follower", "pre_vote", "candidate",
+                    "await_condition"):
+                c.handle(sid, ElectionTimeout())
+        elif roll < 0.7:
+            lead = c.leader()
+            if lead is not None:
+                srv = c.servers[lead]
+                if srv.last_applied > srv.log.snapshot_index_term().index:
+                    c._process_effects(lead, srv.handle_machine_effect(
+                        ReleaseCursor(srv.last_applied,
+                                      srv.machine_state)))
+        elif roll < 0.8:
+            lead = c.leader()
+            if lead is not None:
+                srv = c.servers[lead]
+                target = rng.choice(sids)
+                stopped = c.servers[target].raft_state.value in (
+                    "stop", "delete_and_terminate")
+                if rng.random() < 0.5 and target not in srv.cluster \
+                        and not stopped:
+                    ms = rng.choice((Membership.VOTER,
+                                     Membership.PROMOTABLE))
+                    c.handle(lead, CommandEvent(
+                        JoinCommand(target, membership=ms)))
+                elif target in srv.cluster and len(srv.cluster) > 1:
+                    c.handle(lead, CommandEvent(LeaveCommand(target)))
+        else:
+            lead = c.leader()
+            if lead is not None:
+                c.handle(lead, CommandEvent(
+                    UserCommand(rng.randrange(1, 9))))
+        observe()
+
+    c.heal()
+    final_members = None
+    for _ in range(300):
+        c.run()
+        for sid in sids:
+            srv = c.servers[sid]
+            for p in srv.cluster.values():
+                if p.status == PeerStatus.SENDING_SNAPSHOT:
+                    p.snapshot_started = 0.0
+            c.handle(sid, TickEvent())
+            # randomized stand-ins for election timers: parked members
+            # exit their condition and stuck electors retry — but NOT
+            # in lockstep, or a hopeless candidate's term churn forever
+            # outruns the viable candidate's pre-vote window (real
+            # timers are randomized for exactly this reason)
+            st = srv.raft_state.value
+            # condition timeouts fire fast (each cycle consumes one
+            # stale postponed event before re-parking, so a member
+            # needs ~backlog-length kicks before it can stand);
+            # elector retries stay slow so rival candidacies cannot
+            # run in lockstep
+            if (st == "await_condition" and rng.random() < 0.9) or \
+                    (st in ("pre_vote", "candidate") and
+                     rng.random() < 0.3):
+                c.handle(sid, ElectionTimeout())
+        c.run()
+        lds = live_leaders()
+        if not lds:
+            sid = rng.choice(sids)
+            if c.servers[sid].raft_state.value in ("follower", "pre_vote",
+                                                   "candidate"):
+                c.handle(sid, ElectionTimeout())
+            continue
+        lead = max(lds, key=lambda s: c.servers[s].current_term)
+        srv = c.servers[lead]
+        members = [pid for pid in srv.cluster
+                   if c.servers[pid].raft_state.value not in
+                   ("stop", "delete_and_terminate")]
+        if lead not in members:
+            continue
+        la = srv.last_applied
+        tail = srv.log.last_index_term()
+        if la > 0 and all(
+                c.servers[m].last_applied == la and
+                c.servers[m].log.last_index_term() == tail
+                for m in members):
+            states = {m: c.servers[m].machine_state for m in members}
+            if len(set(states.values())) == 1:
+                final_members = members
+                break
+    observe()
+    assert final_members is not None, \
+        "membership+snapshot fuzz did not converge"
+    lead = max(live_leaders(), key=lambda s: c.servers[s].current_term)
     lead_cluster = set(c.servers[lead].cluster)
     for m in final_members:
         assert set(c.servers[m].cluster) == lead_cluster, \
