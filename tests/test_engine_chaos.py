@@ -1,0 +1,108 @@
+"""Seeded chaos schedule for the lockstep lane engine — the device-path
+counterpart of the core interleaving fuzzers: random member failures
+(quorum preserved), recoveries through the snapshot-install contract,
+elections for dead-leader lanes, and continuous traffic, with
+per-step invariants and a final all-replica convergence check against
+the RegisterMachine host oracle.
+"""
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ra_tpu.engine import LockstepEngine
+from ra_tpu.models import RegisterMachine
+
+from test_engine_elections_adversarial import drain_committed
+from test_register_machine import host_fold
+
+N, P, K = 4, 5, 4
+
+
+def run_chaos(seed, rounds=30):
+    rng = random.Random(seed)
+    eng = LockstepEngine(RegisterMachine(n_slots=8), N, P,
+                         ring_capacity=256, max_step_cmds=K,
+                         write_delay=1, donate=False)
+    committed_cmds: list = []       # acked = fully committed batches
+    down: dict = {lane: set() for lane in range(N)}
+    prev_total = 0
+
+    def drain_all():
+        drain_committed(eng, limit=40)
+
+    for _round in range(rounds):
+        roll = rng.random()
+        if roll < 0.5:
+            # traffic: identical commands across lanes (oracle stays 1-D)
+            cmds = [(1, rng.randrange(0, 8), rng.randrange(1, 100), 0)
+                    for _ in range(K)]
+            pay = np.zeros((N, K, 4), np.int32)
+            for k, cmd in enumerate(cmds):
+                pay[:, k] = cmd
+            eng.step(np.full((N,), K, np.int32), jnp.asarray(pay))
+            drain_all()
+            committed_cmds.extend(cmds)
+        elif roll < 0.7:
+            # fail a random member on every lane, quorum preserved
+            leads = np.asarray(eng.state.leader_slot)
+            for lane in range(N):
+                if len(down[lane]) >= (P - 1) // 2:
+                    continue
+                choices = [s for s in range(P) if s not in down[lane]]
+                victim = rng.choice(choices)
+                eng.fail_member(lane, victim)
+                down[lane].add(victim)
+                if victim == int(leads[lane]):
+                    eng.trigger_election([lane])
+        elif roll < 0.9:
+            # recover one dead member per lane (leader-guard respected)
+            leads = np.asarray(eng.state.leader_slot)
+            for lane in range(N):
+                if down[lane]:
+                    slot = rng.choice(sorted(down[lane]))
+                    if slot != int(leads[lane]):
+                        eng.recover_member(lane, slot)
+                        down[lane].discard(slot)
+        else:
+            eng.trigger_election(list(range(N)))  # gratuitous transfer
+        total = eng.committed_total()
+        assert total >= prev_total, "committed total regressed"
+        prev_total = total
+        st = eng.state
+        lane = np.arange(N)
+        leads = np.asarray(st.leader_slot)
+        com = np.asarray(st.commit)[lane, leads]
+        tail = np.asarray(st.last_index)[lane, leads]
+        assert (com <= tail).all(), "commit beyond leader log"
+
+    # heal everything and converge
+    leads = np.asarray(eng.state.leader_slot)
+    for lane in range(N):
+        for slot in sorted(down[lane]):
+            if slot != int(leads[lane]):
+                eng.recover_member(lane, slot)
+                down[lane].discard(slot)
+    stalled = [lane for lane in range(N) if down[lane]]
+    if stalled:
+        eng.trigger_election(stalled)
+        leads = np.asarray(eng.state.leader_slot)
+        for lane in stalled:
+            for slot in sorted(down[lane]):
+                if slot != int(leads[lane]):
+                    eng.recover_member(lane, slot)
+                    down[lane].discard(slot)
+    assert not any(down.values()), down
+    drain_all()
+    want = host_fold(committed_cmds)
+    mac = np.asarray(eng.state.mac)
+    for lane in range(N):
+        for member in range(P):
+            assert mac[lane, member].tolist() == want, \
+                (lane, member, mac[lane, member].tolist(), want)
+
+
+@pytest.mark.parametrize("seed", [1, 9])
+def test_engine_chaos_schedule(seed):
+    run_chaos(seed)

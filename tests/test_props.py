@@ -1007,8 +1007,6 @@ def test_safety_fuzz_mixed_machine_versions(seed):
     pre-bump prefix."""
     from ra_tpu.core.types import PeerStatus, TickEvent
 
-    from test_machine_version import CounterV0, CounterV1
-
     from test_machine_version import mixed_cluster
 
     rng = random.Random(seed)
@@ -1089,11 +1087,13 @@ def test_safety_fuzz_mixed_machine_versions(seed):
         la = c.servers[lead].last_applied
         if la > 0 and all(c.servers[m].last_applied == la
                           for m in v1_members):
+            converged = lead
             break
+    else:
+        raise AssertionError("version fuzz did not converge")
     observe()
-    lead = c.leader()
-    assert lead is not None
-    srv_l = c.servers[lead]
+    lead = converged   # the max-term leader — c.leader() could return a
+    srv_l = c.servers[lead]  # deposed one still unaware of the new term
     # the bump must have committed (every seed exercises it; a silent
     # version-0 ending would make the rest of the test vacuous)
     assert srv_l.effective_machine_version == 1
