@@ -78,9 +78,55 @@ class Fabric:
             assert r[0] == "ready", r
 
     def ask(self, n, *cmd, timeout=60):
+        import queue as _q
         cq, rq = self.chans[n]
         cq.put(cmd)
-        return rq.get(timeout=timeout)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return rq.get(timeout=1.0)
+            except _q.Empty:
+                if not self.workers[n].is_alive():
+                    # the reply may have landed just as the process
+                    # exited (e.g. the "stop" ack): drain once before
+                    # declaring death
+                    try:
+                        return rq.get_nowait()
+                    except _q.Empty:
+                        raise RuntimeError(
+                            f"worker {n} died while awaiting "
+                            f"{cmd[0]!r}") from None
+                if time.monotonic() > deadline:
+                    raise
+
+    def await_identical_lists(self, acked, timeout=90):
+        """Poll every member until all hold ONE identical list that
+        contains every acked value; returns it.  Short per-poll
+        timeouts so one unresponsive worker cannot eat the budget, and
+        the last error is surfaced instead of a vacuous pass."""
+        deadline = time.monotonic() + timeout
+        states, last_err = {}, None
+        while time.monotonic() < deadline:
+            try:
+                states = {n: self.ask(n, "state", timeout=5)[2]
+                          for n in self.names}
+            except Exception as e:  # noqa: BLE001 — retried probe
+                last_err = e
+                time.sleep(0.5)
+                continue
+            lists = list(states.values())
+            if all(x == lists[0] for x in lists) and \
+                    set(acked) <= set(lists[0]):
+                break
+            time.sleep(0.4)
+        assert states, f"no member ever answered: {last_err!r}"
+        lists = list(states.values())
+        assert all(x == lists[0] for x in lists), states
+        final = lists[0]
+        assert set(acked) <= set(final), \
+            (sorted(set(acked) - set(final)), "acked values lost")
+        assert len(final) == len(set(final)), "duplicates applied"
+        return final
 
     def stop(self):
         for n, p in self.workers.items():
@@ -335,20 +381,73 @@ def test_wal_crash_on_node_over_tcp(tmp_path, victim_role):
         # replicas converge to one identical list containing every
         # acked value exactly once (timed-out attempts may or may not
         # appear — but never twice)
-        deadline = time.monotonic() + 60
-        states = {}
-        while time.monotonic() < deadline:
-            states = {n: f.ask(n, "state")[2] for n in f.names}
-            lists = list(states.values())
-            if all(x == lists[0] for x in lists) and \
-                    set(acked) <= set(lists[0]):
-                break
-            time.sleep(0.3)
-        lists = list(states.values())
-        assert all(x == lists[0] for x in lists), states
-        final = lists[0]
-        assert set(acked) <= set(final), (acked, final)   # no acked loss
-        assert len(final) == len(set(final)), final       # no dup
+        f.await_identical_lists(acked, timeout=60)
+    finally:
+        f.stop()
+
+
+def test_randomized_fault_schedule_over_tcp(tmp_path):
+    """Seeded random schedule over real OS processes and sockets:
+    socket-level partitions and heals, WAL crashes, process kill +
+    respawn over the durable log, and client commands with unique
+    values — every acked value must survive exactly once on every
+    member (the partitions_SUITE nemesis shape, randomized)."""
+    import random
+
+    rng = random.Random(7)
+    f = Fabric(["tn1", "tn2", "tn3"], machine="list",
+               data_root=str(tmp_path))
+    try:
+        f.ask("tn1", "elect")
+        leader = f.await_leader()
+        acked = []
+        val = 0
+        # one fault active at a time (the nemesis discipline): a
+        # partition PLUS a kill exceeds quorum and makes unavailability
+        # legitimate, which is not what this test asserts
+        fault = None          # None | ("part", victim) | ("kill", victim)
+        for step in range(26):
+            roll = rng.random()
+            if roll < 0.5:
+                val += 1
+                r = f.ask(leader, "command", val, timeout=45)
+                if r[0] == "ok":
+                    acked.append(val)
+                else:
+                    leader = f.await_leader(timeout=45)
+            elif roll < 0.65 and fault is None:
+                victim = rng.choice([n for n in f.names if n != leader])
+                f.ask(victim, "partition",
+                      [n for n in f.names if n != victim])
+                for n in f.names:
+                    if n != victim:
+                        f.ask(n, "partition", [victim])
+                fault = ("part", victim)
+            elif roll < 0.8 and fault is not None:
+                kind, victim = fault
+                if kind == "part":
+                    for n in f.names:
+                        f.ask(n, "heal")
+                else:
+                    f.respawn(victim)
+                fault = None
+            elif roll < 0.9 and fault is None:
+                victim = rng.choice(f.names)
+                f.workers[victim].terminate()
+                f.workers[victim].join(timeout=10)
+                fault = ("kill", victim)
+                if victim == leader:
+                    leader = f.await_leader(timeout=45)
+            elif fault is None:
+                f.ask(leader, "kill_wal", timeout=45)
+        if fault is not None:
+            kind, victim = fault
+            if kind == "kill":
+                f.respawn(victim)
+        for n in f.names:
+            f.ask(n, "heal")
+        # converge: identical lists everywhere, every ack exactly once
+        f.await_identical_lists(acked, timeout=90)
     finally:
         f.stop()
 
