@@ -271,3 +271,80 @@ def test_rtw1_files_remain_readable(tmp_path):
     scan_wal_file(path, tables)
     assert sorted(tables["legacy"]) == [1, 2, 3, 4, 5]
     assert tables["legacy"][3] == (7, b"old-3")
+
+
+def test_same_uid_reregistration_reroutes_confirms(tmp_path):
+    """same_uid_different_process: a restarted server re-registers its
+    uid; confirms from then on go to the NEW notify identity, and the
+    fresh writer's sequence check tolerates the restart (writer_id =
+    {UId, pid} in the reference, ra_log_wal.erl:44-51)."""
+    wal = Wal(str(tmp_path), sync_mode=0)
+    try:
+        old, new = Sink(), Sink()
+        wal.register("u1", old)
+        wal.write("u1", 1, 1, b"a")
+        wal.write("u1", 2, 1, b"b")
+        assert old.wait_hi(2)
+        n_old = len(old.confirms)
+        # "process restart": same uid, new incarnation
+        wal.register("u1", new)
+        # the restarted writer resumes ABOVE its durable tail; a fresh
+        # sequence is accepted without a resend signal
+        wal.write("u1", 3, 1, b"c")
+        assert new.wait_hi(3)
+        assert len(old.confirms) == n_old, "stale identity kept confirms"
+        assert not new.resends
+    finally:
+        wal.close()
+
+
+def test_recover_empty_wal_file(tmp_path):
+    """recover_empty: a zero-entry (header-only or 0-byte) WAL file
+    recovers to an empty table without complaint."""
+    wal = Wal(str(tmp_path), sync_mode=0)
+    wal.close()                       # leaves the fresh file, no records
+    # plus a truly empty stray file
+    open(os.path.join(str(tmp_path), "wal", "99999999.wal"),
+         "wb").close()
+    wal2 = Wal(str(tmp_path), sync_mode=0)
+    try:
+        assert wal2.recovered_table("anyuid") == {}
+        s = Sink()
+        wal2.register("u1", s)
+        wal2.write("u1", 1, 1, b"x")
+        assert s.wait_hi(1)
+    finally:
+        wal2.close()
+
+
+def test_recover_overwrite_in_same_batch(tmp_path):
+    """recover_overwrite_in_same_batch: an overwrite landing in the SAME
+    fsync batch as the overwritten entries must recover to the final
+    values only.  The same-batch property is scheduling-dependent, so
+    it is asserted (batches == 1) with retries on fresh directories."""
+    for attempt in range(5):
+        d = os.path.join(str(tmp_path), f"try{attempt}")
+        wal = Wal(d, sync_mode=0)
+        s = Sink()
+        wal.register("u1", s)
+        # queue all writes before the batch thread drains: same batch
+        wal.write("u1", 1, 1, b"one")
+        wal.write("u1", 2, 1, b"two")
+        wal.write("u1", 3, 1, b"three")
+        wal.write("u1", 2, 2, b"TWO'")     # overwrite invalidates 3
+        wal.write("u1", 3, 2, b"THREE'")
+        assert s.wait_hi(3)
+        one_batch = wal.counters["batches"] == 1
+        wal.close()
+        if one_batch:
+            str_d = d
+            break
+    else:
+        pytest.skip("scheduler split the writes across batches 5x")
+    wal2 = Wal(str_d, sync_mode=0)
+    try:
+        table = wal2.recovered_table("u1")
+        assert {i: (t, bytes(p)) for i, (t, p) in table.items()} == {
+            1: (1, b"one"), 2: (2, b"TWO'"), 3: (2, b"THREE'")}
+    finally:
+        wal2.close()
