@@ -118,3 +118,36 @@ def test_dbg_replay(tmp_path):
     final = replay_log(str(tmp_path), "uid_dbg",
                        SimpleMachine(lambda c, s: s + c, 0))
     assert final == 210
+
+
+def test_dbg_replay_dedups_overwritten_indexes(tmp_path):
+    """filter_entry_duplicate (ra_dbg_SUITE): a WAL holding both the
+    original and the overwriting records for an index must replay only
+    the surviving values — the offline fold sees each index once, at
+    its final term."""
+    from ra_tpu.core.types import Entry, UserCommand
+    from ra_tpu.dbg import read_log, replay_log
+
+    from test_durable_log import drain, mk_log, mk_system
+
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 11):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    # follower-path overwrite: 6..8 replaced at term 2 (truncates 9-10)
+    log.write([Entry(i, 2, UserCommand(i * 100)) for i in (6, 7, 8)])
+    drain(log)
+    sys_.close()
+
+    snapshot, entries = read_log(str(tmp_path), "u1")
+    assert snapshot is None
+    by_idx = {}
+    for e in entries:
+        assert e.index not in by_idx, f"duplicate index {e.index}"
+        by_idx[e.index] = e
+    assert {i: e.term for i, e in by_idx.items()} == {
+        1: 1, 2: 1, 3: 1, 4: 1, 5: 1, 6: 2, 7: 2, 8: 2}
+    final = replay_log(str(tmp_path), "u1",
+                       SimpleMachine(lambda c, s: s + c, 0))
+    assert final == 1 + 2 + 3 + 4 + 5 + 600 + 700 + 800
