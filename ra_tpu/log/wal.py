@@ -134,6 +134,7 @@ class Wal:
                  write_strategy: str = "default",
                  max_size: int = DEFAULT_MAX_SIZE,
                  max_batch: int = DEFAULT_MAX_BATCH,
+                 max_entries: int = 0,
                  segment_writer=None) -> None:
         """write_strategy (ra_log_wal.erl:66-96):
 
@@ -155,6 +156,10 @@ class Wal:
         self.sync_mode = sync_mode
         self.write_strategy = write_strategy
         self.max_size = max_size
+        #: optional per-file record cap (wal_max_entries; the reference
+        #: rolls on either limit, ra_log_wal.erl:593-620) — 0 disables
+        self.max_entries = max_entries
+        self._file_entries = 0
         self.max_batch = max_batch
         self.segment_writer = segment_writer
         self._writers: dict[str, _Writer] = {}
@@ -276,7 +281,16 @@ class Wal:
                 # cleanup, fd left open, queued writes abandoned)
                 raise RuntimeError("wal killed")
             batch = [first]
-            while len(batch) < self.max_batch:
+            # cap the batch at the remaining per-file entry budget so a
+            # file never exceeds max_entries (the reference evaluates
+            # its roll condition per write, ra_log_wal.erl:426-441 —
+            # batch-granularity enforcement alone could overshoot by a
+            # whole max_batch under bursty load)
+            cap = self.max_batch
+            if self.max_entries:
+                cap = min(cap, max(1, self.max_entries -
+                                   self._file_entries))
+            while len(batch) < cap:
                 try:
                     batch.append(self._queue.get_nowait())
                 except queue.Empty:
@@ -373,6 +387,7 @@ class Wal:
             else:
                 n = IO.write_batch(self._fd, bytes(buf), self.sync_mode)
             self._file_size += n
+            self._file_entries += n_entries
             self.counters["batches"] += 1
             self.counters["writes"] += n_entries
             self.counters["bytes_written"] += n
@@ -401,7 +416,9 @@ class Wal:
             # (complete_batch with post-notify sync, ra_log_wal.erl:66-96)
             IO.sync(self._fd, self.sync_mode)
             self.counters["syncs"] += 1
-        if roll or self._file_size >= self.max_size:
+        if roll or self._file_size >= self.max_size or \
+                (self.max_entries and
+                 self._file_entries >= self.max_entries):
             self._rollover()
         # flush barriers release only after any requested rollover has been
         # handed to the segment writer (callers chain await_idle after)
@@ -419,6 +436,7 @@ class Wal:
                                o_sync=self.write_strategy == "o_sync")
         IO.write_batch(self._fd, MAGIC, 0)
         self._file_size = len(MAGIC)
+        self._file_entries = 0
         self._registered_in_file = set()
         self._file_ranges = {}
 

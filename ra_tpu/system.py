@@ -54,6 +54,7 @@ class RaSystem:
                  wal_sync_mode: int = 1,
                  wal_max_size: int = DEFAULT_MAX_SIZE,
                  wal_max_batch: int = DEFAULT_MAX_BATCH,
+                 wal_max_entries: int = 0,
                  segment_max_count: int = 4096,
                  wal_supervise: bool = True) -> None:
         self.name = name
@@ -66,6 +67,7 @@ class RaSystem:
         self.segment_writer = SegmentWriter(resolve=self._resolve)
         self.wal = Wal(data_dir, sync_mode=wal_sync_mode,
                        max_size=wal_max_size, max_batch=wal_max_batch,
+                       max_entries=wal_max_entries,
                        segment_writer=self.segment_writer)
         # Recovered WAL entries are purged at boot ONLY for uids with an
         # explicit force-delete tombstone.  Absence from the registry is

@@ -348,3 +348,40 @@ def test_recover_overwrite_in_same_batch(tmp_path):
             1: (1, b"one"), 2: (2, b"TWO'"), 3: (2, b"THREE'")}
     finally:
         wal2.close()
+
+
+def test_rollover_on_entry_limit(tmp_path):
+    """roll_over_entry_limit: the file rolls once it holds max_entries
+    records, independent of byte size."""
+    ranges = []
+
+    class Catcher:
+        def accept_ranges(self, r, path):
+            ranges.append((dict(r), path))
+
+        def retire(self, uids, files):
+            pass
+
+        def mark_deleted(self, uid):
+            pass
+
+    wal = Wal(str(tmp_path), sync_mode=0, max_entries=10,
+              segment_writer=Catcher())
+    try:
+        s = Sink()
+        wal.register("u1", s)
+        for i in range(1, 26):     # 25 tiny records, far under max_size
+            wal.write("u1", i, 1, b"x")
+        assert s.wait_hi(25)
+        wal.flush()
+        files = wal_files(tmp_path)
+        assert len(files) >= 3, files   # >= two rollovers for 25/10
+        assert len(ranges) >= 2, ranges
+        # the cap is a hard per-file bound, batch granularity included
+        for _r, path in ranges:
+            tables: dict = {}
+            scan_wal_file(path, tables)
+            n = sum(len(t) for t in tables.values())
+            assert n <= 10, (path, n)
+    finally:
+        wal.close()
