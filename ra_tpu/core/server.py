@@ -387,6 +387,14 @@ class RaServer:
             self.stats["term_and_voted_for_updates"] += 1
             self.log.store_meta(current_term=term, voted_for=voted_for)
 
+    def _applied_idx_term(self) -> IdxTerm:
+        """(last_applied, its term) — the validated progress marker used
+        by rewind/refusal replies.  fetch_term covers the snapshot index
+        itself; anything below it is 0 (never sent in practice: applied
+        never trails the snapshot)."""
+        la = self.last_applied
+        return IdxTerm(la, self.log.fetch_term(la) or 0)
+
     def last_idx_term(self) -> IdxTerm:
         """Effective last idx/term: log tail or snapshot (last_idx_term)."""
         lit = self.log.last_index_term()
@@ -750,14 +758,10 @@ class RaServer:
             effects.append(reply_eff)
             return effects
         # term mismatch: rewind to last_applied (ra_server.erl:1134-1156)
-        la = self.last_applied
-        la_term = self.log.fetch_term(la)
-        if la_term is None:
-            snap = self.log.snapshot_index_term()
-            la_term = snap.term if snap.index == la else 0
+        la, la_term = self._applied_idx_term()
         reply = AppendEntriesReply(term=rpc.term, success=False,
                                    next_index=la + 1, last_index=la,
-                                   last_term=la_term or 0, from_=self.id)
+                                   last_term=la_term, from_=self.id)
         reply_eff = SendRpc(rpc.leader_id, reply)
         self.condition = Condition(
             predicate=_follower_catchup_predicate,
@@ -862,12 +866,18 @@ class RaServer:
             self.log.begin_accept(rpc.meta)
             self.raft_state = RaftState.RECEIVE_SNAPSHOT
             return [NextEvent(rpc), StartElectionTimeout("medium")]
-        # stale snapshot: confirm our progress so the leader moves on
-        last = self.last_idx_term()
+        # stale snapshot: confirm our progress so the leader can resume
+        # replication.  The marker is the APPLIED frontier, not the raw
+        # log tail: the tail may be a deposed leader's unvalidated
+        # suffix, and advertising it sends the leader's repair below its
+        # own snapshot — re-triggering the very install we just refused,
+        # forever (found by the snapshot fuzz).  The applied point is
+        # always validated state the leader can safely resume above.
+        la, la_term = self._applied_idx_term()
         return [SendRpc(rpc.leader_id,
                         InstallSnapshotResult(term=self.current_term,
-                                              last_index=last.index,
-                                              last_term=last.term,
+                                              last_index=la,
+                                              last_term=la_term,
                                               from_=self.id,
                                               token=rpc.token))]
 
