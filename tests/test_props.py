@@ -329,8 +329,9 @@ def test_stale_retained_wal_file_does_not_rewind_tail(tmp_path):
 # property 4: Raft safety under fuzzed interleavings
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed,n_members", [(11, 3), (23, 3), (37, 3),
-                                             (59, 3), (71, 5), (83, 5)])
+@pytest.mark.parametrize("seed,n_members",
+                         [(s, 3) for s in (11, 23, 37, 59, 101, 151)] +
+                         [(s, 5) for s in (71, 83, 127)])
 def test_election_safety_and_log_matching_fuzz(seed, n_members):
     """Figure-3 safety properties under a random schedule of message
     deliveries, drops, partitions, election timeouts, and client
@@ -581,8 +582,9 @@ def test_safety_fuzz_over_durable_logs(tmp_path, seed, n_members):
 # property 6: safety fuzz with snapshots/truncation in the schedule
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed,n_members", [(7, 3), (19, 3), (43, 3),
-                                             (61, 5)])
+@pytest.mark.parametrize("seed,n_members",
+                         [(s, 3) for s in (7, 8, 19, 43, 230)] +
+                         [(61, 5), (89, 5)])
 def test_safety_fuzz_with_snapshots(seed, n_members):
     """The interleaving fuzz with snapshot actions mixed in: leaders
     release their cursor at the applied index (truncating the log), so
@@ -692,7 +694,7 @@ def test_safety_fuzz_with_snapshots(seed, n_members):
 # property 7: safety fuzz with membership changes in the schedule
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed", [5, 29, 47, 97])
+@pytest.mark.parametrize("seed", [5, 29, 47, 97, 147, 189, 220, 348])
 def test_safety_fuzz_with_membership_changes(seed):
     """Joins and leaves ('$ra_join'/'$ra_leave' -> '$ra_cluster_change'
     appends, effective on append, one change in flight at a time) racing
@@ -844,7 +846,7 @@ def test_safety_fuzz_with_membership_changes(seed):
 # property 8: combined chaos — membership + snapshots + partitions
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed", [3, 17, 31, 53])
+@pytest.mark.parametrize("seed", [3, 17, 31, 53, 113, 162, 374, 446])
 def test_safety_fuzz_membership_and_snapshots(seed):
     """The two hardest schedules combined: cluster changes (effective on
     append, carried in snapshot metas, install-restored on laggards)
@@ -994,7 +996,7 @@ def test_safety_fuzz_membership_and_snapshots(seed):
 # property 9: safety fuzz with mixed machine versions
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed", [13, 41, 67])
+@pytest.mark.parametrize("seed", [13, 41, 67, 97, 211])
 def test_safety_fuzz_mixed_machine_versions(seed):
     """A rolling upgrade under chaos: three members run the v1 machine,
     two still run v0, with partitions/drops/elections/commands racing
