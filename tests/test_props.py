@@ -441,8 +441,8 @@ def test_election_safety_and_log_matching_fuzz(seed, n_members):
 # property 5: safety fuzz over REAL durable logs
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed,n_members", [(101, 3), (137, 3),
-                                             (151, 5)])
+@pytest.mark.parametrize("seed,n_members", [(101, 3), (137, 3), (42, 3),
+                                             (151, 5), (77, 5)])
 def test_safety_fuzz_over_durable_logs(tmp_path, seed, n_members):
     """The interleaving safety fuzz with RaSystem-backed DurableLogs
     instead of the in-memory mock: WAL confirms arrive asynchronously
