@@ -20,11 +20,18 @@ from test_register_machine import host_fold
 N, P, K = 4, 5, 4
 
 
-def run_chaos(seed, rounds=30):
+def run_chaos(seed, rounds=30, make_engine=None):
+    """Drive the chaos schedule; returns (engine, host_fold oracle).
+    ``make_engine`` swaps in a different engine construction (e.g. the
+    durable open_engine) — the schedule, invariants, and final
+    convergence check are identical for both paths."""
     rng = random.Random(seed)
-    eng = LockstepEngine(RegisterMachine(n_slots=8), N, P,
-                         ring_capacity=256, max_step_cmds=K,
-                         write_delay=1, donate=False)
+    if make_engine is not None:
+        eng = make_engine()
+    else:
+        eng = LockstepEngine(RegisterMachine(n_slots=8), N, P,
+                             ring_capacity=256, max_step_cmds=K,
+                             write_delay=1, donate=False)
     committed_cmds: list = []       # acked = fully committed batches
     down: dict = {lane: set() for lane in range(N)}
     prev_total = 0
@@ -101,8 +108,35 @@ def run_chaos(seed, rounds=30):
         for member in range(P):
             assert mac[lane, member].tolist() == want, \
                 (lane, member, mac[lane, member].tolist(), want)
+    return eng, want
 
 
 @pytest.mark.parametrize("seed", [1, 9])
 def test_engine_chaos_schedule(seed):
     run_chaos(seed)
+
+
+def test_engine_chaos_durable_mode(tmp_path):
+    """The SAME chaos schedule (invariants included) over the DURABLE
+    engine: every commit is WAL-confirm-gated while members fail,
+    recover, and elections churn — then a checkpoint + reopen must
+    recover the converged state."""
+    from ra_tpu.engine import open_engine
+
+    def make():
+        return open_engine(RegisterMachine(n_slots=8), str(tmp_path),
+                           N, P, sync_mode=0, ring_capacity=256,
+                           max_step_cmds=K)
+
+    eng, want = run_chaos(3, rounds=14, make_engine=make)
+    eng.checkpoint()
+    totals = eng.committed_per_lane().copy()
+    eng.close()
+    eng2 = open_engine(RegisterMachine(n_slots=8), str(tmp_path), N, P,
+                       sync_mode=0, ring_capacity=256, max_step_cmds=K)
+    mac2 = np.asarray(eng2.state.mac)
+    leads2 = np.asarray(eng2.state.leader_slot)
+    for lane in range(N):
+        assert mac2[lane, leads2[lane]].tolist() == want, lane
+    assert (eng2.committed_per_lane() >= totals).all()
+    eng2.close()
