@@ -1438,18 +1438,33 @@ class RaServer:
 
     def match_indexes(self) -> list:
         """Voter match indexes; self is represented by last *written*
-        (ra_server.erl:2977-2987)."""
+        (ra_server.erl:2977-2987) — but ONLY while self is a voter of
+        the current configuration.  A leader removed by its own
+        in-flight '$ra_leave' serves until the change commits
+        (dissertation §4.2.2), and committing requires a majority of
+        the NEW config: counting its own log in place of a new-config
+        voter lets it "commit" entries a real quorum never held (found
+        by the combined fuzz: the removed leader committed its own
+        removal at an index one new-config voter was missing, wedging a
+        follower with applied > tail).  The reference includes own
+        unconditionally and shares the hazard."""
         lw = self.log.last_written()
         snap = self.log.snapshot_index_term()
         own = max(lw.index, snap.index)
-        idxs = [own]
+        self_peer = self.cluster.get(self.id)
+        idxs = []
+        if self_peer is not None and \
+                self_peer.membership == Membership.VOTER:
+            idxs.append(own)
         for pid, peer in self.cluster.items():
             if pid == self.id:
                 continue
             if peer.membership != Membership.VOTER:
                 continue
             idxs.append(peer.match_index)
-        return idxs
+        # degenerate safety net: no voters visible (transient states) —
+        # fall back to own so the median is defined
+        return idxs or [own]
 
     @staticmethod
     def agreed_commit(indexes: list) -> int:
@@ -1706,12 +1721,20 @@ class RaServer:
         return self._apply_ready_queries()
 
     def _agreed_query_index(self) -> int:
-        idxs = [self.query_index]
+        # same voter gate as match_indexes: a leader removed by its
+        # in-flight change must not count its own confirmation toward
+        # the new config's heartbeat quorum, or a linearizable read can
+        # be certified by a minority of the real voters
+        self_peer = self.cluster.get(self.id)
+        idxs = []
+        if self_peer is not None and \
+                self_peer.membership == Membership.VOTER:
+            idxs.append(self.query_index)
         for pid, peer in self.cluster.items():
             if pid == self.id or peer.membership != Membership.VOTER:
                 continue
             idxs.append(peer.query_index)
-        return self.agreed_commit(idxs)
+        return self.agreed_commit(idxs or [self.query_index])
 
     def _apply_ready_queries(self) -> list:
         agreed = self._agreed_query_index()
@@ -1947,19 +1970,65 @@ class RaServer:
 
     # -- machine effects executed in the core (release_cursor etc.) --------
 
+    def _cluster_spec_at(self, idx: int) -> tuple:
+        """The configuration in force at log index ``idx``: the live
+        view when the recorded change is at/below idx; else
+        previous_cluster when ITS change index is at/below idx (one
+        change in flight at a time makes it the config between the two
+        newest changes); else the newest change found scanning the log
+        down to the snapshot, whose meta cluster is the base case."""
+        if self.cluster_index_term.index <= idx:
+            return tuple((sid, p.membership)
+                         for sid, p in self.cluster.items())
+        if self.previous_cluster is not None and \
+                self.previous_cluster[0].index <= idx:
+            return self.previous_cluster[1]
+        # fetch downward with an early break — the wanted change is
+        # typically near idx; a forward read_range would materialize
+        # the whole prefix first
+        for i in range(idx, self.log.first_index() - 1, -1):
+            e = self.log.fetch(i)
+            if e is not None and isinstance(e.command,
+                                            ClusterChangeCommand):
+                return tuple(e.command.cluster)
+        meta = self.log.snapshot_meta()
+        if meta is not None and meta.index <= idx:
+            return tuple(meta.cluster)
+        return tuple((sid, p.membership)
+                     for sid, p in self.cluster.items())
+
+    def _machine_version_at(self, idx: int) -> int:
+        """The effective machine version at log index ``idx`` — the
+        newest bump at or below it (machine_versions is newest-first).
+        Stamping the LIVE version would mis-label a snapshot taken at
+        an index below a just-applied bump (index_machine_version,
+        ra_server.erl parity; the same stamp-at-index rule as
+        _cluster_spec_at)."""
+        for bump_idx, ver in self.machine_versions:
+            if bump_idx <= idx:
+                return ver
+        return 0
+
     def handle_machine_effect(self, eff: Any) -> list:
         """Called by the shell for machine effects that mutate log state
-        (ra_server.erl:2018-2046)."""
-        cluster_spec = tuple((sid, p.membership)
-                             for sid, p in self.cluster.items())
+        (ra_server.erl:2018-2046).
+
+        The snapshot/checkpoint meta must record the configuration in
+        force AT eff.index, not the live view: cluster changes take
+        effect on append, so the view can contain an in-flight change
+        NEWER than the snapshot point — if that change is later
+        reverted, a snapshot stamped with it would immortalize a
+        configuration that never existed, and installs would spread it
+        (found by the combined fuzz; the reference stamps the live
+        cluster, ra_server.erl:2018-2027, and shares the hazard)."""
+        cluster_spec = self._cluster_spec_at(eff.index)
+        mac_ver = self._machine_version_at(eff.index)
         if isinstance(eff, ReleaseCursor):
             return self.log.update_release_cursor(
-                eff.index, cluster_spec, self.effective_machine_version,
-                eff.machine_state)
+                eff.index, cluster_spec, mac_ver, eff.machine_state)
         if isinstance(eff, Checkpoint):
             return self.log.checkpoint(
-                eff.index, cluster_spec, self.effective_machine_version,
-                eff.machine_state)
+                eff.index, cluster_spec, mac_ver, eff.machine_state)
         if isinstance(eff, PromoteCheckpoint):
             self.log.promote_checkpoint(eff.index)
             return []

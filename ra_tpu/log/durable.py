@@ -640,6 +640,12 @@ class DurableLog:
         m = self._snapshot[0]
         return IdxTerm(m.index, m.term)
 
+    def snapshot_meta(self):
+        """The current snapshot's metadata (in-memory; no data read)."""
+        with self._lock:
+            return self._snapshot[0] if self._snapshot is not None \
+                else None
+
     def checkpoint_index(self) -> int:
         """Newest checkpoint index, 0 if none (the checkpoint_index
         gauge, ra.hrl:378)."""

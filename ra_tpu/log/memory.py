@@ -220,6 +220,10 @@ class MemoryLog:
         meta = self._snapshot[0]
         return IdxTerm(meta.index, meta.term)
 
+    def snapshot_meta(self):
+        """The current snapshot's metadata (in-memory; no data read)."""
+        return self._snapshot[0] if self._snapshot is not None else None
+
     def checkpoint_index(self) -> int:
         """Newest checkpoint index, 0 if none (ra.hrl:378)."""
         return self._checkpoints[-1][0].index if self._checkpoints else 0

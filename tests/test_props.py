@@ -781,7 +781,7 @@ def test_safety_fuzz_with_membership_changes(seed):
     # heal + converge on the FINAL committed membership
     c.heal()
     final_members = None
-    for _ in range(300):
+    for _ in range(600):
         c.run()
         for sid in sids:
             srv = c.servers[sid]
@@ -846,7 +846,8 @@ def test_safety_fuzz_with_membership_changes(seed):
 # property 8: combined chaos — membership + snapshots + partitions
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed", [3, 17, 31, 53, 113, 162, 374, 446])
+@pytest.mark.parametrize("seed", [3, 17, 31, 53, 113, 162, 374, 446,
+                                  1967, 2110, 2677, 2738])
 def test_safety_fuzz_membership_and_snapshots(seed):
     """The two hardest schedules combined: cluster changes (effective on
     append, carried in snapshot metas, install-restored on laggards)
@@ -934,7 +935,7 @@ def test_safety_fuzz_membership_and_snapshots(seed):
 
     c.heal()
     final_members = None
-    for _ in range(300):
+    for _ in range(600):
         c.run()
         for sid in sids:
             srv = c.servers[sid]
