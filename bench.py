@@ -38,6 +38,34 @@ PROBE_TIMEOUT_S = 120
 CHILD_TIMEOUT_S = 480
 
 
+def _host_meta() -> dict:
+    """Environment stamp for cross-round comparability: the same config
+    read 112.8M cmds/s in BENCH_r02 but 33.7M in BENCH_r04 because the
+    host differed — without this stamp a reader cannot tell environment
+    drift from regression."""
+    meta = {"unknown": True}
+    try:
+        import platform as _pf
+        model = ""
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.startswith("model name"):
+                        model = line.split(":", 1)[1].strip()
+                        break
+        except OSError:
+            pass
+        meta = {
+            "hostname": _pf.node(),
+            "cpu_model": model,
+            "cpu_count": os.cpu_count(),
+            "loadavg_1m": round(os.getloadavg()[0], 2),
+        }
+    except Exception:  # noqa: BLE001 — metadata must never kill a bench
+        pass
+    return meta
+
+
 # ---------------------------------------------------------------------------
 # child mode: one measurement in one process (safe to kill from the parent)
 # ---------------------------------------------------------------------------
@@ -187,7 +215,7 @@ def _child_main() -> None:
         "device": str(jax.devices()[0]),
         "quorum_impl": quorum_impl, "machine": machine_name,
         "lanes": n_lanes, "members": n_members, "cmds_per_step": cmds,
-        "durable": durable,
+        "durable": durable, "host": _host_meta(),
         **({"sync_mode": sync_mode,
             "wal_strategy": wal_strategy} if durable else {}),
     }))
@@ -330,7 +358,7 @@ def _frontier_main() -> None:
         "note": "observed-commit latency floor ~= sync_rtt_ms on "
                 "tunneled backends; p99 bar is max(25ms, 3*rtt)",
         "platform": jax.devices()[0].platform,
-        "lanes": n_lanes, "members": n_members,
+        "lanes": n_lanes, "members": n_members, "host": _host_meta(),
     }))
 
 
@@ -413,7 +441,7 @@ def main() -> None:
             best_impl = max(results, key=lambda k: results[k]["value"])
             best = results[best_impl]
             value = best["value"]
-            detail = {"best_quorum_impl": best_impl}
+            detail = {"best_quorum_impl": best_impl, "host": _host_meta()}
             for impl, res in results.items():
                 detail[impl] = res
             # secondary BASELINE.md rows (short windows): 5k x 5 fifo
@@ -477,6 +505,7 @@ def main() -> None:
             "note": "TPU backend unreachable; value is a CPU smoke "
                     "datapoint at 512 lanes (not the headline config)",
             "cpu_smoke": res,
+            "host": _host_meta(),
         }
         # protocol-complete evidence even off-hardware: fsync-backed
         # commits and the sequential-machine (fifo) apply path.  Tight
