@@ -168,6 +168,11 @@ class RaServer:
         self.query_index: int = 0
         self.queries_waiting_heartbeats: list = []  # [(qidx, from, fun, ci)]
         self.pending_consistent_queries: list = []  # [(from, fun, ci)]
+        # memo for _cluster_spec_at's downward scan: (idx, spec) == "the
+        # newest cluster change at/below idx resolves to spec".  Entries
+        # at/below a release cursor are committed and immutable, so a
+        # cached answer never goes stale; it only ever narrows the scan.
+        self._spec_cache: Optional[tuple] = None
 
         self.machine_state: Any = None
         self.aux_state: Any = self.machine.init_aux(config.uid)
@@ -350,8 +355,16 @@ class RaServer:
                 # normal exit teardown)
                 self.log.abort_accept()
                 self._accepting_snapshot = None
-            effects = self._append_cluster_change(
-                {self.id: (Membership.VOTER, 0)}, None, None, [])
+            effects = []
+            if self.raft_state == RaftState.LEADER:
+                # the reference re-dispatches through leader->follower
+                # (ra_server.erl:830-831) so leader-only bookkeeping is
+                # dropped before the shrink; do the teardown explicitly —
+                # no snapshot-send token or waiting consistent query may
+                # survive into the single-member configuration
+                effects.extend(self._leader_teardown())
+            effects.extend(self._append_cluster_change(
+                {self.id: (Membership.VOTER, 0)}, None, None, []))
             if event.from_ is not None:
                 effects.append(Reply(event.from_, "ok"))
             effects.extend(self._call_for_election_pre_vote())
@@ -571,13 +584,46 @@ class RaServer:
                         RequestVoteResult(term=rpc.term, vote_granted=False,
                                           from_=self.id))]
 
+    def _leader_teardown(self) -> list:
+        """Abandon leader-only bookkeeping on an involuntary step-down.
+
+        Waiting/pending consistent queries are answered not_leader — the
+        reference parks them and redirects once a new leader is known
+        (process_new_leader_queries, ra_server.erl:1500-1510); with no
+        successor known at teardown time not_leader is the honest reply
+        and clients re-resolve.  In-flight snapshot-send tokens are
+        invalidated so a late SnapshotSenderDone from a dead leadership
+        cannot flip a peer back to NORMAL under a different regime."""
+        effects: list = []
+        for _qidx, from_, _fun, _ci in self.queries_waiting_heartbeats:
+            if from_ is not None:
+                effects.append(Reply(from_,
+                                     ErrorResult("not_leader", None)))
+        for from_, _fun, _ci in self.pending_consistent_queries:
+            if from_ is not None:
+                effects.append(Reply(from_,
+                                     ErrorResult("not_leader", None)))
+        self.queries_waiting_heartbeats = []
+        self.pending_consistent_queries = []
+        for peer in self.cluster.values():
+            peer.snapshot_sender = None
+            if peer.status == PeerStatus.SENDING_SNAPSHOT:
+                peer.status = PeerStatus.NORMAL
+        self.votes = 0
+        return effects
+
     def _become_follower(self, term: int,
                          next_event: Any = None) -> list:
+        # an actual LEADER stepping down (higher-term RPC/reply) drops
+        # its leader-only bookkeeping here — the one choke point every
+        # involuntary step-down goes through
+        pre = (self._leader_teardown()
+               if self.raft_state == RaftState.LEADER else [])
         self._update_term(term)
         self.leader_id = None
         self.votes = 0
         self.raft_state = RaftState.FOLLOWER
-        effects: list = [StartElectionTimeout("medium")]
+        effects: list = pre + [StartElectionTimeout("medium")]
         if next_event is not None:
             effects.insert(0, NextEvent(next_event))
         return effects
@@ -1841,19 +1887,47 @@ class RaServer:
             # while parked starves elections — e.g. after a leader's
             # self-removal commits, the survivors parked on its log gap
             # would veto every candidacy forever (found by the
-            # membership fuzz).
+            # membership fuzz).  A parked LEADER, however, applies the
+            # same gates an active leader does (:1233-1243): a stale
+            # same/lower-term request is denied in place and a
+            # non-member candidate is ignored — otherwise a removed
+            # node replaying an old candidacy would depose the parked
+            # leader, erroring its waiting queries and aborting
+            # snapshot sends an active leader would have kept.
+            if self.condition is not None and \
+                    self.condition.transition_to == RaftState.LEADER:
+                if event.term <= self.current_term:
+                    return [SendRpc(event.candidate_id,
+                                    RequestVoteResult(
+                                        term=self.current_term,
+                                        vote_granted=False,
+                                        from_=self.id))]
+                if event.candidate_id not in self.cluster:
+                    return []
+                pre = self._leader_teardown()
+            else:
+                pre = []
             self.condition = None
             self.raft_state = RaftState.FOLLOWER
-            return [NextEvent(event)] + self._replay_condition_pending()
+            return (pre + [NextEvent(event)] +
+                    self._replay_condition_pending())
         if isinstance(event, PreVoteRpc):
             # a HIGHER-term pre-vote exits the wait like a vote request
             # does: a parked LEADER that merely adopted the term in
             # place would later resume as leader of a term it never won
             # (two leaders in one term)
             if event.term > self.current_term:
+                if self.condition is not None and \
+                        self.condition.transition_to == RaftState.LEADER:
+                    if event.candidate_id not in self.cluster:
+                        return []    # non-member: same gate as :1246
+                    pre = self._leader_teardown()
+                else:
+                    pre = []
                 self.condition = None
                 self.raft_state = RaftState.FOLLOWER
-                return [NextEvent(event)] + self._replay_condition_pending()
+                return (pre + [NextEvent(event)] +
+                        self._replay_condition_pending())
             # same-term pre-votes are answered IN PLACE — granting one
             # does not exit the wait (ra_server.erl:1455-1456).  Like
             # the follower path, no granter-side membership gate: real
@@ -1985,15 +2059,36 @@ class RaServer:
             return self.previous_cluster[1]
         # fetch downward with an early break — the wanted change is
         # typically near idx; a forward read_range would materialize
-        # the whole prefix first
-        for i in range(idx, self.log.first_index() - 1, -1):
+        # the whole prefix first.  The memo bounds the scan: snapshot/
+        # checkpoint effects arrive with monotonically growing indexes,
+        # and everything at/below an earlier release cursor is committed
+        # prefix, so on a change-free log the common case is O(new
+        # entries since the last call), not O(log length).
+        lo = self.log.first_index()
+        cached = self._spec_cache
+        # the memo only narrows the scan while the log still covers
+        # (cached_idx, idx] in full — once the snapshot floor passes the
+        # cached index a change may hide under the snapshot, and the
+        # meta fallback below (newer information) must win instead
+        use_cache = (cached is not None and cached[0] <= idx and
+                     lo <= cached[0] + 1)
+        if use_cache:
+            lo = cached[0] + 1
+        for i in range(idx, lo - 1, -1):
             e = self.log.fetch(i)
             if e is not None and isinstance(e.command,
                                             ClusterChangeCommand):
-                return tuple(e.command.cluster)
+                spec = tuple(e.command.cluster)
+                self._spec_cache = (idx, spec)
+                return spec
+        if use_cache:
+            self._spec_cache = (idx, cached[1])
+            return cached[1]
         meta = self.log.snapshot_meta()
         if meta is not None and meta.index <= idx:
-            return tuple(meta.cluster)
+            spec = tuple(meta.cluster)
+            self._spec_cache = (idx, spec)
+            return spec
         return tuple((sid, p.membership)
                      for sid, p in self.cluster.items())
 

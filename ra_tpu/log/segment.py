@@ -114,9 +114,23 @@ class SegmentFile:
         ({error, full} in the reference).  Appending at-or-below an
         existing index is an overwrite: it invalidates every LIVE entry
         at/above it immediately (see _invalidate_from)."""
+        # capacity already in the FILE is append-only: refuse before
+        # touching any state, so a refused append never makes the live
+        # index disagree with what a reload reconstructs
+        if self._count >= self.max_count:
+            return False
+        # invalidate BEFORE the pending-capacity check: an overwrite
+        # landing in a segment whose capacity is consumed by PENDING
+        # entries frees the superseded tail and fits in place instead of
+        # forcing a roll.  A refusal below cannot follow a mutation: it
+        # requires no pending ≥ idx (freeing even one slot admits this
+        # append), and live flushed entries ≥ idx with all pending < idx
+        # cannot coexist (the lower-idx pending append already swept
+        # that flushed tail) — so on the refusal path the invalidation
+        # was the _max_idx fast-path no-op.
+        self._invalidate_from(idx)
         if self._count + len(self._pending) >= self.max_count:
             return False
-        self._invalidate_from(idx)
         self._pending.append((idx, term, payload))
         self._max_idx = max(self._max_idx, idx)
         return True

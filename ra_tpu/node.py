@@ -625,7 +625,16 @@ class RaNode:
                 self.counters.incr(server.cfg.uid, "forced_gcs")
             elif isinstance(eff, TimerEffect):
                 # {timer, Name, T}: arm/cancel a named machine timer
-                # (ra_server_proc.erl:1549-1550); ms=None cancels
+                # (ra_server_proc.erl:1549-1550); ms=None cancels.
+                # MACHINE CONTRACT: timers are local to this replica and
+                # an expiry is routed through consensus only while it is
+                # the leader (_poll_shell) — an expiry on a non-leader is
+                # discarded, so a machine that must keep machine-time
+                # alive across failover re-arms its timers in
+                # state_enter(leader) (exactly the reference's posture:
+                # the timeout command is leader-routed, ra_server_proc
+                # .erl:556-560, and a deposed leader's pending timers
+                # die with its leadership)
                 if eff.ms is None:
                     shell.machine_timers.pop(eff.name, None)
                 else:

@@ -368,6 +368,148 @@ def test_force_shrink_aborts_inflight_snapshot_accept():
     assert set(srv3.cluster) == {s3}
 
 
+def test_force_shrink_on_leader_tears_down_leader_state():
+    """ForceMemberChange on a LEADER drops leader-only bookkeeping
+    before the shrink (the reference re-dispatches through
+    leader->follower, ra_server.erl:830-831): a consistent query
+    waiting on heartbeats is answered not_leader instead of leaking,
+    and an in-flight snapshot-send token is invalidated."""
+    from ra_tpu.core.types import (ConsistentQueryEvent, ErrorResult,
+                                   ForceMemberChangeEvent, PeerStatus,
+                                   Reply)
+
+    c = SimCluster(3)
+    s1, s2, _s3 = c.ids
+    c.elect(s1)
+    c.run()
+    srv1 = c.servers[s1]
+    assert srv1.raft_state.value == "leader"
+    # park a consistent query: handle directly (heartbeats unanswered)
+    srv1.handle(ConsistentQueryEvent(lambda st: st, from_="q1"))
+    assert srv1.queries_waiting_heartbeats or \
+        srv1.pending_consistent_queries
+    srv1.cluster[s2].snapshot_sender = "tok"
+    srv1.cluster[s2].status = PeerStatus.SENDING_SNAPSHOT
+    effs = srv1.handle(ForceMemberChangeEvent(from_="op"))
+    not_leader = [e for e in effs if isinstance(e, Reply) and
+                  isinstance(e.msg, ErrorResult) and
+                  e.msg.reason == "not_leader"]
+    assert [e.to for e in not_leader] == ["q1"]
+    assert srv1.queries_waiting_heartbeats == []
+    assert srv1.pending_consistent_queries == []
+    assert all(p.snapshot_sender is None
+               for p in srv1.cluster.values())
+    # quorum of one: the shrink self-elects straight back to leader
+    assert srv1.raft_state.value == "leader"
+    assert set(srv1.cluster) == {s1}
+
+
+def test_deposed_leader_answers_parked_queries_not_leader():
+    """A leader deposed by a higher-term AER (the normal involuntary
+    step-down) must not leak its parked consistent queries or keep
+    stale snapshot-send tokens (_become_follower teardown)."""
+    from ra_tpu.core.types import (ConsistentQueryEvent, ErrorResult,
+                                   Reply)
+
+    c = SimCluster(3)
+    s1, s2, _s3 = c.ids
+    c.elect(s1)
+    c.run()
+    srv1 = c.servers[s1]
+    assert srv1.raft_state.value == "leader"
+    srv1.handle(ConsistentQueryEvent(lambda st: st, from_="q1"))
+    assert srv1.queries_waiting_heartbeats or \
+        srv1.pending_consistent_queries
+    srv1.cluster[s2].snapshot_sender = "tok"
+    effs = srv1.handle(AppendEntriesRpc(
+        term=srv1.current_term + 5, leader_id=s2, prev_log_index=0,
+        prev_log_term=0, leader_commit=0, entries=()))
+    not_leader = [e for e in effs if isinstance(e, Reply) and
+                  isinstance(e.msg, ErrorResult) and
+                  e.msg.reason == "not_leader"]
+    assert [e.to for e in not_leader] == ["q1"]
+    assert srv1.raft_state.value == "follower"
+    assert srv1.queries_waiting_heartbeats == []
+    assert srv1.pending_consistent_queries == []
+    assert all(p.snapshot_sender is None for p in srv1.cluster.values())
+
+
+def test_parked_leader_gates_stale_and_foreign_vote_requests():
+    """A leader parked in await_condition (transfer/wal_down) applies
+    the active leader's vote-request gates: same/lower-term requests
+    are denied in place, non-member candidates are ignored, and only a
+    genuine higher-term member candidacy deposes it (with teardown)."""
+    from ra_tpu.core.types import (ConsistentQueryEvent, ErrorResult,
+                                   Reply, TransferLeadershipEvent)
+
+    c = SimCluster(3)
+    s1, s2, s3 = c.ids
+    c.elect(s1)
+    c.run()
+    srv1 = c.servers[s1]
+    srv1.handle(ConsistentQueryEvent(lambda st: st, from_="q1"))
+    srv1.handle(TransferLeadershipEvent(s2))
+    assert srv1.raft_state.value == "await_condition"
+    term = srv1.current_term
+    # stale same-term candidacy from a member: denied, still parked
+    effs = srv1.handle(RequestVoteRpc(term=term, candidate_id=s3,
+                                      last_log_index=99, last_log_term=99))
+    assert srv1.raft_state.value == "await_condition"
+    assert any(isinstance(e, SendRpc) and
+               not e.msg.vote_granted for e in effs)
+    # higher-term candidacy from a NON-member: ignored entirely
+    stranger = ServerId("sX", "nX")
+    effs = srv1.handle(RequestVoteRpc(term=term + 1, candidate_id=stranger,
+                                      last_log_index=99, last_log_term=99))
+    assert effs == []
+    assert srv1.raft_state.value == "await_condition"
+    assert srv1.queries_waiting_heartbeats or \
+        srv1.pending_consistent_queries
+    # higher-term member candidacy: genuine step-down with teardown
+    effs = srv1.handle(RequestVoteRpc(term=term + 2, candidate_id=s3,
+                                      last_log_index=99, last_log_term=99))
+    assert srv1.raft_state.value == "follower"
+    not_leader = [e for e in effs if isinstance(e, Reply) and
+                  isinstance(e.msg, ErrorResult) and
+                  e.msg.reason == "not_leader"]
+    assert [e.to for e in not_leader] == ["q1"]
+
+
+def test_cluster_spec_at_cache_matches_uncached_scan():
+    """_cluster_spec_at's scan memo is an optimization only: with two
+    membership changes in flight above the queried index (forcing the
+    downward log scan), a cache-warm answer must equal a cold one for
+    every index in the log."""
+    c = SimCluster(4, initial_count=3)
+    s1, _s2, _s3, s4 = c.ids
+    c.elect(s1)
+    c.run()
+    srv1 = c.servers[s1]
+    for i in range(6):
+        c.command(s1, i)
+        c.run()
+    c.handle(s1, CommandEvent(JoinCommand(s4)))
+    c.run()
+    for i in range(4):
+        c.command(s1, i)
+        c.run()
+    c.handle(s1, CommandEvent(LeaveCommand(s4)))
+    c.run()
+    last = srv1.last_idx_term().index
+    for idx in range(srv1.log.first_index(), last + 1):
+        srv1._spec_cache = None
+        cold = srv1._cluster_spec_at(idx)
+        warm = srv1._cluster_spec_at(idx)       # memo from the cold call
+        assert warm == cold, idx
+    # ascending queries with a warm memo (the release-cursor pattern)
+    srv1._spec_cache = None
+    for idx in range(srv1.log.first_index(), last + 1):
+        got = srv1._cluster_spec_at(idx)
+        srv1._spec_cache, saved = None, srv1._spec_cache
+        assert got == srv1._cluster_spec_at(idx), idx
+        srv1._spec_cache = saved
+
+
 def test_force_shrink_refused_while_parked_in_await_condition():
     """ForceMemberChange in AWAIT_CONDITION is refused (the reference
     has no clause for it there): exiting a park would race the parked

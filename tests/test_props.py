@@ -56,6 +56,16 @@ def _converge(cluster):
     cluster.heal()
     for attempt in range(25):
         cluster.run()
+        # a parked member postpones unsatisfying AERs and relies on the
+        # await_condition timeout for liveness (the shell's state
+        # timeout, armed at server.py:752; ra_server_proc.erl:946-1010)
+        # — the harness has no clock, so deliver the timeout explicitly
+        # or a member whose nack was lost pre-heal never rejoins (seen
+        # at soak seeds 50014/50019)
+        for sid, srv in cluster.servers.items():
+            if srv.raft_state.value == "await_condition":
+                cluster.handle(sid, ElectionTimeout())
+        cluster.run()
         leaders = [sid for sid, srv in cluster.servers.items()
                    if srv.raft_state.value == "leader"]
         if not leaders:

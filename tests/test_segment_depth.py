@@ -55,6 +55,31 @@ def test_full_file_refuses_and_reports(tmp_path):
     seg.close()
 
 
+def test_overwrite_into_full_pending_segment_fits_in_place(tmp_path):
+    """An overwrite landing in a segment whose capacity is consumed by
+    PENDING entries frees the superseded tail first and fits in place
+    instead of forcing a roll (invalidate-before-capacity-check)."""
+    p = str(tmp_path / "a.segment")
+    seg = SegmentFile(p, max_count=8, create=True)
+    for i in range(1, 9):
+        assert seg.append(i, 1, f"e{i}".encode())
+    assert seg.full
+    assert seg.append(5, 2, b"new5")        # drops pending 5..8, fits
+    seg.flush()
+    assert seg.range() == (1, 5)
+    assert seg.read(5) == (2, b"new5")
+    # flushed slots are append-only: once capacity is in the FILE an
+    # overwrite still refuses ({error, full} -> roll), and the refusal
+    # mutates nothing — the live view must keep agreeing with a reload
+    for i in range(6, 9):
+        assert seg.append(i, 2, b"x")
+    seg.flush()
+    assert not seg.append(3, 3, b"y")
+    assert seg.range() == (1, 8)
+    assert seg.read(6) == (2, b"x")
+    seg.close()
+
+
 def test_try_read_missing(tmp_path):
     p = str(tmp_path / "a.segment")
     seg = SegmentFile(p, max_count=16, create=True)
