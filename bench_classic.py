@@ -1,0 +1,348 @@
+"""Classic node-path benchmark — the ra_bench parity run.
+
+The reference's only benchmark drives REAL server processes over a
+cluster with pipelined clients and a credit window
+(/root/reference/src/ra_bench.erl:84-129, 153-190): `degree` client
+processes each keep `pipe` commands in flight at low priority, counting
+applied notifications; the workload target is 20,000 commands/sec
+sustained (ra_bench.erl:54-69).  ra_tpu's lane engine benches the
+vectorized path; THIS file benches the full-featured classic path — the
+one that carries every feature (durable WAL + segments, membership,
+snapshots) — in two phases:
+
+  A. "local": 1 cluster x 3 members on three in-process RaNodes over a
+     LocalRouter, durable RaSystem logs.
+  B. "tcp": 1 cluster x 3 members, each member its own OS process
+     behind a TcpRouter (the erlang-dist role), the client in the
+     parent process pipelining over real sockets.
+
+Machine: ra_bench's noop counter with a release_cursor every 100k
+applies (ra_bench.erl:43-49); payloads are 256-byte blobs
+(?DATA_SIZE, ra_bench.erl:34).
+
+Prints ONE JSON line:
+  {"metric": "classic_node_committed_cmds_per_sec", "value": <tcp phase>,
+   "unit": "cmds/s", "vs_baseline": value/20000, "detail": {...}}
+vs_baseline is against the reference workload target, 20k cmds/s.
+Always exits 0; phase failures appear in detail.errors.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DEGREE = int(os.environ.get("RA_TPU_CLASSIC_DEGREE", "5"))
+PIPE = int(os.environ.get("RA_TPU_CLASSIC_PIPE", "500"))
+SECONDS = float(os.environ.get("RA_TPU_CLASSIC_SECONDS", "10.0"))
+DATA_SIZE = int(os.environ.get("RA_TPU_CLASSIC_DATA_SIZE", "256"))
+RELEASE_EVERY = 100_000
+TARGET = 20_000.0
+
+
+def _noop_machine():
+    """ra_bench's machine: state counts applies, cursor released every
+    100k so the log truncates (ra_bench.erl:43-49)."""
+    from ra_tpu.core.machine import Machine
+    from ra_tpu.core.types import ReleaseCursor
+
+    class NoopBench(Machine):
+        def init(self, config):
+            return 0
+
+        def apply(self, meta, command, state):
+            new = state + 1
+            if meta.index % RELEASE_EVERY == 0:
+                return new, new, [ReleaseCursor(meta.index, new)]
+            return new, new
+
+    return NoopBench()
+
+
+class _Client:
+    """One pipelining client: keeps ``pipe`` commands in flight, counts
+    applied notifications, records enqueue->applied latency
+    (ra_bench.erl:153-190 measures the same edge via ra_event applied
+    batches)."""
+
+    def __init__(self, cid: int, pipe: int):
+        self.cid = cid
+        self.credit = threading.Semaphore(pipe)
+        self.applied = 0
+        self.lats: list = []
+        self.inflight: dict = {}
+        self._lock = threading.Lock()
+
+    def on_notify(self, batch) -> None:
+        now = time.perf_counter()
+        n = 0
+        with self._lock:
+            for corr, _reply in batch:
+                t0 = self.inflight.pop(corr, None)
+                if t0 is not None:
+                    n += 1
+                    if self.applied % 16 == 0:  # sample 1/16
+                        self.lats.append(now - t0)
+                self.applied += 1
+        for _ in range(n):
+            self.credit.release()
+
+    def run(self, send, stop_evt, payload) -> None:
+        seq = 0
+        while not stop_evt.is_set():
+            if not self.credit.acquire(timeout=0.25):
+                continue
+            corr = (self.cid, seq)
+            seq += 1
+            with self._lock:
+                self.inflight[corr] = time.perf_counter()
+            try:
+                send(payload, corr, self.on_notify)
+            except Exception:  # noqa: BLE001 — leader moved; retry path
+                with self._lock:
+                    self.inflight.pop(corr, None)
+                self.credit.release()
+                time.sleep(0.05)
+
+
+def _drive(send, warm_send) -> dict:
+    """Run DEGREE clients against ``send`` for SECONDS; return the row."""
+    payload = bytes(DATA_SIZE)
+    clients = [_Client(i, PIPE) for i in range(DEGREE)]
+    stop_evt = threading.Event()
+    warm_send(payload)
+    threads = [threading.Thread(target=c.run,
+                                args=(send, stop_evt, payload), daemon=True)
+               for c in clients]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(SECONDS)
+    stop_evt.set()
+    for t in threads:
+        t.join(timeout=5)
+    # drain: credit released after stop still counts applied work
+    time.sleep(0.5)
+    elapsed = time.perf_counter() - t0
+    applied = sum(c.applied for c in clients)
+    lats = sorted(x for c in clients for x in c.lats)
+    n = len(lats)
+    return {
+        "value": round(applied / elapsed, 1),
+        "applied": applied,
+        "elapsed_s": round(elapsed, 3),
+        "p50_applied_latency_ms":
+            round(1000 * lats[n // 2], 3) if n else -1.0,
+        "p99_applied_latency_ms":
+            round(1000 * lats[min(n - 1, int(n * 0.99))], 3) if n else -1.0,
+        "latency_samples": n,
+        "degree": DEGREE, "pipe": PIPE, "data_size": DATA_SIZE,
+        "seconds": SECONDS,
+        "meets_reference_target": applied / elapsed >= TARGET,
+    }
+
+
+# ---------------------------------------------------------------------------
+# phase A: in-process (1 RaNode per member name, LocalRouter)
+# ---------------------------------------------------------------------------
+
+def _phase_local() -> dict:
+    import ra_tpu
+    from ra_tpu.core.types import ServerId
+    from ra_tpu.node import LocalRouter, RaNode
+    from ra_tpu.system import RaSystem
+
+    tmp = tempfile.mkdtemp(prefix="ra_classic_local_")
+    router = LocalRouter()
+    sids = [ServerId(f"b{i}", f"bn{i}") for i in (1, 2, 3)]
+    systems = {s.node: RaSystem(os.path.join(tmp, s.node)) for s in sids}
+    nodes = {s.node: RaNode(s.node, router=router,
+                            log_factory=systems[s.node].log_factory)
+             for s in sids}
+    try:
+        ra_tpu.start_cluster("classic", _noop_machine, sids, router=router,
+                             election_timeout_ms=500, tick_interval_ms=100)
+        res = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                res = ra_tpu.process_command(sids[0], bytes(8),
+                                             router=router, timeout=5.0)
+                break
+            except TimeoutError:
+                pass
+        assert res is not None, "no leader elected"
+        leader = res.leader
+
+        def send(payload, corr, cb):
+            ra_tpu.pipeline_command(leader, payload, correlation=corr,
+                                    notify_to=cb, router=router)
+
+        def warm(payload):
+            ra_tpu.process_command(leader, payload, router=router)
+
+        row = _drive(send, warm)
+        row["members"] = 3
+        row["transport"] = "in-process"
+        row["durable"] = True
+        return row
+    finally:
+        for n in nodes.values():
+            n.stop()
+        for s in systems.values():
+            s.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# phase B: one OS process per member over TCP
+# ---------------------------------------------------------------------------
+
+def _tcp_member_main(node_name, port_map, data_dir, ready_q, stop_q):
+    """One cluster member in its own process (the ct_slave peer-VM role,
+    erlang_node_helpers.erl:12-48)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ra_tpu.core.types import ServerConfig, ServerId
+    from ra_tpu.node import RaNode
+    from ra_tpu.system import RaSystem
+    from ra_tpu.transport.tcp import TcpRouter
+
+    router = TcpRouter(("127.0.0.1", port_map[node_name]),
+                       {n: ("127.0.0.1", p) for n, p in port_map.items()
+                        if n != node_name})
+    system = RaSystem(data_dir)
+    node = RaNode(node_name, router=router, log_factory=system.log_factory)
+    member_names = sorted(n for n in port_map if n != "client")
+    sids = [ServerId(f"m_{n}", n) for n in member_names]
+    me = ServerId(f"m_{node_name}", node_name)
+    node.start_server(ServerConfig(
+        server_id=me, uid=f"uid_{node_name}", cluster_name="classic_tcp",
+        initial_members=tuple(sids), machine=_noop_machine(),
+        election_timeout_ms=800, tick_interval_ms=200,
+        log_init_args={"data_dir": data_dir}))
+    ready_q.put(("ready", node_name))
+    stop_q.get()          # block until the parent says stop
+    node.stop()
+    router.stop()
+    ready_q.put(("stopped", node_name))
+
+
+def _phase_tcp() -> dict:
+    import multiprocessing as mp
+
+    import ra_tpu
+    from ra_tpu.core.types import (CommandEvent, ForceElectionEvent,
+                                   ReplyMode, ServerId, UserCommand)
+    from ra_tpu.transport.tcp import TcpRouter
+
+    ctx = mp.get_context("spawn")
+    names = ["cn1", "cn2", "cn3"]
+    # bind ephemeral listeners up front so the port map is collision-free
+    import socket as _socket
+    socks = []
+    port_map = {}
+    for n in names + ["client"]:
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port_map[n] = s.getsockname()[1]
+        socks.append(s)
+    for s in socks:
+        s.close()
+
+    tmp = tempfile.mkdtemp(prefix="ra_classic_tcp_")
+    ready_q = ctx.Queue()
+    stop_qs = {n: ctx.Queue() for n in names}
+    procs = [ctx.Process(target=_tcp_member_main,
+                         args=(n, port_map, os.path.join(tmp, n),
+                               ready_q, stop_qs[n]), daemon=True)
+             for n in names]
+    for p in procs:
+        p.start()
+    client = None
+    try:
+        for _ in names:   # readiness handshake (1-core box: slow imports)
+            msg = ready_q.get(timeout=180)
+            assert msg[0] == "ready", msg
+        client = TcpRouter(("127.0.0.1", port_map["client"]),
+                           {n: ("127.0.0.1", port_map[n]) for n in names})
+        sids = [ServerId(f"m_{n}", n) for n in names]
+        client.send("?", sids[0], ForceElectionEvent())
+        res = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                res = ra_tpu.process_command(sids[0], bytes(8),
+                                             router=client, timeout=5.0)
+                break
+            except TimeoutError:
+                client.send("?", sids[0], ForceElectionEvent())
+        assert res is not None, "no leader elected over TCP"
+        leader = res.leader
+
+        def send(payload, corr, cb):
+            ok = client.send("?", leader, CommandEvent(
+                UserCommand(payload, reply_mode=ReplyMode.NOTIFY,
+                            correlation=corr, notify_to=cb)))
+            if not ok:
+                raise RuntimeError("send failed")
+
+        def warm(payload):
+            ra_tpu.process_command(leader, payload, router=client)
+
+        row = _drive(send, warm)
+        row["members"] = 3
+        row["transport"] = "tcp (3 OS processes)"
+        row["durable"] = True
+        return row
+    finally:
+        if client is not None:
+            client.stop()
+        for n in names:
+            stop_qs[n].put("stop")
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _host_meta() -> dict:
+    import bench
+    return bench._host_meta()
+
+
+def main() -> None:
+    detail: dict = {"host": _host_meta(), "errors": {}}
+    for name, phase in (("local", _phase_local), ("tcp", _phase_tcp)):
+        try:
+            detail[name] = phase()
+        except Exception as exc:  # noqa: BLE001 — contract: always JSON
+            detail["errors"][name] = repr(exc)[:500]
+    value = (detail.get("tcp") or detail.get("local") or {}).get("value", 0.0)
+    print(json.dumps({
+        "metric": "classic_node_committed_cmds_per_sec",
+        "value": value,
+        "unit": "cmds/s",
+        "vs_baseline": round(value / TARGET, 4),
+        "detail": detail,
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BaseException as exc:  # noqa: BLE001
+        print(json.dumps({
+            "metric": "classic_node_committed_cmds_per_sec",
+            "value": 0.0, "unit": "cmds/s", "vs_baseline": 0.0,
+            "error": f"crashed: {type(exc).__name__}",
+            "detail": {"exception": repr(exc)[:500]},
+        }))
+    sys.exit(0)

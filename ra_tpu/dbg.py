@@ -11,7 +11,7 @@ from typing import Any, Callable, Optional
 
 from .core.machine import ApplyMeta, Machine
 from .core.types import Entry, NoopCommand, UserCommand
-from .log.durable import _read_snapshot_file
+from .log.durable import _read_snapshot_file, decode_command
 from .log.snapshot import DEFAULT_SNAPSHOT_MODULE
 from .log.segment import SegmentFile
 from .log.wal import scan_wal_file
@@ -55,7 +55,7 @@ def read_log(data_dir: str, uid: str, snapshot_module=None) -> tuple:
     for idx, (term, payload) in tables.get(uid, {}).items():
         entries[idx] = (term, payload)
     snap_idx = snapshot[0].index if snapshot else 0
-    ordered = [Entry(i, entries[i][0], pickle.loads(entries[i][1]))
+    ordered = [Entry(i, entries[i][0], decode_command(entries[i][1]))
                for i in sorted(entries) if i > snap_idx]
     return snapshot, ordered
 

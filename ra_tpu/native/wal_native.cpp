@@ -1,12 +1,12 @@
 // Native hot path for the fan-in write-ahead log.
 //
-// The reference's WAL hot loop (batch encode + write(2) + fsync + checksum,
+// The reference's WAL hot loop (batch encode + write(2) + fsync,
 // /root/reference/src/ra_log_wal.erl:488-560,753-800) runs on the BEAM's
 // native runtime; this library is the equivalent layer for ra-tpu: the
 // Python WAL thread hands a fully-encoded batch buffer to wal_write_batch,
 // which performs the write + durability syscall with the GIL released
-// (ctypes releases it for the call), and crc32 of record payloads is
-// computed here with a slice-by-8 table instead of per-byte Python work.
+// (ctypes releases it for the call).  Record checksums use zlib.crc32 on
+// the Python side — same polynomial, no FFI overhead per record.
 //
 // Build: g++ -O3 -shared -fPIC -o libra_wal.so wal_native.cpp
 //
@@ -15,7 +15,6 @@
 //   long     ra_wal_write_batch(int fd, const uint8_t *buf, size_t len,
 //                               int sync_mode);  // 0=none 1=fdatasync 2=fsync
 //   int      ra_wal_close(int fd);
-//   uint32_t ra_crc32(uint32_t seed, const uint8_t *buf, size_t len);
 //   long     ra_pwrite(int fd, const uint8_t *buf, size_t len, long off);
 //   long     ra_pread(int fd, uint8_t *buf, size_t len, long off);
 
@@ -28,41 +27,6 @@
 #include <unistd.h>
 
 extern "C" {
-
-static uint32_t crc_table[8][256];
-static int crc_ready = 0;
-
-static void crc_init() {
-  for (uint32_t i = 0; i < 256; i++) {
-    uint32_t c = i;
-    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-    crc_table[0][i] = c;
-  }
-  for (uint32_t i = 0; i < 256; i++)
-    for (int s = 1; s < 8; s++)
-      crc_table[s][i] =
-          crc_table[0][crc_table[s - 1][i] & 0xFF] ^ (crc_table[s - 1][i] >> 8);
-  crc_ready = 1;
-}
-
-uint32_t ra_crc32(uint32_t seed, const uint8_t *buf, size_t len) {
-  if (!crc_ready) crc_init();
-  uint32_t c = seed ^ 0xFFFFFFFFu;
-  while (len >= 8) {
-    c ^= (uint32_t)buf[0] | ((uint32_t)buf[1] << 8) | ((uint32_t)buf[2] << 16) |
-         ((uint32_t)buf[3] << 24);
-    uint32_t hi = (uint32_t)buf[4] | ((uint32_t)buf[5] << 8) |
-                  ((uint32_t)buf[6] << 16) | ((uint32_t)buf[7] << 24);
-    c = crc_table[7][c & 0xFF] ^ crc_table[6][(c >> 8) & 0xFF] ^
-        crc_table[5][(c >> 16) & 0xFF] ^ crc_table[4][c >> 24] ^
-        crc_table[3][hi & 0xFF] ^ crc_table[2][(hi >> 8) & 0xFF] ^
-        crc_table[1][(hi >> 16) & 0xFF] ^ crc_table[0][hi >> 24];
-    buf += 8;
-    len -= 8;
-  }
-  while (len--) c = crc_table[0][(c ^ *buf++) & 0xFF] ^ (c >> 8);
-  return c ^ 0xFFFFFFFFu;
-}
 
 int ra_wal_open(const char *path, int truncate) {
   int flags = O_CREAT | O_RDWR | O_APPEND;

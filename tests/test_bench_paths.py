@@ -75,3 +75,32 @@ def test_child_frontier_mode_contract():
         assert p["batches_measured"] > 0
     assert doc["sync_rtt_ms"] > 0
     assert doc["best_point"] in doc["points"]
+
+
+def test_classic_bench_contract():
+    """bench_classic.py (the ra_bench-parity run over the full node
+    path, ra_bench.erl:84-129) must emit one JSON line with both phase
+    rows, host metadata, and nonzero throughput at a tiny config."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_classic.py")],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+             "RA_TPU_CLASSIC_SECONDS": "1.5",
+             "RA_TPU_CLASSIC_DEGREE": "2",
+             "RA_TPU_CLASSIC_PIPE": "50"},
+        cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    doc = json.loads(lines[0])
+    assert doc["metric"] == "classic_node_committed_cmds_per_sec"
+    assert doc["unit"] == "cmds/s"
+    detail = doc["detail"]
+    assert detail["errors"] == {}, detail["errors"]
+    assert "cpu_count" in detail["host"]
+    for phase in ("local", "tcp"):
+        row = detail[phase]
+        assert row["value"] > 0, (phase, row)
+        assert row["durable"] is True
+        assert row["p50_applied_latency_ms"] > 0
