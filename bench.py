@@ -88,11 +88,15 @@ def _child_main() -> None:
     # dequeue), kv (2k mixed put/get with jittable apply)
     if machine_name == "fifo":
         from ra_tpu.models import JitFifoMachine
-        machine = JitFifoMachine(capacity=64, checkout_slots=8)
+        # capacity 256: a realistic queue depth for the BASELINE row
+        # (the round-4 review called the former 64 dimensionally a toy)
+        machine = JitFifoMachine(
+            capacity=int(os.environ.get("RA_TPU_BENCH_FIFO_CAP", "256")),
+            checkout_slots=8)
         import numpy as np
-        host_payloads = np.zeros((n_lanes, cmds, 2), np.int32)
-        host_payloads[:, 0::2] = (1, 7)        # enqueue 7
-        host_payloads[:, 1::2] = (2, 0)        # dequeue settled
+        host_payloads = np.zeros((n_lanes, cmds, 3), np.int32)
+        host_payloads[:, 0::2] = (1, 7, 0)     # enqueue 7
+        host_payloads[:, 1::2] = (2, 0, 0)     # dequeue settled
         payloads = jnp.asarray(host_payloads)
     elif machine_name == "kv":
         from ra_tpu.models import JitKvMachine
@@ -214,6 +218,10 @@ def _child_main() -> None:
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0]),
         "quorum_impl": quorum_impl, "machine": machine_name,
+        **({"fifo_capacity": machine.capacity,
+            "fifo_checkout_slots": machine.checkout_slots,
+            "fifo_consumer_slots": machine.consumer_slots}
+           if machine_name == "fifo" else {}),
         "lanes": n_lanes, "members": n_members, "cmds_per_step": cmds,
         "durable": durable, "host": _host_meta(),
         **({"sync_mode": sync_mode,

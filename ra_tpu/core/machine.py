@@ -113,6 +113,10 @@ class SimpleMachine(Machine):
         return new_state, new_state
 
 
+#: compiled host-path apply fns shared across same-config instances
+_HOST_APPLY_JIT_CACHE: dict = {}
+
+
 class JitMachine(Machine):
     """TPU-native machine: committed commands are dense arrays folded
     on-device.
@@ -171,7 +175,35 @@ class JitMachine(Machine):
     def apply(self, meta: ApplyMeta, command: Any, state: Any):
         import jax.numpy as jnp
         import jax
+        # jit once per (class, scalar config): an eager jit_apply
+        # re-traces control-flow primitives (lax.fori_loop bodies) on
+        # every call, turning each classic-path apply into a fresh
+        # compile — and caching per-instance would still compile once
+        # per cluster member.  Sound because jit_apply is pure in
+        # (meta, command, state) given the config (the class contract
+        # above) — but only when the whole config is scalar: a machine
+        # holding non-scalar config (arrays, tuples) falls back to a
+        # per-instance compile, since two such instances could share
+        # every scalar attr yet differ in behavior.
+        attrs = [(k, v) for k, v in sorted(self.__dict__.items())
+                 if not k.startswith("_")]
+        if all(isinstance(v, (int, float, str, bool)) for _k, v in attrs):
+            key = (type(self), tuple(attrs))
+            fn = _HOST_APPLY_JIT_CACHE.get(key)
+        else:
+            # non-scalar config: keep the compile on the instance itself
+            # (an id()-keyed shared cache could alias a GC'd instance)
+            key = None
+            fn = self.__dict__.get("_host_apply_jit")
+        if fn is None:
+            bound = type(self).jit_apply
+            inst = self
+            fn = jax.jit(lambda m, c, s: bound(inst, m, c, s))
+            if key is not None:
+                _HOST_APPLY_JIT_CACHE[key] = fn
+            else:
+                self.__dict__["_host_apply_jit"] = fn
         meta_arr = {"index": jnp.int32(meta.index), "term": jnp.int32(meta.term)}
         enc = self.encode_command(command)
-        new_state, reply = self.jit_apply(meta_arr, enc, state)
+        new_state, reply = fn(meta_arr, enc, state)
         return new_state, self.decode_reply(reply)

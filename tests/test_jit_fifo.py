@@ -20,6 +20,7 @@ META = {"index": jnp.int32(1), "term": jnp.int32(1)}
 def apply_seq(m, state, cmds):
     replies = []
     for cmd in cmds:
+        cmd = list(cmd) + [0] * (3 - len(cmd))   # pad to [op, a, b]
         state, r = m.jit_apply(META, jnp.asarray(cmd, jnp.int32), state)
         replies.append(int(r))
     return state, replies
@@ -81,12 +82,14 @@ def test_scripted_semantics():
 
 def fifo_fold(cmds, Q, K):
     """Plain-Python oracle of the encoded op semantics.  Ready entries are
-    (mid, val, dc); returns re-insert sorted by enqueue ticket."""
+    (mid, val, dc); returns re-insert sorted by enqueue ticket.  Capacity
+    bounds LIVE messages (ready + checked out) so requeues never
+    overflow — the machine's documented contract."""
     ready: list = []
     co: dict = {}
     next_id = next_mid = 0
     for op, arg in cmds:
-        if op == 1 and len(ready) < Q:
+        if op == 1 and len(ready) + len(co) < Q:
             ready.append((next_mid, arg, 0))
             next_mid += 1
         elif op == 2 and ready:
@@ -96,7 +99,7 @@ def fifo_fold(cmds, Q, K):
             next_id += 1
         elif op == 4:
             co.pop(arg, None)
-        elif op == 5 and arg in co and len(ready) < Q:
+        elif op == 5 and arg in co:
             m, v, d = co.pop(arg)
             ready.append((m, v, d + 1))
             ready.sort()
@@ -233,16 +236,16 @@ def test_engine_replicas_match_oracle():
                          donate=False)
     lane_cmds = [[] for _ in range(N)]
     for _ in range(STEPS):
-        payloads = np.zeros((N, K, 2), np.int32)
+        payloads = np.zeros((N, K, 3), np.int32)
         for lane in range(N):
             for k in range(K):
                 op = int(rng.integers(1, 4))  # enqueue / deq-s / deq-u
                 arg = int(rng.integers(0, 100)) if op == 1 else 0
-                payloads[lane, k] = (op, arg)
+                payloads[lane, k] = (op, arg, 0)
                 lane_cmds[lane].append((op, arg))
         eng.step(jnp.full((N,), K, jnp.int32), jnp.asarray(payloads))
     for _ in range(4):
-        eng.step(jnp.zeros((N,), jnp.int32), jnp.zeros((N, K, 2), jnp.int32))
+        eng.step(jnp.zeros((N,), jnp.int32), jnp.zeros((N, K, 3), jnp.int32))
     eng.block_until_ready()
     mac = {k: np.asarray(v) for k, v in eng.state.mac.items()}  # [N,P,...]
     for lane in range(N):
@@ -277,3 +280,202 @@ def test_same_machine_on_classic_path():
     finally:
         for n in nodes:
             n.stop()
+
+
+# -- consumer semantics (round-5 depth: credit / cancel / down) -------------
+
+def test_scripted_consumer_credit_cancel_down():
+    """attach/checkout/credit/cancel/down against ra_fifo's consumer
+    model (ra_fifo.erl:254-368): per-consumer credit caps unsettled
+    checkouts; cancel and down requeue owned messages at their original
+    ticket position with delivery_count+1."""
+    m = JitFifoMachine(capacity=8, checkout_slots=4, consumer_slots=2)
+    st = {k: v[0] for k, v in m.jit_init(1).items()}
+
+    st, r = apply_seq(m, st, [[1, 10], [1, 11], [1, 12], [1, 13]])
+    assert r == [1, 1, 1, 1]
+    # unknown consumer cannot check out
+    st, r = apply_seq(m, st, [[10, 7, 0]])
+    assert r == [-4]
+    # attach pid 7 with credit 2; pid 9 with credit 1; table then full
+    st, r = apply_seq(m, st, [[7, 7, 2], [7, 9, 1], [7, 8, 1]])
+    assert r == [1, 1, -4]
+    # pid 7 checks out two (ids 0,1), third refused on credit
+    st, r = apply_seq(m, st, [[10, 7, 0], [10, 7, 0], [10, 7, 0]])
+    assert r == [0, 1, -5]
+    # pid 9 takes one; its second refused on credit
+    st, r = apply_seq(m, st, [[10, 9, 0], [10, 9, 0]])
+    assert r == [2, -5]
+    assert ready_window(st) == [(13, 0)]
+    # raising pid 9's credit unlocks another checkout
+    st, r = apply_seq(m, st, [[11, 9, 2], [10, 9, 0]])
+    assert r == [1, 3]
+    # settle frees credit: pid 7 settles id 0, can check out again (empty)
+    st, r = apply_seq(m, st, [[4, 0, 0], [10, 7, 0]])
+    assert r == [1, -1]
+    # cancel pid 7: its one remaining checkout (11) requeues at rank
+    st, r = apply_seq(m, st, [[8, 7, 0]])
+    assert r == [1]
+    assert ready_window(st) == [(11, 1)]
+    # canceled consumer is gone; re-attach claims a slot again
+    st, r = apply_seq(m, st, [[10, 7, 0], [7, 7, 1]])
+    assert r == [-4, 1]
+    # down pid 9: both its checkouts (12, 13) requeue in ticket order
+    st, r = apply_seq(m, st, [[9, 9, 0]])
+    assert r == [2]
+    assert ready_window(st) == [(11, 1), (12, 1), (13, 1)]
+    # down of an unknown pid is a no-op reply 0
+    st, r = apply_seq(m, st, [[9, 99, 0]])
+    assert r == [0]
+
+
+def test_interleaved_return_and_cancel_ordering():
+    """A canceled consumer's messages merge into a ready window that
+    already contains returned (low-ticket) messages — the rank insert
+    must interleave, not prepend (the host's sorted rebuild)."""
+    m = JitFifoMachine(capacity=8, checkout_slots=4, consumer_slots=2)
+    st = {k: v[0] for k, v in m.jit_init(1).items()}
+    st, r = apply_seq(m, st, [[1, 20], [1, 21], [1, 22],
+                              [7, 5, 3], [10, 5, 0], [10, 5, 0],
+                              [3, 0, 0]])
+    assert r == [1, 1, 1, 1, 0, 1, 2]
+    # anon row holds 22 (id 2); pid 5 holds 20 (id 0) and 21 (id 1).
+    # Return 21, then cancel pid 5: 20 must land BEFORE 21.
+    st, r = apply_seq(m, st, [[5, 1, 0], [8, 5, 0]])
+    assert r == [1, 1]
+    assert ready_window(st) == [(20, 1), (21, 1)]
+    # the anonymous checkout (22) is untouched by the cancel
+    assert checked_out(st) == [(22, 0)]
+
+
+def test_drop_head_overflow_policy():
+    """overflow="drop_head": a full queue admits the new message by
+    discarding the oldest ready one (quorum-queue max-length drop-head);
+    n_dropped counts the losses; reject stays the default."""
+    m = JitFifoMachine(capacity=3, checkout_slots=2, overflow="drop_head")
+    st = {k: v[0] for k, v in m.jit_init(1).items()}
+    st, r = apply_seq(m, st, [[1, 10], [1, 11], [1, 12], [1, 13], [1, 14]])
+    assert r == [1, 1, 1, 1, 1]
+    assert ready_window(st) == [(12, 0), (13, 0), (14, 0)]
+    assert int(st["n_dropped"]) == 2
+    # full via checkouts with a ready message: drop-head still admits
+    st, r = apply_seq(m, st, [[3, 0, 0], [3, 0, 0], [2, 0, 0], [1, 15]])
+    assert r == [0, 1, 14, 1]
+    st, r = apply_seq(m, st, [[1, 16]])   # live = 2 co + 1 ready = full
+    assert r == [1]                        # drops ready 15
+    assert ready_window(st) == [(16, 0)]
+    assert int(st["n_dropped"]) == 3
+    # capacity entirely consumed by checkouts: nothing ready to drop ->
+    # reject even under drop_head
+    m2 = JitFifoMachine(capacity=2, checkout_slots=2, overflow="drop_head")
+    st2 = {k: v[0] for k, v in m2.jit_init(1).items()}
+    st2, r = apply_seq(m2, st2, [[1, 10], [1, 11], [3, 0], [3, 0], [1, 12]])
+    assert r == [1, 1, 0, 1, -2]
+    with np.testing.assert_raises(Exception):
+        JitFifoMachine(overflow="bogus")
+
+
+def test_differential_consumers_vs_host_fifo_machine():
+    """Two registered consumers with distinct credits, random
+    settle/return/cancel/down/credit traffic: the device machine tracks
+    the host FifoMachine oracle exactly.  Host auto-consumers are PUSH
+    (delivery effects); the device is PULL — each host delivery is
+    mirrored as a device checkout(pid) in host pop order (ascending
+    msg_in_id, the order _deliver_ready drains the window)."""
+    rng = np.random.default_rng(23)
+    host = FifoMachine()
+    hstate = host.init({})
+    dev = JitFifoMachine(capacity=64, checkout_slots=16, consumer_slots=4)
+    dstate = {k: v[0] for k, v in dev.jit_init(1).items()}
+    idx = 0
+    PIDS = (1, 2)
+    cids = {p: ("t", p) for p in PIDS}
+    # host msg_id -> device msg_id per consumer, kept in sync
+    id_map: dict = {p: {} for p in PIDS}
+    attached: dict = {p: False for p in PIDS}
+
+    def h_apply(cmd):
+        nonlocal hstate, idx
+        idx += 1
+        hstate, reply, _eff = host.apply(
+            ApplyMeta(index=idx, term=1), cmd, hstate)
+        return reply
+
+    def d_apply(cmd):
+        nonlocal dstate
+        dstate, r = dev.jit_apply(META, dev.encode_command(cmd), dstate)
+        return int(r)
+
+    def snapshot_checked():
+        return {p: dict(hstate.consumers[cids[p]].checked_out)
+                if cids[p] in hstate.consumers else {} for p in PIDS}
+
+    def mirror_new_deliveries(before):
+        """Issue a device checkout(pid) for every message the host just
+        pushed, in ascending msg_in_id order."""
+        new = []
+        for p in PIDS:
+            now = snapshot_checked()[p]
+            for hid, entry in now.items():
+                if hid not in before[p]:
+                    new.append((entry[0], p, hid))   # (msg_in_id, pid, hid)
+        for _mid, p, hid in sorted(new):
+            did = d_apply(("checkout", p))
+            assert did >= 0, (p, hid, did)
+            id_map[p][hid] = did
+
+    for i in range(350):
+        before = snapshot_checked()
+        roll = rng.integers(0, 14)
+        if roll < 5:
+            v = int(rng.integers(0, 10_000))
+            h_apply(("enqueue", None, None, v))
+            assert d_apply(("enqueue", v)) == 1
+        elif roll < 7:
+            p = int(rng.choice(PIDS))
+            credit = int(rng.integers(1, 4))
+            h_apply(("checkout", ("auto", credit), cids[p]))
+            if not attached[p]:
+                assert d_apply(("attach", p, credit)) == 1
+                attached[p] = True
+            else:
+                assert d_apply(("credit", p, credit)) == 1
+        elif roll < 9:
+            p = int(rng.choice(PIDS))
+            if id_map[p]:
+                hid = int(rng.choice(list(id_map[p])))
+                h_apply(("settle", (hid,), cids[p]))
+                assert d_apply(("settle", id_map[p].pop(hid))) == 1
+        elif roll < 11:
+            p = int(rng.choice(PIDS))
+            if id_map[p]:
+                hid = int(rng.choice(list(id_map[p])))
+                h_apply(("return", (hid,), cids[p]))
+                assert d_apply(("return", id_map[p].pop(hid))) == 1
+        elif roll == 11:
+            p = int(rng.choice(PIDS))
+            if attached[p]:
+                h_apply(("checkout", "cancel", cids[p]))
+                assert d_apply(("cancel", p)) == len(before[p])
+                id_map[p].clear()
+                attached[p] = False
+        elif roll == 12:
+            p = int(rng.choice(PIDS))
+            if attached[p]:
+                h_apply(("down", p, "died"))
+                assert d_apply(("down", p)) == len(before[p])
+                id_map[p].clear()
+                attached[p] = False
+        else:
+            h_apply(("purge",))
+            d_apply(("purge",))
+        mirror_new_deliveries(before)
+
+        hready = [(raw, h["delivery_count"])
+                  for (_i, h, raw) in hstate.messages.values()]
+        assert ready_window(dstate) == hready, i
+        hco = sorted(
+            (raw, h["delivery_count"])
+            for con in hstate.consumers.values()
+            for (_mid, _idx, h, raw) in con.checked_out.values())
+        assert checked_out(dstate) == hco, i
