@@ -23,6 +23,7 @@ from .api import (  # noqa: E402,F401
     member_overview,
     members,
     new_uid,
+    node_call,
     overview,
     ping,
     pipeline_command,

@@ -48,23 +48,84 @@ def new_uid(prefix: str = "") -> str:
     return f"{safe}{uuid.uuid4().hex[:12]}"
 
 
-def start_cluster(cluster_name: str, machine_factory: Callable[[], Machine],
+def node_call(node_name: str, op: str, args: dict,
+              router: Optional[LocalRouter] = None,
+              timeout: float = 60.0) -> Any:
+    """Node-lifecycle RPC — the rpc:call of ra_server_sup_sup.erl:42-130.
+    Reaches a LOCAL RaNode directly or a REMOTE one over the router's
+    transport (TcpRouter); raises on unreachable nodes/timeouts."""
+    from .core.types import NODE_SCOPE, NodeControlEvent
+    router = router or DEFAULT_ROUTER
+    node = router.nodes.get(node_name)
+    if node is not None:
+        fut = Future()
+        node.deliver(ServerId(NODE_SCOPE, node_name),
+                     NodeControlEvent(op, args, from_=fut))
+        return fut.wait(timeout)
+    fut = router.remote_call(
+        ServerId(NODE_SCOPE, node_name),
+        lambda handle: NodeControlEvent(op, args, from_=handle))
+    if fut is None:
+        raise RuntimeError(f"node {node_name} is unreachable for {op}")
+    return fut.wait(timeout)
+
+
+def _config_snapshot_for(cluster_name: str, spec: tuple, sid: ServerId,
+                         server_ids: list, uid: str,
+                         election_timeout_ms: int, tick_interval_ms: int,
+                         membership: Membership = Membership.VOTER) -> dict:
+    return {
+        "server_id": tuple(sid),
+        "uid": uid,
+        "cluster_name": cluster_name,
+        "initial_members": tuple(tuple(m) for m in server_ids),
+        "election_timeout_ms": election_timeout_ms,
+        "tick_interval_ms": tick_interval_ms,
+        "membership": membership.value,
+        "machine_spec": spec,
+    }
+
+
+def start_cluster(cluster_name: str, machine_factory: Any,
                   server_ids: list, router: Optional[LocalRouter] = None,
                   election_timeout_ms: int = 100,
                   tick_interval_ms: int = 100,
                   log_init_args: Optional[dict] = None) -> list:
     """Start every member and trigger an election (ra:start_cluster/5 :374).
-    RaNodes named by each ServerId.node must already exist on the router."""
+
+    ``machine_factory`` is either a zero-arg callable returning a
+    Machine (members on LOCAL RaNodes only), or a machine SPEC from
+    :func:`ra_tpu.machines.machine_spec` — with a spec, members whose
+    node is not on this process's router are started REMOTELY over the
+    control plane (the multi-node ra:start_cluster flow, which the
+    reference routes through ra_server_sup_sup's rpc:call)."""
+    from .machines import is_machine_spec, resolve_machine
     router = router or DEFAULT_ROUTER
+    spec = machine_factory if is_machine_spec(machine_factory) else None
     started = []
     for sid in server_ids:
         node = router.nodes.get(sid.node)
+        uid = new_uid(f"{sid.name}_")
         if node is None:
-            raise RuntimeError(f"no RaNode registered for {sid.node}")
-        cfg = ServerConfig(server_id=sid, uid=new_uid(f"{sid.name}_"),
+            if spec is None:
+                raise RuntimeError(
+                    f"no RaNode registered for {sid.node} and no machine "
+                    "spec to start it remotely")
+            res = node_call(sid.node, "start_server", {
+                "config": _config_snapshot_for(
+                    cluster_name, spec, sid, server_ids, uid,
+                    election_timeout_ms, tick_interval_ms)}, router)
+            if isinstance(res, ErrorResult):
+                raise RuntimeError(
+                    f"remote start of {sid} failed: {res.reason}")
+            started.append(sid)
+            continue
+        machine = resolve_machine(spec) if spec is not None \
+            else machine_factory()
+        cfg = ServerConfig(server_id=sid, uid=uid,
                            cluster_name=cluster_name,
                            initial_members=tuple(server_ids),
-                           machine=machine_factory(),
+                           machine=machine,
                            election_timeout_ms=election_timeout_ms,
                            tick_interval_ms=tick_interval_ms,
                            log_init_args=dict(log_init_args or {}))
@@ -75,24 +136,42 @@ def start_cluster(cluster_name: str, machine_factory: Callable[[], Machine],
     return started
 
 
-def start_server(cluster_name: str, machine_factory: Callable[[], Machine],
+def start_server(cluster_name: str, machine_factory: Any,
                  server_id: ServerId, initial_members: list,
                  router: Optional[LocalRouter] = None,
                  election_timeout_ms: int = 100,
                  tick_interval_ms: int = 100,
                  membership: Membership = Membership.VOTER,
-                 log_init_args: Optional[dict] = None) -> ServerId:
+                 log_init_args: Optional[dict] = None) -> Any:
     """Start one member without electing (ra:start_server/4) — used before
-    add_member to bring the new member up."""
+    add_member to bring the new member up.  Accepts a machine spec like
+    start_cluster, and starts on remote nodes over the control plane."""
+    from .machines import is_machine_spec, resolve_machine
     router = router or DEFAULT_ROUTER
+    spec = machine_factory if is_machine_spec(machine_factory) else None
     node = router.nodes.get(server_id.node)
+    uid = new_uid(f"{server_id.name}_")
     if node is None:
-        raise RuntimeError(f"no RaNode registered for {server_id.node}")
+        if spec is None:
+            raise RuntimeError(
+                f"no RaNode registered for {server_id.node} and no "
+                "machine spec to start it remotely")
+        res = node_call(server_id.node, "start_server", {
+            "config": _config_snapshot_for(
+                cluster_name, spec, server_id, list(initial_members), uid,
+                election_timeout_ms, tick_interval_ms, membership)},
+            router)
+        if isinstance(res, ErrorResult):
+            raise RuntimeError(f"remote start of {server_id} failed: "
+                               f"{res.reason}")
+        return res
+    machine = resolve_machine(spec) if spec is not None \
+        else machine_factory()
     cfg = ServerConfig(server_id=server_id,
-                       uid=new_uid(f"{server_id.name}_"),
+                       uid=uid,
                        cluster_name=cluster_name,
                        initial_members=tuple(initial_members),
-                       machine=machine_factory(),
+                       machine=machine,
                        election_timeout_ms=election_timeout_ms,
                        tick_interval_ms=tick_interval_ms,
                        membership=membership,
@@ -101,19 +180,35 @@ def start_server(cluster_name: str, machine_factory: Callable[[], Machine],
 
 
 def restart_server(server_id: ServerId,
-                   router: Optional[LocalRouter] = None) -> ServerId:
+                   router: Optional[LocalRouter] = None) -> Any:
     """Stop and re-init one member over its existing log
-    (ra:restart_server/2 :188-199)."""
+    (ra:restart_server/2 :188-199).  For members on remote nodes this
+    goes over the control plane, recovering the persisted config from
+    the target node's disk (restart_server_rpc + recover_config,
+    ra_server_sup_sup.erl:80-103)."""
     router = router or DEFAULT_ROUTER
-    return _node_of(server_id, router).restart_server(server_id.name)
+    node = router.nodes.get(server_id.node)
+    if node is not None:
+        return node.restart_server(server_id.name)
+    res = node_call(server_id.node, "restart_server",
+                    {"name": server_id.name}, router)
+    if isinstance(res, ErrorResult):
+        raise RuntimeError(f"remote restart of {server_id} failed: "
+                           f"{res.reason}")
+    return res
 
 
 def stop_server(server_id: ServerId,
                 router: Optional[LocalRouter] = None) -> None:
     """Gracefully stop one member; its durable state stays on disk
-    (ra:stop_server/2)."""
+    (ra:stop_server/2).  Remote members stop over the control plane."""
     router = router or DEFAULT_ROUTER
-    _node_of(server_id, router).stop_server(server_id.name)
+    node = router.nodes.get(server_id.node)
+    if node is not None:
+        node.stop_server(server_id.name)
+        return
+    node_call(server_id.node, "stop_server", {"name": server_id.name},
+              router)
 
 
 def force_delete_server(server_id: ServerId, system=None,
@@ -122,8 +217,17 @@ def force_delete_server(server_id: ServerId, system=None,
     (ra:force_delete_server/2 — used when the cluster is already gone).
     Pass the member's RaSystem to delete its on-disk data.  Works on a
     stopped member too: the uid then resolves through the system
-    directory rather than the live shell."""
+    directory rather than the live shell.  For a member on a REMOTE
+    node, the control plane deletes against the target node's own
+    system (no ``system`` argument needed)."""
     router = router or DEFAULT_ROUTER
+    if router.nodes.get(server_id.node) is None:
+        res = node_call(server_id.node, "force_delete_server",
+                        {"name": server_id.name}, router)
+        if isinstance(res, ErrorResult):
+            raise RuntimeError(f"remote force_delete of {server_id} "
+                               f"failed: {res.reason}")
+        return
     node = _node_of(server_id, router)
     shell = node.shells.get(server_id.name)
     uid = shell.server.cfg.uid if shell is not None else None
@@ -325,8 +429,14 @@ def delete_cluster(server_id: ServerId,
 def trigger_election(server_id: ServerId,
                      router: Optional[LocalRouter] = None) -> None:
     router = router or DEFAULT_ROUTER
-    node = _node_of(server_id, router)
-    node.submit(server_id.name, ForceElectionEvent())
+    node = router.nodes.get(server_id.node)
+    if node is not None:
+        node.submit(server_id.name, ForceElectionEvent())
+        return
+    # remote member: the event travels the data plane like any RPC
+    if not router.send("?", server_id, ForceElectionEvent()):
+        raise RuntimeError(
+            f"trigger_election: {server_id} is unreachable")
 
 
 def force_shrink_members_to_current_member(

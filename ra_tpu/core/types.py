@@ -410,6 +410,23 @@ class AuxCommandEvent:
     from_: Any = None
 
 
+#: reserved server name addressing a NODE's control plane rather than a
+#: member (the rpc:call target role of ra_server_sup_sup.erl:42-130)
+NODE_SCOPE = "$node"
+
+
+@dataclass(frozen=True)
+class NodeControlEvent:
+    """Node-lifecycle RPC: start/restart/stop/force-delete a member on
+    the receiving node (ra_server_sup_sup's start_server_rpc /
+    restart_server_rpc / prepare_server_stop_rpc).  Picklable — args
+    carry config snapshots and machine SPECS, never live objects."""
+
+    op: str
+    args: dict
+    from_: Any = None
+
+
 # ---------------------------------------------------------------------------
 # Effects — returned by the pure core / machine, executed by the shell
 # (ra_machine.erl:121-142 + ra_server internal effects)

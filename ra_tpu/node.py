@@ -50,6 +50,8 @@ from .core.types import (
     LogReadEffect,
     ModCall,
     Monitor,
+    NODE_SCOPE,
+    NodeControlEvent,
     Notify,
     Priority,
     PromoteCheckpoint,
@@ -191,9 +193,15 @@ class RaNode:
     """One 'node': hosts many cluster members on one event-loop thread."""
 
     def __init__(self, name: str, router: Optional[LocalRouter] = None,
-                 log_factory: Optional[Callable] = None) -> None:
+                 log_factory: Optional[Callable] = None,
+                 system: Any = None) -> None:
         self.name = name
         self.router = router or DEFAULT_ROUTER
+        #: owning RaSystem (optional): enables control-plane recovery of
+        #: members from the on-disk directory (recover_config role)
+        self.system = system
+        if log_factory is None and system is not None:
+            log_factory = system.log_factory
         self.log_factory = log_factory or (lambda cfg: MemoryLog())
         from .metrics import Counters, Leaderboard
         self.counters = Counters()
@@ -358,12 +366,132 @@ class RaNode:
     # -- ingress ------------------------------------------------------------
 
     def deliver(self, to: ServerId, msg: Any) -> bool:
+        if to.name == NODE_SCOPE:
+            # node-lifecycle RPC (ra_server_sup_sup's rpc:call target):
+            # runs on its own thread — start/restart recover logs and
+            # must never block the transport's recv loop
+            if not isinstance(msg, NodeControlEvent):
+                return False
+            threading.Thread(target=self._handle_control, args=(msg,),
+                             daemon=True,
+                             name=f"ra-node-ctrl-{self.name}").start()
+            return True
         shell = self.shells.get(to.name)
         if shell is None or shell.stopped:
             return False
         shell.inbox.append(msg)
         self._wake.set()
         return True
+
+    # -- control plane (cross-node lifecycle, ra_server_sup_sup.erl:42-130)
+
+    def _handle_control(self, event: NodeControlEvent) -> None:
+        from .core.types import ErrorResult
+        op, args = event.op, dict(event.args)
+        try:
+            if op == "ping":
+                result: Any = ("pong", self.name)
+            elif op == "start_server":
+                result = self._control_start(args)
+            elif op == "restart_server":
+                result = self._control_restart(args)
+            elif op == "stop_server":
+                self.stop_server(args["name"])
+                result = "ok"
+            elif op == "force_delete_server":
+                result = self._control_force_delete(args)
+            else:
+                result = ErrorResult(f"unknown_control_op:{op}", None)
+        except Exception as exc:  # noqa: BLE001 — errors travel to caller
+            logger.exception("ra_tpu node %s: control op %s failed",
+                             self.name, op)
+            result = ErrorResult(f"control_failed: {exc!r}"[:400], None)
+        to = event.from_
+        if to is None:
+            return
+        if isinstance(to, Future):
+            to.set(result)
+        elif isinstance(to, tuple) and to and to[0] == "rcall":
+            self.router.reply_remote(to, result)
+        elif callable(to):
+            to(result)
+
+    def _control_start(self, args: dict) -> Any:
+        """start_server_rpc (ra_server_sup_sup.erl:56-77): build the
+        member from a picklable config snapshot + machine spec.  A name
+        that is RUNNING is already_started; a name with existing durable
+        (or node-directory) state is not_new — recreating it under a
+        fresh uid would orphan its log and rejoin it with amnesia (the
+        double-vote hazard forget_server documents); the caller wants
+        restart_server."""
+        from .core.types import ErrorResult
+        cfg = self._config_from_snapshot(args["config"])
+        name = cfg.server_id.name
+        shell = self.shells.get(name)
+        if shell is not None and not shell.stopped:
+            return ErrorResult("already_started", None)
+        if self._config_for(name) is not None or \
+                (self.system is not None and
+                 self.system.directory.where_is(name) is not None):
+            return ErrorResult("not_new", None)
+        return self.start_server(cfg)
+
+    def _control_restart(self, args: dict) -> Any:
+        """restart_server_rpc: prefer the in-memory config; fall back to
+        the system directory's persisted snapshot (recover_config,
+        ra_server_sup_sup.erl:80-103)."""
+        from .core.types import ErrorResult
+        name = args["name"]
+        if self._config_for(name) is not None:
+            return self.restart_server(name)
+        snap = self._disk_snapshot_for(name)
+        if snap is None:
+            return ErrorResult("not_found", None)
+        cfg = self._config_from_snapshot(snap)
+        return self.start_server(cfg)
+
+    def _control_force_delete(self, args: dict) -> Any:
+        name = args["name"]
+        shell = self.shells.get(name)
+        uid = shell.server.cfg.uid if shell is not None else None
+        if uid is None and self.system is not None:
+            uid = self.system.directory.where_is(name)
+        self.kill_server(name)
+        self.forget_server(name)
+        if self.system is not None and uid is not None:
+            self.system.delete_server_data(uid)
+        return "ok"
+
+    def _disk_snapshot_for(self, name: str) -> Optional[dict]:
+        if self.system is None:
+            return None
+        directory = self.system.directory
+        uid = directory.where_is(name)
+        if uid is None:
+            return None
+        snap = dict(directory.config_of(uid) or {})
+        if not snap:
+            return None
+        snap.setdefault("uid", uid)
+        return snap
+
+    def _config_from_snapshot(self, snap: dict) -> ServerConfig:
+        from .core.types import Membership
+        from .machines import resolve_machine
+        machine = resolve_machine(snap["machine_spec"])
+        return ServerConfig(
+            server_id=ServerId(*snap["server_id"]),
+            uid=snap["uid"],
+            cluster_name=snap["cluster_name"],
+            initial_members=tuple(ServerId(*m)
+                                  for m in snap["initial_members"]),
+            machine=machine,
+            election_timeout_ms=snap.get("election_timeout_ms", 100),
+            tick_interval_ms=snap.get("tick_interval_ms", 100),
+            broadcast_time_ms=snap.get("broadcast_time_ms", 50),
+            membership=Membership(snap.get("membership", "voter")),
+            system_name=snap.get("system_name", "default"),
+        )
 
     def submit(self, name: str, event: Any) -> bool:
         shell = self.shells.get(name)

@@ -28,8 +28,10 @@ def _config_snapshot(cfg: ServerConfig) -> dict:
     in the directory for recover_servers — the ra_server_sup_sup
     recover_config role (:80-103).  The machine is resolved at recovery
     time."""
+    from .machines import spec_of
     return {
         "server_id": tuple(cfg.server_id),
+        "uid": cfg.uid,
         "cluster_name": cfg.cluster_name,
         "initial_members": tuple(tuple(m) for m in cfg.initial_members),
         "election_timeout_ms": cfg.election_timeout_ms,
@@ -37,6 +39,10 @@ def _config_snapshot(cfg: ServerConfig) -> dict:
         "broadcast_time_ms": cfg.broadcast_time_ms,
         "membership": cfg.membership.value,
         "system_name": cfg.system_name,
+        # spec-built machines persist their recipe so a restart (local
+        # boot recovery OR the cross-node control plane) can rebuild
+        # them from disk alone; None for machines passed as live objects
+        "machine_spec": spec_of(cfg.machine),
     }
 
 
@@ -198,13 +204,16 @@ class RaSystem:
 
     # -- recovery / deletion (ra_system_recover + force_delete) ------------
 
-    def recover_servers(self, node, machine_for) -> list:
+    def recover_servers(self, node, machine_for=None) -> list:
         """Restart every registered server on ``node`` — the boot-time
         `server_recovery_strategy: registered` (ra_system_recover.erl:
         34-68).  ``machine_for(cluster_name, server_name) -> Machine``
         resolves the user machine (the durable equivalent of the module
-        reference the reference persists); returning None skips that
-        server.  Already-running servers are left alone."""
+        reference the reference persists); when it is None or returns
+        None, a persisted machine_spec in the config snapshot resolves
+        through the machine registry instead.  Servers with neither are
+        skipped; already-running servers are left alone."""
+        from .machines import resolve_machine, spec_of
         started = []
         for uid in self.directory.uids():
             snap = self.directory.config_of(uid)
@@ -213,9 +222,19 @@ class RaSystem:
             name = self.directory.name_of(uid)
             if name is None or name in node.shells:
                 continue
-            machine = machine_for(snap["cluster_name"], name)
+            machine = machine_for(snap["cluster_name"], name) \
+                if machine_for is not None else None
+            spec = snap.get("machine_spec")
+            if machine is None and spec is not None:
+                machine = resolve_machine(spec)
             if machine is None:
                 continue
+            if spec is not None and spec_of(machine) is None:
+                # carry the persisted spec onto a machine_for-supplied
+                # machine: the re-register below snapshots spec_of(), and
+                # erasing it would break later disk-based control-plane
+                # restarts of this member
+                machine._machine_spec = spec
             cfg = ServerConfig(
                 server_id=ServerId(*snap["server_id"]),
                 uid=uid,

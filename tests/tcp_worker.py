@@ -45,6 +45,10 @@ def worker_main(node_name, port_map, cmd_q, res_q, machine_kind="counter",
     from ra_tpu.node import RaNode
     from ra_tpu.transport.tcp import TcpRouter
 
+    from ra_tpu.machines import machine_spec, register_machine, \
+        resolve_machine
+    register_machine("tcpw", make_machine)
+
     my_addr = ("127.0.0.1", port_map[node_name])
     book = {n: ("127.0.0.1", p) for n, p in port_map.items()
             if n != node_name}
@@ -53,17 +57,20 @@ def worker_main(node_name, port_map, cmd_q, res_q, machine_kind="counter",
     if data_dir:
         from ra_tpu.system import RaSystem
         system = RaSystem(data_dir)
-        node = RaNode(node_name, router=router,
-                      log_factory=system.log_factory)
+        node = RaNode(node_name, router=router, system=system)
     else:
         node = RaNode(node_name, router=router)
-    member_names = sorted(set(port_map) - set(extra_members))
+    member_names = sorted(set(port_map) - set(extra_members)
+                          - {"client"})
     sids = [ServerId(f"m_{n}", n) for n in member_names]
     me = ServerId(f"m_{node_name}", node_name)
     log_args = {"data_dir": data_dir} if data_dir else {}
+    # spec-built machine: the config snapshot then persists the recipe,
+    # so the control plane can restart this member from disk alone
     cfg = ServerConfig(
         server_id=me, uid=f"uid_{node_name}", cluster_name="tcp",
-        initial_members=tuple(sids), machine=make_machine(machine_kind),
+        initial_members=tuple(sids),
+        machine=resolve_machine(machine_spec("tcpw", kind=machine_kind)),
         election_timeout_ms=election_timeout_ms, tick_interval_ms=200,
         log_init_args=log_args)
     if node_name not in extra_members:

@@ -1,0 +1,39 @@
+"""Machine registry — picklable machine specs for cross-node lifecycle
+(the module-name-over-rpc role of ra_server_sup_sup.erl:42-130)."""
+import pickle
+
+import pytest
+
+from ra_tpu.core.machine import SimpleMachine
+from ra_tpu.machines import (is_machine_spec, machine_spec,
+                             register_machine, resolve_machine, spec_of)
+
+
+def test_spec_roundtrip_and_resolution():
+    spec = machine_spec("jit_fifo", capacity=32, checkout_slots=4)
+    assert is_machine_spec(spec)
+    assert pickle.loads(pickle.dumps(spec)) == spec
+    m = resolve_machine(spec)
+    assert m.capacity == 32 and m.checkout_slots == 4
+    assert spec_of(m) == spec
+
+
+def test_builtin_counter_and_custom_registration():
+    m = resolve_machine(machine_spec("counter", initial=7))
+    assert m.apply(None, 3, 7)[0] == 10
+
+    register_machine("t_custom", lambda n=1: SimpleMachine(
+        lambda c, s: s + c * n, 0))
+    m2 = resolve_machine(machine_spec("t_custom", n=5))
+    assert m2.apply(None, 2, 0)[0] == 10
+    assert spec_of(m2) == ("$machine", "t_custom", {"n": 5})
+
+
+def test_resolution_errors_and_idempotence():
+    with pytest.raises(KeyError, match="not registered"):
+        resolve_machine(machine_spec("no_such_machine"))
+    with pytest.raises(ValueError, match="not a machine spec"):
+        resolve_machine(("bogus",))
+    live = SimpleMachine(lambda c, s: s, 0)
+    assert resolve_machine(live) is live     # idempotent on instances
+    assert spec_of(live) is None

@@ -476,3 +476,93 @@ def test_node_restart_over_tcp(tmp_path):
         assert states[victim][2] == 65
     finally:
         f.stop()
+
+
+def test_cross_node_lifecycle_control_plane(tmp_path):
+    """The ra_server_sup_sup role over the fabric
+    (/root/reference/src/ra_server_sup_sup.erl:42-130): a client with NO
+    local members brings up a 3-node cluster in ONE start_cluster call
+    (machine specs resolve on each target node), then remotely stops,
+    restarts — including a restart that recovers config + machine from
+    the target node's DISK after a full process kill (recover_config) —
+    and force-deletes members over the control plane."""
+    import ra_tpu
+    from ra_tpu.core.types import ServerId
+    from ra_tpu.machines import machine_spec
+    from ra_tpu.transport.tcp import TcpRouter
+
+    names = ["cp1", "cp2", "cp3"]
+    # every worker is an "extra member": it hosts a RaNode + RaSystem but
+    # starts NO server — the control plane does that remotely
+    f = Fabric(names, data_root=str(tmp_path), extra_members=tuple(names))
+    client = None
+    try:
+        client = TcpRouter(("127.0.0.1", 0),
+                           {n: ("127.0.0.1", f.ports[n]) for n in names})
+        assert ra_tpu.node_call("cp1", "ping", {}, router=client) == \
+            ("pong", "cp1")
+        sids = [ServerId(f"m_{n}", n) for n in names]
+        started = ra_tpu.start_cluster(
+            "ctl", machine_spec("tcpw", kind="counter"), sids,
+            router=client, election_timeout_ms=500, tick_interval_ms=200)
+        assert started == sids
+        # double-start is refused like the reference's not_new/
+        # already_started
+        with pytest.raises(RuntimeError, match="already_started"):
+            ra_tpu.start_server("ctl", machine_spec("tcpw", kind="counter"),
+                                sids[0], sids, router=client)
+        res = None
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                res = ra_tpu.process_command(sids[0], 5, router=client,
+                                             timeout=10.0)
+                break
+            except (TimeoutError, RuntimeError):
+                ra_tpu.trigger_election(sids[0], router=client)
+        assert res is not None and res.leader is not None
+        leader = res.leader
+        r = ra_tpu.process_command(leader, 3, router=client, timeout=30.0)
+        assert r.reply == 8
+        # remote graceful stop of a follower
+        follower = next(s for s in sids if s != leader)
+        ra_tpu.stop_server(follower, router=client)
+        assert f.ask(follower.node, "state")[1] == "noproc"
+        # a STOPPED member with durable state refuses a fresh start
+        # (the reference's not_new): recreating it under a new uid
+        # would orphan its log and rejoin it with amnesia
+        with pytest.raises(RuntimeError, match="not_new"):
+            ra_tpu.start_server("ctl", machine_spec("tcpw", kind="counter"),
+                                follower, sids, router=client)
+        assert ra_tpu.process_command(leader, 10, router=client,
+                                      timeout=30.0).reply == 18
+        # kill the follower's whole OS process, respawn it with no
+        # member, then control-plane restart: config AND machine recover
+        # from the target node's persisted snapshot (recover_config)
+        f.workers[follower.node].terminate()
+        f.workers[follower.node].join(timeout=15)
+        f.respawn(follower.node)
+        restarted = ra_tpu.restart_server(follower, router=client)
+        assert tuple(restarted) == tuple(follower)
+        deadline = time.monotonic() + 60
+        state = None
+        while time.monotonic() < deadline:
+            state = f.ask(follower.node, "state")
+            if state[1] in ("follower", "leader") and state[2] == 18:
+                break
+            time.sleep(0.4)
+        assert state is not None and state[2] == 18, state
+        # remote force-delete wipes the member + its durable footprint
+        ra_tpu.force_delete_server(follower, router=client)
+        assert f.ask(follower.node, "state")[1] == "noproc"
+        member_dirs = [d for d in os.listdir(
+            os.path.join(str(tmp_path), follower.node))
+            if d.startswith("m_")]
+        assert member_dirs == [], member_dirs
+        # a deleted member cannot be disk-restarted any more
+        with pytest.raises(RuntimeError, match="not_found"):
+            ra_tpu.restart_server(follower, router=client)
+    finally:
+        if client is not None:
+            client.stop()
+        f.stop()
