@@ -17,7 +17,6 @@ import time
 import uuid
 from typing import Any, Callable, Optional
 
-from .core.machine import Machine
 from .core.types import (
     CommandResult,
     ConsistentQueryEvent,

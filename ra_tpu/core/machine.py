@@ -22,7 +22,7 @@ Two flavours exist:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 from .types import Effects
 

@@ -65,7 +65,6 @@ from .types import (
     PeerStatus,
     PreVoteResult,
     PreVoteRpc,
-    Priority,
     PromoteCheckpoint,
     RaftState,
     RecordLeader,
@@ -83,7 +82,6 @@ from .types import (
     SnapshotMeta,
     StartElectionTimeout,
     TickEvent,
-    TimerEffect,
     TransferLeadershipEvent,
     UserCommand,
     WalUpEvent,

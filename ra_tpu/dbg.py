@@ -6,11 +6,10 @@ indexes the same way WAL recovery does.
 from __future__ import annotations
 
 import os
-import pickle
 from typing import Any, Callable, Optional
 
 from .core.machine import ApplyMeta, Machine
-from .core.types import Entry, NoopCommand, UserCommand
+from .core.types import Entry, UserCommand
 from .log.durable import _read_snapshot_file, decode_command
 from .log.snapshot import DEFAULT_SNAPSHOT_MODULE
 from .log.segment import SegmentFile

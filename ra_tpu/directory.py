@@ -19,7 +19,7 @@ from __future__ import annotations
 import os
 import pickle
 import threading
-from typing import Any, Optional
+from typing import Optional
 
 
 class Directory:

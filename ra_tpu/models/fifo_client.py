@@ -15,7 +15,7 @@ from __future__ import annotations
 import itertools
 import time
 from collections import deque
-from typing import Any, Optional
+from typing import Any
 
 from .. import api
 from ..core.types import Priority, ServerId

@@ -30,7 +30,6 @@ import zlib
 from collections import deque
 from typing import Any, Callable, Optional
 
-from .core.machine import Machine
 from .core.server import RaServer
 from .core.types import (
     AuxCommandEvent,
@@ -38,13 +37,11 @@ from .core.types import (
     CancelElectionTimeout,
     Checkpoint,
     CommandEvent,
-    CommandResult,
     CommandsEvent,
     ConsistentQueryEvent,
     Demonitor,
     ElectionTimeout,
     ErrorResult,
-    ForceElectionEvent,
     GarbageCollection,
     InstallSnapshotRpc,
     LogReadEffect,
@@ -69,7 +66,6 @@ from .core.types import (
     StartElectionTimeout,
     TickEvent,
     TimerEffect,
-    TransferLeadershipEvent,
     UserCommand,
 )
 from .log.memory import MemoryLog

@@ -11,7 +11,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Any, Callable, Optional
 
-from ra_tpu.core.machine import Machine, SimpleMachine
+from ra_tpu.core.machine import SimpleMachine
 from ra_tpu.core.server import RaServer
 from ra_tpu.core.types import (
     CancelElectionTimeout,

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Any, Iterable, Optional
+from typing import Iterable, Optional
 
 from ra_tpu.core.types import ServerId
 from ra_tpu.node import LocalRouter, RaNode

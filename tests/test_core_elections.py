@@ -1,13 +1,12 @@
 """Election conformance tests — scenarios modeled on the election cases of
 /root/reference/test/ra_server_SUITE.erl (pre-vote, vote counting, higher
 term stepping, §5.4.1 up-to-date checks)."""
-from harness import SimCluster, mk_ids
+from harness import SimCluster
 
 from ra_tpu.core.server import RaServer
 from ra_tpu.core.types import (
     AppendEntriesRpc,
     ElectionTimeout,
-    IdxTerm,
     PreVoteResult,
     PreVoteRpc,
     RequestVoteRpc,

@@ -5,12 +5,9 @@ import os
 import pickle
 import time
 
-import pytest
 
-from ra_tpu.core.types import Entry, SnapshotMeta, UserCommand
-from ra_tpu.log.durable import DurableLog
+from ra_tpu.core.types import Entry, UserCommand
 from ra_tpu.log.segment import SegmentFile
-from ra_tpu.log.wal import Wal
 from ra_tpu.system import RaSystem
 
 

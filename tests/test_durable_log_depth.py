@@ -9,12 +9,9 @@ metadata round-trips (machine_version through release_cursor).
 import os
 import pickle
 import shutil
-import time
 
-import pytest
 
 from ra_tpu.core.types import Entry, SnapshotMeta, UserCommand
-from ra_tpu.system import RaSystem
 
 from test_durable_log import drain, mk_log, mk_system
 

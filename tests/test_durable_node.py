@@ -2,7 +2,6 @@
 restart (the ra_2_SUITE restart/recovery lifecycles)."""
 import time
 
-import pytest
 
 import ra_tpu
 from ra_tpu import LocalRouter, RaNode, RaSystem

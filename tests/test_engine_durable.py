@@ -15,10 +15,9 @@ import sys
 import time
 
 import numpy as np
-import pytest
 
 from ra_tpu.engine import LockstepEngine, open_engine
-from ra_tpu.engine.durable import (UID, decode_block, encode_block,
+from ra_tpu.engine.durable import (decode_block, encode_block,
                                    _final_logs)
 from ra_tpu.models import CounterMachine
 

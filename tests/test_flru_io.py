@@ -1,6 +1,5 @@
 """Flru, the open-segment fd cap, and io metrics (the reference's
 ra_flru.erl, ra_log_reader open_segments, and ra_file_handle roles)."""
-import pytest
 
 from ra_tpu.core.types import Entry, ServerConfig, ServerId
 from ra_tpu.core.machine import SimpleMachine

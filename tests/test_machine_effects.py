@@ -2,9 +2,8 @@
 completeness audit against the reference's effect() type
 (/root/reference/src/ra_machine.erl:121-142).
 """
-import pytest
 
-from harness import SimCluster, mk_ids
+from harness import SimCluster
 from ra_tpu.core.machine import Machine
 from ra_tpu.core.types import (AppendEffect, CommandEvent, ElectionTimeout,
                                ReplyMode, UserCommand)

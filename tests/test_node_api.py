@@ -7,7 +7,7 @@ import time
 import pytest
 
 import ra_tpu
-from ra_tpu.core.types import Membership, ServerId
+from ra_tpu.core.types import ServerId
 from ra_tpu.core.machine import SimpleMachine
 from ra_tpu.node import LocalRouter, RaNode
 

@@ -2,7 +2,6 @@
 with the scalar pure core on randomized inputs (the TPU analogue of driving
 ra_server's quorum functions directly in ra_server_SUITE)."""
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 

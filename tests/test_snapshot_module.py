@@ -6,12 +6,10 @@ unchanged."""
 import os
 import struct
 
-import pytest
 
 from harness import SimCluster
 from ra_tpu.core.machine import Machine
-from ra_tpu.core.types import (CommandEvent, ElectionTimeout, Entry,
-                               ReleaseCursor, ServerConfig, ServerId,
+from ra_tpu.core.types import (CommandEvent, ElectionTimeout,                                ReleaseCursor, ServerConfig, ServerId,
                                UserCommand)
 from ra_tpu.log.snapshot import SnapshotModule
 from ra_tpu.system import RaSystem

@@ -1,0 +1,216 @@
+"""Self-contained static gate — the dialyzer/xref/elvis role of the
+reference's CI (/root/reference/rebar.config:30-44), implemented over
+the stdlib ``ast`` because this image ships no ruff/mypy/flake8 and
+installing tools is off the table.
+
+Checks (cheap, high-signal, zero-config):
+
+  syntax        file must parse
+  F401          module-level import never referenced (``__init__.py``
+                re-export files and ``# noqa`` lines exempt)
+  B006          mutable default argument (list/dict/set literals or
+                constructors)
+  E722          bare ``except:``
+  F631          assert on a non-empty tuple literal (always true)
+  F632          ``is``/``is not`` comparison against a str/number literal
+  F541          f-string without any placeholder
+  F601          duplicate constant key in a dict literal
+  F811          redefinition of a function/class in the same scope
+                (property setters/overloads exempt)
+  W101          unreachable statement after return/raise/break/continue
+
+Usage: ``python tools/lint.py [paths...]`` (defaults to the repo's
+source roots).  Exits nonzero with one line per finding.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_TARGETS = ["ra_tpu", "tools", "tests", "bench.py",
+                   "bench_classic.py", "__graft_entry__.py"]
+
+_MUTABLE_CALLS = {"list", "dict", "set", "bytearray", "deque",
+                  "defaultdict", "OrderedDict", "Counter"}
+
+
+def _is_mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else \
+            fn.attr if isinstance(fn, ast.Attribute) else None
+        return name in _MUTABLE_CALLS
+    return False
+
+
+def _decorator_exempts_redef(dec: ast.AST) -> bool:
+    # @x.setter / @x.deleter / @overload / @singledispatchmethod.register
+    if isinstance(dec, ast.Attribute):
+        return True
+    if isinstance(dec, ast.Name) and dec.id in ("overload",):
+        return True
+    if isinstance(dec, ast.Call):
+        return _decorator_exempts_redef(dec.func)
+    return False
+
+
+_TERMINAL = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+
+def check_file(path: str) -> list:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, path)
+    except SyntaxError as exc:
+        return [f"{path}:{exc.lineno}: syntax: {exc.msg}"]
+    errors: list = []
+    noqa = {i + 1 for i, line in enumerate(src.splitlines())
+            if "noqa" in line}
+    # format specs (the ':03d' in f"{i:03d}") are themselves JoinedStr
+    # nodes with constant-only parts — never F541 candidates
+    spec_ids = {id(n.format_spec) for n in ast.walk(tree)
+                if isinstance(n, ast.FormattedValue)
+                and n.format_spec is not None}
+
+    def err(node: ast.AST, code: str, msg: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if line not in noqa:
+            errors.append(f"{path}:{line}: {code} {msg}")
+
+    # -- F401: unused module-level imports ------------------------------
+    if os.path.basename(path) != "__init__.py":
+        used = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                pass  # base resolves through a Name anyway
+        # names referenced in __all__ strings count as used
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "__all__":
+                        for elt in ast.walk(node.value):
+                            if isinstance(elt, ast.Constant) and \
+                                    isinstance(elt.value, str):
+                                used.add(elt.value)
+        for node in tree.body:
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "__future__":
+                continue
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = (alias.asname or
+                             alias.name.split(".")[0])
+                    if bound not in used:
+                        err(node, "F401",
+                            f"'{alias.name}' imported but unused")
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    if bound not in used:
+                        err(node, "F401",
+                            f"'{alias.name}' imported but unused")
+
+    for node in ast.walk(tree):
+        # -- B006 mutable defaults --------------------------------------
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in list(node.args.defaults) + \
+                    [d for d in node.args.kw_defaults if d is not None]:
+                if _is_mutable_default(default):
+                    err(default, "B006",
+                        f"mutable default argument in {node.name}()")
+        # -- E722 bare except -------------------------------------------
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            err(node, "E722", "bare 'except:'")
+        # -- F631 assert on tuple ---------------------------------------
+        if isinstance(node, ast.Assert) and \
+                isinstance(node.test, ast.Tuple) and node.test.elts:
+            err(node, "F631", "assert on a non-empty tuple is always true")
+        # -- F632 is-literal --------------------------------------------
+        if isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Is, ast.IsNot)) and \
+                        isinstance(comp, ast.Constant) and \
+                        isinstance(comp.value, (str, int, float, bytes)) \
+                        and not isinstance(comp.value, bool):
+                    err(node, "F632",
+                        "'is' comparison with a literal; use ==")
+        # -- F541 placeholder-less f-string -----------------------------
+        if isinstance(node, ast.JoinedStr) and id(node) not in spec_ids \
+                and not any(isinstance(v, ast.FormattedValue)
+                            for v in node.values):
+            err(node, "F541", "f-string without placeholders")
+        # -- F601 duplicate dict keys -----------------------------------
+        if isinstance(node, ast.Dict):
+            seen: set = set()
+            for key in node.keys:
+                if isinstance(key, ast.Constant):
+                    try:
+                        if key.value in seen:
+                            err(key, "F601",
+                                f"duplicate dict key {key.value!r}")
+                        seen.add(key.value)
+                    except TypeError:
+                        pass
+        # -- F811 redefinition in one scope + W101 unreachable ----------
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            defs: dict = {}
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    decs = getattr(stmt, "decorator_list", [])
+                    if any(_decorator_exempts_redef(d) for d in decs):
+                        continue
+                    if stmt.name in defs:
+                        err(stmt, "F811",
+                            f"redefinition of '{stmt.name}' "
+                            f"(first at line {defs[stmt.name]})")
+                    defs[stmt.name] = stmt.lineno
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(node, field, None)
+            if isinstance(body, list):
+                for i, stmt in enumerate(body[:-1]):
+                    if isinstance(stmt, _TERMINAL):
+                        err(body[i + 1], "W101",
+                            "unreachable code after "
+                            f"{type(stmt).__name__.lower()}")
+                        break
+    return errors
+
+
+def main(argv: list) -> int:
+    targets = argv or DEFAULT_TARGETS
+    files: list = []
+    for t in targets:
+        p = os.path.join(REPO, t) if not os.path.isabs(t) else t
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".pytest_cache")]
+                files += [os.path.join(root, n) for n in names
+                          if n.endswith(".py")]
+        elif p.endswith(".py"):
+            files.append(p)
+    errors: list = []
+    for f in sorted(files):
+        errors += check_file(f)
+    for e in errors:
+        print(e)
+    print(f"lint: {len(files)} files, {len(errors)} findings")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
