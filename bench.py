@@ -37,6 +37,11 @@ CMDS_PER_STEP = 128          # per-lane pipelined batch per round
 PROBE_TIMEOUT_S = 120
 CHILD_TIMEOUT_S = 480
 
+#: the documented latency-mode operating point (docs/BENCHMARKS.md):
+#: pipelined batches of 32 cmds/lane with a 4-deep unacked window
+FRONTIER_DEFAULT_CMDS = 32
+FRONTIER_DEFAULT_WINDOW = 4
+
 
 def _host_meta() -> dict:
     """Environment stamp for cross-round comparability: the same config
@@ -355,12 +360,23 @@ def _frontier_main() -> None:
 
     # headline frontier value: best throughput among points meeting the
     # p99 < 25 ms latency bar (BASELINE.md "without p99 collapse")
-    ok = [p for p in points
-          if 0 < p["p99_commit_latency_ms"] < max(25.0, 3 * sync_rtt_ms)]
+    bar = max(25.0, 3 * sync_rtt_ms)
+    for p in points:
+        p["meets_p99_bar"] = bool(0 < p["p99_commit_latency_ms"] < bar)
+    ok = [p for p in points if p["meets_p99_bar"]]
     best = max(ok or points, key=lambda p: p["value"])
+    # the documented DEFAULT operating point (docs/BENCHMARKS.md):
+    # cmds_per_step=32 with a window of 4 — deep enough batching to
+    # amortize dispatch, shallow enough that the oldest in-flight batch
+    # is never more than 4 device rounds from its readback
+    default_point = next(
+        (p for p in points if p["cmds_per_step"] == FRONTIER_DEFAULT_CMDS),
+        None)
     print(json.dumps({
         "value": best["value"],
         "best_point": best,
+        "default_point": default_point,
+        "p99_bar_ms": round(bar, 3),
         "points": points,
         "sync_rtt_ms": sync_rtt_ms,
         "note": "observed-commit latency floor ~= sync_rtt_ms on "

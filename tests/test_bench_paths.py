@@ -76,6 +76,23 @@ def test_child_frontier_mode_contract():
     assert doc["best_point"] in doc["points"]
 
 
+def test_frontier_default_operating_point_holds_p99_bar():
+    """The documented default operating point (32 cmds/step, window 4 —
+    docs/BENCHMARKS.md) must be reported by the frontier sweep, meet
+    the p99 bar, and sustain the north-star line scaled to the lane
+    count (1M cmds/s at 10k lanes == 100 cmds/s/lane)."""
+    doc = run_child({"RA_TPU_BENCH_MODE": "frontier",
+                     "RA_TPU_BENCH_SIZES": "8,32",
+                     "RA_TPU_BENCH_WINDOW": "4",
+                     "RA_TPU_BENCH_LANES": "256",
+                     "RA_TPU_BENCH_SECONDS": "1.0"})
+    dp = doc["default_point"]
+    assert dp is not None and dp["cmds_per_step"] == 32
+    assert dp["meets_p99_bar"], (dp, doc["p99_bar_ms"])
+    assert dp["value"] >= 100.0 * 256, dp
+    assert doc["p99_bar_ms"] >= 25.0
+
+
 def test_classic_bench_contract():
     """bench_classic.py (the ra_bench-parity run over the full node
     path, ra_bench.erl:84-129) must emit one JSON line with both phase
