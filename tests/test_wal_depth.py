@@ -385,3 +385,41 @@ def test_rollover_on_entry_limit(tmp_path):
             assert n <= 10, (path, n)
     finally:
         wal.close()
+
+
+def test_writer_id_cached_once_per_file(tmp_path):
+    """Record density: the uid string is framed ONCE per WAL file (a
+    registration record mapping wid -> uid); every entry record carries
+    only the u32 wid — the reference's per-file writer-id cache
+    (ra_log_wal.erl:404-421).  A new file after rollover re-registers."""
+    wal = Wal(str(tmp_path), sync_mode=0)
+    sink = Sink()
+    uid = "dense_uid_marker"
+    wal.register(uid, sink)
+    for i in range(1, 201):
+        wal.write(uid, i, 1, b"p" * 8)
+    wal.flush()
+    assert sink.wait_hi(200)
+    waldir = os.path.join(str(tmp_path), "wal")
+    files = sorted(f for f in os.listdir(waldir) if f.endswith(".wal"))
+    assert files
+    blob = open(os.path.join(waldir, files[-1]), "rb").read()
+    assert blob.count(uid.encode()) == 1, \
+        "uid must appear exactly once per file (the wid table), " \
+        f"found {blob.count(uid.encode())}"
+    # rollover: the NEXT file carries its own registration record
+    # (flush first — a roll queued with the write in one batch applies
+    # after the batch, so the write would land in the OLD file)
+    wal.rollover()
+    wal.flush()
+    wal.write(uid, 201, 1, b"q" * 8)
+    wal.flush()
+    assert sink.wait_hi(201)
+    files2 = sorted(f for f in os.listdir(waldir) if f.endswith(".wal"))
+    newest = open(os.path.join(waldir, files2[-1]), "rb").read()
+    assert newest.count(uid.encode()) == 1
+    # and recovery resolves entries through the table
+    tables: dict = {}
+    scan_wal_file(os.path.join(waldir, files2[-1]), tables)
+    assert 201 in tables[uid]
+    wal.close()
