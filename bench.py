@@ -528,6 +528,11 @@ def main() -> None:
         detail = {
             "note": "TPU backend unreachable; value is a CPU smoke "
                     "datapoint at 512 lanes (not the headline config)",
+            "retry_schedule": "tools/tpu_watch.sh probes the tunnel on "
+                              "a fixed schedule all session and captures "
+                              "the full TPU matrix (headline xla+pallas, "
+                              "fifo 5k, frontier, durable, kv) into "
+                              "tpu_rows_r05/ the moment it is reachable",
             "cpu_smoke": res,
             "host": _host_meta(),
         }

@@ -595,7 +595,8 @@ def test_safety_fuzz_over_durable_logs(tmp_path, seed, n_members):
 @pytest.mark.parametrize("seed,n_members",
                          [(s, 3) for s in (7, 8, 19, 43, 230)] +
                          [(61, 5), (89, 5)])
-def test_safety_fuzz_with_snapshots(seed, n_members):
+def test_safety_fuzz_with_snapshots(seed, n_members,
+                                    require_snapshot=True):
     """The interleaving fuzz with snapshot actions mixed in: leaders
     release their cursor at the applied index (truncating the log), so
     laggards must catch up via chunked snapshot installs racing
@@ -695,9 +696,13 @@ def test_safety_fuzz_with_snapshots(seed, n_members):
     assert lead is not None
     states = c.machine_states()
     assert len(set(states.values())) == 1, states
-    # snapshots actually happened (the schedule exercises the path)
-    assert any(c.servers[s].log.snapshot_index_term().index > 0
-               for s in sids), "no snapshot taken during fuzz"
+    # snapshots actually happened — an anti-vacuity guard for the
+    # ANCHORED seeds (chosen to exercise the path); exploration soaks
+    # pass require_snapshot=False since a random schedule occasionally
+    # never crosses the release-cursor threshold (seen at seed 200691)
+    if require_snapshot:
+        assert any(c.servers[s].log.snapshot_index_term().index > 0
+                   for s in sids), "no snapshot taken during fuzz"
 
 
 # ---------------------------------------------------------------------------

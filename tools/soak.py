@@ -27,7 +27,8 @@ def main() -> int:
     families = [
         ("elections_3", lambda s: tp.test_election_safety_and_log_matching_fuzz(s, 3)),
         ("elections_5", lambda s: tp.test_election_safety_and_log_matching_fuzz(s, 5)),
-        ("snapshots_3", lambda s: tp.test_safety_fuzz_with_snapshots(s, 3)),
+        ("snapshots_3", lambda s: tp.test_safety_fuzz_with_snapshots(
+            s, 3, require_snapshot=False)),
         ("membership", tp.test_safety_fuzz_with_membership_changes),
         ("member_snap", tp.test_safety_fuzz_membership_and_snapshots),
         ("mixed_macver", tp.test_safety_fuzz_mixed_machine_versions),
