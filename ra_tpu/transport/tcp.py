@@ -231,24 +231,29 @@ class TcpRouter(LocalRouter):
                     name=f"ra-tcp-send-{peer.name}")
                 peer.thread.start()
 
+    #: frames coalesced into one sendall by the sender loop — the
+    #: gen_batch_server shape on the wire: whatever accumulated while
+    #: the previous syscall ran goes out as one write
+    SEND_COALESCE = 64
+
     def _sender_loop(self, peer: _Peer) -> None:
         while not self._stop:
             try:
                 item = peer.queue.get(timeout=1.0)
             except queue.Empty:
                 continue
-            if not self._send_item(peer, item):
-                # drop the item (and drain cheaply while down: pipeline
+            items = [item]
+            while len(items) < self.SEND_COALESCE:
+                try:
+                    items.append(peer.queue.get_nowait())
+                except queue.Empty:
+                    break
+            if not self._send_items(peer, items):
+                # drop the batch (and drain cheaply while down: pipeline
                 # catch-up will resend what matters)
-                self.dropped_sends += 1
+                self.dropped_sends += len(items)
 
-    def _send_item(self, peer: _Peer, item) -> bool:
-        if peer.name in self.blocked_nodes or \
-                self._addr_blocked(tuple(peer.addr)):
-            return False  # partitioned: no redial, no flush
-        sock = self._peer_sock(peer)
-        if sock is None:
-            return False
+    def _encode_item(self, item) -> Optional[bytes]:
         to, msg, src = (item if len(item) == 3 else (*item, None))
         try:
             if to == "__reply__":
@@ -263,10 +268,26 @@ class TcpRouter(LocalRouter):
                 frame = bytes([FRAME_MSG]) + payload
         except (pickle.PicklingError, TypeError, AttributeError):
             # per-message failure: drop it, the connection is healthy
+            return None
+        return _LEN.pack(len(frame)) + frame
+
+    def _send_items(self, peer: _Peer, items: list) -> bool:
+        if peer.name in self.blocked_nodes or \
+                self._addr_blocked(tuple(peer.addr)):
+            return False  # partitioned: no redial, no flush
+        sock = self._peer_sock(peer)
+        if sock is None:
             return False
+        buf = bytearray()
+        for item in items:
+            encoded = self._encode_item(item)
+            if encoded is not None:
+                buf += encoded
+        if not buf:
+            return True  # every item unpicklable: dropped individually
         try:
             with peer.send_lock:
-                sock.sendall(_LEN.pack(len(frame)) + frame)
+                sock.sendall(bytes(buf))
             return True
         except OSError:
             self._close_peer(peer)
