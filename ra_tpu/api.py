@@ -97,41 +97,63 @@ def start_cluster(cluster_name: str, machine_factory: Any,
     :func:`ra_tpu.machines.machine_spec` — with a spec, members whose
     node is not on this process's router are started REMOTELY over the
     control plane (the multi-node ra:start_cluster flow, which the
-    reference routes through ra_server_sup_sup's rpc:call)."""
+    reference routes through ra_server_sup_sup's rpc:call).
+
+    Formation follows the reference (ra.erl:397-409): the cluster forms
+    when MORE THAN HALF of the members started — stragglers can be
+    retried with start_server later; on failure to form, every member
+    that did start is force-deleted and RuntimeError('cluster_not_
+    formed') is raised."""
     from .machines import is_machine_spec, resolve_machine
     router = router or DEFAULT_ROUTER
     spec = machine_factory if is_machine_spec(machine_factory) else None
-    started = []
+    started: list = []
+    failures: list = []
     for sid in server_ids:
         node = router.nodes.get(sid.node)
         uid = new_uid(f"{sid.name}_")
-        if node is None:
-            if spec is None:
-                raise RuntimeError(
-                    f"no RaNode registered for {sid.node} and no machine "
-                    "spec to start it remotely")
-            res = node_call(sid.node, "start_server", {
-                "config": _config_snapshot_for(
-                    cluster_name, spec, sid, server_ids, uid,
-                    election_timeout_ms, tick_interval_ms)}, router)
-            if isinstance(res, ErrorResult):
-                raise RuntimeError(
-                    f"remote start of {sid} failed: {res.reason}")
-            started.append(sid)
+        try:
+            if node is None:
+                if spec is None:
+                    raise RuntimeError(
+                        f"no RaNode registered for {sid.node} and no "
+                        "machine spec to start it remotely")
+                res = node_call(sid.node, "start_server", {
+                    "config": _config_snapshot_for(
+                        cluster_name, spec, sid, server_ids, uid,
+                        election_timeout_ms, tick_interval_ms)}, router)
+                if isinstance(res, ErrorResult):
+                    raise RuntimeError(
+                        f"remote start of {sid} failed: {res.reason}")
+            else:
+                machine = resolve_machine(spec) if spec is not None \
+                    else machine_factory()
+                cfg = ServerConfig(server_id=sid, uid=uid,
+                                   cluster_name=cluster_name,
+                                   initial_members=tuple(server_ids),
+                                   machine=machine,
+                                   election_timeout_ms=election_timeout_ms,
+                                   tick_interval_ms=tick_interval_ms,
+                                   log_init_args=dict(log_init_args or {}))
+                node.start_server(cfg)
+        except (RuntimeError, TimeoutError, ValueError) as exc:
+            failures.append((sid, exc))
             continue
-        machine = resolve_machine(spec) if spec is not None \
-            else machine_factory()
-        cfg = ServerConfig(server_id=sid, uid=uid,
-                           cluster_name=cluster_name,
-                           initial_members=tuple(server_ids),
-                           machine=machine,
-                           election_timeout_ms=election_timeout_ms,
-                           tick_interval_ms=tick_interval_ms,
-                           log_init_args=dict(log_init_args or {}))
-        node.start_server(cfg)
         started.append(sid)
-    # nudge the first member so a fresh cluster elects promptly
-    trigger_election(server_ids[0], router)
+    if len(started) * 2 <= len(server_ids):
+        # cluster_not_formed: force-delete whatever did start
+        # (ra.erl:407-409 — leftovers would be amnesiac split fragments)
+        for sid in started:
+            try:
+                force_delete_server(sid, router=router)
+            except (RuntimeError, TimeoutError):
+                pass
+        raise RuntimeError(
+            f"cluster_not_formed: {len(started)}/{len(server_ids)} "
+            f"members started; failures: "
+            f"{[(str(s), repr(e)[:120]) for s, e in failures]}")
+    # nudge a started member so a fresh cluster elects promptly
+    trigger_election(started[0], router)
     return started
 
 

@@ -307,3 +307,25 @@ def test_ping(fabric):
     assert ra_tpu.ping(follower, router=router)[0] == "pong"
     with pytest.raises(RuntimeError):
         ra_tpu.ping(ServerId("ghost", sids[0].node), router=router)
+
+
+def test_start_cluster_majority_formation(fabric):
+    """ra.erl:397-409 formation semantics: the cluster forms when more
+    than half the members start (stragglers retried later); when it
+    cannot form, the members that DID start are force-deleted."""
+    router, nodes = fabric
+    # 2 of 3 nodes exist: majority forms, the missing member reported
+    sids = ids() [:2] + [ServerId("mX", "no_such_node")]
+    from ra_tpu.machines import machine_spec
+    started = ra_tpu.start_cluster("tmaj", machine_spec("counter"), sids,
+                                   router=router)
+    assert started == sids[:2]
+    leader = await_leader(router, started)
+    assert ra_tpu.process_command(leader, 4, router=router).reply == 4
+    # 1 of 3: cluster_not_formed, and the one started member is deleted
+    sids2 = [ServerId("q1", "n1"), ServerId("q2", "ghost2"),
+             ServerId("q3", "ghost3")]
+    with pytest.raises(RuntimeError, match="cluster_not_formed"):
+        ra_tpu.start_cluster("tfail", machine_spec("counter"), sids2,
+                             router=router)
+    assert nodes[0].shells.get("q1") is None
