@@ -201,18 +201,22 @@ def start_server(cluster_name: str, machine_factory: Any,
 
 
 def restart_server(server_id: ServerId,
-                   router: Optional[LocalRouter] = None) -> Any:
+                   router: Optional[LocalRouter] = None,
+                   mutable_config: Optional[dict] = None) -> Any:
     """Stop and re-init one member over its existing log
-    (ra:restart_server/2 :188-199).  For members on remote nodes this
+    (ra:restart_server/2,3 :188-199).  For members on remote nodes this
     goes over the control plane, recovering the persisted config from
     the target node's disk (restart_server_rpc + recover_config,
-    ra_server_sup_sup.erl:80-103)."""
+    ra_server_sup_sup.erl:80-103).  ``mutable_config`` merges
+    whitelisted keys (RaNode.MUTABLE_CONFIG_KEYS — the reference's
+    ?MUTABLE_CONFIG_KEYS) into the recovered config."""
     router = router or DEFAULT_ROUTER
     node = router.nodes.get(server_id.node)
     if node is not None:
-        return node.restart_server(server_id.name)
+        return node.restart_server(server_id.name, mutable=mutable_config)
     res = node_call(server_id.node, "restart_server",
-                    {"name": server_id.name}, router)
+                    {"name": server_id.name, "mutable": mutable_config},
+                    router)
     if isinstance(res, ErrorResult):
         raise RuntimeError(f"remote restart of {server_id} failed: "
                            f"{res.reason}")

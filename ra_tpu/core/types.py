@@ -524,10 +524,20 @@ class TimerEffect:
 
 @dataclass(frozen=True)
 class LogReadEffect:
-    """Machine effect {log, Indexes, Fun}: read back committed entries."""
+    """Machine effect {log, Indexes, Fun[, {local, Node}]}: read back
+    committed entries (ra_machine.erl:136-137).
+
+    Reference parity: the BARE effect executes on EVERY member that
+    applies the command (filter_follower_effects keeps it,
+    ra_server.erl:1837-1838; executed in any raft state,
+    ra_server_proc.erl:1383-1397) — the fn must be idempotent or
+    deduplicate via its closure.  ``local`` restricts execution to the
+    named node (the {local, Node} option, :1369-1376).  Effects
+    returned by fn are executed in place (the reference's recursion)."""
 
     indexes: tuple
     fn: Any
+    local: Any = None  # node name, or None = every member
 
 
 @dataclass(frozen=True)

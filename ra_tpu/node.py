@@ -294,10 +294,47 @@ class RaNode:
                     cfg = c
             return cfg
 
-    def restart_server(self, name: str) -> ServerId:
-        """Restart from the persisted log (ra:restart_server, §3.4)."""
+    #: config keys a restart may modify — the reference's
+    #: ?MUTABLE_CONFIG_KEYS whitelist (ra_server_sup_sup.erl:12-20);
+    #: identity/consensus-bearing keys (uid, members, machine,
+    #: election timeout) are immutable across restarts
+    MUTABLE_CONFIG_KEYS = frozenset({
+        "cluster_name", "broadcast_time_ms", "tick_interval_ms",
+        "install_snap_rpc_timeout_ms", "await_condition_timeout_ms",
+        "max_pipeline_count", "friendly_name",
+    })
+
+    def _merge_mutable(self, cfg: ServerConfig,
+                       mutable: Optional[dict]) -> ServerConfig:
+        if not mutable:
+            return cfg
+        from dataclasses import replace as _dc_replace
+        accepted = {k: v for k, v in mutable.items()
+                    if k in self.MUTABLE_CONFIG_KEYS}
+        dropped = set(mutable) - set(accepted)
+        if dropped:
+            logger.warning("ra_tpu node %s: restart config keys %s are "
+                           "not mutable; ignored", self.name,
+                           sorted(dropped))
+        return _dc_replace(cfg, **accepted) if accepted else cfg
+
+    def restart_server(self, name: str,
+                       mutable: Optional[dict] = None) -> ServerId:
+        """Restart from the persisted log (ra:restart_server, §3.4).
+        ``mutable`` merges whitelisted config keys into the recovered
+        config (config_modification_at_restart, ra_server_sup_sup.erl:
+        80-103).  Falls back to the system directory's persisted
+        snapshot when the in-memory config is gone (node process
+        restarted) — the same recover_config path the control plane
+        takes."""
         cfg = self._config_for(name)
-        assert cfg is not None, f"unknown server {name}"
+        if cfg is None:
+            snap = self._disk_snapshot_for(name)
+            if snap is None:
+                raise RuntimeError(f"restart_server: unknown server "
+                                   f"{name} (not_found)")
+            cfg = self._config_from_snapshot(snap)
+        cfg = self._merge_mutable(cfg, mutable)
         self.stop_server(name)
         return self.start_server(cfg)
 
@@ -438,12 +475,14 @@ class RaNode:
         ra_server_sup_sup.erl:80-103)."""
         from .core.types import ErrorResult
         name = args["name"]
+        mutable = args.get("mutable")
         if self._config_for(name) is not None:
-            return self.restart_server(name)
+            return self.restart_server(name, mutable=mutable)
         snap = self._disk_snapshot_for(name)
         if snap is None:
             return ErrorResult("not_found", None)
-        cfg = self._config_from_snapshot(snap)
+        cfg = self._merge_mutable(self._config_from_snapshot(snap),
+                                  mutable)
         return self.start_server(cfg)
 
     def _control_force_delete(self, args: dict) -> Any:
@@ -487,6 +526,11 @@ class RaNode:
             broadcast_time_ms=snap.get("broadcast_time_ms", 50),
             membership=Membership(snap.get("membership", "voter")),
             system_name=snap.get("system_name", "default"),
+            **{k: snap[k] for k in (
+                "await_condition_timeout_ms", "max_pipeline_count",
+                "max_append_entries_batch", "snapshot_chunk_size",
+                "install_snap_rpc_timeout_ms", "friendly_name")
+               if k in snap},
         )
 
     def submit(self, name: str, event: Any) -> bool:
@@ -722,11 +766,17 @@ class RaNode:
                 except Exception:
                     logger.exception("mod_call effect failed")
             elif isinstance(eff, LogReadEffect):
-                entries = server.log.sparse_read(eff.indexes)
-                try:
-                    eff.fn(entries)
-                except Exception:
-                    logger.exception("log effect failed")
+                # bare form runs on every member; {local, Node} targets
+                # one node (ra_server_proc.erl:1369-1397)
+                if eff.local is None or eff.local == self.name:
+                    entries = server.log.sparse_read(eff.indexes)
+                    try:
+                        follow_up = eff.fn(entries)
+                    except Exception:
+                        logger.exception("log effect failed")
+                    else:
+                        if follow_up:
+                            self._execute(shell, list(follow_up))
             elif isinstance(eff, AuxEffect):
                 self._execute(shell, server.handle_aux("eval", eff.msg))
             elif isinstance(eff, Monitor):

@@ -37,6 +37,15 @@ def _config_snapshot(cfg: ServerConfig) -> dict:
         "election_timeout_ms": cfg.election_timeout_ms,
         "tick_interval_ms": cfg.tick_interval_ms,
         "broadcast_time_ms": cfg.broadcast_time_ms,
+        # the remaining tunables round-trip too — a restart-applied
+        # mutable-config change (RaNode.MUTABLE_CONFIG_KEYS) must
+        # survive node/system recovery, not silently revert
+        "await_condition_timeout_ms": cfg.await_condition_timeout_ms,
+        "max_pipeline_count": cfg.max_pipeline_count,
+        "max_append_entries_batch": cfg.max_append_entries_batch,
+        "snapshot_chunk_size": cfg.snapshot_chunk_size,
+        "install_snap_rpc_timeout_ms": cfg.install_snap_rpc_timeout_ms,
+        "friendly_name": cfg.friendly_name,
         "membership": cfg.membership.value,
         "system_name": cfg.system_name,
         # spec-built machines persist their recipe so a restart (local
@@ -247,6 +256,11 @@ class RaSystem:
                 broadcast_time_ms=snap["broadcast_time_ms"],
                 membership=Membership(snap["membership"]),
                 system_name=snap.get("system_name", "default"),
+                **{k: snap[k] for k in (
+                    "await_condition_timeout_ms", "max_pipeline_count",
+                    "max_append_entries_batch", "snapshot_chunk_size",
+                    "install_snap_rpc_timeout_ms", "friendly_name")
+                   if k in snap},
             )
             started.append(node.start_server(cfg))
         return started

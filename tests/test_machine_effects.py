@@ -242,3 +242,91 @@ def test_effect_vocabulary_parity():
     import inspect
     assert "component" in inspect.signature(T.Monitor).parameters or \
         hasattr(T.Monitor, "component")
+
+
+def test_log_read_effect_reads_back_committed_entries():
+    """The {log, Indexes, Fun} effect (ra_machine.erl:136-137,
+    ra_machine_int_SUITE log_effect): the shell reads the requested
+    committed entries back from the log and hands them to the fun."""
+    import time as _t
+
+    import ra_tpu
+    from ra_tpu.core.types import LogReadEffect, ServerId
+    from ra_tpu.node import LocalRouter, RaNode
+    from nemesis import await_leader
+
+    got: list = []
+
+    class Reader(Machine):
+        def init(self, config):
+            return 0
+
+        def apply(self, meta, command, state):
+            if isinstance(command, tuple) and command[0] == "readback":
+                # {local, Node}: execute on exactly one member
+                # (the bare form runs on EVERY member, reference parity)
+                return state, "ok", [LogReadEffect(command[1], got.extend,
+                                                   local=command[2])]
+            return state + command, state + command
+
+    router = LocalRouter()
+    nodes = [RaNode(f"lr{i}", router=router) for i in (1, 2, 3)]
+    sids = [ServerId(f"lrm{i}", f"lr{i}") for i in (1, 2, 3)]
+    try:
+        ra_tpu.start_cluster("logread", Reader, sids, router=router)
+        leader = await_leader(router, sids)
+        for v in (7, 8, 9):
+            ra_tpu.process_command(leader, v, router=router)
+        # read a range wide enough to cover noops from any extra
+        # elections; assert on the user entries, in log order
+        ra_tpu.process_command(
+            leader, ("readback", tuple(range(1, 9)), leader.node),
+            router=router)
+        deadline = _t.monotonic() + 10
+        while _t.monotonic() < deadline and len(got) < 4:
+            _t.sleep(0.05)
+        vals = [(e.index, e.command.data) for e in got
+                if getattr(e.command, "data", None) in (7, 8, 9)]
+        assert [v for _i, v in vals] == [7, 8, 9], got
+        assert [i for i, _v in vals] == sorted(i for i, _v in vals)
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_deleted_cluster_emits_eol_to_attached_pids():
+    """deleted_cluster_emits_eol_effect (ra_machine_int_SUITE): on
+    '$ra_cluster' delete the machine's state_enter('eol') effects run,
+    telling every attached process the queue is gone
+    (ra_fifo.erl:381)."""
+    import time as _t
+
+    import ra_tpu
+    from ra_tpu.core.types import ServerId
+    from ra_tpu.models import FifoClient, FifoMachine
+    from ra_tpu.node import LocalRouter, RaNode
+    from nemesis import await_leader
+
+    router = LocalRouter()
+    nodes = [RaNode(f"el{i}", router=router) for i in (1, 2, 3)]
+    sids = [ServerId(f"elm{i}", f"el{i}") for i in (1, 2, 3)]
+    try:
+        ra_tpu.start_cluster("eolq", FifoMachine, sids, router=router)
+        leader = await_leader(router, sids)
+        cli = FifoClient(sids, router=router, tag="eol-consumer")
+        con = cli.mailbox
+        cli.checkout(credit=2)
+        cli.enqueue_sync("m1")
+        r = ra_tpu.delete_cluster(leader, router=router)
+        assert r.reply == "ok"
+        deadline = _t.monotonic() + 10
+        eol = None
+        while _t.monotonic() < deadline and eol is None:
+            for msg in con.drain():
+                if isinstance(msg, tuple) and msg[0] == "eol":
+                    eol = msg
+            _t.sleep(0.05)
+        assert eol is not None, "consumer never saw the eol signal"
+    finally:
+        for n in nodes:
+            n.stop()

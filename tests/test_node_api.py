@@ -329,3 +329,29 @@ def test_start_cluster_majority_formation(fabric):
         ra_tpu.start_cluster("tfail", machine_spec("counter"), sids2,
                              router=router)
     assert nodes[0].shells.get("q1") is None
+
+
+def test_config_modification_at_restart(fabric):
+    """config_modification_at_restart (ra_2_SUITE): a restart merges
+    whitelisted mutable keys into the recovered config
+    (?MUTABLE_CONFIG_KEYS, ra_server_sup_sup.erl:12-20); identity and
+    consensus-bearing keys are silently refused."""
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("tmut", counter_factory, sids, router=router)
+    leader = await_leader(router, sids)
+    ra_tpu.process_command(leader, 5, router=router)
+    victim = [s for s in sids if s != leader][0]
+    node = router.nodes[victim.node]
+    old_uid = node.shells[victim.name].server.cfg.uid
+    ra_tpu.restart_server(victim, router=router, mutable_config={
+        "tick_interval_ms": 12345,
+        "friendly_name": "renamed",
+        "uid": "evil_uid",              # NOT mutable: ignored
+        "election_timeout_ms": 1,       # NOT mutable: ignored
+    })
+    cfg = node.shells[victim.name].server.cfg
+    assert cfg.tick_interval_ms == 12345
+    assert cfg.friendly_name == "renamed"
+    assert cfg.uid == old_uid
+    assert cfg.election_timeout_ms != 1

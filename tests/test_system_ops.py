@@ -229,7 +229,7 @@ def test_force_delete_stopped_member_and_no_resurrection(tmp_path):
     assert not os.path.isdir(os.path.join(system.data_dir, uid))
     assert not system.directory.is_registered_uid(uid)
     # the node directory forgot it too: no amnesiac resurrection
-    with pytest.raises(AssertionError):
+    with pytest.raises(RuntimeError, match="not_found"):
         ra_tpu.restart_server(sid, router=router)
     # and system recovery skips it (nothing registered anymore)
     assert system.recover_servers(node, lambda c, n: counter()) == []
@@ -442,3 +442,49 @@ def test_start_server_uid_validation(tmp_path):
         assert not (tmp_path / "a").exists()
     finally:
         system.close()
+
+
+def test_mutable_config_survives_disk_recovery(tmp_path):
+    """A restart-applied mutable-config change persists in the config
+    snapshot and survives a full node-process recovery from disk —
+    the reference persists the EFFECTIVE config
+    (ra_server_sup_sup.erl:80-103)."""
+    import ra_tpu
+    from ra_tpu.core.types import ServerId
+    from ra_tpu.machines import machine_spec
+    from ra_tpu.node import LocalRouter, RaNode
+    from ra_tpu.system import RaSystem
+
+    router = LocalRouter()
+    system = RaSystem(str(tmp_path))
+    node = RaNode("mc1", router=router, system=system)
+    sid = ServerId("mcm1", "mc1")
+    try:
+        ra_tpu.start_cluster("mcc", machine_spec("counter"), [sid],
+                             router=router)
+        ra_tpu.restart_server(sid, router=router, mutable_config={
+            "friendly_name": "kept", "max_pipeline_count": 777})
+        cfg = node.shells[sid.name].server.cfg
+        assert cfg.friendly_name == "kept"
+        assert cfg.max_pipeline_count == 777
+    finally:
+        node.stop()
+        system.close()
+    # full process-restart simulation: fresh system + node over the
+    # same data dir; the member recovers from the persisted snapshot
+    system2 = RaSystem(str(tmp_path))
+    node2 = RaNode("mc1", router=LocalRouter(), system=system2)
+    try:
+        started = system2.recover_servers(node2)
+        assert started == [sid]
+        cfg2 = node2.shells[sid.name].server.cfg
+        assert cfg2.friendly_name == "kept"
+        assert cfg2.max_pipeline_count == 777
+        # local restart with NO in-memory loss also goes through the
+        # disk fallback path when the node directory is empty
+        node2.directory.clear()
+        ra_tpu.restart_server(sid, router=node2.router)
+        assert node2.shells[sid.name].server.cfg.friendly_name == "kept"
+    finally:
+        node2.stop()
+        system2.close()
