@@ -326,14 +326,40 @@ def _leader_call(seed: ServerId, make_event: Callable[["Future"], Any],
 def process_command(server_id: ServerId, data: Any,
                     router: Optional[LocalRouter] = None,
                     timeout: float = 5.0,
-                    reply_mode: ReplyMode = ReplyMode.AWAIT_CONSENSUS) -> Any:
+                    reply_mode: ReplyMode = ReplyMode.AWAIT_CONSENSUS,
+                    reply_from: Any = None) -> Any:
     """Send a command and await consensus (ra:process_command/3 :804-828),
-    following not_leader redirects like the reference's leader_call loop."""
+    following not_leader redirects like the reference's leader_call loop.
+
+    ``reply_from`` picks which member answers (the reply_from command
+    option, ra.erl:786-823): None/"leader" (default), ("member", sid),
+    or "local" — resolved client-side to a cluster member hosted on one
+    of THIS process's nodes (falling back to the leader when none is).
+    A non-leader replier needs the reply handle to reach that member's
+    log copy: true for in-process routing (objects travel unpickled)
+    and for TCP rcall handles (tuples survive the wire/durable image);
+    recovery replays suppress reply effects everywhere regardless."""
     from .core.types import CommandEvent
     router = router or DEFAULT_ROUTER
+    if reply_from == "local":
+        # find ANY member of the seed's cluster hosted by one of this
+        # process's nodes — the seed itself need not be local; shells
+        # know their whole cluster, so a co-located sibling resolves it
+        reply_from = None
+        for node in router.nodes.values():
+            for shell in list(node.shells.values()):
+                srv = shell.server
+                if server_id == srv.id or server_id in srv.cluster:
+                    reply_from = ("member", srv.id)
+                    break
+            if reply_from is not None:
+                break
+    elif reply_from == "leader":
+        reply_from = None
     return _leader_call(
         server_id,
-        lambda fut: CommandEvent(UserCommand(data, reply_mode=reply_mode),
+        lambda fut: CommandEvent(UserCommand(data, reply_mode=reply_mode,
+                                             reply_from=reply_from),
                                  from_=fut),
         router, timeout, timeout_msg="ra: command not completed")
 

@@ -1639,8 +1639,10 @@ class RaServer:
         mode = getattr(cmd, "reply_mode", None)
         if mode == ReplyMode.AWAIT_CONSENSUS and \
                 getattr(cmd, "from_", None) is not None:
+            replier = getattr(cmd, "reply_from", None) or "leader"
             effects.append(Reply(cmd.from_,
-                                 CommandResult(idx, term, reply, self.id)))
+                                 CommandResult(idx, term, reply, self.id),
+                                 replier=replier))
         elif mode == ReplyMode.NOTIFY and \
                 getattr(cmd, "notify_to", None) is not None:
             notifys.setdefault(cmd.notify_to, []).append(
@@ -2232,9 +2234,11 @@ def _filter_follower_effects(effects: list) -> list:
             continue
         if isinstance(e, SendMsg) and "local" not in e.options:
             continue
-        if isinstance(e, Reply) and isinstance(e.msg, CommandResult):
-            # consensus replies have replier=leader by default: follower
-            # copies are dropped ({reply,_,_,leader} filtering)
+        if isinstance(e, Reply) and isinstance(e.msg, CommandResult) and \
+                e.replier == "leader":
+            # leader-replier consensus replies: follower copies dropped
+            # ({reply,_,_,leader} filtering); member-replier replies
+            # survive — the named member executes them at the shell
             continue
         if isinstance(e, _FOLLOWER_SAFE_EFFECTS):
             out.append(e)

@@ -106,6 +106,10 @@ class UserCommand:
     correlation: Any = None  # used with ReplyMode.NOTIFY
     notify_to: Any = None    # destination for applied-notifications
     from_: Any = None        # reply destination, attached at append time
+    #: which member answers an await_consensus call: None/"leader", or
+    #: ("member", ServerId) — the reply_from command option
+    #: (ra.erl:786-823); useful when the caller sits nearer a follower
+    reply_from: Any = None
 
     kind = "usr"
 
@@ -449,10 +453,18 @@ class SendVoteRequests:
 
 @dataclass(frozen=True)
 class Reply:
-    """Reply to a synchronous caller."""
+    """Reply to a synchronous caller.
+
+    ``replier`` picks WHICH member sends it ({reply, From, Reply,
+    Replier}, ra_server.erl:2771-2781): "leader" (default — follower
+    copies are filtered) or ("member", ServerId) — every member emits
+    the effect, the shell executes it only on the named member.  The
+    reply value is deterministic across replicas, so any member's copy
+    is THE reply."""
 
     to: Any
     msg: Any
+    replier: Any = "leader"
 
 
 @dataclass(frozen=True)
@@ -663,6 +675,17 @@ def strip_msg_handles(msg: Any) -> Any:
 # ---------------------------------------------------------------------------
 # Server configuration (ra_server:ra_server_config(), ra_server.erl:188-213)
 # ---------------------------------------------------------------------------
+
+#: tunables persisted in (and restored from) the directory's config
+#: snapshot beyond the always-present identity/timing fields — ONE list
+#: shared by the snapshot writer and both restore sites, so adding a
+#: tunable cannot silently stop round-tripping through recovery
+SNAPSHOT_TUNABLE_KEYS = (
+    "await_condition_timeout_ms", "max_pipeline_count",
+    "max_append_entries_batch", "snapshot_chunk_size",
+    "install_snap_rpc_timeout_ms", "friendly_name",
+)
+
 
 @dataclass
 class ServerConfig:

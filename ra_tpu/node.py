@@ -55,6 +55,7 @@ from .core.types import (
     RaftState,
     RecordLeader,
     ReleaseCursor,
+    SNAPSHOT_TUNABLE_KEYS,
     Reply,
     ReplyMode,
     SendMsg,
@@ -474,16 +475,11 @@ class RaNode:
         the system directory's persisted snapshot (recover_config,
         ra_server_sup_sup.erl:80-103)."""
         from .core.types import ErrorResult
-        name = args["name"]
-        mutable = args.get("mutable")
-        if self._config_for(name) is not None:
-            return self.restart_server(name, mutable=mutable)
-        snap = self._disk_snapshot_for(name)
-        if snap is None:
+        try:
+            return self.restart_server(args["name"],
+                                       mutable=args.get("mutable"))
+        except RuntimeError:
             return ErrorResult("not_found", None)
-        cfg = self._merge_mutable(self._config_from_snapshot(snap),
-                                  mutable)
-        return self.start_server(cfg)
 
     def _control_force_delete(self, args: dict) -> Any:
         name = args["name"]
@@ -526,10 +522,7 @@ class RaNode:
             broadcast_time_ms=snap.get("broadcast_time_ms", 50),
             membership=Membership(snap.get("membership", "voter")),
             system_name=snap.get("system_name", "default"),
-            **{k: snap[k] for k in (
-                "await_condition_timeout_ms", "max_pipeline_count",
-                "max_append_entries_batch", "snapshot_chunk_size",
-                "install_snap_rpc_timeout_ms", "friendly_name")
+            **{k: snap[k] for k in SNAPSHOT_TUNABLE_KEYS
                if k in snap},
         )
 
@@ -719,6 +712,14 @@ class RaNode:
                 for to, msg in eff.requests:
                     self.router.send(self.name, to, msg)
             elif isinstance(eff, Reply):
+                # member-replier replies execute ONLY on the named
+                # member; everyone else (including the leader) skips —
+                # can_execute_locally (ra_server_proc.erl)
+                rep = getattr(eff, "replier", "leader")
+                if rep != "leader" and not (
+                        isinstance(rep, tuple) and len(rep) == 2 and
+                        rep[0] == "member" and rep[1] == server.id):
+                    continue
                 if isinstance(eff.to, Future):
                     eff.to.set(eff.msg)
                 elif isinstance(eff.to, tuple) and eff.to and \
@@ -772,11 +773,16 @@ class RaNode:
                     entries = server.log.sparse_read(eff.indexes)
                     try:
                         follow_up = eff.fn(entries)
+                        # a fn may return follow-up EFFECTS (reference
+                        # recursion); anything non-iterable is treated
+                        # as no effects, not a crash
+                        follow_up = list(follow_up) if \
+                            isinstance(follow_up, (list, tuple)) else []
                     except Exception:
                         logger.exception("log effect failed")
-                    else:
-                        if follow_up:
-                            self._execute(shell, list(follow_up))
+                        follow_up = []
+                    if follow_up:
+                        self._execute(shell, follow_up)
             elif isinstance(eff, AuxEffect):
                 self._execute(shell, server.handle_aux("eval", eff.msg))
             elif isinstance(eff, Monitor):

@@ -16,7 +16,8 @@ import time
 from collections import deque
 from typing import Optional
 
-from .core.types import Membership, ServerConfig, ServerId
+from .core.types import (Membership, SNAPSHOT_TUNABLE_KEYS,
+                         ServerConfig, ServerId)
 from .directory import Directory
 from .log.durable import DurableLog
 from .log.segment import SegmentWriter
@@ -40,12 +41,7 @@ def _config_snapshot(cfg: ServerConfig) -> dict:
         # the remaining tunables round-trip too — a restart-applied
         # mutable-config change (RaNode.MUTABLE_CONFIG_KEYS) must
         # survive node/system recovery, not silently revert
-        "await_condition_timeout_ms": cfg.await_condition_timeout_ms,
-        "max_pipeline_count": cfg.max_pipeline_count,
-        "max_append_entries_batch": cfg.max_append_entries_batch,
-        "snapshot_chunk_size": cfg.snapshot_chunk_size,
-        "install_snap_rpc_timeout_ms": cfg.install_snap_rpc_timeout_ms,
-        "friendly_name": cfg.friendly_name,
+        **{k: getattr(cfg, k) for k in SNAPSHOT_TUNABLE_KEYS},
         "membership": cfg.membership.value,
         "system_name": cfg.system_name,
         # spec-built machines persist their recipe so a restart (local
@@ -256,10 +252,7 @@ class RaSystem:
                 broadcast_time_ms=snap["broadcast_time_ms"],
                 membership=Membership(snap["membership"]),
                 system_name=snap.get("system_name", "default"),
-                **{k: snap[k] for k in (
-                    "await_condition_timeout_ms", "max_pipeline_count",
-                    "max_append_entries_batch", "snapshot_chunk_size",
-                    "install_snap_rpc_timeout_ms", "friendly_name")
+                **{k: snap[k] for k in SNAPSHOT_TUNABLE_KEYS
                    if k in snap},
             )
             started.append(node.start_server(cfg))

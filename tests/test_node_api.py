@@ -355,3 +355,33 @@ def test_config_modification_at_restart(fabric):
     assert cfg.friendly_name == "renamed"
     assert cfg.uid == old_uid
     assert cfg.election_timeout_ms != 1
+
+
+def test_reply_from_member(fabric):
+    """The reply_from command option (ra.erl:786-823,
+    process_command_reply_from_member): the NAMED member answers an
+    await_consensus call instead of the leader — and exactly once."""
+    from ra_tpu.core.types import ReplyMode, UserCommand
+
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("trf", counter_factory, sids, router=router)
+    leader = await_leader(router, sids)
+    follower = [s for s in sids if s != leader][0]
+    got = []
+    cmd = UserCommand(5, reply_mode=ReplyMode.AWAIT_CONSENSUS,
+                      reply_from=("member", follower))
+    router.nodes[leader.node].submit_command(leader.name, cmd, got.append)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not got:
+        time.sleep(0.02)
+    assert got and got[0].reply == 5, got
+    time.sleep(0.5)     # a second (duplicate) reply must never arrive
+    assert len(got) == 1, got
+    # api surface: explicit member and client-side "local" resolution
+    res = ra_tpu.process_command(leader, 3, router=router,
+                                 reply_from=("member", follower))
+    assert res.reply == 8
+    res = ra_tpu.process_command(leader, 1, router=router,
+                                 reply_from="local")
+    assert res.reply == 9

@@ -524,6 +524,13 @@ def test_cross_node_lifecycle_control_plane(tmp_path):
         leader = res.leader
         r = ra_tpu.process_command(leader, 3, router=client, timeout=30.0)
         assert r.reply == 8
+        # reply_from over real sockets: the rcall handle survives
+        # replication and the NAMED member answers (reply_from option,
+        # ra.erl:786-823)
+        fol0 = next(s for s in sids if s != leader)
+        r = ra_tpu.process_command(leader, 2, router=client, timeout=30.0,
+                                   reply_from=("member", fol0))
+        assert r.reply == 10
         # remote graceful stop of a follower
         follower = next(s for s in sids if s != leader)
         ra_tpu.stop_server(follower, router=client)
@@ -535,7 +542,7 @@ def test_cross_node_lifecycle_control_plane(tmp_path):
             ra_tpu.start_server("ctl", machine_spec("tcpw", kind="counter"),
                                 follower, sids, router=client)
         assert ra_tpu.process_command(leader, 10, router=client,
-                                      timeout=30.0).reply == 18
+                                      timeout=30.0).reply == 20
         # kill the follower's whole OS process, respawn it with no
         # member, then control-plane restart: config AND machine recover
         # from the target node's persisted snapshot (recover_config)
@@ -548,10 +555,10 @@ def test_cross_node_lifecycle_control_plane(tmp_path):
         state = None
         while time.monotonic() < deadline:
             state = f.ask(follower.node, "state")
-            if state[1] in ("follower", "leader") and state[2] == 18:
+            if state[1] in ("follower", "leader") and state[2] == 20:
                 break
             time.sleep(0.4)
-        assert state is not None and state[2] == 18, state
+        assert state is not None and state[2] == 20, state
         # remote force-delete wipes the member + its durable footprint
         ra_tpu.force_delete_server(follower, router=client)
         assert f.ask(follower.node, "state")[1] == "noproc"
