@@ -22,6 +22,7 @@ from .api import (  # noqa: E402,F401
     local_query,
     member_overview,
     members,
+    members_info,
     new_uid,
     node_call,
     overview,

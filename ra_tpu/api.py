@@ -393,14 +393,36 @@ def ping(server_id: ServerId,
 
 
 def local_query(server_id: ServerId, query_fn: Callable,
-                router: Optional[LocalRouter] = None) -> Any:
-    """Query this member's machine state directly (ra:local_query :962)."""
+                router: Optional[LocalRouter] = None,
+                condition: Any = None, timeout: float = 5.0) -> Any:
+    """Query this member's machine state directly (ra:local_query :962).
+
+    ``condition=("applied", (idx, term))`` delays evaluation until this
+    member has applied at least idx with a matching term (the
+    query_condition option, ra.erl:115-131 — read-your-writes on a
+    follower); raises TimeoutError if the condition never holds, and
+    returns an ErrorResult if idx was applied under a DIFFERENT term
+    (the awaited entry was overwritten)."""
     router = router or DEFAULT_ROUTER
     node = _node_of(server_id, router)
     shell = node.shells.get(server_id.name)
     if shell is None:
         raise RuntimeError(f"no such server {server_id}")
     srv = shell.server
+    if condition is not None:
+        kind, (idx, term) = condition
+        if kind != "applied":
+            raise ValueError(f"unknown query condition {kind!r}")
+        deadline = time.monotonic() + timeout
+        while srv.last_applied < idx:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"ra: local_query condition applied>={idx} not met "
+                    f"(at {srv.last_applied})")
+            time.sleep(0.005)
+        actual = srv.log.fetch_term(idx)
+        if actual is not None and term is not None and actual != term:
+            return ErrorResult("condition_term_mismatch", srv.leader_id)
     node.counters.incr(srv.cfg.uid, "local_queries")
     return CommandResult(srv.last_applied, srv.current_term,
                          query_fn(srv.machine_state), srv.leader_id)
@@ -435,6 +457,42 @@ def members(server_id: ServerId,
     if shell is None:
         raise RuntimeError(f"no such server {server_id}")
     return list(shell.server.cluster.keys())
+
+
+def members_info(server_id: ServerId,
+                 router: Optional[LocalRouter] = None,
+                 timeout: float = 5.0) -> dict:
+    """Per-member replication detail (ra:members_info/1 :1108,
+    state_query(members_info), ra_server.erl:2422-2466).  Resolved
+    against the LEADER's peer table: match/next/query index, status,
+    and membership per member; a follower target is first redirected
+    like any leader call."""
+    router = router or DEFAULT_ROUTER
+    leader = _await_leader(server_id, router, timeout)
+    node = _node_of(leader, router)
+    shell = node.shells.get(leader.name)
+    if shell is None:
+        raise RuntimeError(f"no such server {leader}")
+    srv = shell.server
+    out: dict = {}
+    for sid, peer in srv.cluster.items():
+        if sid == srv.id:
+            out[sid] = {
+                "match_index": srv.commit_index,
+                "next_index": srv.commit_index + 1,
+                "query_index": srv.query_index,
+                "status": "normal",
+                "membership": srv.membership.value,
+            }
+        else:
+            out[sid] = {
+                "match_index": peer.match_index,
+                "next_index": peer.next_index,
+                "query_index": peer.query_index,
+                "status": peer.status.value,
+                "membership": peer.membership.value,
+            }
+    return out
 
 
 def add_member(server_id: ServerId, new_member: ServerId,

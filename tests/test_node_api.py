@@ -385,3 +385,39 @@ def test_reply_from_member(fabric):
     res = ra_tpu.process_command(leader, 1, router=router,
                                  reply_from="local")
     assert res.reply == 9
+
+
+def test_members_info_and_local_query_condition(fabric):
+    """members_info (ra:members_info, state_query(members_info)) and
+    local_query's {applied, IdxTerm} condition (query_condition,
+    ra.erl:115-131): read-your-writes on a follower."""
+    router, nodes = fabric
+    sids = ids()
+    ra_tpu.start_cluster("tmi", counter_factory, sids, router=router)
+    leader = await_leader(router, sids)
+    res = ra_tpu.process_command(leader, 5, router=router)
+    follower = [s for s in sids if s != leader][0]
+    # condition blocks until the follower applied the commit, then the
+    # read observes it — no retry loop needed
+    got = ra_tpu.local_query(follower, lambda s: s, router=router,
+                             condition=("applied", (res.index, res.term)))
+    assert got.reply == 5
+    assert got.index >= res.index
+    # a mismatched term reports the overwrite instead of lying
+    from ra_tpu.core.types import ErrorResult
+    bad = ra_tpu.local_query(follower, lambda s: s, router=router,
+                             condition=("applied", (res.index,
+                                                    res.term + 9)))
+    assert isinstance(bad, ErrorResult)
+    assert bad.reason == "condition_term_mismatch"
+    # an index that never applies times out rather than hanging
+    with pytest.raises(TimeoutError):
+        ra_tpu.local_query(follower, lambda s: s, router=router,
+                           condition=("applied", (10_000, 1)),
+                           timeout=0.3)
+    info = ra_tpu.members_info(follower, router=router)  # redirects
+    assert set(info) == set(sids)
+    for sid, row in info.items():
+        assert row["membership"] == "voter"
+        assert row["match_index"] >= res.index, (sid, row)
+    assert info[leader]["status"] == "normal"
