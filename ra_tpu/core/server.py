@@ -1097,7 +1097,20 @@ class RaServer:
         if isinstance(event, InstallSnapshotRpc):
             if event.term >= self.current_term:
                 return self._become_follower(event.term, next_event=event)
-            return []
+            # stale install chunk: refuse with OUR term (the follower
+            # state's stale branch, :890-896) — found by the snapshot
+            # soak (seeds 401146/401363/402692): a candidate that
+            # dropped these silently left the deposed-but-unaware
+            # leader retrying the install forever — with the peer in
+            # SENDING_SNAPSHOT it gets no AER traffic either, so
+            # nothing ever carried the higher term back
+            last = self.last_idx_term()
+            return [SendRpc(event.leader_id,
+                            InstallSnapshotResult(
+                                term=self.current_term,
+                                last_index=last.index,
+                                last_term=last.term, from_=self.id,
+                                token=event.token))]
         if isinstance(event, PreVoteResult):
             return []
         if isinstance(event, ElectionTimeout):
@@ -1132,7 +1145,11 @@ class RaServer:
                 return [NextEvent(event)]
             if isinstance(event, HeartbeatRpc):
                 return [SendRpc(event.leader_id, self._heartbeat_reply())]
-            return []
+            # stale AER: answer success=false with our term, exactly as
+            # the follower state would — pre-vote never bumped the term,
+            # so this is the deposed-leader path, not an election race
+            return [SendRpc(event.leader_id,
+                            self._aer_reply(self.current_term, False))]
         if isinstance(event, (AppendEntriesReply, HeartbeatReply)):
             if event.term > self.current_term:
                 return self._become_follower(event.term)
@@ -1147,7 +1164,15 @@ class RaServer:
                 self.votes = 0
                 self.raft_state = RaftState.FOLLOWER
                 return [NextEvent(event)]
-            return []
+            # stale install chunk: refuse with our term, exactly as the
+            # follower state would (:890-896)
+            last = self.last_idx_term()
+            return [SendRpc(event.leader_id,
+                            InstallSnapshotResult(
+                                term=self.current_term,
+                                last_index=last.index,
+                                last_term=last.term, from_=self.id,
+                                token=event.token))]
         if isinstance(event, PreVoteRpc):
             return self._process_pre_vote(event)
         if isinstance(event, RequestVoteResult):

@@ -593,7 +593,12 @@ def test_safety_fuzz_over_durable_logs(tmp_path, seed, n_members):
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("seed,n_members",
-                         [(s, 3) for s in (7, 8, 19, 43, 230)] +
+                         [(s, 3) for s in (7, 8, 19, 43, 230,
+                                           # candidate-vs-install wedge:
+                                           # stale chunks at a higher-term
+                                           # candidate must be refused
+                                           # with the candidate's term
+                                           401146, 401363, 402692)] +
                          [(61, 5), (89, 5)])
 def test_safety_fuzz_with_snapshots(seed, n_members,
                                     require_snapshot=True):
