@@ -375,14 +375,18 @@ def test_drop_head_overflow_policy():
         JitFifoMachine(overflow="bogus")
 
 
-def test_differential_consumers_vs_host_fifo_machine():
+import pytest
+
+
+@pytest.mark.parametrize("seed", [23, 101, 404, 777])
+def test_differential_consumers_vs_host_fifo_machine(seed):
     """Two registered consumers with distinct credits, random
     settle/return/cancel/down/credit traffic: the device machine tracks
     the host FifoMachine oracle exactly.  Host auto-consumers are PUSH
     (delivery effects); the device is PULL — each host delivery is
     mirrored as a device checkout(pid) in host pop order (ascending
     msg_in_id, the order _deliver_ready drains the window)."""
-    rng = np.random.default_rng(23)
+    rng = np.random.default_rng(seed)
     host = FifoMachine()
     hstate = host.init({})
     dev = JitFifoMachine(capacity=64, checkout_slots=16, consumer_slots=4)
