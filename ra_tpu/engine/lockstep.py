@@ -503,6 +503,10 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
     return new_state, aux
 
 
+#: shared jitted step fns (see _compile_step)
+_STEP_JIT_CACHE: dict = {}
+
+
 class LockstepEngine:
     """Host API around the jitted lockstep step function."""
 
@@ -554,6 +558,7 @@ class LockstepEngine:
                                  max_append_batch=max_append_batch,
                                  write_delay=write_delay, ring_io=ring_io,
                                  quorum_fn=make_evaluate_quorum(quorum_impl))
+        self._quorum_impl = quorum_impl
         self._donate = donate
         self._dur = None
         self._compile_step(durable=False)
@@ -563,6 +568,31 @@ class LockstepEngine:
         self._fail_host = np.zeros((n_lanes, n_members), bool)
 
     def _compile_step(self, durable: bool) -> None:
+        # share the jitted step across same-config engines: jax.jit
+        # caches by function identity, so a per-instance partial forces
+        # a full recompile for every engine construction (a fuzz seed,
+        # a test case, a bench child).  Sound for machines whose config
+        # is all scalars — jit_apply is pure in (meta, cmd, state)
+        # given that config (the JitMachine contract), so same-config
+        # instances are interchangeable; others keep per-instance jits.
+        m = self.machine
+        attrs = [(k, v) for k, v in sorted(m.__dict__.items())
+                 if not k.startswith("_")]
+        if all(isinstance(v, (int, float, str, bool)) for _k, v in attrs):
+            key = (type(m), tuple(attrs), durable, self._donate,
+                   self._quorum_impl,
+                   tuple(sorted((k, v)
+                                for k, v in self._step_kwargs.items()
+                                if k not in ("machine", "quorum_fn"))))
+            fn = _STEP_JIT_CACHE.get(key)
+            if fn is None:
+                step = functools.partial(_step, durable=durable,
+                                         **self._step_kwargs)
+                fn = jax.jit(step,
+                             donate_argnums=(0,) if self._donate else ())
+                _STEP_JIT_CACHE[key] = fn
+            self._step = fn
+            return
         step = functools.partial(_step, durable=durable,
                                  **self._step_kwargs)
         self._step = jax.jit(step,

@@ -10,6 +10,13 @@ first failing seed (which should then be added to the in-suite list).
 from __future__ import annotations
 
 import os
+
+# force the CPU backend BEFORE anything imports jax: the engine-chaos
+# family pulls in the engine, and on a dead axon tunnel default backend
+# init hangs for minutes (same setup as tests/conftest.py)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
 import sys
 import tempfile
 import time
@@ -17,6 +24,13 @@ import traceback
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# a tunnel site hook may have registered a PJRT plugin whose discovery
+# blocks on a dead endpoint even under JAX_PLATFORMS=cpu — the same
+# guard tests/conftest.py uses
+from ra_tpu.utils import force_platform_from_env  # noqa: E402
+
+force_platform_from_env()
 
 import test_props as tp  # noqa: E402
 
@@ -64,6 +78,23 @@ def main() -> int:
                 if len(failed) == 1:
                     traceback.print_exc()
     print(f"durable_logs: {dn - len(failed)}/{dn} ok in "
+          f"{time.time() - t0:.1f}s"
+          + (f"  FAILED seeds: {failed[:10]}" if failed else ""), flush=True)
+    rc = rc or (1 if failed else 0)
+    # device-path chaos (engine): slower per seed (jit warms on the
+    # first), so a reduced count
+    import test_engine_chaos as tec
+    t0 = time.time()
+    failed = []
+    en = max(1, n // 16)
+    for seed in range(off, off + en):
+        try:
+            tec.run_chaos(seed, rounds=16)
+        except Exception:  # noqa: BLE001
+            failed.append(seed)
+            if len(failed) == 1:
+                traceback.print_exc()
+    print(f"engine_chaos: {en - len(failed)}/{en} ok in "
           f"{time.time() - t0:.1f}s"
           + (f"  FAILED seeds: {failed[:10]}" if failed else ""), flush=True)
     return rc or (1 if failed else 0)
