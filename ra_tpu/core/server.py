@@ -1274,8 +1274,20 @@ class RaServer:
                 return self._become_follower(event.term, next_event=event)
             # enforce leadership (ra_server.erl:793-797)
             return self._make_all_rpcs()
-        if isinstance(event, (AppendEntriesRpc, HeartbeatRpc,
-                              InstallSnapshotRpc)):
+        if isinstance(event, InstallSnapshotRpc):
+            # higher term abdicates only for a KNOWN peer
+            # (ra_server.erl:662-671); same/lower term is ignored — the
+            # reference has no reply clause here and the suite pins it
+            # (leader_receives_install_snapshot_rpc: "leader ignores
+            # lower term"), unlike stale AERs which get a nack
+            if event.term > self.current_term:
+                if event.leader_id not in self.cluster:
+                    return []
+                self._update_term(event.term)
+                self.leader_id = None
+                return self._become_follower(event.term, next_event=event)
+            return []
+        if isinstance(event, (AppendEntriesRpc, HeartbeatRpc)):
             if event.term > self.current_term:
                 self._update_term(event.term)
                 self.leader_id = None

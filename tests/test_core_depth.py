@@ -796,3 +796,72 @@ def test_restorative_snapshot_install_accepted_at_applied_index():
         prev_log_term=srv2.current_term, leader_commit=la,
         entries=(Entry(nxt, srv2.current_term, UserCommand(9)),)))
     assert srv2.log.last_index_term().index == nxt
+
+
+def test_leader_install_rpc_higher_term_abdicates_known_peer_only():
+    """leader_receives_install_snapshot_rpc (+ the unknown-peer guard,
+    ra_server.erl:662-671): a higher-term install from a KNOWN member
+    abdicates and re-dispatches; one from an unknown sender is ignored
+    — abdicating to a stranger would let any forged packet depose a
+    leader."""
+    c = SimCluster(3)
+    s1, s2, _s3 = c.ids
+    c.elect(s1)
+    c.run()
+    srv1 = c.servers[s1]
+    assert srv1.raft_state.value == "leader"
+    term = srv1.current_term
+    stranger = ServerId("zz", "zz")
+    effs = srv1.handle(InstallSnapshotRpc(
+        term=term + 5, leader_id=stranger, meta=snap_meta(9, term, c.ids),
+        chunk_number=1, chunk_flag="last", data=b"", token="tu"))
+    assert effs == []
+    assert srv1.raft_state.value == "leader"
+    assert srv1.current_term == term
+    effs = srv1.handle(InstallSnapshotRpc(
+        term=term + 5, leader_id=s2, meta=snap_meta(9, term, c.ids),
+        chunk_number=1, chunk_flag="next", data=b"xx", token="tk"))
+    assert srv1.raft_state.value != "leader"
+    assert srv1.current_term == term + 5
+
+
+def test_leader_ignores_lower_term_install_rpc():
+    """'leader ignores lower term' (leader_receives_install_snapshot_rpc
+    tail): no reply, no state change — unlike stale AERs, which are
+    nacked."""
+    c = SimCluster(3)
+    s1, s2, _s3 = c.ids
+    c.elect(s1)
+    c.run()
+    c.command(s1, 1)
+    c.run()
+    srv1 = c.servers[s1]
+    term = srv1.current_term
+    effs = srv1.handle(InstallSnapshotRpc(
+        term=term - 1 if term > 1 else 0, leader_id=s2,
+        meta=snap_meta(1, 0, c.ids),
+        chunk_number=1, chunk_flag="last", data=b"", token="tl"))
+    assert effs == []
+    assert srv1.raft_state.value == "leader"
+    assert srv1.current_term == term
+
+
+def test_follower_refuses_snapshot_with_higher_machine_version():
+    """follower_ignores_installs_snapshot_with_higher_machine_version:
+    a snapshot whose machine version exceeds what this member can run
+    is refused (it could not apply entries above it); the refusal
+    reports the applied frontier so the leader resumes replication
+    there instead of looping the install."""
+    from ra_tpu.core.types import InstallSnapshotResult, SendRpc
+
+    c = SimCluster(3)
+    s1, _s2, s3 = c.ids
+    srv3 = c.servers[s3]
+    effs = srv3.handle(InstallSnapshotRpc(
+        term=1, leader_id=s1, meta=snap_meta(10, 1, c.ids, mv=99),
+        chunk_number=1, chunk_flag="last", data=b"", token="tv"))
+    assert srv3.raft_state.value == "follower"      # never entered accept
+    results = [e for e in effs if isinstance(e, SendRpc) and
+               isinstance(e.msg, InstallSnapshotResult)]
+    assert len(results) == 1
+    assert results[0].msg.last_index == srv3.last_applied
