@@ -72,14 +72,18 @@ def encode_command(cmd: Any) -> bytes:
             if isinstance(cmd.notify_to, (str, int, tuple)) else None
         return _CMD_FAST + pickle.dumps(
             (cmd.data, cmd.reply_mode.value, cmd.correlation, from_,
-             notify), protocol=pickle.HIGHEST_PROTOCOL)
+             notify, cmd.reply_from), protocol=pickle.HIGHEST_PROTOCOL)
     return pickle.dumps(strip_local_handles(cmd))
 
 
 def decode_command(payload: bytes) -> Any:
     if payload[:1] == _CMD_FAST:
-        data, rm, corr, from_, notify = pickle.loads(payload[1:])
-        return UserCommand(data, ReplyMode(rm), corr, notify, from_)
+        fields = pickle.loads(payload[1:])
+        data, rm, corr, from_, notify = fields[:5]
+        # frames written before the reply_from field carry five entries
+        reply_from = fields[5] if len(fields) > 5 else None
+        return UserCommand(data, ReplyMode(rm), corr, notify, from_,
+                           reply_from)
     return pickle.loads(payload)
 
 
