@@ -14,8 +14,10 @@ Two flavours exist:
   north star).  Its ``apply`` must be a pure, shape-stable JAX function
   ``(meta_array, cmd_array, state_pytree) -> (state_pytree, reply_array)``
   so committed batches can be folded on-device by the lane engine — via
-  ``lax.scan`` or, for commutative machines, the one-shot
-  ``jit_apply_batch`` window fold (see ra_tpu/engine/lockstep.py, step 5).
+  ``lax.scan`` or the one-shot ``jit_apply_batch`` window fold (see
+  ra_tpu/engine/lockstep.py, step 5).  The window fold must preserve
+  command ORDER; commutative machines fold trivially, and order-dependent
+  ones may fold vectorized when the algebra allows (see jit_fifo/jit_kv).
   A JitMachine also provides the host-side protocol so the same machine
   works on both paths.
 """
@@ -25,6 +27,19 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from .types import Effects
+
+
+def cond_concrete(pred, true_fn, false_fn, operands):
+    """``lax.cond`` that short-circuits in Python when ``pred`` is
+    concrete (host/eager calls): picks the branch without tracing the
+    other, avoiding lax.cond's per-call branch retrace outside jit.
+    Under tracing it is exactly ``lax.cond``."""
+    import jax
+    from jax import lax
+
+    if isinstance(pred, jax.core.Tracer):
+        return lax.cond(pred, true_fn, false_fn, operands)
+    return true_fn(operands) if bool(pred) else false_fn(operands)
 
 
 @dataclass(frozen=True)
@@ -137,10 +152,12 @@ class JitMachine(Machine):
     #: shape/dtype spec of one reply
     reply_spec: tuple = ("int32", ())
 
-    #: set True and override jit_apply_batch when the machine can fold a
-    #: whole committed window in one shot (commutative/associative applies);
-    #: the engine then skips the sequential lax.scan — O(1) depth instead
-    #: of O(window)
+    #: set True when jit_apply_batch folds a whole committed window in
+    #: one shot FASTER than the engine's representative lax.scan.  The
+    #: fold must be IN ORDER-equivalent to applying the masked commands
+    #: sequentially — commutativity is sufficient but not necessary
+    #: (jit_fifo/jit_kv fold order-dependent vocabularies vectorized,
+    #: falling back to sequential_window_fold for the hard windows)
     supports_batch_apply: bool = False
 
     def jit_init(self, n_lanes: int) -> Any:
@@ -152,11 +169,43 @@ class JitMachine(Machine):
         raise NotImplementedError
 
     def jit_apply_batch(self, meta, commands, mask, state):
-        """Fold a window of commands at once.  commands: [..., A, C];
+        """Fold a window of commands at once, order-equivalently to a
+        sequential masked jit_apply fold.  commands: [..., A, C];
         mask: bool[..., A] (True = apply); state leading dims match the
-        ... prefix.  Returns the new state.  Only called when
-        supports_batch_apply is True."""
-        raise NotImplementedError
+        ... prefix.  Returns the new state (per-command replies are not
+        part of this path — the engine discards them).  Only called when
+        supports_batch_apply is True.  The default is the sequential
+        fold; machines override it to add vectorized fast paths."""
+        return self.sequential_window_fold(meta, commands, mask, state)
+
+    def sequential_window_fold(self, meta, commands, mask, state):
+        """Masked in-order lax.scan of jit_apply over the window axis —
+        the universal (slow) jit_apply_batch; custom folds use it as
+        their fallback branch for windows they cannot vectorize."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        idx = meta["index"]
+        # term arrives window-shaped (the engine passes [N,1,1]); give
+        # jit_apply the same per-command leading dims as index so a
+        # machine reading meta["term"] broadcasts correctly
+        term = jnp.broadcast_to(meta["term"], idx.shape)
+
+        def body(mac, xs):
+            cmd, do, ix, tm = xs
+            new, _reply = self.jit_apply(
+                {"index": ix, "term": tm}, cmd, mac)
+            merged = jax.tree.map(
+                lambda n, o: jnp.where(
+                    do.reshape(do.shape + (1,) * (n.ndim - do.ndim)), n, o),
+                new, mac)
+            return merged, None
+
+        xs = (jnp.moveaxis(commands, -2, 0), jnp.moveaxis(mask, -1, 0),
+              jnp.moveaxis(idx, -1, 0), jnp.moveaxis(term, -1, 0))
+        final, _ = lax.scan(body, state, xs)
+        return final
 
     def encode_command(self, command: Any):
         raise NotImplementedError

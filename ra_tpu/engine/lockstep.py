@@ -49,31 +49,11 @@ import numpy as np
 
 from .. import trace
 from ..core.machine import JitMachine
+from ..ops.exact import split16_matmul
 from ..ops.quorum import (election_quorum, evaluate_quorum, pipeline_credit,
                           query_quorum, update_match_next)
 
 Array = jax.Array
-
-
-def _split16_matmul(onehot_f32: Array, values: Array) -> Array:
-    """Exact int32 gather/scatter-by-matmul: contract a {0,1} one-hot
-    f32 tensor with int32 values split into two 16-bit halves (two f32
-    matmuls, recombined bitwise).  Each one-hot row has exactly one 1,
-    so every product and sum is exact in f32; the int32 recombination
-    (lo | hi<<16) is modular and reproduces the original bit pattern,
-    negatives included.  On TPU this routes the ring's per-lane
-    variable-index IO onto the MXU — the generic per-element
-    gather/scatter lowering costs ~15-25ms per step at 10k lanes, the
-    matmul form ~7ms (measured v5e)."""
-    # Precision.HIGHEST: TPU otherwise lowers f32 matmuls through bf16
-    # passes, which silently rounds the 16-bit halves
-    lo = (values & 0xFFFF).astype(jnp.float32)
-    hi = ((values >> 16) & 0xFFFF).astype(jnp.float32)
-    glo = jnp.einsum("...ar,...rc->...ac", onehot_f32, lo,
-                     precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
-    ghi = jnp.einsum("...ar,...rc->...ac", onehot_f32, hi,
-                     precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
-    return glo | (ghi << 16)
 
 
 def _ring_write(ring: Array, payloads: Array, leader_last: Array,
@@ -99,7 +79,7 @@ def _ring_write(ring: Array, payloads: Array, leader_last: Array,
         col = jnp.where(rel == n_acc[:, None], K, rel)
         oh = (col[:, :, None] ==
               jnp.arange(K + 1)[None, None, :]).astype(jnp.float32)
-        written = _split16_matmul(oh, vals)                  # [N,R,C]
+        written = split16_matmul(oh, vals)                  # [N,R,C]
         return jnp.where(in_rng[..., None], written, ring)
     k_idx = jnp.arange(K + 1)
     dest = (leader_last[:, None] + k_idx[None, :]) % R       # [N,K+1]
@@ -123,7 +103,7 @@ def _ring_read_window(ring: Array, idx_lane: Array, *, impl: str) -> Array:
     if impl == "onehot":
         oh = (slot[:, :, None] ==
               jnp.arange(R)[None, None, :]).astype(jnp.float32)
-        return _split16_matmul(oh, ring)
+        return split16_matmul(oh, ring)
     return jnp.take_along_axis(
         ring, jnp.broadcast_to(slot[..., None], slot.shape + (C,)),
         axis=1)
@@ -405,7 +385,8 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
     idx = jnp.broadcast_to(idx, do.shape)
 
     if machine.supports_batch_apply:
-        # one-shot masked window fold (commutative machines): no scan depth
+        # one-shot masked window fold (machine-managed, order-preserving):
+        # no scan depth
         cmds = jnp.broadcast_to(cmds_lane[:, None],
                                 do.shape + cmds_lane.shape[-1:])
         meta = {"index": idx, "term": term[:, None, None]}
@@ -467,7 +448,7 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
                              jnp.uint8, jnp.uint16, jnp.bool_):
                 # exact one-hot matmul (MXU path): <=32-bit ints
                 # round-trip through the 16-bit split losslessly
-                picked = _split16_matmul(
+                picked = split16_matmul(
                     oh, flat.astype(jnp.int32)).astype(old.dtype)
             else:
                 # floats / 64-bit: gather (a matmul select would mix

@@ -9,8 +9,15 @@ state is a handful of dense arrays, covering the ra_fifo command
 vocabulary — ordered enqueue, settled and unsettled dequeue, settlement,
 return-with-redelivery-count, purge, **registered consumers with
 per-consumer credit, consumer cancel, and consumer-down requeue**
-(ra_fifo.erl apply clauses :254-368) — as a shape-stable ``lax.scan``
-fold (order matters, so ``supports_batch_apply = False``).
+(ra_fifo.erl apply clauses :254-368) — as a shape-stable fold.
+
+Queue ops do not commute, but the machine still supports the engine's
+one-shot window fold (``jit_apply_batch``): a window of only noop/
+enqueue/dequeue-settled commands — the ra_bench workload and the
+quorum-queue steady state — folds vectorized via a clamped-add
+``associative_scan`` (see the method comment); anything else falls back
+to an in-order masked ``lax.scan`` of ``jit_apply`` under a
+``lax.cond``.
 
 Scope split vs the host machine: pull-style checkout (the device cannot
 emit delivery effects), death == cancel (the host's ``noconnection``
@@ -72,8 +79,10 @@ error replies / free markers.
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
-from ..core.machine import JitMachine
+from ..core.machine import JitMachine, cond_concrete
+from ..ops.exact import place16
 
 _I32 = jnp.int32
 
@@ -86,7 +95,10 @@ class JitFifoMachine(JitMachine):
     command_spec = ("int32", (3,))
     reply_spec = ("int32", ())
     version = 0
-    supports_batch_apply = False  # queue ops do not commute
+    #: queue ops do NOT commute — batch apply is still sound because
+    #: jit_apply_batch folds the window IN ORDER (vectorized fast path
+    #: for noop/enqueue/dequeue windows, masked sequential fold else)
+    supports_batch_apply = True
 
     def __init__(self, capacity: int = 64, checkout_slots: int = 8,
                  consumer_slots: int = 4,
@@ -209,40 +221,54 @@ class JitFifoMachine(JitMachine):
         # entries shift back by the number of requeued tickets below
         # them.  One rank computation + one gather per array — O(Q*K)
         # comparisons, no sequential loop (a masked-per-row fori_loop
-        # was ~9x this cost and ran for EVERY command).
+        # was ~9x this cost and ran for EVERY command).  The whole merge
+        # sits behind a lax.cond: its [..., K, Q] intermediates dominate
+        # the apply (~25x on TPU at Q=256) yet are dead work for every
+        # command that is not a return/cancel/down — the common case.
         kr = jnp.arange(K)
         req = (cancel[..., None] & owned) | \
             (ret[..., None] & (kr == match_slot[..., None]))
         n_req = jnp.sum(req.astype(_I32), axis=-1)
-        size2 = new_tail - head
-        in_win = jnp.mod(qr - head[..., None], Q) < size2[..., None]
-        # rank over ready mids [..., K, Q] + over fellow requeues [...,K,K]
-        rank = jnp.sum((in_win[..., None, :] &
-                        (mid[..., None, :] < co_mid[..., :, None]))
-                       .astype(_I32), axis=-1)
-        rank = rank + jnp.sum((req[..., None, :] &
-                               (co_mid[..., None, :] < co_mid[..., :, None]))
-                              .astype(_I32), axis=-1)
-        rank = jnp.where(req, rank, -1)          # inactive rows never land
         new_head = head - n_req
-        jd = jnp.mod(qr - new_head[..., None], Q)            # [..., Q]
-        valid = jd < (size2 + n_req)[..., None]
-        eq = rank[..., :, None] == jd[..., None, :]          # [..., K, Q]
-        land = jnp.any(eq, axis=-2)
-        req_val_at = jnp.sum(jnp.where(eq, co_val[..., :, None], 0), axis=-2)
-        req_dc_at = jnp.sum(jnp.where(eq, (co_dc + 1)[..., :, None], 0),
-                            axis=-2)
-        req_mid_at = jnp.sum(jnp.where(eq, co_mid[..., :, None], 0), axis=-2)
-        cnt_lt = jnp.sum(((rank[..., :, None] >= 0) &
-                          (rank[..., :, None] < jd[..., None, :]))
-                         .astype(_I32), axis=-2)
-        src_slot = jnp.mod(head[..., None] + jd - cnt_lt, Q)
-        g_buf = jnp.take_along_axis(buf, src_slot, axis=-1)
-        g_dc = jnp.take_along_axis(dc, src_slot, axis=-1)
-        g_mid = jnp.take_along_axis(mid, src_slot, axis=-1)
-        buf = jnp.where(valid, jnp.where(land, req_val_at, g_buf), buf)
-        dc = jnp.where(valid, jnp.where(land, req_dc_at, g_dc), dc)
-        mid = jnp.where(valid, jnp.where(land, req_mid_at, g_mid), mid)
+
+        def _requeue_merge(ops):
+            buf, dc, mid, co_val, co_dc, co_mid = ops
+            size2 = new_tail - head
+            in_win = jnp.mod(qr - head[..., None], Q) < size2[..., None]
+            # rank over ready mids [...,K,Q] + fellow requeues [...,K,K]
+            rank = jnp.sum((in_win[..., None, :] &
+                            (mid[..., None, :] < co_mid[..., :, None]))
+                           .astype(_I32), axis=-1)
+            rank = rank + jnp.sum(
+                (req[..., None, :] &
+                 (co_mid[..., None, :] < co_mid[..., :, None]))
+                .astype(_I32), axis=-1)
+            rank = jnp.where(req, rank, -1)      # inactive rows never land
+            jd = jnp.mod(qr - new_head[..., None], Q)        # [..., Q]
+            valid = jd < (size2 + n_req)[..., None]
+            eq = rank[..., :, None] == jd[..., None, :]      # [..., K, Q]
+            land = jnp.any(eq, axis=-2)
+            req_val_at = jnp.sum(jnp.where(eq, co_val[..., :, None], 0),
+                                 axis=-2)
+            req_dc_at = jnp.sum(jnp.where(eq, (co_dc + 1)[..., :, None], 0),
+                                axis=-2)
+            req_mid_at = jnp.sum(jnp.where(eq, co_mid[..., :, None], 0),
+                                 axis=-2)
+            cnt_lt = jnp.sum(((rank[..., :, None] >= 0) &
+                              (rank[..., :, None] < jd[..., None, :]))
+                             .astype(_I32), axis=-2)
+            src_slot = jnp.mod(head[..., None] + jd - cnt_lt, Q)
+            g_buf = jnp.take_along_axis(buf, src_slot, axis=-1)
+            g_dc = jnp.take_along_axis(dc, src_slot, axis=-1)
+            g_mid = jnp.take_along_axis(mid, src_slot, axis=-1)
+            buf = jnp.where(valid, jnp.where(land, req_val_at, g_buf), buf)
+            dc = jnp.where(valid, jnp.where(land, req_dc_at, g_dc), dc)
+            mid = jnp.where(valid, jnp.where(land, req_mid_at, g_mid), mid)
+            return buf, dc, mid
+
+        buf, dc, mid = cond_concrete(
+            jnp.any(n_req > 0), _requeue_merge, lambda ops: ops[:3],
+            (buf, dc, mid, co_val, co_dc, co_mid))
         head = new_head
 
         # -- checkout-table writes ----------------------------------------
@@ -298,6 +324,140 @@ class JitFifoMachine(JitMachine):
                      "con_credit": con_credit, "next_id": new_next_id,
                      "next_mid": new_next_mid, "n_dropped": n_dropped}
         return new_state, reply
+
+    # -- one-shot window fold (engine batch path) --------------------------
+    #
+    # supports_batch_apply is True NOT because queue ops commute (they do
+    # not) but because a window whose commands are all noop/enqueue/
+    # dequeue-settled — the ra_bench workload shape and the common
+    # quorum-queue steady state — folds in one vectorized pass:
+    #
+    #   * the ready-size recurrence  s' = clamp(s + d, 0, Qeff)  is a
+    #     composition of clamped-add maps  x -> clamp(x+a, lo, hi),
+    #     a family closed under composition, so a log-depth
+    #     lax.associative_scan yields every command's pre-state;
+    #   * ring positions are exclusive cumsums of the admit/pop flags;
+    #   * ring writes are scatter-free: positional wheres plus one
+    #     exact one-hot matmul for the payload values (see the
+    #     _batch_fast comment — TPU's scatter lowering was ~70ms/step
+    #     here, the matmul form ~3ms).
+    #
+    # Windows containing any consumer/settlement op fall back to
+    # sequential_window_fold (an in-order masked lax.scan of jit_apply)
+    # under the same lax.cond.  The engine discards per-command replies
+    # on this path (lockstep.py step 5), so the fold only has to
+    # produce the new state.
+    #
+    # Measured on TPU v5e, 5,000 lanes x 5 members, Q=256, window 130.
+    # Before this fold existed, the engine's representative-scan branch
+    # (supports_batch_apply=False) paid the [K,Q] requeue merge on
+    # every command and ran 5.42 s/step (0.12M cmds/s) even on a pure
+    # enqueue/dequeue workload.  Now: the vectorized fast path runs
+    # ~0.026 s/step (~25M cmds/s) on that workload, and the fallback
+    # scan ~0.50 s/step on a worst-case consumer-mix window (~10x the
+    # old branch, despite folding per member, because the lax.cond
+    # inside jit_apply pays the requeue merge only on the commands
+    # that actually return/cancel).
+
+    def jit_apply_batch(self, meta, commands, mask, state):
+        op_raw = commands[..., 0]
+        fast_ok = ~jnp.any(mask & (op_raw > 2))
+        return cond_concrete(
+            fast_ok,
+            lambda args: self._batch_fast(*args),
+            lambda args: self.sequential_window_fold(meta, *args),
+            (commands, mask, state))
+
+    def _batch_fast(self, commands, mask, state):
+        """Vectorized noop/enqueue/dequeue-settled window fold."""
+        Q = self.capacity
+        BIG = jnp.int32(1 << 20)
+        op = jnp.where(mask, commands[..., 0], 0)           # [..., A]
+        val = commands[..., 1]
+        head, tail = state["head"], state["tail"]           # [...]
+        checked = jnp.sum((state["co_id"] >= 0).astype(_I32), axis=-1)
+        qeff = Q - checked                                  # live-msg room
+        size0 = tail - head
+
+        is_enq = op == 1
+        is_deq = op == 2
+        # clamped-add element per command: enqueue tops out at qeff
+        # (reject AND drop_head both leave the ready size pinned there),
+        # dequeue floors at 0, noop is the identity.
+        a_el = is_enq.astype(_I32) - is_deq.astype(_I32)
+        lo_el = jnp.broadcast_to(jnp.int32(0), a_el.shape)
+        hi_el = jnp.where(is_enq, qeff[..., None], Q)
+
+        def combine(c1, c2):                     # c2 AFTER c1
+            a1, l1, h1 = c1
+            a2, l2, h2 = c2
+            return (a1 + a2,
+                    jnp.clip(l1 + a2, l2, h2),
+                    jnp.clip(h1 + a2, l2, h2))
+
+        a_in, lo_in, hi_in = lax.associative_scan(
+            combine, (a_el, lo_el, hi_el), axis=-1)
+        # exclusive prefix: command i sees the composition of 0..i-1
+        ident = (jnp.zeros_like(a_el[..., :1]),
+                 jnp.full_like(a_el[..., :1], -BIG),
+                 jnp.full_like(a_el[..., :1], BIG))
+        a_ex = jnp.concatenate([ident[0], a_in[..., :-1]], axis=-1)
+        lo_ex = jnp.concatenate([ident[1], lo_in[..., :-1]], axis=-1)
+        hi_ex = jnp.concatenate([ident[2], hi_in[..., :-1]], axis=-1)
+        s = jnp.clip(size0[..., None] + a_ex, lo_ex, hi_ex)  # pre-cmd size
+
+        drop_head = self.overflow == "drop_head"
+        at_cap = s >= qeff[..., None]
+        if drop_head:
+            enq_adm = is_enq & (~at_cap | (s > 0))
+            enq_drop = is_enq & at_cap & (s > 0)
+        else:
+            enq_adm = is_enq & ~at_cap
+            enq_drop = jnp.zeros_like(enq_adm)
+        deq_ok = is_deq & (s > 0)
+        head_adv = deq_ok.astype(_I32) + enq_drop.astype(_I32)
+
+        w_rank = jnp.cumsum(enq_adm.astype(_I32), axis=-1) \
+            - enq_adm.astype(_I32)                           # exclusive
+        n_enq = jnp.sum(enq_adm.astype(_I32), axis=-1)
+
+        # Ring writes WITHOUT a scatter (TPU scatter lowering costs
+        # ~70ms/step at this scale; this form ~5ms): written slots are
+        # ring indexes tail0..tail0+n_enq-1, so a slot's window offset
+        # jd = (q - tail0) mod Q says everything positional — dc is 0
+        # and the enqueue tickets are CONSECUTIVE in ring order, so
+        # only buf needs real value placement: an exact one-hot matmul
+        # (ops/exact.py place16) contracting the admitted-enqueue rank
+        # one-hot against the payload column on the MXU.
+        #
+        # Windows WIDER than the queue (A > Q) are fine: when several
+        # admitted enqueues alias one slot mod Q, only the LAST can
+        # survive (its predecessors were dequeued within the window —
+        # the live count never exceeds Q — and pops read nothing on
+        # this reply-free path), so each slot selects the maximal
+        # aliasing rank rank_win = jd + Q*floor((n_enq-1-jd)/Q), which
+        # degenerates to jd when A <= Q.
+        qr2 = jnp.arange(Q)
+        jd = jnp.mod(qr2 - tail[..., None], Q)               # [..., Q]
+        written = jd < n_enq[..., None]
+        rank_win = jd + Q * ((n_enq[..., None] - 1 - jd) // Q)
+        onehot = (enq_adm[..., None, :] &
+                  (w_rank[..., None, :] == rank_win[..., None])
+                  ).astype(jnp.float32)                      # [..., Q, A]
+        placed = place16(onehot, val)
+
+        new_state = dict(state)
+        new_state["buf"] = jnp.where(written, placed, state["buf"])
+        new_state["dc"] = jnp.where(written, 0, state["dc"])
+        new_state["mid"] = jnp.where(
+            written, state["next_mid"][..., None] + rank_win,
+            state["mid"])
+        new_state["head"] = head + jnp.sum(head_adv, axis=-1)
+        new_state["tail"] = tail + n_enq
+        new_state["next_mid"] = state["next_mid"] + n_enq
+        new_state["n_dropped"] = state["n_dropped"] + \
+            jnp.sum(enq_drop.astype(_I32), axis=-1)
+        return new_state
 
     # -- host protocol -----------------------------------------------------
 

@@ -5,7 +5,8 @@ README.md:33-35) keeps a Python dict plus watcher effects.  This is its
 TPU-native counterpart for the BASELINE.md "2,000 clusters, kv machine,
 mixed put/get, jittable apply/3" row: a fixed key space of ``n_keys``
 int32 cells per lane, folded on-device under ``lax.scan`` (put/cas
-sequences are order-dependent, so ``supports_batch_apply = False``).
+sequences are order-dependent; cas-free windows still fold one-shot
+via ``jit_apply_batch`` — see the method comment).
 
 Absence is encoded as -1 (mirroring the host machine's ``None`` reply for
 a missing key), so stored values must be >= 0.  ``get`` exists as a
@@ -30,7 +31,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..core.machine import JitMachine
+from ..core.machine import JitMachine, cond_concrete
+from ..ops.exact import place16
 
 _I32 = jnp.int32
 
@@ -39,7 +41,10 @@ class JitKvMachine(JitMachine):
     command_spec = ("int32", (4,))
     reply_spec = ("int32", (2,))
     version = 0
-    supports_batch_apply = False  # put/cas do not commute
+    #: put/cas do not commute — batch apply stays sound because
+    #: jit_apply_batch folds the window IN ORDER (last-writer-wins
+    #: vectorized fast path for cas-free windows, masked scan else)
+    supports_batch_apply = True
 
     def __init__(self, n_keys: int = 64) -> None:
         self.n_keys = n_keys
@@ -83,6 +88,50 @@ class JitKvMachine(JitMachine):
         code = jnp.where(bad, -2, code)
         reply = jnp.stack([code, jnp.where(bad, -1, cur)], axis=-1)
         return new_state, reply
+
+    # -- one-shot window fold (engine batch path) --------------------------
+    #
+    # put/cas do not commute, but a window WITHOUT cas folds in one
+    # vectorized pass: gets read, puts/deletes write, and the final cell
+    # value is simply the LAST write targeting that key — last-writer-
+    # wins needs no sequential fold.  Per key: the winning command is
+    # the max window position among its writes (a masked max-reduce),
+    # and its value lands via the exact split16 one-hot matmul (ops/exact.py) so placement rides the MXU
+    # instead of a scatter.  Windows containing cas fall back to an
+    # in-order masked lax.scan of jit_apply — cas reads the evolving
+    # cell, the one true sequential dependency in the vocabulary.
+    # The engine discards per-command replies on this path
+    # (lockstep.py step 5), so the fold only produces the new state.
+
+    def jit_apply_batch(self, meta, commands, mask, state):
+        op_raw = commands[..., 0]
+        fast_ok = ~jnp.any(mask & (op_raw >= 4))
+        return cond_concrete(
+            fast_ok,
+            lambda args: self._batch_fast(*args),
+            lambda args: self.sequential_window_fold(meta, *args),
+            (commands, mask, state))
+
+    def _batch_fast(self, commands, mask, state):
+        """Vectorized cas-free window fold: last write per key wins."""
+        S = self.n_keys
+        A = commands.shape[-2]
+        op = jnp.where(mask, commands[..., 0], 0)           # [..., A]
+        raw_key = commands[..., 1]
+        value = commands[..., 2]
+        key_ok = (raw_key >= 0) & (raw_key < S)
+        val_bad = (op == 1) & (value < 0)
+        is_write = ((op == 1) | (op == 3)) & key_ok & ~val_bad
+        wval = jnp.where(op == 1, value, -1)                # delete = -1
+
+        kr = jnp.arange(S)
+        hits = (raw_key[..., None, :] == kr[..., :, None]) & \
+            is_write[..., None, :]                          # [..., S, A]
+        pos = jnp.arange(A)
+        maxpos = jnp.max(jnp.where(hits, pos, -1), axis=-1)  # [..., S]
+        winner = hits & (pos == maxpos[..., None])
+        placed = place16(winner.astype(jnp.float32), wval)
+        return jnp.where(maxpos >= 0, placed, state)
 
     # -- host protocol -----------------------------------------------------
 

@@ -1,0 +1,41 @@
+"""Exact integer one-hot contraction on the MXU.
+
+The TPU's generic per-element gather/scatter lowering is the slowest way
+to move per-lane variable-index data; contracting a {0,1} one-hot f32
+tensor against the values routes the same movement onto the systolic
+array.  f32 accumulation is exact for 16-bit operands, so int32 values
+ride as two 16-bit halves (two matmuls) and recombine bitwise —
+negatives included, since the (lo | hi<<16) recombination is modular.
+
+Shared by the lockstep engine's ring IO / trajectory select
+(engine/lockstep.py) and the machines' vectorized window folds
+(models/jit_fifo.py, models/jit_kv.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def split16_matmul(onehot_f32: jax.Array, values: jax.Array) -> jax.Array:
+    """Exact int32 gather/scatter-by-matmul: contract a {0,1} one-hot
+    f32 tensor [..., A, R] with int32 values [..., R, C] -> [..., A, C].
+    Each one-hot row has at most one 1, so every product and sum is
+    exact in f32.  Precision.HIGHEST: TPU otherwise lowers f32 matmuls
+    through bf16 passes, which silently rounds the 16-bit halves.
+    Measured v5e: the engine ring's per-lane variable-index IO costs
+    ~15-25ms/step at 10k lanes via the generic gather/scatter
+    lowering, ~7ms via this form."""
+    lo = (values & 0xFFFF).astype(jnp.float32)
+    hi = ((values >> 16) & 0xFFFF).astype(jnp.float32)
+    glo = jnp.einsum("...ar,...rc->...ac", onehot_f32, lo,
+                     precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+    ghi = jnp.einsum("...ar,...rc->...ac", onehot_f32, hi,
+                     precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+    return glo | (ghi << 16)
+
+
+def place16(onehot_f32: jax.Array, values: jax.Array) -> jax.Array:
+    """split16_matmul for a value VECTOR: [..., A, R] x [..., R] ->
+    [..., A] — the window-fold placement shape."""
+    return split16_matmul(onehot_f32, values[..., None])[..., 0]

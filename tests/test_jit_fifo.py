@@ -1,6 +1,7 @@
 """JitFifoMachine — device-path FIFO semantics, differential-tested against
 the host FifoMachine oracle (models/fifo.py) and a plain-Python fold, and
 run under the lane engine and the classic replicated path."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -483,3 +484,83 @@ def test_differential_consumers_vs_host_fifo_machine(seed):
             for con in hstate.consumers.values()
             for (_mid, _idx, h, raw) in con.checked_out.values())
         assert checked_out(dstate) == hco, i
+
+
+@pytest.mark.parametrize("seed,overflow", [
+    (5, "reject"), (17, "reject"), (29, "drop_head"), (31, "drop_head")])
+def test_batch_apply_matches_sequential_fold(seed, overflow):
+    """jit_apply_batch == an in-order masked jit_apply fold, over random
+    windows, states, and masks, on BOTH of its internal paths: the
+    vectorized noop/enqueue/dequeue fast path (clamped-add
+    associative_scan + scatter) and the lax.cond fallback scan for
+    windows carrying consumer ops.  Initial states are produced by a
+    random warmup through jit_apply so checked-out rows shrink the
+    effective capacity (the fast path's Qeff) in some lanes."""
+    rng = np.random.default_rng(seed)
+    Q, K, A, N = 8, 4, 6, 5
+    m = JitFifoMachine(capacity=Q, checkout_slots=K, consumer_slots=2,
+                       overflow=overflow)
+    state = m.jit_init(N)
+
+    # warmup: random traffic incl. unsettled checkouts, attach, credit
+    for i in range(12):
+        cmd = jnp.asarray(
+            rng.integers(0, 5, size=(N, 3)).astype(np.int32))
+        state, _ = m.jit_apply({"index": i, "term": 1}, cmd, state)
+
+    for window_kind in ("fast", "mixed"):
+        hi_op = 3 if window_kind == "fast" else 12
+        cmds = np.zeros((N, A, 3), np.int32)
+        cmds[..., 0] = rng.integers(0, hi_op, size=(N, A))
+        cmds[..., 1] = rng.integers(0, 6, size=(N, A))
+        cmds[..., 2] = rng.integers(0, 4, size=(N, A))
+        mask = rng.random((N, A)) < 0.8
+        mask[0, :] = True
+        mask[1, :] = False
+        cmds_j = jnp.asarray(cmds)
+        mask_j = jnp.asarray(mask)
+        idx = jnp.broadcast_to(jnp.arange(A, dtype=jnp.int32), (N, A))
+        meta = {"index": idx, "term": jnp.int32(1)}
+
+        got = m.jit_apply_batch(meta, cmds_j, mask_j, state)
+
+        want = state
+        for i in range(A):
+            new, _ = m.jit_apply({"index": idx[:, i], "term": 1},
+                                 cmds_j[:, i], want)
+            want = jax.tree.map(
+                lambda n, o: jnp.where(
+                    mask_j[:, i].reshape((N,) + (1,) * (n.ndim - 1)), n, o),
+                new, want)
+
+        for key in want:
+            np.testing.assert_array_equal(
+                np.asarray(got[key]), np.asarray(want[key]),
+                err_msg=f"{window_kind}:{key}")
+        state = want  # chain: next window starts from evolved state
+
+
+def test_batch_apply_window_wider_than_queue():
+    """A window wider than the queue capacity aliases ring slots mod Q
+    inside one window; the vectorized fast path must resolve each slot
+    to its LAST aliasing enqueue (rank_win selection) and stay exact
+    against the sequential fold."""
+    rng = np.random.default_rng(3)
+    Q, A, N = 4, 9, 3
+    m = JitFifoMachine(capacity=Q, checkout_slots=2)
+    state = m.jit_init(N)
+    cmds = np.zeros((N, A, 3), np.int32)
+    cmds[..., 0] = rng.integers(0, 3, size=(N, A))
+    cmds[..., 1] = rng.integers(0, 6, size=(N, A))
+    cmds_j = jnp.asarray(cmds)
+    mask_j = jnp.ones((N, A), bool)
+    idx = jnp.broadcast_to(jnp.arange(A, dtype=jnp.int32), (N, A))
+    got = m.jit_apply_batch({"index": idx, "term": jnp.int32(1)},
+                            cmds_j, mask_j, state)
+    want = state
+    for i in range(A):
+        want, _ = m.jit_apply({"index": idx[:, i], "term": 1},
+                              cmds_j[:, i], want)
+    for key in want:
+        np.testing.assert_array_equal(np.asarray(got[key]),
+                                      np.asarray(want[key]), err_msg=key)

@@ -13,6 +13,7 @@ from ra_tpu.models.jit_kv import query_kv
 from ra_tpu.node import LocalRouter, RaNode
 
 from nemesis import await_leader
+import pytest
 
 META = {"index": jnp.int32(1), "term": jnp.int32(1)}
 
@@ -160,3 +161,44 @@ def test_same_machine_on_classic_path():
     finally:
         for n in nodes:
             n.stop()
+
+
+@pytest.mark.parametrize("seed", [7, 19, 43])
+def test_batch_apply_matches_sequential_fold(seed):
+    """jit_apply_batch == an in-order masked jit_apply fold on BOTH
+    internal paths: the last-writer-wins fast path (cas-free windows:
+    put/get/delete incl. out-of-range keys and negative put values) and
+    the lax.cond fallback scan once a cas appears in the window."""
+    rng = np.random.default_rng(seed)
+    S, A, N = 8, 6, 4
+    m = JitKvMachine(n_keys=S)
+    state = m.jit_init(N)
+    for i in range(5):   # warmup so cells hold values
+        cmd = np.zeros((N, 4), np.int32)
+        cmd[:, 0] = 1
+        cmd[:, 1] = rng.integers(0, S, N)
+        cmd[:, 2] = rng.integers(0, 50, N)
+        state, _ = m.jit_apply({"index": i, "term": 1},
+                               jnp.asarray(cmd), state)
+
+    for hi_op, label in ((4, "fast"), (5, "with-cas")):
+        cmds = np.zeros((N, A, 4), np.int32)
+        cmds[..., 0] = rng.integers(0, hi_op, size=(N, A))
+        cmds[..., 1] = rng.integers(-1, S + 1, size=(N, A))  # incl. bad keys
+        cmds[..., 2] = rng.integers(-2, 50, size=(N, A))     # incl. bad vals
+        cmds[..., 3] = rng.integers(-1, 50, size=(N, A))
+        mask = rng.random((N, A)) < 0.8
+        mask[0, :] = True
+        cmds_j = jnp.asarray(cmds)
+        mask_j = jnp.asarray(mask)
+        idx = jnp.broadcast_to(jnp.arange(A, dtype=jnp.int32), (N, A))
+        got = m.jit_apply_batch({"index": idx, "term": jnp.int32(1)},
+                                cmds_j, mask_j, state)
+        want = state
+        for i in range(A):
+            new, _ = m.jit_apply({"index": idx[:, i], "term": 1},
+                                 cmds_j[:, i], want)
+            want = jnp.where(mask_j[:, i][..., None], new, want)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=label)
+        state = want
