@@ -11,9 +11,12 @@ import pickle
 import shutil
 
 
+import pytest
+
 from ra_tpu.core.types import Entry, SnapshotMeta, UserCommand
 
 from test_durable_log import drain, mk_log, mk_system
+
 
 
 def put(log, lo, hi, term, val=None):
@@ -188,6 +191,10 @@ def test_release_cursor_roundtrips_machine_version(tmp_path):
 
 # -- WAL-down availability --------------------------------------------------
 
+# Wal.kill() below makes the batch thread die by an uncaught
+# exception on purpose — that IS the scenario under test
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
 def test_wal_down_reads_still_serve(tmp_path):
     """A dead WAL blocks writes, not reads: everything already written
     stays readable from memtable and segments

@@ -15,11 +15,13 @@ import sys
 import time
 
 import numpy as np
+import pytest
 
 from ra_tpu.engine import LockstepEngine, open_engine
 from ra_tpu.engine.durable import (decode_block, encode_block,
                                    _final_logs)
 from ra_tpu.models import CounterMachine
+
 
 
 N, P, K = 16, 3, 8
@@ -102,6 +104,10 @@ def test_commits_gate_on_wal_confirm(tmp_path):
     eng.close()
 
 
+# Wal.kill() below makes the batch thread die by an uncaught
+# exception on purpose — that IS the scenario under test
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
 def test_commits_freeze_when_wal_dies(tmp_path):
     eng = make_engine(tmp_path)
     drive(eng, 6)
