@@ -178,6 +178,19 @@ class JitMachine(Machine):
         fold; machines override it to add vectorized fast paths."""
         return self.sequential_window_fold(meta, commands, mask, state)
 
+    def window_fold_dispatch(self, meta, commands, mask, state, fast_ok):
+        """Shared jit_apply_batch dispatcher for machines with a
+        vectorized common-case fold: route to ``self._batch_fast`` when
+        ``fast_ok`` (a scalar bool — commonly "no sequential-only op in
+        the masked window"), else to the in-order sequential fold.
+        Concrete predicates branch in Python (host/eager callers);
+        traced ones become a single lax.cond."""
+        return cond_concrete(
+            fast_ok,
+            lambda args: self._batch_fast(*args),
+            lambda args: self.sequential_window_fold(meta, *args),
+            (commands, mask, state))
+
     def sequential_window_fold(self, meta, commands, mask, state):
         """Masked in-order lax.scan of jit_apply over the window axis —
         the universal (slow) jit_apply_batch; custom folds use it as

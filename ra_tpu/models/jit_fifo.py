@@ -360,13 +360,10 @@ class JitFifoMachine(JitMachine):
     # that actually return/cancel).
 
     def jit_apply_batch(self, meta, commands, mask, state):
-        op_raw = commands[..., 0]
-        fast_ok = ~jnp.any(mask & (op_raw > 2))
-        return cond_concrete(
-            fast_ok,
-            lambda args: self._batch_fast(*args),
-            lambda args: self.sequential_window_fold(meta, *args),
-            (commands, mask, state))
+        # fast only for noop/enqueue/dequeue-settled windows
+        fast_ok = ~jnp.any(mask & (commands[..., 0] > 2))
+        return self.window_fold_dispatch(meta, commands, mask, state,
+                                         fast_ok)
 
     def _batch_fast(self, commands, mask, state):
         """Vectorized noop/enqueue/dequeue-settled window fold."""

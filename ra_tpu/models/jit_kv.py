@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..core.machine import JitMachine, cond_concrete
+from ..core.machine import JitMachine
 from ..ops.exact import place16
 
 _I32 = jnp.int32
@@ -104,13 +104,9 @@ class JitKvMachine(JitMachine):
     # (lockstep.py step 5), so the fold only produces the new state.
 
     def jit_apply_batch(self, meta, commands, mask, state):
-        op_raw = commands[..., 0]
-        fast_ok = ~jnp.any(mask & (op_raw >= 4))
-        return cond_concrete(
-            fast_ok,
-            lambda args: self._batch_fast(*args),
-            lambda args: self.sequential_window_fold(meta, *args),
-            (commands, mask, state))
+        fast_ok = ~jnp.any(mask & (commands[..., 0] >= 4))  # no cas
+        return self.window_fold_dispatch(meta, commands, mask, state,
+                                         fast_ok)
 
     def _batch_fast(self, commands, mask, state):
         """Vectorized cas-free window fold: last write per key wins."""

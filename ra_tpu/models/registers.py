@@ -3,10 +3,11 @@
 Second `ra_machine_xla`-contract machine family (after the commutative
 CounterMachine): each lane replicates a fixed file of ``n_slots`` int32
 registers supporting put / fetch-add / compare-and-set.  CAS makes the
-fold **order-dependent**, so this machine exercises the lane engine's
-sequential `lax.scan` apply path (`supports_batch_apply = False`) — the
-device analogue of the host KvMachine's cas counters, and the shape of a
-metadata/config store replicated per cluster.
+fold **order-dependent**; cas-free windows still fold one-shot via
+``jit_apply_batch`` (last-put + subsequent adds per slot — see the
+method comment), cas windows take the in-order masked scan fallback.
+The device analogue of the host KvMachine's cas counters, and the
+shape of a metadata/config store replicated per cluster.
 
 Encoding (command_spec int32[4]): ``[op, slot, value, expected]``
   op 0 = noop (term-opening entry)
@@ -29,7 +30,10 @@ class RegisterMachine(JitMachine):
     command_spec = ("int32", (4,))
     reply_spec = ("int32", ())
     version = 0
-    supports_batch_apply = False  # CAS does not commute
+    #: CAS does not commute — batch apply stays sound because
+    #: jit_apply_batch folds the window IN ORDER (vectorized fast path
+    #: for cas-free windows, masked sequential fold once a cas appears)
+    supports_batch_apply = True
 
     def __init__(self, n_slots: int = 8) -> None:
         self.n_slots = n_slots
@@ -62,6 +66,45 @@ class RegisterMachine(JitMachine):
                                               cas_ok.astype(jnp.int32),
                                               0)))
         return updated, reply
+
+    # -- one-shot window fold (engine batch path) --------------------------
+    #
+    # A window WITHOUT cas folds in one vectorized pass: the final value
+    # of a slot is (value of its LAST put) + (sum of the adds AFTER that
+    # put), or (current value + sum of all its adds) when no put landed.
+    # With a small slot file the [..., S, A] masked sums are exact plain
+    # int32 ops (int32 addition wraps identically to the sequential
+    # fold), no matmul tricks needed.  Windows containing cas fall back
+    # to JitMachine.sequential_window_fold under a lax.cond — cas reads
+    # the evolving register, the one sequential dependency.  The engine
+    # discards per-command replies on this path (lockstep.py step 5).
+
+    def jit_apply_batch(self, meta, commands, mask, state):
+        fast_ok = ~jnp.any(mask & (commands[..., 0] == 3))  # no cas
+        return self.window_fold_dispatch(meta, commands, mask, state,
+                                         fast_ok)
+
+    def _batch_fast(self, commands, mask, state):
+        """Vectorized cas-free window fold: last-put + subsequent adds."""
+        S = self.n_slots
+        A = commands.shape[-2]
+        op = jnp.where(mask, commands[..., 0], 0)           # [..., A]
+        slot = jnp.clip(commands[..., 1], 0, S - 1)         # jit_apply clips
+        value = commands[..., 2]
+        sr = jnp.arange(S)
+        at_slot = slot[..., None, :] == sr[..., :, None]    # [..., S, A]
+        hits_put = at_slot & (op == 1)[..., None, :]
+        hits_add = at_slot & (op == 2)[..., None, :]
+        pos = jnp.arange(A)
+        lastput = jnp.max(jnp.where(hits_put, pos, -1), axis=-1)
+        base_put = jnp.sum(
+            jnp.where(hits_put & (pos == lastput[..., None]), value[..., None, :], 0),
+            axis=-1)                                        # single selection
+        base = jnp.where(lastput >= 0, base_put, state)
+        adds_after = jnp.sum(
+            jnp.where(hits_add & (pos > lastput[..., None]), value[..., None, :], 0),
+            axis=-1)
+        return base + adds_after
 
     def encode_command(self, command) -> jnp.ndarray:
         """Host commands: ("put", slot, v) | ("add", slot, v) |

@@ -12,6 +12,7 @@ from ra_tpu.models.registers import query_registers
 from ra_tpu.node import LocalRouter, RaNode
 
 from nemesis import await_leader
+import pytest
 
 
 def host_fold(cmds, n_slots=8):
@@ -112,3 +113,48 @@ def test_malformed_commands_encode_as_noop():
                 ("put", 0, 2**31), ("add", 0, -2**40)):
         enc = np.asarray(m.encode_command(bad))
         assert enc.tolist() == [0, 0, 0, 0], bad
+
+
+@pytest.mark.parametrize("seed", [3, 11, 59])
+def test_batch_apply_matches_sequential_fold(seed):
+    """jit_apply_batch == an in-order masked jit_apply fold on BOTH
+    internal paths: the cas-free fast path (last-put + subsequent adds
+    per slot, incl. out-of-range slots that clip and int32 wrap) and
+    the lax.cond fallback scan once a cas appears in the window."""
+    rng = np.random.default_rng(seed)
+    S, A, N = 4, 7, 5
+    m = RegisterMachine(n_slots=S)
+    state = m.jit_init(N)
+    for i in range(4):   # warmup so slots hold values
+        cmd = np.zeros((N, 4), np.int32)
+        cmd[:, 0] = rng.integers(1, 3, N)
+        cmd[:, 1] = rng.integers(0, S, N)
+        cmd[:, 2] = rng.integers(-5, 50, N)
+        state, _ = m.jit_apply({"index": i, "term": 1},
+                               jnp.asarray(cmd), state)
+
+    for hi_op, label in ((3, "fast"), (4, "with-cas")):
+        cmds = np.zeros((N, A, 4), np.int32)
+        cmds[..., 0] = rng.integers(0, hi_op, size=(N, A))
+        cmds[..., 1] = rng.integers(-1, S + 1, size=(N, A))  # clips
+        cmds[..., 2] = rng.integers(-10, 50, size=(N, A))
+        cmds[..., 3] = rng.integers(0, 50, size=(N, A))
+        # wrap coverage: one giant add per lane in the fast window
+        if hi_op == 3:
+            cmds[:, 2, 0] = 2
+            cmds[:, 2, 2] = 2**31 - 3
+        mask = rng.random((N, A)) < 0.8
+        mask[0, :] = True
+        cmds_j = jnp.asarray(cmds)
+        mask_j = jnp.asarray(mask)
+        idx = jnp.broadcast_to(jnp.arange(A, dtype=jnp.int32), (N, A))
+        got = m.jit_apply_batch({"index": idx, "term": jnp.int32(1)},
+                                cmds_j, mask_j, state)
+        want = state
+        for i in range(A):
+            new, _ = m.jit_apply({"index": idx[:, i], "term": 1},
+                                 cmds_j[:, i], want)
+            want = jnp.where(mask_j[:, i][..., None], new, want)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=label)
+        state = want
