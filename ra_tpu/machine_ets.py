@@ -1,0 +1,51 @@
+"""Node-owned side tables for machines — the ra_machine_ets role.
+
+The reference runs a hidden gen_server under the top supervisor whose
+only job is to OWN ETS tables created on behalf of user machines
+(ra_machine_ets.erl:28-33, started from ra_sup.erl:33-35): because the
+owner is the long-lived service and not the server process, a machine's
+side table survives member crash/restart.  There are no in-tree
+callers — it is a service for user machine modules.
+
+Here an Erlang node maps to the Python process, so the registry is
+process-global: tables survive server stop/start, supervised restarts,
+and RaNode teardown, and are dropped only explicitly (or with the
+process).  A "table" is a plain dict — the host-machine analogue of an
+ETS set — guarded by the registry lock only for create/delete;
+per-table access follows the same discipline as the reference (the
+creating machine coordinates its own readers/writers).
+
+Usage from a machine (any callback; typically ``init``)::
+
+    from ra_tpu import machine_ets
+    tab = machine_ets.create_table("my_machine_index")
+    tab[key] = value          # survives this member's restart
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_tables: Dict[str, dict] = {}
+
+
+def create_table(name: str) -> dict:
+    """Return the named table, creating it if needed (idempotent — the
+    reference's create_table replaces an existing table only because
+    ETS errors on duplicate names; machines recreate on restart, so
+    keep-existing is the behaviour they actually rely on)."""
+    with _lock:
+        return _tables.setdefault(name, {})
+
+
+def delete_table(name: str) -> None:
+    """Drop the named table (no-op if absent)."""
+    with _lock:
+        _tables.pop(name, None)
+
+
+def which_tables() -> tuple:
+    """Names of live tables (overview/debugging)."""
+    with _lock:
+        return tuple(sorted(_tables))

@@ -1,0 +1,61 @@
+"""machine_ets — node-owned side tables survive member restarts (the
+ra_machine_ets role, ra_machine_ets.erl:28-33 / ra_sup.erl:33-35)."""
+import ra_tpu
+from ra_tpu import machine_ets
+from ra_tpu.core.machine import Machine
+from ra_tpu.core.types import ServerId
+from ra_tpu.node import LocalRouter, RaNode
+
+from nemesis import await_leader
+
+
+class IndexingMachine(Machine):
+    """Counts applies into a node-owned side table (the pattern the
+    reference service exists for: machine-maintained indexes that
+    outlive the server process)."""
+
+    def init(self, config):
+        machine_ets.create_table("idx_table")
+        return 0
+
+    def apply(self, meta, command, state):
+        tab = machine_ets.create_table("idx_table")
+        tab[meta.index] = command
+        return state + 1, state + 1
+
+
+def test_registry_is_idempotent_and_deletable():
+    t1 = machine_ets.create_table("t_reg")
+    t1["k"] = 1
+    assert machine_ets.create_table("t_reg") is t1
+    assert "t_reg" in machine_ets.which_tables()
+    machine_ets.delete_table("t_reg")
+    assert "t_reg" not in machine_ets.which_tables()
+    machine_ets.delete_table("t_reg")  # no-op
+
+
+def test_side_table_survives_member_restart():
+    machine_ets.delete_table("idx_table")
+    router = LocalRouter()
+    sids = [ServerId(f"e{i}", f"en{i}") for i in (1, 2, 3)]
+    nodes = {s.node: RaNode(s.node, router=router) for s in sids}
+    try:
+        ra_tpu.start_cluster("ets", IndexingMachine, sids, router=router,
+                             election_timeout_ms=300, tick_interval_ms=50)
+        leader = await_leader(router, sids)
+        for i in range(5):
+            ra_tpu.process_command(leader, f"c{i}", router=router)
+        tab = machine_ets.create_table("idx_table")
+        n_before = len(tab)
+        assert n_before >= 5  # every member's apply writes the table
+        # kill + restart one member: the node-owned table is untouched
+        victim = next(s for s in sids if s != leader)
+        ra_tpu.stop_server(victim, router=router)
+        assert len(machine_ets.create_table("idx_table")) == n_before
+        ra_tpu.restart_server(victim, router=router)
+        ra_tpu.process_command(leader, "after", router=router)
+        assert len(machine_ets.create_table("idx_table")) > n_before
+    finally:
+        for n in nodes.values():
+            n.stop()
+        machine_ets.delete_table("idx_table")

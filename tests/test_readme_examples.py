@@ -5,6 +5,14 @@ import os
 import re
 
 
+def _patch(src, old, new):
+    """Replace that REFUSES to no-op: README drift must fail the test,
+    not silently run the unpatched block (full-size configs, shared
+    /tmp paths, files written into the CWD)."""
+    assert old in src, f"README drift: {old!r} not found"
+    return src.replace(old, new)
+
+
 def _blocks():
     root = os.path.join(os.path.dirname(__file__), "..")
     with open(os.path.join(root, "README.md"), encoding="utf-8") as f:
@@ -18,7 +26,7 @@ def test_classic_quickstart_block(tmp_path):
     src = _blocks()[0]
     assert "start_server" in src and "consistent_query" in src
     # patch only the data dir; everything else runs as documented
-    src = src.replace('f"/tmp/ra/{s.node}"', 'str(tmp_path / s.node)')
+    src = _patch(src, 'f"/tmp/ra/{s.node}"', 'str(tmp_path / s.node)')
     ns: dict = {"tmp_path": tmp_path}
     try:
         exec(compile(src, "README.md[classic]", "exec"), ns)  # noqa: S102
@@ -39,17 +47,17 @@ def test_engine_quickstart_block():
     assert "LockstepEngine" in src
     # shrink the documented 10k-lane config for suite runtime; the
     # structure (shapes, calls) runs exactly as written
-    src = src.replace("10_000", "64")
+    src = _patch(src, "10_000", "64")
     ns = {}
     exec(compile(src, "README.md[engine]", "exec"), ns)  # noqa: S102
     assert ns["eng"].committed_total() > 0
 
 def test_trace_quickstart_block():
     src = _blocks()[2]
-    lines = [ln for ln in src.splitlines() if ln.strip() != "..."]
+    lines = [ln for ln in src.splitlines()
+             if not ln.strip().startswith("...")]
     src = "\n".join(lines)
-    src = src.replace('t.dump_chrome_trace("ra_trace.json")',
-                      'pass')
+    src = _patch(src, 't.dump_chrome_trace("ra_trace.json")', 'pass')
     from ra_tpu import trace
     ns = {}
     try:
