@@ -1,6 +1,6 @@
 from .counter import CounterMachine
 from .fifo import FifoMachine
-from .fifo_client import FifoClient, Mailbox
+from .fifo_client import FifoClient, Mailbox, StopSending
 from .jit_fifo import JitFifoMachine
 from .jit_kv import JitKvMachine
 from .kv import KvMachine
@@ -9,4 +9,4 @@ from .queue import QueueMachine
 
 __all__ = ["CounterMachine", "FifoMachine", "FifoClient", "JitFifoMachine",
            "JitKvMachine", "KvMachine", "Mailbox", "QueueMachine",
-           "RegisterMachine"]
+           "RegisterMachine", "StopSending"]

@@ -57,15 +57,31 @@ class Mailbox:
         return f"<Mailbox {self.name}>"
 
 
+class StopSending(RuntimeError):
+    """enqueue() refused: unapplied commands reached max_pending (the
+    reference's `{error, stop_sending}`, ra_fifo_client.erl:106-110) —
+    drain with flush()/poll_applied() before sending more."""
+
+
 class FifoClient:
     """Enqueue/checkout session against one fifo cluster."""
 
+    #: in-flight window where enqueue() starts answering "slow"
+    #: (?SOFT_LIMIT, ra_fifo_client.erl:21)
+    SOFT_LIMIT = 256
+
     def __init__(self, servers: list, router=None, tag: str = "c1",
-                 node: str = "") -> None:
+                 node: str = "", soft_limit: int = SOFT_LIMIT,
+                 max_pending: int = 0) -> None:
         assert servers, "need at least one member"
         self.servers = list(servers)
         self.router = router
         self.tag = tag
+        self.soft_limit = soft_limit
+        # hard ceiling defaults to 4x the soft signal so the graduated
+        # ok -> slow -> StopSending protocol cannot invert
+        self.max_pending = max_pending or 4 * soft_limit
+        assert self.soft_limit <= self.max_pending
         # globally unique pid name: two clients sharing a tag must not
         # alias each other's enqueuer/consumer identity
         self.mailbox = Mailbox(name=f"{tag}.{next(_mailbox_ids)}", node=node)
@@ -78,14 +94,22 @@ class FifoClient:
 
     # -- enqueue ------------------------------------------------------------
 
-    def enqueue(self, msg: Any) -> int:
-        """Pipeline an enqueue; returns its seqno.  Delivery/apply is
-        asynchronous — track with :meth:`pending_count` / :meth:`flush`."""
+    def enqueue(self, msg: Any) -> tuple:
+        """Pipeline an enqueue; returns ``(status, seqno)`` where status
+        is "ok", or "slow" once the unapplied window passes soft_limit
+        (keep sending, but ease off — the reference's `{slow, State}`
+        backpressure signal).  Raises :class:`StopSending` at
+        max_pending.  Delivery/apply is asynchronous — track with
+        :meth:`pending_count` / :meth:`flush`."""
+        self.poll_applied()                  # status must see fresh acks
+        if len(self.pending) >= self.max_pending:
+            raise StopSending(f"{len(self.pending)} enqueues unapplied")
         seqno = self.next_seqno
         self.next_seqno += 1
         self.pending[seqno] = msg
         self._pipeline(seqno, msg)
-        return seqno
+        status = "slow" if len(self.pending) >= self.soft_limit else "ok"
+        return status, seqno
 
     def _pipeline(self, seqno: int, msg: Any) -> None:
         target = self._leader_hint()

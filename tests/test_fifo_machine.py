@@ -345,3 +345,47 @@ def test_fifo_cross_host_pipeline_acks():
         for n in names:
             nodes[n].stop()
             routers[n].stop()
+
+
+def test_client_backpressure_soft_limit_and_stop_sending():
+    """ra_fifo_client flow control (ra_fifo_client.erl:21, :93-110):
+    enqueue answers "slow" once the unapplied window passes soft_limit
+    and raises StopSending at max_pending; once a leader applies the
+    backlog the window drains and status returns to "ok"."""
+    from ra_tpu.core.types import ServerConfig
+    from ra_tpu.models import StopSending
+
+    router = LocalRouter()
+    sids = [ServerId(f"bp{i}", f"bpn{i}") for i in (1, 2, 3)]
+    nodes = {s.node: RaNode(s.node, router=router) for s in sids}
+    try:
+        # cluster is configured but NOT elected: pipelined enqueues park
+        # in the client's pending set, so the window only grows
+        for sid in sids:
+            nodes[sid.node].start_server(ServerConfig(
+                server_id=sid, uid=ra_tpu.new_uid(sid.name),
+                cluster_name="bp", initial_members=tuple(sids),
+                machine=FifoMachine(),
+                election_timeout_ms=10_000, tick_interval_ms=50))
+        client = FifoClient(sids, router=router, soft_limit=4,
+                            max_pending=8)
+        statuses = [client.enqueue(i)[0] for i in range(8)]
+        assert statuses[:3] == ["ok"] * 3
+        assert statuses[3:] == ["slow"] * 5          # window >= soft_limit
+        with pytest.raises(StopSending):
+            client.enqueue("overflow")
+        # now elect and let the backlog apply: the window drains, dedup
+        # keeps the queue exactly-once, and enqueue is "ok" again
+        ra_tpu.trigger_election(sids[0], router=router)
+        await_leader(router, sids)
+        client.flush(timeout=15.0)
+        assert client.pending_count() == 0
+        assert client.enqueue("after")[0] == "ok"
+        client.flush(timeout=15.0)
+        leader = await_leader(router, sids)
+        res = ra_tpu.local_query(
+            leader, query_messages_ready, router=router)
+        assert res.reply == 9                         # 0..7 + "after", no dupes
+    finally:
+        for n in nodes.values():
+            n.stop()

@@ -51,10 +51,19 @@ class Enqueuer:
         self._thread.start()
 
     def _run(self):
+        from ra_tpu.models import StopSending
         i = 0
         while not self._stop.is_set():
             payload = f"{self.client.tag}-{i}"
-            self.client.enqueue(payload)
+            try:
+                self.client.enqueue(payload)
+            except StopSending:
+                # window full during a long partition: back off and
+                # retry THE SAME payload — dying here would silently
+                # shrink the workload the assertions cover
+                self.client.resend()
+                self._stop.wait(0.05)
+                continue
             self.sent.append(payload)
             i += 1
             # periodic resend keeps progress through leader changes
