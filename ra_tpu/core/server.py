@@ -1997,6 +1997,26 @@ class RaServer:
             # postponed backlog replays in arrival order (gen_statem's
             # postpone-retry on state change, ra_server_proc.erl:946-1010)
             return [NextEvent(event)] + self._replay_condition_pending()
+        # An unsatisfying AER during the CATCH-UP park is dropped with a
+        # refusal to ITS sender, not postponed (the reference's await
+        # catch-all drops such messages, ra_server.erl:1766-1775).  Two
+        # liveness holes otherwise (soak seed 140855, anchored
+        # in-suite): postponed AERs re-park on replay AHEAD of fresh
+        # traffic — each condition kick consumes exactly one stale head
+        # while a live leader's heartbeats queue behind, a treadmill
+        # that never drains — and the park-time refusal stays addressed
+        # to the leader of the PARKING term, so a newer leader would
+        # never learn this follower's position.  The refusal carries
+        # the sender's term when current (term adoption itself waits
+        # for an entry we can use) and our own term against a stale
+        # sender, exactly as a live follower would answer.  Safe: a
+        # refusal only resets the sender's next_index; AERs carry no
+        # client state to lose and leaders resend.
+        if isinstance(event, AppendEntriesRpc) and cond is not None and \
+                cond.predicate is _follower_catchup_predicate:
+            reply_term = max(event.term, self.current_term)
+            return [SendRpc(event.leader_id,
+                            self._aer_reply(reply_term, False))]
         # postpone: buffer the event for replay when the condition exits
         # (ra_server_proc postpones via gen_statem; dropping would force a
         # leader resend round-trip).  Periodic ticks are not worth keeping.

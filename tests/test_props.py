@@ -341,7 +341,7 @@ def test_stale_retained_wal_file_does_not_rewind_tail(tmp_path):
 
 @pytest.mark.parametrize("seed,n_members",
                          [(s, 3) for s in (11, 23, 37, 59, 101, 151)] +
-                         [(s, 5) for s in (71, 83, 127)])
+                         [(s, 5) for s in (71, 83, 127, 140855)])
 def test_election_safety_and_log_matching_fuzz(seed, n_members):
     """Figure-3 safety properties under a random schedule of message
     deliveries, drops, partitions, election timeouts, and client
@@ -710,11 +710,77 @@ def test_safety_fuzz_with_snapshots(seed, n_members,
                    for s in sids), "no snapshot taken during fuzz"
 
 
+
+class _WedgeEscape:
+    """Model the disaster-recovery runbook for a wedged membership state
+    (reachable: a join racing a self-removal can commit a config whose
+    quorum includes a permanently terminated member — then no change can
+    ever commit and even the leader's own removal hangs; found by seed
+    140095).  After ``threshold`` healing cycles with zero progress AND
+    a verified wedged configuration, the operator force-shrinks the
+    live server with the most advanced log to a single-member cluster
+    (quorum of one) — ra:force_shrink_members_to_current_member
+    (test_force_shrink.py).  The wedge shape is asserted so a future
+    liveness regression (a stall WITHOUT quorum hostage to terminated
+    members) still fails the fuzz instead of being silently repaired.
+    One intervention per run."""
+
+    def __init__(self, c, sids, threshold: int = 250):
+        self.c, self.sids, self.threshold = c, sids, threshold
+        self.stale, self.last_prog, self.forced = 0, None, False
+
+    def _live(self):
+        return [s for s in self.sids
+                if self.c.servers[s].raft_state.value not in
+                ("stop", "delete_and_terminate")]
+
+    def _config_is_wedged(self) -> bool:
+        """True iff some live server's effective config cannot form a
+        quorum from LIVE voters (terminated members hold it hostage)."""
+        from ra_tpu.core.types import Membership
+        live = set(self._live())
+        for s in live:
+            cluster = self.c.servers[s].cluster
+            voters = [pid for pid, p in cluster.items()
+                      if p.membership == Membership.VOTER]
+            if not voters:
+                continue
+            alive = [pid for pid in voters if pid in live]
+            if len(alive) < len(voters) // 2 + 1:
+                return True
+        return False
+
+    def tick(self) -> None:
+        c, sids = self.c, self.sids
+        prog = tuple(sorted(
+            (s.name, c.servers[s].last_applied,
+             c.servers[s].commit_index) for s in sids))
+        self.stale = self.stale + 1 if prog == self.last_prog else 0
+        self.last_prog = prog
+        if self.stale < self.threshold or self.forced:
+            return
+        self.forced = True
+        assert self._config_is_wedged(), \
+            "healing stalled without a wedged config: liveness bug"
+        from ra_tpu.core.types import ForceMemberChangeEvent
+        live = [s for s in self._live()
+                if c.servers[s].raft_state.value != "await_condition"]
+        assert live, "operator intervention with no live servers"
+
+        def rank(s):
+            srv = c.servers[s]
+            t = srv.log.last_index_term()
+            return (t.term, t.index, srv.last_applied)
+
+        c.handle(max(live, key=rank), ForceMemberChangeEvent(from_=None))
+
+
 # ---------------------------------------------------------------------------
 # property 7: safety fuzz with membership changes in the schedule
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed", [5, 29, 47, 97, 147, 189, 220, 348])
+@pytest.mark.parametrize("seed", [5, 29, 47, 97, 147, 189, 220, 348,
+                                  140095])
 def test_safety_fuzz_with_membership_changes(seed):
     """Joins and leaves ('$ra_join'/'$ra_leave' -> '$ra_cluster_change'
     appends, effective on append, one change in flight at a time) racing
@@ -801,7 +867,9 @@ def test_safety_fuzz_with_membership_changes(seed):
     # heal + converge on the FINAL committed membership
     c.heal()
     final_members = None
+    escape = _WedgeEscape(c, sids)
     for _ in range(600):
+        escape.tick()
         c.run()
         for sid in sids:
             srv = c.servers[sid]
@@ -955,7 +1023,9 @@ def test_safety_fuzz_membership_and_snapshots(seed):
 
     c.heal()
     final_members = None
+    escape = _WedgeEscape(c, sids)   # same escape hatch, same gate
     for _ in range(600):
+        escape.tick()
         c.run()
         for sid in sids:
             srv = c.servers[sid]
