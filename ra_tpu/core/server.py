@@ -777,8 +777,16 @@ class RaServer:
                     # (and then apply) never-validated stale entries in
                     # (prev, commit]; unapplied entries are always safe
                     # to truncate and re-receive.
-                    self.log.set_last_index(max(rpc.prev_log_index,
-                                                self.last_applied))
+                    new_tail = max(rpc.prev_log_index, self.last_applied)
+                    self.log.set_last_index(new_tail)
+                    # the reset may have truncated the entry whose
+                    # cluster change this server adopted — revert NOW,
+                    # not at the next append: a truncated server can
+                    # win an election first and overwrite the change's
+                    # index with its own noop, freezing a phantom
+                    # configuration (soak seed 161122: the final leader
+                    # held a config whose change entry no log carried)
+                    self._revert_config_below(new_tail)
                 effects.extend(self._evaluate_commit_index_follower())
                 effects.append(SendRpc(rpc.leader_id,
                                        self._aer_reply(rpc.term, True)))
@@ -857,13 +865,7 @@ class RaServer:
         keys on cit==0) is computed against the new index."""
         if not entries:
             return
-        cit = self.cluster_index_term
-        if cit.index > 0 and entries[0].index <= cit.index and \
-                self.previous_cluster is not None:
-            prev_it, prev_spec = self.previous_cluster
-            self.previous_cluster = None
-            self.cluster_index_term = prev_it
-            self._set_cluster(dict_from_cluster_spec(prev_spec))
+        self._revert_config_below(entries[0].index - 1)
         for e in entries:
             if isinstance(e.command, ClusterChangeCommand):
                 self.previous_cluster = (
@@ -873,6 +875,45 @@ class RaServer:
                 self.cluster_index_term = IdxTerm(e.index, e.term)
                 self._set_cluster(
                     dict_from_cluster_spec(e.command.cluster))
+
+    def _revert_config_below(self, surviving_tail: int) -> None:
+        """The log above ``surviving_tail`` is being discarded (an
+        overwriting append batch, or the empty-AER shorter-log reset):
+        if the adopted cluster change sat above it, the effective
+        configuration must revert to what the surviving prefix says.
+        previous_cluster covers the common one-change-rewind; when BOTH
+        recorded changes are truncated, rescan the surviving prefix
+        (newest change wins, snapshot meta as the base — the same
+        resolution order as _cluster_spec_at)."""
+        if self.cluster_index_term.index <= surviving_tail:
+            return
+        if self.previous_cluster is not None and \
+                self.previous_cluster[0].index <= surviving_tail:
+            prev_it, prev_spec = self.previous_cluster
+            self.previous_cluster = None
+            self.cluster_index_term = prev_it
+            self._set_cluster(dict_from_cluster_spec(prev_spec))
+            return
+        self.previous_cluster = None
+        for i in range(surviving_tail, self.log.first_index() - 1, -1):
+            e = self.log.fetch(i)
+            if e is not None and isinstance(e.command,
+                                            ClusterChangeCommand):
+                self.cluster_index_term = IdxTerm(e.index, e.term)
+                self._set_cluster(
+                    dict_from_cluster_spec(tuple(e.command.cluster)))
+                return
+        meta = self.log.snapshot_meta()
+        if meta is not None:
+            self.cluster_index_term = IdxTerm(meta.index, meta.term)
+            self._set_cluster(dict_from_cluster_spec(tuple(meta.cluster)))
+            return
+        # no surviving change and no snapshot: back to the bootstrap
+        # configuration (cit (0,0) keys the fresh-member config fallback,
+        # same as init) — leaving the truncated view in force would keep
+        # a phantom membership no log carries
+        self.cluster_index_term = IdxTerm(0, 0)
+        self._set_cluster({sid: Peer() for sid in self.cfg.initial_members})
 
     def _evaluate_commit_index_follower(self) -> list:
         """Apply up to min(last_index, commit_index) — may apply entries not

@@ -12,11 +12,13 @@ from ra_tpu.core.server import RaServer
 from ra_tpu.core.types import (
     AppendEntriesReply,
     AppendEntriesRpc,
+    ClusterChangeCommand,
     CommandEvent,
     ElectionTimeout,
     Entry,
     HeartbeatReply,
     HeartbeatRpc,
+    IdxTerm,
     InstallSnapshotResult,
     InstallSnapshotRpc,
     JoinCommand,
@@ -865,3 +867,60 @@ def test_follower_refuses_snapshot_with_higher_machine_version():
                isinstance(e.msg, InstallSnapshotResult)]
     assert len(results) == 1
     assert results[0].msg.last_index == srv3.last_applied
+
+
+def test_truncation_reverts_adopted_config_to_surviving_prefix():
+    """The empty-AER shorter-log reset must revert the effective
+    configuration when it truncates the change entry it came from —
+    at truncation time, through every fallback level: previous_cluster,
+    a rescan of the surviving prefix, and (with neither) the bootstrap
+    config (soak seed 161122 + review's no-snapshot base case)."""
+    c = SimCluster(3)
+    s1, s2, s3 = c.ids
+    c.elect(s1)
+    c.command(s1, 1)                       # idx 2 committed everywhere
+    c.run()
+    leader = c.servers[s1]
+    srv2 = c.servers[s2]
+    term = leader.current_term
+    base_cit = srv2.cluster_index_term
+    # feed s2 two uncommitted config changes above its applied frontier
+    spec_a = tuple((sid, Membership.VOTER) for sid in (s1, s2))
+    spec_b = tuple((sid, Membership.VOTER) for sid in (s1, s2, s3))
+    tail = srv2.log.last_index_term()
+    e_a = Entry(tail.index + 1, term, ClusterChangeCommand(spec_a))
+    e_b = Entry(tail.index + 2, term, ClusterChangeCommand(spec_b))
+    srv2.handle(AppendEntriesRpc(
+        term=term, leader_id=s1, prev_log_index=tail.index,
+        prev_log_term=tail.term, entries=(e_a, e_b),
+        leader_commit=srv2.commit_index))
+    assert srv2.cluster_index_term.index == e_b.index
+    assert set(srv2.cluster) == {s1, s2, s3}
+    # shorter-log reset truncates BOTH changes; no snapshot exists and
+    # the surviving prefix (noop + user cmd) carries no change -> the
+    # view must fall all the way back instead of keeping B's phantom
+    srv2.handle(AppendEntriesRpc(
+        term=term, leader_id=s1, prev_log_index=tail.index,
+        prev_log_term=tail.term, entries=(),
+        leader_commit=srv2.commit_index))
+    assert srv2.log.last_index_term() == tail
+    assert srv2.cluster_index_term.index <= tail.index
+    assert srv2.cluster_index_term == base_cit or \
+        srv2.cluster_index_term == IdxTerm(0, 0)
+    assert set(srv2.cluster) == {s1, s2, s3}  # bootstrap = initial members
+    assert srv2.previous_cluster is None
+    # and a one-change rewind uses previous_cluster: adopt A then B,
+    # truncate only B
+    tail2 = srv2.log.last_index_term()
+    e_a2 = Entry(tail2.index + 1, term, ClusterChangeCommand(spec_a))
+    e_b2 = Entry(tail2.index + 2, term, ClusterChangeCommand(spec_b))
+    srv2.handle(AppendEntriesRpc(
+        term=term, leader_id=s1, prev_log_index=tail2.index,
+        prev_log_term=tail2.term, entries=(e_a2, e_b2),
+        leader_commit=srv2.commit_index))
+    srv2.handle(AppendEntriesRpc(
+        term=term, leader_id=s1, prev_log_index=e_a2.index,
+        prev_log_term=term, entries=(),
+        leader_commit=srv2.commit_index))
+    assert srv2.cluster_index_term == IdxTerm(e_a2.index, term)
+    assert set(srv2.cluster) == {s1, s2}

@@ -780,7 +780,7 @@ class _WedgeEscape:
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("seed", [5, 29, 47, 97, 147, 189, 220, 348,
-                                  140095])
+                                  140095, 161122])
 def test_safety_fuzz_with_membership_changes(seed):
     """Joins and leaves ('$ra_join'/'$ra_leave' -> '$ra_cluster_change'
     appends, effective on append, one change in flight at a time) racing
