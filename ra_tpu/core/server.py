@@ -1022,6 +1022,19 @@ class RaServer:
                 self.previous_cluster = None
                 self._set_cluster({sid: Peer(membership=m)
                                    for sid, m in meta.cluster})
+                # the log RETAINS any consistent suffix above the
+                # snapshot (install-at-applied-index restoration):
+                # config changes in it are NEWER than the meta and must
+                # stay in force — pinning only the meta silently
+                # regressed this server's view to a config two changes
+                # old, and it later elected itself under the stale
+                # (larger) membership against a quorum the committed
+                # chain had dissolved (soak seed 181279)
+                retained = [
+                    e for e in self.log.read_range(
+                        meta.index + 1, self.log.last_index_term().index)
+                    if isinstance(e.command, ClusterChangeCommand)]
+                self._adopt_cluster_changes(retained)
                 self._accepting_snapshot = None
                 self.raft_state = RaftState.FOLLOWER
                 effs = list(self.machine.snapshot_installed(
@@ -1437,8 +1450,31 @@ class RaServer:
         # success=false: next_index repair (ra_server.erl:477-532)
         t = self.log.fetch_term(reply.last_index)
         if t is None:
-            peer.match_index = reply.last_index
-            peer.next_index = reply.next_index
+            # DESIGN DIVERGENCE: the reference forwards match_index to
+            # an UNVERIFIABLE point here (ra_server.erl:489-494).  A
+            # refusal can advertise a deposed leader's surplus tail —
+            # beyond our own log — and a poisoned match freezes commit
+            # evaluation forever: agreed_commit lands on an index whose
+            # term the leader cannot verify, so the §5.4.2 gate refuses
+            # every subsequent commit (soak seed 181279: leader at tail
+            # 36 held match=68 for its only voter, ci frozen while both
+            # logs kept growing).  Same rule as the verified success
+            # path: unverified points never advance replication state —
+            # repair next_index only.
+            my_last = self.log.last_index_term().index
+            if reply.last_index > my_last:
+                # surplus beyond our log: the empty-AER reset at our
+                # tail truncates it (the follower's shorter-log branch).
+                # Force the probe NOW like the sibling surplus repairs
+                # (success path, install-result path): the pipelined
+                # sender sees nothing new to send and would defer the
+                # truncation to the next tick
+                peer.next_index = my_last + 1
+                eff = self._make_rpc_for_peer(reply.from_, peer, 1)
+                return [eff] if eff is not None else []
+            # at/below our snapshot floor: unverifiable here; the
+            # snapshot-send path repairs such peers
+            peer.next_index = max(reply.next_index, 1)
         elif t == reply.last_term and reply.last_index >= peer.match_index:
             peer.match_index = reply.last_index
             peer.next_index = reply.next_index

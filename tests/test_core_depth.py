@@ -6,6 +6,9 @@ interruptions and stale installs, membership (leave/rejoin/promote,
 leader removal), recovery of cluster changes, and the heartbeat state
 matrix across raft states.
 """
+import pickle
+import zlib
+
 from harness import SimCluster
 
 from ra_tpu.core.server import RaServer
@@ -924,3 +927,48 @@ def test_truncation_reverts_adopted_config_to_surviving_prefix():
         leader_commit=srv2.commit_index))
     assert srv2.cluster_index_term == IdxTerm(e_a2.index, term)
     assert set(srv2.cluster) == {s1, s2}
+
+
+def test_snapshot_install_keeps_retained_newer_config():
+    """A catch-up snapshot install (meta.index > last_applied) pins the
+    config to the meta, but the log RETAINS its suffix above the
+    snapshot — config changes there are NEWER than the meta and must
+    stay in force (soak seed 181279: the pin regressed a server's view
+    two changes back, and it later elected itself under the stale
+    larger membership)."""
+    c = SimCluster(3)
+    s1, s2, s3 = c.ids
+    c.elect(s1)
+    c.run()
+    srv2 = c.servers[s2]
+    term = srv2.current_term
+    tail = srv2.log.last_index_term()
+    la0 = srv2.last_applied
+    # feed s2 an UNCOMMITTED suffix carrying a config change
+    spec_new = tuple((sid, Membership.VOTER) for sid in (s1, s2))
+    e_cmd = Entry(tail.index + 1, term, UserCommand(7))
+    e_chg = Entry(tail.index + 2, term, ClusterChangeCommand(spec_new))
+    e_cmd2 = Entry(tail.index + 3, term, UserCommand(8))
+    srv2.handle(AppendEntriesRpc(
+        term=term, leader_id=s1, prev_log_index=tail.index,
+        prev_log_term=tail.term, entries=(e_cmd, e_chg, e_cmd2),
+        leader_commit=srv2.commit_index))
+    assert set(srv2.cluster) == {s1, s2}
+    assert srv2.last_applied == la0            # suffix uncommitted
+    # catch-up install: snapshot lands between the applied frontier and
+    # the change; the meta carries the OLD three-member config
+    spec_old = tuple((sid, Membership.VOTER) for sid in (s1, s2, s3))
+    meta = SnapshotMeta(index=e_cmd.index, term=term,
+                        cluster=spec_old, machine_version=0)
+    data = pickle.dumps(c.servers[s1].machine_state)
+    srv2.handle(InstallSnapshotRpc(
+        term=term, leader_id=s1, meta=meta, chunk_number=1,
+        chunk_flag="last", data=data, chunk_crc=zlib.crc32(data)))
+    # the install genuinely happened (not refused as stale)...
+    assert srv2.log.snapshot_index_term().index == meta.index
+    # ...the suffix above it is retained...
+    assert srv2.log.last_index_term().index >= e_chg.index
+    # ...and the retained change stays in force over the meta's config
+    assert srv2.cluster_index_term == IdxTerm(e_chg.index, term)
+    assert set(srv2.cluster) == {s1, s2}, \
+        "install pinned the meta config over a retained newer change"

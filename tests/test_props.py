@@ -935,7 +935,7 @@ def test_safety_fuzz_with_membership_changes(seed):
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("seed", [3, 17, 31, 53, 113, 162, 374, 446,
-                                  1967, 2110, 2677, 2738])
+                                  1967, 2110, 2677, 2738, 181279])
 def test_safety_fuzz_membership_and_snapshots(seed):
     """The two hardest schedules combined: cluster changes (effective on
     append, carried in snapshot metas, install-restored on laggards)
