@@ -51,9 +51,16 @@ def node_call(node_name: str, op: str, args: dict,
               router: Optional[LocalRouter] = None,
               timeout: float = 60.0) -> Any:
     """Node-lifecycle RPC — the rpc:call of ra_server_sup_sup.erl:42-130.
-    Reaches a LOCAL RaNode directly or a REMOTE one over the router's
-    transport (TcpRouter); raises on unreachable nodes/timeouts."""
+    Reaches a LOCAL RaNode directly; a REMOTE one rides the reliable
+    control-plane RPC layer (transport/rpc.py): a stable request id
+    retried with backoff until the deadline, deduplicated receiver-side
+    so the op executes at most once, with reconnect-aware routing past
+    peer restarts.  Raises the typed triad — ``Unreachable`` (no route
+    / detector-down peer), ``RpcTimeout`` (reachable but unanswered by
+    the deadline), ``RemoteError`` (the remote executor failed) — all
+    RuntimeError subclasses; RpcTimeout is also a TimeoutError."""
     from .core.types import NODE_SCOPE, NodeControlEvent
+    from .transport.rpc import reliable_node_call
     router = router or DEFAULT_ROUTER
     node = router.nodes.get(node_name)
     if node is not None:
@@ -61,12 +68,7 @@ def node_call(node_name: str, op: str, args: dict,
         node.deliver(ServerId(NODE_SCOPE, node_name),
                      NodeControlEvent(op, args, from_=fut))
         return fut.wait(timeout)
-    fut = router.remote_call(
-        ServerId(NODE_SCOPE, node_name),
-        lambda handle: NodeControlEvent(op, args, from_=handle))
-    if fut is None:
-        raise RuntimeError(f"node {node_name} is unreachable for {op}")
-    return fut.wait(timeout)
+    return reliable_node_call(router, node_name, op, args, timeout=timeout)
 
 
 def _config_snapshot_for(cluster_name: str, spec: tuple, sid: ServerId,
@@ -260,8 +262,7 @@ def force_delete_server(server_id: ServerId, system=None,
         uid = system.directory.where_is(server_id.name)
     node.kill_server(server_id.name)
     node.forget_server(server_id.name)
-    if system is not None and uid is not None:
-        system.delete_server_data(uid)
+    node.wipe_member_footprint(uid, system)
 
 
 def _node_of(sid: ServerId, router: LocalRouter) -> RaNode:

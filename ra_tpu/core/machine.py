@@ -33,13 +33,21 @@ def cond_concrete(pred, true_fn, false_fn, operands):
     """``lax.cond`` that short-circuits in Python when ``pred`` is
     concrete (host/eager calls): picks the branch without tracing the
     other, avoiding lax.cond's per-call branch retrace outside jit.
-    Under tracing it is exactly ``lax.cond``."""
+    Under tracing it is exactly ``lax.cond``.
+
+    Concreteness is probed by ``bool(pred)`` rather than an
+    ``isinstance(pred, jax.core.Tracer)`` check: ``jax.core.Tracer`` is
+    a deprecated public alias slated for removal, while a tracer
+    refusing bool() (TracerBoolConversionError) is the stable,
+    documented contract."""
     import jax
     from jax import lax
 
-    if isinstance(pred, jax.core.Tracer):
+    try:
+        concrete = bool(pred)
+    except jax.errors.TracerBoolConversionError:
         return lax.cond(pred, true_fn, false_fn, operands)
-    return true_fn(operands) if bool(pred) else false_fn(operands)
+    return true_fn(operands) if concrete else false_fn(operands)
 
 
 @dataclass(frozen=True)

@@ -53,6 +53,19 @@ METRIC_FIELDS = (
     "effective_machine_version",
 )
 
+#: router-level reliable-RPC counter fields (transport/rpc.py): the
+#: control plane's at-most-once observability.  Sender side: calls,
+#: retries and the typed failure triad; receiver side: executions,
+#: dedup hits (a retry mapped onto an already-seen request id — the
+#: proof no lifecycle verb ran twice), responses re-sent from the
+#: cache, and requests that arrived past their propagated deadline.
+#: No reference equivalent: rpc:call rides Erlang distribution there.
+RPC_FIELDS = (
+    "rpc_calls", "rpc_retries", "rpc_timeouts", "rpc_unreachable",
+    "rpc_remote_errors", "rpc_dedup_hits", "rpc_requests_executed",
+    "rpc_responses_resent", "rpc_expired",
+)
+
 #: node-wide WAL counter fields (ra_log_wal.erl:32-43 — same names,
 #: plus ``syncs``: fsync count, the number the reference exposes through
 #: ra_file_handle instead)

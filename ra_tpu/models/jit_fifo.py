@@ -360,7 +360,15 @@ class JitFifoMachine(JitMachine):
     # that actually return/cancel).
 
     def jit_apply_batch(self, meta, commands, mask, state):
-        # fast only for noop/enqueue/dequeue-settled windows
+        # fast only for noop/enqueue/dequeue-settled windows.
+        # DEMOTION CLIFF: this gate is all-or-nothing per window — one
+        # consumer/settlement op (opcode > 2) anywhere in the window
+        # demotes the WHOLE window to the sequential fold, a measured
+        # ~19x step cost (~0.026s -> ~0.50s at 5k lanes; docs/
+        # BENCHMARKS.md "demotion cliff").  Throughput therefore scales
+        # with the fraction of CLEAN windows, not the per-op mix —
+        # callers who can batch consumer ops into dedicated windows
+        # keep the fast path for the rest.
         fast_ok = ~jnp.any(mask & (commands[..., 0] > 2))
         return self.window_fold_dispatch(meta, commands, mask, state,
                                          fast_ok)

@@ -489,9 +489,23 @@ class RaNode:
             uid = self.system.directory.where_is(name)
         self.kill_server(name)
         self.forget_server(name)
-        if self.system is not None and uid is not None:
-            self.system.delete_server_data(uid)
+        self.wipe_member_footprint(uid, self.system)
         return "ok"
+
+    @staticmethod
+    def wipe_member_footprint(uid, system) -> None:
+        """The force-delete footprint wipe shared by the control plane
+        and the api layer: durable data via ``system`` when present
+        (delete_server_data also drops the uid-scoped machine_ets side
+        tables), else the side tables alone — a deleted member must
+        leave nothing behind either way."""
+        if uid is None:
+            return
+        if system is not None:
+            system.delete_server_data(uid)
+        else:
+            from . import machine_ets
+            machine_ets.drop_scope(uid)
 
     def _disk_snapshot_for(self, name: str) -> Optional[dict]:
         if self.system is None:

@@ -260,7 +260,12 @@ class RaSystem:
 
     def delete_server_data(self, uid: str) -> None:
         """Wipe a server's durable footprint (the data-dir half of
-        ra:force_delete_server).  The caller stops the process first."""
+        ra:force_delete_server).  The caller stops the process first.
+        Includes the member's uid-scoped machine_ets side tables — the
+        system owns them like the reference's ra_machine_ets service
+        under ra_sup (ra_sup.erl:33-35)."""
+        from . import machine_ets
+        machine_ets.drop_scope(uid)
         with self._lock:
             log = self._logs.pop(uid, None)
         if log is not None:

@@ -1,1 +1,10 @@
-from .tcp import TcpRouter
+from .rpc import (  # noqa: F401
+    FaultPlan,
+    FaultSpec,
+    RemoteError,
+    RpcError,
+    RpcTimeout,
+    Unreachable,
+    reliable_node_call,
+)
+from .tcp import TcpRouter  # noqa: F401

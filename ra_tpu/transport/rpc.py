@@ -1,0 +1,471 @@
+"""Reliable control-plane RPC + deterministic transport fault injection.
+
+The reference rides node-lifecycle calls over ``rpc:call`` on Erlang
+distribution, which transparently re-establishes the connection to a
+restarted peer before delivering (ra_server_sup_sup.erl:42-130).  The
+TCP fabric here is deliberately lossy for Raft DATA traffic (the
+[noconnect, nosuspend] cast semantics — pipeline catch-up recovers),
+but a lifecycle RPC that silently vanishes into a half-dead socket is
+a 60s hang, not a recoverable drop.  This module builds the reliable
+request/response channel the control plane needs, distinct from the
+best-effort replication plane — the same control/data-plane split
+hierarchical Raft designs make explicit (Fast Raft, arxiv 2506.17793;
+CD-Raft, arxiv 2603.10555).
+
+Three pieces:
+
+* **Sender**: :func:`reliable_node_call` — per-request ids, retry with
+  exponential backoff + jitter, deadline propagation (the remaining
+  budget travels inside the request), reconnect-aware routing (a retry
+  against a peer the failure detector holds suspect/down invalidates
+  the cached connection first), and typed error surfaces —
+  :class:`Unreachable` vs :class:`RpcTimeout` vs :class:`RemoteError` —
+  instead of a silent hang.
+* **Receiver**: :class:`RpcReceiver` — an at-most-once execution guard:
+  a bounded LRU of request ids maps retries of an already-executed
+  request onto its cached response (dedup), and retries of an
+  in-flight request onto nothing (the completion will answer), so a
+  lifecycle verb never runs twice no matter how often the sender
+  retries.
+* **FaultPlan**: a seeded, deterministic fault-injection plan the
+  transport consults at send/recv — drop / delay / duplicate / reorder
+  / partition, keyed by (peer, frame-class, direction) so each stream
+  draws from its own RNG and a schedule replays identically regardless
+  of thread interleaving elsewhere.  The in-process chaos counterpart
+  of tests/test_engine_chaos.py for the wire.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Optional
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpec",
+    "RemoteError",
+    "RpcError",
+    "RpcReceiver",
+    "RpcRequest",
+    "RpcResponse",
+    "RpcTimeout",
+    "Unreachable",
+    "reliable_node_call",
+]
+
+
+# ---------------------------------------------------------------------------
+# Error surfaces (ra.erl's {error, noproc|nodedown|timeout} triad)
+# ---------------------------------------------------------------------------
+
+class RpcError(RuntimeError):
+    """Base class for control-plane RPC failures."""
+
+
+class Unreachable(RpcError):
+    """The target node cannot be reached: no route, or the failure
+    detector holds it suspect/down at the deadline (nodedown)."""
+
+
+class RpcTimeout(RpcError, TimeoutError):
+    """The call's deadline elapsed while the peer looked reachable —
+    requests were sent but no response arrived in time."""
+
+
+class RemoteError(RpcError):
+    """The remote executor itself failed; carries the remote repr."""
+
+
+# ---------------------------------------------------------------------------
+# Wire records
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RpcRequest:
+    """One control-plane request.  ``rid`` is stable across retries —
+    it is the at-most-once dedup key.  ``deadline_unix`` propagates the
+    caller's remaining budget (wall clock: monotonic clocks are not
+    comparable across processes; cross-host skew makes this advisory)."""
+
+    rid: str
+    node: str                 # target node name (the $node scope)
+    op: str
+    args: dict
+    deadline_unix: float = 0.0
+    attempt: int = 1
+    origin: tuple = ()        # sender's listen addr, filled by transport
+    origin_router: str = ""   # sender's router id (wildcard-bind safe)
+
+
+@dataclass(frozen=True)
+class RpcResponse:
+    rid: str
+    ok: bool
+    value: Any = None
+    error: str = ""
+    #: a retryable failure means "not executed, try again" (e.g. the
+    #: target RaNode is not registered on that host YET — a restarting
+    #: worker); non-retryable means the executor crashed or refused
+    retryable: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Receiver-side at-most-once guard
+# ---------------------------------------------------------------------------
+
+class RpcReceiver:
+    """Dedup/response cache giving retried requests at-most-once
+    execution.  ``execute(req, done)`` starts the operation and calls
+    ``done(result)`` exactly once when finished; it returns False when
+    the target is not hosted here (retryable, NOT cached — a later
+    retry may find the node registered)."""
+
+    CACHE_MAX = 1024
+
+    def __init__(self, execute: Callable[[RpcRequest, Callable], bool],
+                 counters: Optional[dict] = None) -> None:
+        self._execute = execute
+        self._cache: OrderedDict = OrderedDict()  # rid -> (status, resp)
+        self._lock = threading.Lock()
+        self.counters = counters if counters is not None else {}
+
+    def _note(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def handle(self, req: RpcRequest,
+               respond: Callable[[RpcResponse], None]) -> None:
+        with self._lock:
+            ent = self._cache.get(req.rid)
+            if ent is not None:
+                # a retry of something we already saw: never re-execute
+                self._cache.move_to_end(req.rid)
+                self._note("rpc_dedup_hits")
+                status, resp = ent
+                if status == "done":
+                    self._note("rpc_responses_resent")
+                    respond(resp)
+                # in-flight: say nothing — completion will respond, and
+                # any later retry lands on the cached response
+                return
+            self._cache[req.rid] = ("running", None)
+            while len(self._cache) > self.CACHE_MAX:
+                # evict oldest DONE entry only: evicting a 'running'
+                # rid would let its retry re-execute the verb — the
+                # exact double-execution this cache exists to prevent.
+                # If everything is in flight the cache grows past the
+                # cap, bounded by concurrent executions.
+                for key, (status, _resp) in self._cache.items():
+                    if status != "running":
+                        del self._cache[key]
+                        break
+                else:
+                    break
+        if req.deadline_unix and time.time() > req.deadline_unix:
+            # the sender's budget is spent: executing now could only
+            # produce a zombie side effect nobody awaits
+            self._note("rpc_expired")
+            resp = RpcResponse(req.rid, ok=False, error="deadline_expired")
+            with self._lock:
+                self._cache[req.rid] = ("done", resp)
+            respond(resp)
+            return
+
+        def done(result: Any) -> None:
+            resp = RpcResponse(req.rid, ok=True, value=result)
+            with self._lock:
+                self._cache[req.rid] = ("done", resp)
+            respond(resp)
+
+        self._note("rpc_requests_executed")
+        try:
+            started = self._execute(req, done)
+        except Exception as exc:  # noqa: BLE001 — travels to the caller
+            resp = RpcResponse(req.rid, ok=False, error=repr(exc)[:400])
+            with self._lock:
+                self._cache[req.rid] = ("done", resp)
+            respond(resp)
+            return
+        if not started:
+            # target node not hosted here (yet): forget the rid so a
+            # retry can execute once it registers, and tell the sender
+            # to keep trying
+            self._note("rpc_requests_executed", -1)
+            with self._lock:
+                self._cache.pop(req.rid, None)
+            respond(RpcResponse(req.rid, ok=False, retryable=True,
+                                error=f"node {req.node!r} not hosted"))
+
+    def overview(self) -> dict:
+        with self._lock:
+            return {"cached": len(self._cache), **dict(self.counters)}
+
+
+# ---------------------------------------------------------------------------
+# Sender-side retry loop
+# ---------------------------------------------------------------------------
+
+#: per-attempt response wait: grows exponentially from FIRST to CAP so a
+#: lost first request retries fast while a genuinely slow executor
+#: (start_server recovering a long log) is not hammered
+ATTEMPT_WAIT_FIRST = 0.3
+ATTEMPT_WAIT_CAP = 3.0
+#: sleep between attempts: exponential with full jitter, capped
+BACKOFF_FIRST = 0.05
+BACKOFF_CAP = 1.0
+
+
+def _attempt_wait(attempt: int) -> float:
+    return min(ATTEMPT_WAIT_FIRST * (2 ** (attempt - 1)), ATTEMPT_WAIT_CAP)
+
+
+def reliable_node_call(router, node: str, op: str, args: dict,
+                       timeout: float = 60.0) -> Any:
+    """Call ``op`` on ``node``'s control plane with retries, dedup and
+    typed failures — the rpc:call-over-distribution role.  The router
+    must provide the RPC transport surface (TcpRouter does); a router
+    without it (LocalRouter reaching for a remote node) is Unreachable
+    by construction."""
+    if getattr(router, "rpc_register", None) is None:
+        raise Unreachable(
+            f"node {node} is unreachable for {op}: router has no RPC "
+            "transport (in-process LocalRouter has no remote reach)")
+    if not router.rpc_routable(node):
+        router.rpc_note("rpc_unreachable")
+        raise Unreachable(
+            f"node {node} is unreachable for {op}: not in the address "
+            "book")
+    router.rpc_note("rpc_calls")
+    rid = uuid.uuid4().hex
+    rng = random.Random(rid)
+    deadline = time.monotonic() + timeout
+    fut = router.rpc_register(rid)
+    attempt = 0
+    try:
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            attempt += 1
+            if attempt > 1:
+                router.rpc_note("rpc_retries")
+                # reconnect-aware routing: a cached connection to a
+                # peer the detector suspects is exactly the half-dead
+                # socket that eats one-shot sends
+                router.rpc_invalidate_peer(node)
+            req = RpcRequest(rid=rid, node=node, op=op, args=dict(args),
+                             deadline_unix=time.time() + remaining,
+                             attempt=attempt)
+            router.rpc_send(node, req)
+            try:
+                resp = fut.wait(min(_attempt_wait(attempt), remaining))
+            except TimeoutError:
+                pause = rng.uniform(0.5, 1.0) * min(
+                    BACKOFF_FIRST * (2 ** (attempt - 1)), BACKOFF_CAP)
+                pause = min(pause, max(deadline - time.monotonic(), 0.0))
+                if pause > 0:
+                    time.sleep(pause)
+                continue
+            if resp.ok:
+                return resp.value
+            if resp.retryable:
+                fut = router.rpc_register(rid)  # re-arm for the retry
+                # same exponential schedule as the timeout branch: a
+                # restarting worker can take tens of seconds to
+                # register its node — constant 50ms pacing would hammer
+                # it with hundreds of round trips
+                pause = rng.uniform(0.5, 1.0) * min(
+                    BACKOFF_FIRST * (2 ** (attempt - 1)), BACKOFF_CAP)
+                time.sleep(min(pause,
+                               max(deadline - time.monotonic(), 0.0)))
+                continue
+            if resp.error == "deadline_expired":
+                break  # surfaces as RpcTimeout below
+            router.rpc_note("rpc_remote_errors")
+            raise RemoteError(
+                f"rpc {op} on {node} failed remotely: {resp.error}")
+    finally:
+        router.rpc_forget(rid)
+    state = router.rpc_peer_state(node) if \
+        hasattr(router, "rpc_peer_state") else None
+    if state in ("suspect", "down", "never-connected"):
+        router.rpc_note("rpc_unreachable")
+        raise Unreachable(
+            f"node {node} is unreachable for {op} "
+            f"(peer state: {state}, {attempt} attempts)")
+    router.rpc_note("rpc_timeouts")
+    raise RpcTimeout(
+        f"rpc {op} to {node} timed out after {timeout:.1f}s "
+        f"({attempt} attempts)")
+
+
+# ---------------------------------------------------------------------------
+# Deterministic fault injection
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Per-stream fault probabilities.  ``limit`` bounds the TOTAL
+    number of faults this spec may inject on one stream (0 = unbounded)
+    — a limit of 3 with drop=1.0 means 'drop exactly the first three
+    frames', which is how tests script deterministic scenarios."""
+
+    drop: float = 0.0
+    delay: float = 0.0
+    delay_ms: tuple = (1.0, 10.0)
+    duplicate: float = 0.0
+    reorder: float = 0.0
+    limit: int = 0
+
+
+@dataclass(frozen=True)
+class FaultDecision:
+    action: str = "deliver"        # "deliver" | "drop"
+    delay_s: float = 0.0
+    duplicate: bool = False
+    reorder: bool = False
+
+
+_DELIVER = FaultDecision()
+_DROP = FaultDecision(action="drop")
+
+
+class FaultPlan:
+    """Seeded fault schedule consulted by the transport.
+
+    Specs resolve most-specific-first: ``(peer, frame_class)`` then
+    ``peer`` then ``frame_class`` then the default.  Every
+    ``(peer, frame_class, direction)`` stream owns a private RNG seeded
+    from the plan seed + the key, so one stream's draws never perturb
+    another's — the same schedule replays identically whatever the
+    thread interleaving (the wire counterpart of the engine chaos
+    schedule's seeded rounds, tests/test_engine_chaos.py).
+
+    Frame classes: ``msg`` (Raft data), ``rpc_req``/``rpc_resp``
+    (control plane), ``reply``, ``notify``, ``ping``, ``hello``.
+    Partitions are binary per peer: every frame both ways drops until
+    :meth:`heal`.
+    """
+
+    def __init__(self, seed: int = 0,
+                 default: Optional[FaultSpec] = None,
+                 by_class: Optional[dict] = None,
+                 by_peer: Optional[dict] = None,
+                 by_peer_class: Optional[dict] = None) -> None:
+        self.seed = seed
+        self.default = default or FaultSpec()
+        self.by_class = dict(by_class or {})
+        self.by_peer = dict(by_peer or {})
+        self.by_peer_class = dict(by_peer_class or {})
+        self._rngs: dict = {}
+        self._spent: dict = {}       # stream key -> faults injected
+        self._lock = threading.Lock()
+        self.partitioned: set = set()
+        #: injected-fault counters by kind (drop/delay/duplicate/
+        #: reorder/partition), merged into the router overview
+        self.counters: dict = {}
+
+    # -- schedule control ---------------------------------------------------
+
+    def partition(self, peer: str) -> None:
+        self.partitioned.add(peer)
+
+    def heal(self, peer: Optional[str] = None) -> None:
+        if peer is None:
+            self.partitioned.clear()
+        else:
+            self.partitioned.discard(peer)
+
+    # -- decision -----------------------------------------------------------
+
+    def _spec_for(self, peer: str, frame_class: str) -> FaultSpec:
+        for key in ((peer, frame_class),):
+            if key in self.by_peer_class:
+                return self.by_peer_class[key]
+        if peer in self.by_peer:
+            return self.by_peer[peer]
+        if frame_class in self.by_class:
+            return self.by_class[frame_class]
+        return self.default
+
+    def _note(self, kind: str) -> None:
+        self.counters[kind] = self.counters.get(kind, 0) + 1
+
+    def is_partitioned(self, peer: str) -> bool:
+        if peer in self.partitioned:
+            self._note("partition")
+            return True
+        return False
+
+    def recv_peer(self, names) -> str:
+        """Fault-stream key for an INBOUND connection whose hello named
+        ``names`` (co-hosted routers announce every node behind one
+        conn): the first name the plan explicitly targets (partition or
+        per-peer spec), else the first name.  Recv granularity is the
+        connection — per-peer specs for co-hosted nodes are only
+        distinguishable when the plan targets one of them."""
+        for n in names:
+            if n in self.partitioned or n in self.by_peer or \
+                    any(k[0] == n for k in self.by_peer_class):
+                return n
+        return names[0] if names else "?"
+
+    #: every fault kind a call site may honor; paths that can only
+    #: drop (recv, detector pings) pass honor={"drop"} so un-honorable
+    #: decisions neither spend the spec's limit nor count as injected
+    ALL_FAULTS = frozenset({"drop", "delay", "duplicate", "reorder"})
+
+    def decide(self, peer: str, frame_class: str,
+               direction: str = "send",
+               honor: frozenset = ALL_FAULTS) -> FaultDecision:
+        if peer in self.partitioned:
+            self._note("partition")
+            return _DROP
+        spec = self._spec_for(peer, frame_class)
+        if spec.drop == spec.delay == spec.duplicate == spec.reorder == 0:
+            return _DELIVER
+        key = (peer, frame_class, direction)
+        with self._lock:
+            rng = self._rngs.get(key)
+            if rng is None:
+                rng = self._rngs[key] = random.Random(
+                    f"{self.seed}:{peer}:{frame_class}:{direction}")
+            if spec.limit and self._spent.get(key, 0) >= spec.limit:
+                return _DELIVER
+            roll = rng.random()
+            edge = 0.0
+            for kind, prob in (("drop", spec.drop),
+                               ("delay", spec.delay),
+                               ("duplicate", spec.duplicate),
+                               ("reorder", spec.reorder)):
+                edge += prob
+                if roll >= edge:
+                    continue
+                if kind not in honor:
+                    return _DELIVER
+                self._spent[key] = self._spent.get(key, 0) + 1
+                self._note(kind)
+                if kind == "drop":
+                    return _DROP
+                if kind == "delay":
+                    lo, hi = spec.delay_ms
+                    return FaultDecision(
+                        delay_s=rng.uniform(lo, hi) / 1000.0)
+                if kind == "duplicate":
+                    return FaultDecision(duplicate=True)
+                return FaultDecision(reorder=True)
+        return _DELIVER
+
+    def overview(self) -> dict:
+        return {"seed": self.seed,
+                "partitioned": sorted(self.partitioned),
+                "injected": dict(self.counters)}
+
+
+def stamp_origin(req: RpcRequest, origin: tuple,
+                 router_id: str) -> RpcRequest:
+    """Fill the transport-owned origin fields just before the wire."""
+    return replace(req, origin=tuple(origin), origin_router=router_id)

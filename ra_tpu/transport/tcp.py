@@ -10,10 +10,20 @@ aten (SURVEY.md §2.4 'Distributed communication backend'):
 * per-peer connection status (normal | disconnected) feeds drop decisions
   and metrics (ra.hrl:329-330 drop counters)
 * a lightweight heartbeat failure detector stands in for aten: every
-  connected peer is pinged on an interval; silence beyond a threshold
-  emits NodeEvent(node, "down") to every local server shell, recovery
-  emits NodeEvent(node, "up") (aten's poll-interval role,
+  connected peer is pinged on an interval; silence beyond SUSPECT_AFTER
+  marks the node "suspect" (internal pre-down state the reliable RPC
+  layer uses to invalidate cached connections before retrying), silence
+  beyond DOWN_AFTER emits NodeEvent(node, "down") to every local server
+  shell and closes the cached connection, recovery emits
+  NodeEvent(node, "up") (aten's poll-interval role,
   ra_server_proc.erl:790-810, 1690-1700)
+* node-LIFECYCLE calls ride the reliable RPC frames (FRAME_RPC_REQ/
+  FRAME_RPC_RESP, transport/rpc.py): retried by the sender under one
+  request id, deduplicated by the receiver — control-plane traffic must
+  survive a peer restart that Raft data traffic merely drops through
+* an optional seeded FaultPlan (transport/rpc.py) is consulted on the
+  send and recv paths: deterministic drop/delay/duplicate/reorder/
+  partition per (peer, frame-class) stream for in-process chaos tests
 * frames are length-prefixed pickles between cluster hosts — the same
   mutual-trust model as Erlang distribution inside a cluster; do not
   expose the port beyond it
@@ -39,11 +49,15 @@ from typing import Optional
 from ..core.types import (
     CommandEvent,
     CommandsEvent,
+    NODE_SCOPE,
+    NodeControlEvent,
     NodeEvent,
     ServerId,
     strip_msg_handles,
 )
+from ..metrics import RPC_FIELDS
 from ..node import LocalRouter
+from .rpc import RpcReceiver, stamp_origin
 
 logger = logging.getLogger("ra_tpu.transport")
 
@@ -53,13 +67,39 @@ FRAME_PING = 1
 FRAME_HELLO = 2
 FRAME_REPLY = 3
 FRAME_NOTIFY = 4
+FRAME_RPC_REQ = 5
+FRAME_RPC_RESP = 6
+
+#: fault kinds the recv/ping paths can honor (they cannot delay,
+#: duplicate or reorder — see FaultPlan.decide's honor contract)
+_DROP_ONLY = frozenset({"drop"})
+
+#: frame kind -> FaultPlan frame class (rpc.FaultPlan keys decisions by
+#: (peer, frame-class, direction) so chaos schedules can target the
+#: control plane, the data plane, or the detector independently)
+_FRAME_CLASS = {FRAME_MSG: "msg", FRAME_PING: "ping",
+                FRAME_HELLO: "hello", FRAME_REPLY: "reply",
+                FRAME_NOTIFY: "notify", FRAME_RPC_REQ: "rpc_req",
+                FRAME_RPC_RESP: "rpc_resp"}
 
 SEND_QUEUE_MAX = 10_000
 MAX_FRAME = 64 * 1024 * 1024  # snapshot chunks are 1MB; generous headroom
 PING_INTERVAL = 0.5
+SUSPECT_AFTER = 1.0       # silence before the RPC layer distrusts the conn
 DOWN_AFTER = 2.0          # silence threshold (aten default poll is 1s)
 CONNECT_TIMEOUT = 1.0
 RECONNECT_BACKOFF = 0.5
+
+
+class _FaultHeld:
+    """Wrapper marking a queue item the FaultPlan already processed
+    (delayed frames re-enter the send queue exempt from a second
+    decision, or they would be re-delayed/dropped forever)."""
+
+    __slots__ = ("item",)
+
+    def __init__(self, item) -> None:
+        self.item = item
 
 
 class _Peer:
@@ -121,6 +161,14 @@ class TcpRouter(LocalRouter):
         self._router_id = uuid.uuid4().hex[:12]
         # lazily-created peers keyed by raw address (reply routing)
         self._addr_peers: dict[tuple, _Peer] = {}
+        # reliable control-plane RPC (transport/rpc.py): pending sender
+        # futures by request id, shared counters, receiver-side dedup
+        self._rpc_pending: dict = {}
+        self.rpc_counters: dict = {f: 0 for f in RPC_FIELDS}
+        self._rpc_receiver = RpcReceiver(self._rpc_execute,
+                                         counters=self.rpc_counters)
+        #: optional seeded FaultPlan consulted at send/recv (rpc.py)
+        self.fault_plan = None
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True,
                                                name="ra-tcp-accept")
@@ -248,12 +296,29 @@ class TcpRouter(LocalRouter):
                     items.append(peer.queue.get_nowait())
                 except queue.Empty:
                     break
+            plan = self.fault_plan
+            if plan is not None:
+                # fault filtering happens HERE, before the socket, so a
+                # later socket failure counts only the frames actually
+                # attempted: fault drops count once (inside the filter)
+                # and delayed frames (a Timer re-queues them) never
+                # count as connection losses.  Plan-level partition
+                # also suppresses the redial handshake: a partitioned
+                # peer must go silent for the detector.
+                if plan.is_partitioned(self._fault_peer_name(peer)):
+                    self.dropped_sends += len(items)
+                    continue
+                items = self._apply_send_faults(plan, peer, items)
+                if not items:
+                    continue
             if not self._send_items(peer, items):
                 # drop the batch (and drain cheaply while down: pipeline
                 # catch-up will resend what matters)
                 self.dropped_sends += len(items)
 
     def _encode_item(self, item) -> Optional[bytes]:
+        if isinstance(item, _FaultHeld):  # plan cleared mid-delay
+            item = item.item
         to, msg, src = (item if len(item) == 3 else (*item, None))
         try:
             if to == "__reply__":
@@ -261,6 +326,12 @@ class TcpRouter(LocalRouter):
                     msg, protocol=pickle.HIGHEST_PROTOCOL)
             elif to == "__notify__":
                 frame = bytes([FRAME_NOTIFY]) + pickle.dumps(
+                    msg, protocol=pickle.HIGHEST_PROTOCOL)
+            elif to == "__rpc_req__":
+                frame = bytes([FRAME_RPC_REQ]) + pickle.dumps(
+                    msg, protocol=pickle.HIGHEST_PROTOCOL)
+            elif to == "__rpc_resp__":
+                frame = bytes([FRAME_RPC_RESP]) + pickle.dumps(
                     msg, protocol=pickle.HIGHEST_PROTOCOL)
             else:
                 payload = pickle.dumps((to, src, strip_msg_handles(msg)),
@@ -356,6 +427,170 @@ class TcpRouter(LocalRouter):
                 if f is fut:
                     del self._calls[cid]
 
+    # ------------------------------------------------------------------
+    # reliable control-plane RPC (transport/rpc.py rides these)
+    # ------------------------------------------------------------------
+
+    def set_fault_plan(self, plan) -> None:
+        """Install (or clear, with None) a seeded FaultPlan; consulted
+        on every send/recv until replaced."""
+        self.fault_plan = plan
+
+    def rpc_routable(self, node: str) -> bool:
+        return node in self.nodes or node in self.address_book
+
+    def rpc_note(self, field: str, n: int = 1) -> None:
+        self.rpc_counters[field] = self.rpc_counters.get(field, 0) + n
+
+    def rpc_register(self, rid: str):
+        """Arm (or re-arm, across retryable responses) the future a
+        response to ``rid`` resolves."""
+        from ..node import Future
+        fut = Future()
+        with self._call_lock:
+            self._rpc_pending[rid] = fut
+        return fut
+
+    def rpc_forget(self, rid: str) -> None:
+        with self._call_lock:
+            self._rpc_pending.pop(rid, None)
+
+    def rpc_send(self, node: str, req) -> bool:
+        """Queue one request attempt toward ``node``; loopback requests
+        (the target node hosted HERE) go straight through the receiver
+        so local calls share the same at-most-once path."""
+        req = stamp_origin(req, self.listen_addr, self._router_id)
+        if node in self.nodes:
+            self._rpc_receiver.handle(
+                req, lambda resp, _r=req: self._rpc_respond(_r, resp))
+            return True
+        if node in self.blocked_nodes:
+            self.dropped_sends += 1
+            return False
+        peer = self._peer_for(node)
+        if peer is None:
+            return False
+        try:
+            peer.queue.put_nowait(("__rpc_req__", req))
+        except queue.Full:
+            self.dropped_sends += 1
+            return False
+        self._ensure_sender(peer)
+        return True
+
+    def rpc_peer_state(self, node: str) -> str:
+        """Classification input for the reliable RPC layer's deadline
+        verdict: the detector's status when it has one, else whether a
+        connection was EVER established — a peer refusing every dial is
+        'never-connected' (Unreachable), not a timeout."""
+        status = self.node_status.get(node)
+        if status is not None:
+            return status
+        peer = self.peers.get(node)
+        if peer is None or peer.sock is None:
+            return "never-connected"
+        return "up"
+
+    def rpc_invalidate_peer(self, node: str) -> None:
+        """Reconnect-aware retry: when the failure detector holds the
+        peer suspect/down (or the connection already broke), drop the
+        cached socket and clear the redial backoff so the next attempt
+        dials fresh — a one-shot send into a half-dead socket is
+        exactly the silent loss this layer exists to prevent."""
+        peer = self.peers.get(node)
+        if peer is None:
+            return
+        if self.node_status.get(node) in ("suspect", "down") or \
+                peer.status == "disconnected":
+            self._close_peer(peer)
+            peer.last_attempt = 0.0
+
+    def _rpc_execute(self, req, done) -> bool:
+        """RpcReceiver's executor: hand the op to the local RaNode's
+        control plane; False when that node is not hosted here (the
+        receiver answers 'retryable' — a restarting worker may register
+        it shortly)."""
+        node = self.nodes.get(req.node)
+        if node is None:
+            return False
+        return node.deliver(ServerId(NODE_SCOPE, req.node),
+                            NodeControlEvent(req.op, dict(req.args),
+                                             from_=done))
+
+    def _rpc_respond(self, req, resp) -> None:
+        """Route a response back to the request's origin (loopback
+        resolves the local pending future directly)."""
+        origin = tuple(req.origin)
+        if req.origin_router == self._router_id or \
+                origin == tuple(self.listen_addr):
+            with self._call_lock:
+                fut = self._rpc_pending.pop(resp.rid, None)
+            if fut is not None:
+                fut.set(resp)
+            return
+        self._queue_to_addr(origin, ("__rpc_resp__", resp))
+
+    # ------------------------------------------------------------------
+    # fault injection (FaultPlan hooks)
+    # ------------------------------------------------------------------
+
+    def _fault_peer_name(self, peer: _Peer) -> str:
+        """Resolve reply-path peers (named addr:host:port) back to the
+        node name the FaultPlan keys on, when the address book knows
+        it."""
+        if not peer.name.startswith("addr:"):
+            return peer.name
+        addr = tuple(peer.addr)
+        for node, book_addr in self.address_book.items():
+            if tuple(book_addr) == addr:
+                return node
+        return peer.name
+
+    @staticmethod
+    def _item_class(item) -> str:
+        to = item[0]
+        return {"__reply__": "reply", "__notify__": "notify",
+                "__rpc_req__": "rpc_req",
+                "__rpc_resp__": "rpc_resp"}.get(to, "msg")
+
+    def _apply_send_faults(self, plan, peer: _Peer, items: list) -> list:
+        """Filter one send batch through the plan: drops vanish (and
+        count), delays re-queue exempt after a timer, duplicates send
+        twice, reorders move behind the rest of the batch.  Held items
+        (already-delayed) pass through untouched."""
+        fault_peer = self._fault_peer_name(peer)
+        out: list = []
+        tail: list = []
+        for item in items:
+            if isinstance(item, _FaultHeld):
+                out.append(item.item)
+                continue
+            d = plan.decide(fault_peer, self._item_class(item), "send")
+            if d.action == "drop":
+                self.dropped_sends += 1
+                continue
+            if d.delay_s > 0:
+                t = threading.Timer(d.delay_s, self._requeue_held,
+                                    args=(peer, item))
+                t.daemon = True
+                t.start()
+                continue
+            if d.reorder:
+                tail.append(item)
+                continue
+            out.append(item)
+            if d.duplicate:
+                out.append(item)
+        return out + tail
+
+    def _requeue_held(self, peer: _Peer, item) -> None:
+        try:
+            peer.queue.put_nowait(_FaultHeld(item))
+        except queue.Full:
+            self.dropped_sends += 1
+            return
+        self._ensure_sender(peer)
+
     def _addr_blocked(self, origin: tuple) -> bool:
         """True when the node listening at ``origin`` is partitioned off
         (replies/notifies must not tunnel through a blocked link)."""
@@ -366,6 +601,24 @@ class TcpRouter(LocalRouter):
                 return node in self.blocked_nodes
         return False
 
+    def _queue_to_addr(self, origin: tuple, item: tuple) -> None:
+        """Shared addr-keyed return routing for replies, notifies and
+        RPC responses: lazily build the addr peer, enqueue nonblocking
+        with drop accounting, honor partitions."""
+        if self._addr_blocked(origin):
+            self.dropped_sends += 1
+            return
+        peer = self._addr_peers.get(origin)
+        if peer is None:
+            peer = self._addr_peers.setdefault(
+                origin, _Peer(f"addr:{origin[0]}:{origin[1]}", origin))
+        try:
+            peer.queue.put_nowait(item)
+        except queue.Full:
+            self.dropped_sends += 1
+            return
+        self._ensure_sender(peer)
+
     def reply_remote(self, handle: tuple, msg) -> None:
         _tag, origin, call_id = handle
         origin = tuple(origin)
@@ -375,19 +628,7 @@ class TcpRouter(LocalRouter):
             if fut is not None:
                 fut.set(msg)
             return
-        if self._addr_blocked(origin):
-            self.dropped_sends += 1
-            return
-        peer = self._addr_peers.get(origin)
-        if peer is None:
-            peer = self._addr_peers.setdefault(
-                origin, _Peer(f"addr:{origin[0]}:{origin[1]}", origin))
-        try:
-            peer.queue.put_nowait(("__reply__", (call_id, msg)))
-        except queue.Full:
-            self.dropped_sends += 1
-            return
-        self._ensure_sender(peer)
+        self._queue_to_addr(origin, ("__reply__", (call_id, msg)))
 
     def notify_remote(self, handle: tuple, correlations) -> None:
         """Route an applied-notification batch back to the host that
@@ -399,19 +640,7 @@ class TcpRouter(LocalRouter):
             if fn is not None:
                 fn(correlations)
             return
-        if self._addr_blocked(origin):
-            self.dropped_sends += 1
-            return
-        peer = self._addr_peers.get(origin)
-        if peer is None:
-            peer = self._addr_peers.setdefault(
-                origin, _Peer(f"addr:{origin[0]}:{origin[1]}", origin))
-        try:
-            peer.queue.put_nowait(("__notify__", (nid, correlations)))
-        except queue.Full:
-            self.dropped_sends += 1
-            return
-        self._ensure_sender(peer)
+        self._queue_to_addr(origin, ("__notify__", (nid, correlations)))
 
     # ------------------------------------------------------------------
     # receive path
@@ -442,22 +671,43 @@ class TcpRouter(LocalRouter):
                 if frame is None:
                     break
                 kind = frame[0]
+                plan = self.fault_plan
                 if kind == FRAME_HELLO:
                     remote_names = frame[1:].decode().split(",")
                     for name in remote_names:
-                        if name not in self.blocked_nodes:
-                            self._mark_heard(name)
+                        if name in self.blocked_nodes:
+                            continue
+                        if plan is not None and \
+                                name in plan.partitioned:
+                            # a partitioned peer must stay silent: its
+                            # redial handshake cannot reset last_heard
+                            # or the down verdict would oscillate
+                            continue
+                        self._mark_heard(name)
                     continue
                 if remote_names and \
                         all(n in self.blocked_nodes for n in remote_names):
                     continue  # partitioned: total inbound silence
+                if plan is not None:
+                    # recv side honors drop/partition only; delay/dup/
+                    # reorder are send-side faults (one injection point
+                    # per fault kind keeps schedules interpretable),
+                    # and un-honorable decisions must not spend the
+                    # spec's limit or counters
+                    pname = plan.recv_peer(remote_names)
+                    cls = _FRAME_CLASS.get(kind, "msg")
+                    if plan.decide(pname, cls, "recv",
+                                   honor=_DROP_ONLY).action == "drop":
+                        continue
+                # any delivered frame proves the connection's unblocked
+                # hosts alive (hoisted: every frame kind counts)
+                for name in remote_names:
+                    if name not in self.blocked_nodes:
+                        self._mark_heard(name)
                 if kind == FRAME_MSG:
                     to, src, msg = pickle.loads(frame[1:])
                     if src in self.blocked_nodes:
                         continue  # per-source drop (co-hosted routers)
-                    for name in remote_names:
-                        if name not in self.blocked_nodes:
-                            self._mark_heard(name)
                     node = self.nodes.get(to.node)
                     if node is not None:
                         node.deliver(to, msg)
@@ -472,10 +722,17 @@ class TcpRouter(LocalRouter):
                     fn = self._notify_handles.get(nid)
                     if fn is not None:
                         fn(correlations)
-                elif kind == FRAME_PING:
-                    for name in remote_names:
-                        if name not in self.blocked_nodes:
-                            self._mark_heard(name)
+                elif kind == FRAME_RPC_REQ:
+                    req = pickle.loads(frame[1:])
+                    self._rpc_receiver.handle(
+                        req,
+                        lambda resp, _r=req: self._rpc_respond(_r, resp))
+                elif kind == FRAME_RPC_RESP:
+                    resp = pickle.loads(frame[1:])
+                    with self._call_lock:
+                        fut = self._rpc_pending.pop(resp.rid, None)
+                    if fut is not None:
+                        fut.set(resp)
         except (OSError, pickle.UnpicklingError, EOFError):
             pass
         finally:
@@ -500,36 +757,58 @@ class TcpRouter(LocalRouter):
 
     def _mark_heard(self, node: str) -> None:
         self.last_heard[node] = time.monotonic()
-        if self.node_status.get(node) == "down":
+        status = self.node_status.get(node)
+        if status == "down":
             self.node_status[node] = "up"
             self._broadcast_node_event(node, "up")
         else:
-            self.node_status.setdefault(node, "up")
+            # also clears "suspect" silently — only the down->up edge
+            # is a NodeEvent (aten emits verdicts, not hunches)
+            self.node_status[node] = "up"
 
     def _detector_loop(self) -> None:
         while not self._stop:
             time.sleep(PING_INTERVAL)
             now = time.monotonic()
-            # ping every peer we have a live connection to
-            for peer in list(self.peers.values()):
+            # ping every peer we have a live connection to — including
+            # addr-keyed reply links: a member-less client learns the
+            # server's liveness only through them, and without pings a
+            # verb slower than DOWN_AFTER would decay the caller's view
+            # of a healthy, still-executing peer to down
+            for peer in list(self.peers.values()) + \
+                    list(self._addr_peers.values()):
                 if peer.name in self.blocked_nodes:
                     continue
                 sock = peer.sock
                 if sock is not None:
+                    plan = self.fault_plan
+                    if plan is not None and plan.decide(
+                            peer.name, "ping", "send",
+                            honor=_DROP_ONLY).action == "drop":
+                        continue  # injected ping loss
                     try:
                         frame = bytes([FRAME_PING])
                         with peer.send_lock:
                             sock.sendall(_LEN.pack(len(frame)) + frame)
                     except OSError:
                         self._close_peer(peer)
-            # verdicts
+            # verdicts: up -> suspect (RPC retries stop trusting the
+            # cached conn) -> down (NodeEvent broadcast + conn closed,
+            # so the next send must redial rather than vanish into a
+            # half-dead socket)
             for node, heard in list(self.last_heard.items()):
                 if node in self.nodes:
                     continue
                 status = self.node_status.get(node, "up")
-                if status != "down" and now - heard > DOWN_AFTER:
+                silent = now - heard
+                if status != "down" and silent > DOWN_AFTER:
                     self.node_status[node] = "down"
+                    peer = self.peers.get(node)
+                    if peer is not None:
+                        self._close_peer(peer)
                     self._broadcast_node_event(node, "down")
+                elif status == "up" and silent > SUSPECT_AFTER:
+                    self.node_status[node] = "suspect"
 
     def _broadcast_node_event(self, node: str, status: str) -> None:
         evt = NodeEvent(node, status)
@@ -549,9 +828,13 @@ class TcpRouter(LocalRouter):
             self._close_peer(peer)
 
     def overview(self) -> dict:
-        return {
+        out = {
             "listen": self.listen_addr,
             "dropped_sends": self.dropped_sends,
             "peers": {p.name: p.status for p in self.peers.values()},
             "node_status": dict(self.node_status),
+            "rpc": self._rpc_receiver.overview(),
         }
+        if self.fault_plan is not None:
+            out["faults"] = self.fault_plan.overview()
+        return out

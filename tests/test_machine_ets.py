@@ -34,6 +34,71 @@ def test_registry_is_idempotent_and_deletable():
     machine_ets.delete_table("t_reg")  # no-op
 
 
+def test_uid_scoped_tables_do_not_alias_across_clusters():
+    """Two co-hosted clusters picking the same table NAME get distinct
+    tables when scoped by server uid; the bare name stays the shared
+    process-global table (compatibility shim)."""
+    machine_ets.delete_table("dup_name")
+    try:
+        a = machine_ets.create_table("dup_name", scope="uid_a")
+        b = machine_ets.create_table("dup_name", scope="uid_b")
+        shared = machine_ets.create_table("dup_name")
+        assert a is not b and a is not shared
+        a["k"] = "from_a"
+        assert "k" not in b and "k" not in shared
+        # idempotent per scope
+        assert machine_ets.create_table("dup_name", scope="uid_a") is a
+        assert machine_ets.which_tables("uid_a") == ("dup_name",)
+        # drop_scope wipes ONLY that scope (the force-delete footprint)
+        machine_ets.drop_scope("uid_a")
+        assert machine_ets.which_tables("uid_a") == ()
+        assert machine_ets.create_table("dup_name", scope="uid_b") is b
+    finally:
+        machine_ets.delete_table("dup_name")
+        machine_ets.drop_scope("uid_a")
+        machine_ets.drop_scope("uid_b")
+
+
+def test_force_delete_drops_uid_scoped_tables():
+    """force_delete_server wipes the member's uid-scoped side tables
+    with the rest of its footprint; plain stop does not."""
+
+    class ScopedMachine(Machine):
+        def init(self, config):
+            self._uid = config["uid"]
+            machine_ets.create_table("scoped_idx", scope=self._uid)
+            return 0
+
+        def apply(self, meta, command, state):
+            tab = machine_ets.create_table("scoped_idx",
+                                           scope=self._uid)
+            tab[meta.index] = command
+            return state + 1, state + 1
+
+    router = LocalRouter()
+    sids = [ServerId(f"sc{i}", f"scn{i}") for i in (1, 2, 3)]
+    nodes = {s.node: RaNode(s.node, router=router) for s in sids}
+    try:
+        ra_tpu.start_cluster("ets2", ScopedMachine, sids, router=router,
+                             election_timeout_ms=300, tick_interval_ms=50)
+        leader = await_leader(router, sids)
+        for i in range(3):
+            ra_tpu.process_command(leader, f"v{i}", router=router)
+        victim = next(s for s in sids if s != leader)
+        uid = nodes[victim.node].shells[victim.name].server.cfg.uid
+        assert machine_ets.which_tables(uid) == ("scoped_idx",)
+        # graceful stop keeps the table (the service's whole point)
+        ra_tpu.stop_server(victim, router=router)
+        assert machine_ets.which_tables(uid) == ("scoped_idx",)
+        ra_tpu.restart_server(victim, router=router)
+        # force-delete wipes it
+        ra_tpu.force_delete_server(victim, router=router)
+        assert machine_ets.which_tables(uid) == ()
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
 def test_side_table_survives_member_restart():
     machine_ets.delete_table("idx_table")
     router = LocalRouter()

@@ -1,0 +1,258 @@
+"""Reliable control-plane RPC under seeded transport faults (ISSUE 2).
+
+Drives node_call through FaultPlans — drop / delay / duplicate /
+partition / forced reconnect — over REAL sockets (in-process TcpRouter
+pairs), proving:
+
+* retries + receiver-side dedup give every lifecycle verb at-most-once
+  execution no matter how many attempts the wire forced (the
+  rpc:call-over-distribution contract, ra_server_sup_sup.erl:42-130)
+* failures surface as the typed triad (Unreachable / RpcTimeout /
+  RemoteError) instead of a silent hang
+* the SAME FaultPlan seed replays the same fault schedule, and Raft
+  data traffic keeps committing through seeded message drops (the
+  wire counterpart of tests/test_engine_chaos.py)
+"""
+import time
+
+import pytest
+
+import ra_tpu
+from ra_tpu.core.machine import SimpleMachine
+from ra_tpu.core.types import ServerId
+from ra_tpu.machines import machine_spec, register_machine
+from ra_tpu.node import RaNode
+from ra_tpu.transport.rpc import (
+    FaultPlan,
+    FaultSpec,
+    RpcTimeout,
+    Unreachable,
+)
+from ra_tpu.transport.tcp import TcpRouter
+
+register_machine("rpcfaults",
+                 lambda: SimpleMachine(lambda c, s: s + c, 0))
+
+
+@pytest.fixture
+def pair():
+    """A server router hosting one RaNode + a member-less client router
+    that reaches it over real sockets."""
+    server = TcpRouter(("127.0.0.1", 0), {})
+    node = RaNode("fn1", router=server)
+    client = TcpRouter(("127.0.0.1", 0),
+                       {"fn1": server.listen_addr})
+    yield client, server, node
+    node.stop()
+    client.stop()
+    server.stop()
+
+
+def test_fault_plan_is_deterministic():
+    """Two plans with one seed replay identical decisions per stream;
+    a different seed diverges; streams are isolated (draws on one never
+    shift another)."""
+    spec = FaultSpec(drop=0.3, delay=0.2, duplicate=0.2, reorder=0.1)
+    a = FaultPlan(42, default=spec)
+    b = FaultPlan(42, default=spec)
+    seq_a = [a.decide("p1", "msg") for _ in range(50)]
+    # interleave a second stream on plan b only: per-stream RNGs mean
+    # p1's schedule must not move
+    seq_b = []
+    for _ in range(50):
+        b.decide("p2", "rpc_req")
+        seq_b.append(b.decide("p1", "msg"))
+    assert seq_a == seq_b
+    c = FaultPlan(43, default=spec)
+    assert seq_a != [c.decide("p1", "msg") for _ in range(50)]
+
+
+def test_fault_spec_limit_bounds_injections():
+    plan = FaultPlan(1, by_class={"rpc_resp": FaultSpec(drop=1.0,
+                                                        limit=2)})
+    acts = [plan.decide("p", "rpc_resp").action for _ in range(5)]
+    assert acts == ["drop", "drop", "deliver", "deliver", "deliver"]
+    # other classes untouched
+    assert plan.decide("p", "msg").action == "deliver"
+
+
+def test_node_call_completes_under_mixed_chaos(pair):
+    """20%% drop + delay + duplicate on every stream: every call still
+    completes, and the plan's injection counters prove faults fired."""
+    client, server, _node = pair
+    plan = FaultPlan(7, default=FaultSpec(drop=0.2, delay=0.1,
+                                          duplicate=0.1,
+                                          delay_ms=(1, 10)))
+    client.set_fault_plan(plan)
+    for _ in range(10):
+        assert ra_tpu.node_call("fn1", "ping", {}, router=client,
+                                timeout=30) == ("pong", "fn1")
+    assert client.rpc_counters["rpc_calls"] == 10
+    assert sum(plan.counters.values()) > 0, plan.overview()
+    # retries happened iff the schedule hit an rpc frame; with seed 7
+    # it does (verified: 2 retries, 3 drops) — pin that it recovered
+    assert client.rpc_counters["rpc_retries"] >= 1
+    assert client.rpc_counters["rpc_timeouts"] == 0
+
+
+def test_lifecycle_verbs_exactly_once_under_drop_and_reconnect(pair):
+    """ISSUE 2 acceptance: a seeded 20%% drop plan + one forced peer
+    reconnect + a guaranteed first-response loss; every lifecycle verb
+    completes and the receiver's executed/dedup counters prove no verb
+    ran twice."""
+    client, server, node = pair
+    sid = ServerId("m1", "fn1")
+    plan = FaultPlan(
+        11,
+        default=FaultSpec(drop=0.2),
+        # force at least one retry/dedup cycle: the first response
+        # frame the client sees is dropped, so the sender MUST retry
+        # and the receiver MUST answer from its dedup cache
+        by_class={"rpc_resp": FaultSpec(drop=1.0, limit=1)})
+    client.set_fault_plan(plan)
+    executed0 = server.rpc_counters["rpc_requests_executed"]
+
+    started = ra_tpu.start_server("fc", machine_spec("rpcfaults"),
+                                  sid, [sid], router=client)
+    assert tuple(started) == tuple(sid)
+    assert ra_tpu.restart_server(sid, router=client) is not None
+    # forced reconnect: the cached connection dies mid-sequence (the
+    # peer-restart shape); the next verb must redial and continue
+    peer = client.peers.get("fn1")
+    assert peer is not None
+    client._close_peer(peer)
+    ra_tpu.stop_server(sid, router=client)
+    assert node.shells.get("m1") is None
+    assert ra_tpu.restart_server(sid, router=client) is not None
+    assert node.shells.get("m1") is not None
+    ra_tpu.force_delete_server(sid, router=client)
+    assert node.shells.get("m1") is None
+    with pytest.raises(RuntimeError, match="not_found"):
+        ra_tpu.restart_server(sid, router=client)
+
+    # exactly-once: 6 verbs arrived at the executor exactly 6 times,
+    # however many wire attempts the drops forced
+    executed = server.rpc_counters["rpc_requests_executed"] - executed0
+    assert executed == 6, server.rpc_counters
+    # the forced response loss produced a retry answered from cache
+    assert client.rpc_counters["rpc_retries"] >= 1
+    assert server.rpc_counters["rpc_dedup_hits"] >= 1
+    assert server.rpc_counters["rpc_responses_resent"] >= 1
+
+
+def test_duplicate_requests_execute_once(pair):
+    """Every request frame duplicated on the wire: the dedup cache maps
+    the twin onto the original — executions == calls, dedup hits count
+    the twins."""
+    client, server, _node = pair
+    client.set_fault_plan(FaultPlan(
+        5, by_class={"rpc_req": FaultSpec(duplicate=1.0)}))
+    executed0 = server.rpc_counters["rpc_requests_executed"]
+    dedup0 = server.rpc_counters["rpc_dedup_hits"]
+    for _ in range(5):
+        assert ra_tpu.node_call("fn1", "ping", {}, router=client,
+                                timeout=30) == ("pong", "fn1")
+    assert server.rpc_counters["rpc_requests_executed"] - executed0 == 5
+    assert server.rpc_counters["rpc_dedup_hits"] - dedup0 >= 5
+
+
+def test_partition_unreachable_then_heal(pair):
+    """A plan-level partition goes dark both ways: the detector rules
+    the peer down and node_call surfaces Unreachable (not a 60s hang);
+    healing restores service on the SAME router."""
+    client, server, _node = pair
+    assert ra_tpu.node_call("fn1", "ping", {}, router=client,
+                            timeout=10) == ("pong", "fn1")
+    plan = FaultPlan(3)
+    client.set_fault_plan(plan)
+    plan.partition("fn1")
+    t0 = time.monotonic()
+    with pytest.raises(Unreachable):
+        ra_tpu.node_call("fn1", "ping", {}, router=client, timeout=4)
+    assert time.monotonic() - t0 < 6
+    assert client.rpc_counters["rpc_unreachable"] == 1
+    plan.heal()
+    assert ra_tpu.node_call("fn1", "ping", {}, router=client,
+                            timeout=15) == ("pong", "fn1")
+
+
+def test_timeout_when_peer_alive_but_unresponsive(pair):
+    """The server's recv path eats every request while the connection
+    stays healthy: the deadline surfaces RpcTimeout (reachable but
+    unanswered), not Unreachable."""
+    client, server, _node = pair
+    server.set_fault_plan(FaultPlan(
+        9, by_class={"rpc_req": FaultSpec(drop=1.0)}))
+    with pytest.raises(RpcTimeout):
+        ra_tpu.node_call("fn1", "ping", {}, router=client, timeout=0.6)
+    assert client.rpc_counters["rpc_timeouts"] == 1
+    assert client.rpc_counters["rpc_retries"] >= 1
+
+
+def test_unknown_node_is_unreachable_immediately(pair):
+    client, _server, _node = pair
+    t0 = time.monotonic()
+    with pytest.raises(Unreachable, match="address book"):
+        ra_tpu.node_call("ghost", "ping", {}, router=client, timeout=30)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_local_router_has_no_remote_reach():
+    from ra_tpu.node import LocalRouter
+    with pytest.raises(Unreachable, match="no RPC transport"):
+        ra_tpu.node_call("nowhere", "ping", {}, router=LocalRouter(),
+                         timeout=5)
+
+
+def test_raft_traffic_survives_seeded_message_drops(tmp_path):
+    """The data plane under the same FaultPlan machinery: a 3-member
+    cluster across three in-process TcpRouters (formed OVER the
+    reliable control plane) keeps committing through seeded 10%% drops
+    of Raft msg frames on every router — pipeline catch-up recovers
+    what the plan eats, exactly the drop-tolerance contract the
+    reliable layer does NOT need for data traffic."""
+    names = ["fr1", "fr2", "fr3"]
+    routers: dict = {}
+    nodes: dict = {}
+    try:
+        for n in names:
+            routers[n] = TcpRouter(("127.0.0.1", 0), {})
+        books = {n: {m: routers[m].listen_addr for m in names if m != n}
+                 for n in names}
+        for n in names:
+            routers[n].address_book.update(books[n])
+            nodes[n] = RaNode(n, router=routers[n])
+        sids = [ServerId(f"m_{n}", n) for n in names]
+        # start_cluster from fr1's router: fr2/fr3 members start over
+        # the reliable RPC control plane (machine specs resolve there)
+        started = ra_tpu.start_cluster(
+            "fchaos", machine_spec("rpcfaults"), sids,
+            router=routers["fr1"], election_timeout_ms=200,
+            tick_interval_ms=100)
+        assert set(started) == set(sids)
+        for n in names:
+            routers[n].set_fault_plan(FaultPlan(
+                17, by_class={"msg": FaultSpec(drop=0.1)}))
+        total = 0
+        deadline = time.monotonic() + 90
+        sent = 0
+        while sent < 15 and time.monotonic() < deadline:
+            try:
+                r = ra_tpu.process_command(sids[0], 1,
+                                           router=routers["fr1"],
+                                           timeout=15)
+            except (TimeoutError, RuntimeError):
+                continue
+            total = r.reply
+            sent += 1
+        assert sent == 15, (sent, total)
+        assert total == 15
+        # every plan injected something — the run really was degraded
+        assert any(routers[n].fault_plan.counters.get("drop", 0) > 0
+                   for n in names)
+    finally:
+        for n in names:
+            if n in nodes:
+                nodes[n].stop()
+            if n in routers:
+                routers[n].stop()

@@ -53,6 +53,42 @@ def test_checker_detects_each_rule(tmp_path):
     assert "'sys' imported but unused" not in out
 
 
+def test_checker_forbids_one_shot_sends_in_lifecycle_verbs(tmp_path):
+    """RA01: api-layer lifecycle verbs must ride the reliable RPC layer
+    (transport/rpc.py) — a direct router.send/remote_call from one is
+    the silent-loss race ISSUE 2 removed.  Applies to files named
+    api.py only; non-lifecycle functions keep their one-shot sends."""
+    bad = tmp_path / "api.py"
+    bad.write_text(textwrap.dedent("""\
+        def stop_server(server_id, router):
+            router.send("?", server_id, object())
+
+        def restart_server(server_id, router):
+            return router.remote_call(server_id, object())
+
+        def trigger_election(server_id, router):
+            router.send("?", server_id, object())  # not a lifecycle verb
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA01") == 2, r.stdout
+    assert "stop_server" in r.stdout and "restart_server" in r.stdout
+    assert "trigger_election" not in r.stdout
+    # the same content under another module name is not gated
+    other = tmp_path / "helpers.py"
+    other.write_text(bad.read_text())
+    r = run_lint(str(other))
+    assert "RA01" not in r.stdout
+
+
+def test_api_module_is_ra01_clean():
+    """The real api.py passes the lifecycle-RPC gate (covered by the
+    repo-wide run too; pinned separately so a regression names the
+    rule)."""
+    r = run_lint(os.path.join(REPO, "ra_tpu", "api.py"))
+    assert "RA01" not in r.stdout, r.stdout
+
+
 def test_checker_false_positive_guards(tmp_path):
     ok = tmp_path / "ok.py"
     ok.write_text(textwrap.dedent("""\

@@ -18,6 +18,11 @@ Checks (cheap, high-signal, zero-config):
   F811          redefinition of a function/class in the same scope
                 (property setters/overloads exempt)
   W101          unreachable statement after return/raise/break/continue
+  RA01          (api.py only) node-lifecycle verbs must ride the
+                reliable control-plane RPC layer (transport/rpc.py):
+                a direct one-shot `.send(...)`/`.remote_call(...)`
+                inside a lifecycle function is the silent-loss bug
+                class ISSUE 2 removed — route through node_call
 
 Usage: ``python tools/lint.py [paths...]`` (defaults to the repo's
 source roots).  Exits nonzero with one line per finding.
@@ -62,6 +67,34 @@ def _decorator_exempts_redef(dec: ast.AST) -> bool:
 
 _TERMINAL = (ast.Return, ast.Raise, ast.Break, ast.Continue)
 
+#: api-layer node-LIFECYCLE verbs: cross-node start/restart/stop/delete
+#: must ride the reliable RPC layer (at-most-once retries, typed
+#: failures) — a raw one-shot transport call from any of these is the
+#: race that loses a control-plane call to a restarting peer
+_LIFECYCLE_VERBS = frozenset({
+    "node_call", "start_cluster", "start_server", "restart_server",
+    "stop_server", "force_delete_server",
+})
+_ONE_SHOT_SENDS = frozenset({"send", "remote_call"})
+
+
+def _check_lifecycle_rpc(tree: ast.Module, err) -> None:
+    """RA01: inside lifecycle verbs, forbid direct one-shot transport
+    calls (they must go through the reliable RPC layer)."""
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in _LIFECYCLE_VERBS:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in _ONE_SHOT_SENDS:
+                err(sub, "RA01",
+                    f"lifecycle verb {node.name}() uses one-shot "
+                    f".{sub.func.attr}(); route through the reliable "
+                    "RPC layer (transport/rpc.py)")
+
 
 def check_file(path: str) -> list:
     with open(path, encoding="utf-8") as f:
@@ -83,6 +116,9 @@ def check_file(path: str) -> list:
         line = getattr(node, "lineno", 0)
         if line not in noqa:
             errors.append(f"{path}:{line}: {code} {msg}")
+
+    if os.path.basename(path) == "api.py":
+        _check_lifecycle_rpc(tree, err)
 
     # -- F401: unused module-level imports ------------------------------
     if os.path.basename(path) != "__init__.py":
