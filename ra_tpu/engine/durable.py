@@ -1,25 +1,38 @@
-"""Durability bridge between the lockstep lane engine and the fan-in WAL.
+"""Durability bridge between the lockstep lane engine and the WAL plane.
 
 This closes the loop the engine docstring describes: in durable mode a
-step's accepted entries are pulled off-device (double-buffered — the aux
-readback of step N overlaps the dispatch of step N+1), encoded as ONE
-WAL record per step, and fed through :class:`ra_tpu.log.wal.Wal`.  The
-WAL's fsync confirm comes back as the ``confirm_upto`` input of a later
+step's accepted entries are compacted ON DEVICE to a dense row buffer
+(a prefix-sum gather over the per-lane accept counts — the readback
+carries only bytes that will hit disk), pulled off-device by per-shard
+encode workers (double-buffered: the readback of step N overlaps the
+dispatch of step N+1), encoded as one WAL record per step per shard,
+and fed through S independent :class:`ra_tpu.log.wal.Wal` shards — each
+with its own file, writer thread and fsync, running the adaptive
+group-commit policy (one fdatasync per group).  Every shard's fsync
+confirm comes back as a slice of the ``confirm_upto`` input of a later
 step, so ``last_written`` — and therefore the commit quorum — advances
 only over entries that are really on disk.  This is the engine-scale
 version of the reference's written-event protocol: an entry only counts
 toward the commit median after write(2)+fsync
-(/root/reference/src/ra_log_wal.erl:753-800), and the batch unit is the
-device step — the fan-in batching axis of SURVEY.md §2.4 (one WAL batch
-= one XLA dispatch worth of appends for ALL co-hosted clusters).
+(/root/reference/src/ra_log_wal.erl:753-800), with the single fan-in
+writer multiplied across cores — the fan-in batching axis of SURVEY.md
+§2.4 extended the way partitioned-serialization-point Raft variants
+split their log pipeline.
 
-Record format (one WAL payload per step, uid ``__engine__``):
+Record format (one WAL payload per step per shard, uid ``__engine__``):
 
-  magic "RTB1"(4) | n_lanes:u32 | C:u32 | dtype:8s | n_flat:u32
-  hi:    i32[N]   leader tail after the step (per lane)
+  RTB1:  magic(4) | n_lanes:u32 | C:u32 | dtype:8s | n_flat:u32
+  RTB2:  magic(4) | n_lanes:u32 | C:u32 | dtype:8s | n_flat:u32 | lane_lo:u32
+  hi:    i32[N]   leader tail after the step (per lane of the slice)
   n_app: i32[N]   entries appended this step (accepted cmds + noop)
   n_acc: i32[N]   how many of those came from the host batch
   flat:  [n_flat, C] the accepted host rows, lane-major
+
+RTB1 is the lane_lo=0 form — byte-identical to the pre-sharding format,
+which is what ``wal_shards=1`` emits (the default-compatible path).
+Shards at a nonzero lane offset emit RTB2; blocks therefore fully
+self-describe their lane slice and recovery can merge ANY mix of shard
+layouts found on disk (a shard-count change needs no migration step).
 
 ``hi - n_app`` is the step's append base; a base below the running tail
 records an election truncation (a deposed leader's unconfirmed suffix),
@@ -28,16 +41,18 @@ exactly the overwrite-invalidates-higher-indexes rule of WAL recovery
 Entries between ``n_acc`` and ``n_app`` are the term-opening noop
 (all-zero payload, the machine-noop encoding).
 
-Recovery (:func:`open_engine`) restores the latest checkpoint, scans the
-surviving WAL files, resolves truncations into the final per-lane logs,
-and replays them through the jitted step — machine state is recomputed
-by the same apply fold that produced it.  A crash (kill -9) therefore
-loses nothing that was ever reported committed: commits gate on
-confirms, and confirmed records are on disk by definition.
+Recovery (:func:`open_engine`) restores the latest checkpoint, scans
+every surviving WAL shard (plus foreign-layout leftovers), stitches the
+per-slice pieces into full-lane step blocks — lanes whose shard crashed
+before recording a step carry their tail forward, which is safe because
+the merged per-lane confirm rule means nothing beyond a shard's last
+record was ever reported committed — resolves truncations, and replays
+through the jitted step.  A crash (kill -9) therefore loses nothing
+that was ever reported committed.
 
-Checkpointing (:meth:`EngineDurability.checkpoint`) quiesces the WAL,
-snapshots the full lane state via ``engine.save`` (atomic .npz), and
-prunes WAL files whose records the checkpoint covers — the
+Checkpointing (:meth:`EngineDurability.checkpoint`) quiesces all
+shards, snapshots the full lane state via ``engine.save`` (atomic
+.npz), and prunes WAL files whose records the checkpoint covers — the
 release_cursor/snapshot-truncation role of ra_snapshot.erl at the
 engine scale.
 """
@@ -53,21 +68,32 @@ from typing import Optional
 
 import numpy as np
 
-from ..log.wal import Wal, WalDown
+from .. import trace
+from ..log.wal import Wal, WalDown, scan_wal_file
+from ..metrics import ENGINE_WAL_FIELDS
 
 UID = "__engine__"
 MAGIC = b"RTB1"
+MAGIC2 = b"RTB2"          # RTB1 + lane_lo:u32 (sharded lane slice)
 _BLK = struct.Struct("<4sII8sI")
+_BLK2 = struct.Struct("<4sII8sII")
 
 
-def encode_block(hi: np.ndarray, n_app: np.ndarray, n_acc: np.ndarray,
-                 payload_host: np.ndarray) -> bytes:
-    """Encode one step's append outcome as a single WAL payload."""
-    N, K, C = payload_host.shape
-    mask = np.arange(K)[None, :] < n_acc[:, None]
-    flat = np.ascontiguousarray(payload_host[mask])
-    dt = np.dtype(payload_host.dtype).str.encode().ljust(8, b"\x00")
-    head = _BLK.pack(MAGIC, N, C, dt, flat.shape[0])
+def encode_block_flat(hi: np.ndarray, n_app: np.ndarray, n_acc: np.ndarray,
+                      flat: np.ndarray, lane_lo: int = 0) -> bytes:
+    """Encode one step's append outcome for a lane slice from the
+    already-compacted accepted rows (lane-major).  ``lane_lo == 0``
+    emits the legacy RTB1 bytes; a sharded slice carries its offset."""
+    n = hi.shape[0]
+    flat = np.ascontiguousarray(flat)
+    if flat.ndim != 2:
+        flat = flat.reshape(flat.shape[0], -1)
+    c = flat.shape[1]
+    dt = np.dtype(flat.dtype).str.encode().ljust(8, b"\x00")
+    if lane_lo:
+        head = _BLK2.pack(MAGIC2, n, c, dt, flat.shape[0], lane_lo)
+    else:
+        head = _BLK.pack(MAGIC, n, c, dt, flat.shape[0])
     return b"".join((head,
                      np.ascontiguousarray(hi, "<i4").tobytes(),
                      np.ascontiguousarray(n_app, "<i4").tobytes(),
@@ -75,14 +101,31 @@ def encode_block(hi: np.ndarray, n_app: np.ndarray, n_acc: np.ndarray,
                      flat.tobytes()))
 
 
+def encode_block(hi: np.ndarray, n_app: np.ndarray, n_acc: np.ndarray,
+                 payload_host: np.ndarray) -> bytes:
+    """Legacy host-side path: mask the accepted rows out of the full
+    [N, K, C] batch, then encode.  Byte-identical to what the device
+    compaction path produces — kept for tests and offline tooling."""
+    _N, K, _C = payload_host.shape
+    mask = np.arange(K)[None, :] < n_acc[:, None]
+    return encode_block_flat(hi, n_app, n_acc, payload_host[mask])
+
+
 def decode_block(data: bytes):
-    """Inverse of :func:`encode_block` -> (hi, n_app, n_acc, rows) where
-    rows is [N, Kmax, C] with noop rows already zero-filled."""
-    magic, n, c, dt, n_flat = _BLK.unpack_from(data, 0)
-    if magic != MAGIC:
+    """Inverse of the encoders -> (lane_lo, hi, n_app, n_acc, rows)
+    where rows is [N, Kmax, C] for the block's lane slice with noop
+    rows already zero-filled."""
+    magic = data[:4]
+    if magic == MAGIC2:
+        _m, n, c, dt, n_flat, lane_lo = _BLK2.unpack_from(data, 0)
+        off = _BLK2.size
+    elif magic == MAGIC:
+        _m, n, c, dt, n_flat = _BLK.unpack_from(data, 0)
+        lane_lo = 0
+        off = _BLK.size
+    else:
         raise ValueError("bad engine block magic")
     dtype = np.dtype(dt.rstrip(b"\x00").decode())
-    off = _BLK.size
     hi = np.frombuffer(data, "<i4", n, off).astype(np.int32)
     off += 4 * n
     n_app = np.frombuffer(data, "<i4", n, off).astype(np.int32)
@@ -95,11 +138,11 @@ def decode_block(data: bytes):
     if kmax:
         mask = np.arange(kmax)[None, :] < n_acc[:, None]
         rows[mask] = flat
-    return hi, n_app, n_acc, rows
+    return lane_lo, hi, n_app, n_acc, rows
 
 
 class _WalFileRetirer:
-    """Duck-typed segment_writer for the engine's Wal: instead of
+    """Duck-typed segment_writer for an engine WAL shard: instead of
     flushing per-server memtables to segments, rolled WAL files are kept
     until a checkpoint covers their step range, then unlinked — the
     engine's .npz checkpoint plays the segment role (the WAL-file
@@ -138,52 +181,51 @@ class _WalFileRetirer:
             self._files = keep
 
 
-class EngineDurability:
-    """Host-side bridge: owns the engine's Wal, the inflight aux queue,
-    and the confirm feedback arrays."""
+class _WalShard:
+    """One WAL shard: a contiguous lane slice [lo, hi) with its own
+    file, writer thread and fsync, plus an encode worker that pulls the
+    device-compacted aux of queued steps to the host, encodes the WAL
+    block (CRC included) off the engine dispatch thread, and hands it to
+    this shard's fan-in Wal — so step N+1's XLA dispatch overlaps step
+    N's encode+write+fsync end to end."""
 
-    def __init__(self, data_dir: str, n_lanes: int, *, sync_mode: int = 1,
-                 write_strategy: str = "default", max_pending: int = 8,
-                 wal_max_size: int = 256 * 1024 * 1024) -> None:
-        os.makedirs(data_dir, exist_ok=True)
-        self.dir = data_dir
-        self.n_lanes = n_lanes
-        self.max_pending = max_pending
+    def __init__(self, bridge, idx: int, lo: int, hi: int,
+                 shard_dir: str, wal_kwargs: dict) -> None:
+        self.idx = idx
+        self.lo = lo
+        self.hi = hi
+        self.bridge = bridge
+        self.error: Optional[BaseException] = None
         self.retirer = _WalFileRetirer()
-        self.wal = Wal(data_dir, sync_mode=sync_mode,
-                       write_strategy=write_strategy,
-                       max_size=wal_max_size, segment_writer=self.retirer)
-        self.step_seq = 0
+        self.wal = Wal(shard_dir, segment_writer=self.retirer,
+                       **wal_kwargs)
         self.confirmed_step = 0
-        self.confirm_upto = np.zeros((n_lanes,), np.int32)
-        self._prev_hi = np.zeros((n_lanes,), np.int32)
-        self._appended: dict = {}     # step -> hi np[N] (until confirmed)
-        self._blocks: dict = {}       # step -> bytes   (until confirmed)
-        self._bases: dict = {}        # step -> base np[N] (until confirmed)
-        self._inflight: collections.deque = collections.deque()
-        self._cond = threading.Condition()
-        self._wal_generation = self.wal.generation
+        self.confirm_upto = np.zeros((hi - lo,), np.int32)
+        self._appended: dict = {}   # step -> hi np[N_s] (until confirmed)
+        self._blocks: dict = {}     # step -> bytes      (until confirmed)
+        self._bases: dict = {}      # step -> base np[N_s]
+        self._jobs: collections.deque = collections.deque()
+        self.unprocessed = 0
         self._resend_above: Optional[int] = None
+        self._generation = self.wal.generation
+        self._stop = False
         self.wal.register(UID, self._notify)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"ra-engine-wal-s{idx}")
+        self._thread.start()
 
-    def seed(self, prev_hi: np.ndarray, step_seq: int) -> None:
-        """Set the post-recovery baseline: everything up to ``prev_hi``
-        is durable and recorded through ``step_seq``."""
-        self._prev_hi = prev_hi.astype(np.int32).copy()
-        self.confirm_upto = prev_hi.astype(np.int32).copy()
-        self.step_seq = step_seq
-        self.confirmed_step = step_seq
-
-    # -- WAL confirm path (called from the WAL batch thread) ---------------
+    # -- WAL confirm path (called from this shard's WAL batch thread) ------
 
     def _notify(self, uid: str, lo: Optional[int], hi: int,
                 term: int) -> None:
-        with self._cond:
+        cond = self.bridge._cond
+        with cond:
             if lo is None:
                 # out-of-sequence signal: resend everything above hi on
-                # the host thread (ra_log_wal.erl:457-481)
+                # the encode worker (ra_log_wal.erl:457-481)
                 self._resend_above = hi
-                self._cond.notify_all()
+                cond.notify_all()
                 return
             if hi <= self.confirmed_step:
                 return
@@ -203,139 +245,453 @@ class EngineDurability:
                 del self._appended[s]
                 self._blocks.pop(s, None)
                 self._bases.pop(s, None)
-            self._cond.notify_all()
+            cond.notify_all()
 
-    # -- submit path (engine host thread) ----------------------------------
+    # -- encode worker ------------------------------------------------------
 
-    def submit(self, aux: dict, payload_host: np.ndarray) -> None:
-        """Queue step aux for WAL encoding; drains older steps (their
-        device values are ready by now — one step of overlap)."""
-        self._maybe_resend()
-        self._inflight.append((aux, payload_host))
-        while len(self._inflight) > 1:
-            self._drain_one()
+    def _run(self) -> None:
+        cond = self.bridge._cond
+        while True:
+            with cond:
+                cond.wait_for(
+                    lambda: self._stop or self._jobs
+                    or self._resend_above is not None,
+                    timeout=0.25)
+                if self._stop:
+                    return
+                job = self._jobs.popleft() if self._jobs else None
+            self._maybe_resend()
+            if job is None:
+                continue
+            try:
+                self._process(*job)
+            except Exception as exc:  # noqa: BLE001 — surfaced to callers
+                with cond:
+                    self.error = exc
+            finally:
+                with cond:
+                    self.unprocessed -= 1
+                    cond.notify_all()
 
-    def drain_all(self) -> None:
-        while self._inflight:
-            self._drain_one()
-
-    def _drain_one(self) -> None:
-        aux, ph = self._inflight.popleft()
-        hi = np.asarray(aux["appended_hi"]).astype(np.int32)
-        n_acc = np.asarray(aux["n_acc"]).astype(np.int32)
-        n_app = np.asarray(aux["n_app"]).astype(np.int32)
+    def _process(self, step: int, aux: dict) -> None:
+        lo, hi_l = self.lo, self.hi
+        with trace.span("wal.encode", "wal", shard=self.idx, step=step):
+            # documented readback point: this worker runs one step
+            # behind dispatch, so the device values are ready (or the
+            # pull overlaps the next dispatch) — RA02's allowlisted home
+            hi = np.asarray(aux["appended_hi"][lo:hi_l]).astype(np.int32)
+            n_app = np.asarray(aux["n_app"][lo:hi_l]).astype(np.int32)
+            n_acc = np.asarray(aux["n_acc"][lo:hi_l]).astype(np.int32)
+            # only this slice's row-offset boundary values are needed —
+            # pulling the full-N cumsum on every shard would duplicate
+            # the transfer S times
+            csum = np.asarray(aux["row_csum"][max(0, lo - 1):hi_l])
+            r0 = int(csum[0]) if lo else 0
+            r1 = int(csum[-1])
+            flat = np.asarray(aux["flat_rows"][r0:r1])
+            blk = encode_block_flat(hi, n_app, n_acc, flat, lane_lo=lo)
+        n_s = hi_l - lo
+        k = aux["flat_rows"].shape[0] // max(1, self.bridge.n_lanes)
+        item = flat.dtype.itemsize * (flat.shape[-1] if flat.ndim > 1
+                                      else 1)
         base = hi - n_app
-        blk = encode_block(hi, n_app, n_acc, ph)
-        self._prev_hi = hi
-        self.step_seq += 1
-        with self._cond:
-            self._appended[self.step_seq] = hi
-            self._blocks[self.step_seq] = blk
-            self._bases[self.step_seq] = base
+        cond = self.bridge._cond
+        with cond:
+            ctr = self.bridge.counters
+            ctr["readback_bytes"] += (hi.nbytes + n_app.nbytes +
+                                      n_acc.nbytes + csum.nbytes +
+                                      flat.nbytes)
+            # what the pre-compaction full-ring readback moved for the
+            # same step slice: the whole [N_s, K, C] host batch
+            ctr["readback_bytes_full"] += (hi.nbytes + n_app.nbytes +
+                                           n_acc.nbytes + n_s * k * item)
+            ctr["encoded_blocks"] += 1
+            ctr["encoded_bytes"] += len(blk)
+            self._appended[step] = hi
+            self._blocks[step] = blk
+            self._bases[step] = base
             # an election truncation reuses indexes: the durable horizon
             # drops to the step's base until this block itself confirms
             self.confirm_upto = np.minimum(self.confirm_upto, base)
-        self.wal.write(UID, self.step_seq, 1, blk)
+        try:
+            self.wal.write(UID, step, 1, blk)
+        except WalDown:
+            # block is recorded; the resend path replays it once the
+            # supervisor restarts this shard's WAL
+            pass
 
     def _maybe_resend(self) -> None:
         """After a WAL crash+restart (or an out-of-sequence signal),
-        resend every unconfirmed block above the WAL's durable horizon
+        resend every unconfirmed block above the shard's durable horizon
         (the resend_from protocol, ra_log.erl:778-793)."""
+        cond = self.bridge._cond
         resend_from = None
-        with self._cond:
+        with cond:
             if self._resend_above is not None:
                 resend_from = self._resend_above
                 self._resend_above = None
-        if self.wal.generation != self._wal_generation and self.wal.alive:
-            self._wal_generation = self.wal.generation
-            resend_from = self.confirmed_step
+        if self.wal.generation != self._generation and self.wal.alive:
+            self._generation = self.wal.generation
+            with cond:
+                resend_from = self.confirmed_step if resend_from is None \
+                    else min(resend_from, self.confirmed_step)
         if resend_from is None:
             return
-        with self._cond:
+        with cond:
             pending = sorted((s, b) for s, b in self._blocks.items()
                              if s > resend_from)
         for s, b in pending:
-            self.wal.write(UID, s, 1, b)
+            try:
+                self.wal.write(UID, s, 1, b)
+            except WalDown:
+                return
+
+    def stop(self) -> None:
+        with self.bridge._cond:
+            self._stop = True
+            self.bridge._cond.notify_all()
+        self._thread.join(timeout=5)
+
+
+class EngineDurability:
+    """Host-side bridge: owns the engine's sharded WAL plane (S lane
+    shards, each with its own file/writer/fsync and encode worker) and
+    the merged confirm feedback arrays."""
+
+    def __init__(self, data_dir: str, n_lanes: int, *, sync_mode: int = 1,
+                 write_strategy: str = "default", max_pending: int = 8,
+                 wal_max_size: int = 256 * 1024 * 1024,
+                 wal_shards: int = 1,
+                 wal_batch_bytes: int = 4 * 1024 * 1024,
+                 wal_batch_interval_ms: Optional[float] = None) -> None:
+        os.makedirs(data_dir, exist_ok=True)
+        if not 1 <= wal_shards <= n_lanes:
+            raise ValueError(
+                f"wal_shards must be in [1, n_lanes]; got {wal_shards}")
+        self.dir = data_dir
+        self.n_lanes = n_lanes
+        self.max_pending = max_pending
+        self.wal_shards = wal_shards
+        if wal_batch_interval_ms is None:
+            # default: no wait.  Group commit still emerges under load
+            # (the greedy drain batches every record queued behind the
+            # backpressure window); an explicit interval only pays off
+            # when the caller KNOWS records arrive faster than fsyncs
+            # complete — on boxes with slow/serializing fsync a forced
+            # wait just adds a per-step confirm-latency tax.
+            wal_batch_interval_ms = 0.0
+        self._cond = threading.Condition()
+        self.counters: dict = {f: 0 for f in ENGINE_WAL_FIELDS}
+        self.step_seq = 0
+        wal_kwargs = dict(sync_mode=sync_mode,
+                          write_strategy=write_strategy,
+                          max_size=wal_max_size,
+                          max_batch_bytes=wal_batch_bytes,
+                          max_batch_interval_ms=wal_batch_interval_ms)
+        bounds = [round(i * n_lanes / wal_shards)
+                  for i in range(wal_shards + 1)]
+        self._shards: list = []
+        own_dirs = set()
+        for i in range(wal_shards):
+            sdir = data_dir if wal_shards == 1 else \
+                os.path.join(data_dir, f"shard{i:02d}")
+            own_dirs.add(os.path.abspath(os.path.join(sdir, "wal")))
+            self._shards.append(
+                _WalShard(self, i, bounds[i], bounds[i + 1], sdir,
+                          wal_kwargs))
+        # foreign-layout recovery: wal dirs left by a run with a
+        # different shard count are scanned read-only here and their
+        # files retired at the first checkpoint — blocks self-describe
+        # their lane slice, so a shard-count change needs no migration.
+        # One table PER DIRECTORY: different shards reuse the same step
+        # index for different lane slices, so merging them into one
+        # idx-keyed table would trip the overwrite-dedup rule across
+        # slices and silently drop whole shards.
+        self._legacy_tables: list = []
+        self._legacy_files: list = []
+        for wdir in self._discover_wal_dirs(data_dir):
+            if os.path.abspath(wdir) in own_dirs:
+                continue
+            tables: dict = {}
+            for fname in sorted(os.listdir(wdir)):
+                if not fname.endswith(".wal"):
+                    continue
+                path = os.path.join(wdir, fname)
+                try:
+                    scan_wal_file(path, tables)
+                except Exception:
+                    import logging
+                    logging.getLogger("ra_tpu").warning(
+                        "wal recovery: truncated/corrupt tail in %s",
+                        path)
+                self._legacy_files.append(path)
+            self._legacy_tables.append(tables)
+
+    @staticmethod
+    def _discover_wal_dirs(data_dir: str) -> list:
+        dirs = []
+        top = os.path.join(data_dir, "wal")
+        if os.path.isdir(top):
+            dirs.append(top)
+        try:
+            names = sorted(os.listdir(data_dir))
+        except OSError:
+            names = []
+        for name in names:
+            w = os.path.join(data_dir, name, "wal")
+            if name.startswith("shard") and os.path.isdir(w):
+                dirs.append(w)
+        return dirs
+
+    # -- compat surface -----------------------------------------------------
+
+    @property
+    def wal(self) -> Wal:
+        """The first shard's WAL — the whole plane when ``wal_shards=1``
+        (the surface the single-shard tests drive kill/restart/flush
+        through)."""
+        return self._shards[0].wal
+
+    @property
+    def wals(self) -> list:
+        return [sh.wal for sh in self._shards]
+
+    @property
+    def confirm_upto(self) -> np.ndarray:
+        """Merged per-lane durable horizon across shards."""
+        if len(self._shards) == 1:
+            return self._shards[0].confirm_upto
+        with self._cond:
+            return np.concatenate(
+                [sh.confirm_upto for sh in self._shards])
+
+    @property
+    def confirmed_step(self) -> int:
+        return min(sh.confirmed_step for sh in self._shards)
+
+    def seed(self, prev_hi: np.ndarray, step_seq: int) -> None:
+        """Set the post-recovery baseline: everything up to ``prev_hi``
+        is durable and recorded through ``step_seq``."""
+        prev = prev_hi.astype(np.int32)
+        with self._cond:
+            self.step_seq = step_seq
+            for sh in self._shards:
+                sh.confirm_upto = prev[sh.lo:sh.hi].copy()
+                sh.confirmed_step = step_seq
+
+    # -- submit path (engine dispatch thread — must never host-sync) --------
+
+    def submit(self, aux: dict) -> None:
+        """Queue one step's device aux for off-thread encode + WAL write
+        on every shard.  No host sync happens here: the shard workers
+        pull the compacted readback when the device values are ready."""
+        with self._cond:
+            self.step_seq += 1
+            step = self.step_seq
+            for sh in self._shards:
+                sh._jobs.append((step, aux))
+                sh.unprocessed += 1
+            self._cond.notify_all()
+
+    def flush_all(self, timeout: float = 5.0) -> None:
+        """Durability barrier on every shard: drains the encode workers
+        first so steps still queued there are written, then flushes
+        each shard's WAL."""
+        self.drain_all(timeout)
+        for sh in self._shards:
+            sh.wal.flush(timeout)
+
+    def _raise_shard_error(self) -> None:
+        err = next((sh.error for sh in self._shards if sh.error), None)
+        if err is not None:
+            raise err
+
+    def drain_all(self, timeout: float = 30.0) -> None:
+        """Barrier: every submitted step is encoded and handed to its
+        shard WAL (not necessarily fsynced — flush the shards for that).
+        After this returns, every election truncation's base clamp is
+        reflected in ``confirm_upto``."""
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: any(sh.error for sh in self._shards)
+                or all(sh.unprocessed == 0 for sh in self._shards),
+                timeout)
+        self._raise_shard_error()
+        if not ok:
+            raise TimeoutError("WAL encode workers stalled")
 
     def backpressure(self, timeout: float = 30.0) -> None:
         """Bound the unconfirmed window: wait for WAL confirms when more
-        than ``max_pending`` steps are in flight (the flow control a
-        gen_batch_server gets from its bounded mailbox)."""
-        self._maybe_resend()
-        while self._inflight and \
-                self.step_seq - self.confirmed_step >= self.max_pending:
-            self._drain_one()
-        if self.step_seq - self.confirmed_step < self.max_pending:
-            return
+        than ``max_pending`` steps are in flight on the laggiest shard
+        (the flow control a gen_batch_server gets from its bounded
+        mailbox)."""
+
+        def lag() -> int:
+            return self.step_seq - min(sh.confirmed_step
+                                       for sh in self._shards)
+
         deadline = time.monotonic() + timeout
         while True:
-            # sliced wait: WAL thread death never notifies the condition
             with self._cond:
+                # sliced wait: WAL thread death never notifies the cond
                 self._cond.wait_for(
-                    lambda: self.step_seq - self.confirmed_step <
-                    self.max_pending or self._resend_above is not None
-                    or not self.wal.alive,
+                    lambda: lag() < self.max_pending
+                    or any(sh.error for sh in self._shards)
+                    or any(not sh.wal.alive for sh in self._shards),
                     min(0.5, max(0.0, deadline - time.monotonic())))
-                under = self.step_seq - self.confirmed_step < \
-                    self.max_pending
-            if not self.wal.alive:
-                raise WalDown("engine WAL died under backpressure; call "
-                              "wal.restart() to resume")
-            self._maybe_resend()
+                under = lag() < self.max_pending
+            self._raise_shard_error()
             if under:
                 return
+            for sh in self._shards:
+                if not sh.wal.alive:
+                    raise WalDown(
+                        f"engine WAL shard {sh.idx} died under "
+                        "backpressure; call wal.restart() to resume")
             if time.monotonic() > deadline:
                 raise TimeoutError("WAL confirms stalled")
 
-    # -- checkpoint / recovery --------------------------------------------
+    # -- observability ------------------------------------------------------
+
+    def wal_overview(self) -> dict:
+        """ENGINE_WAL_FIELDS plus per-shard WAL stats (batch bytes,
+        records per fsync, fsync latency p50/p99, confirm lag) — the
+        key_metrics merge mirroring the RPC_FIELDS pattern."""
+        with self._cond:
+            eng = dict(self.counters)
+            eng["confirm_lag_steps"] = self.step_seq - min(
+                sh.confirmed_step for sh in self._shards)
+            shards = []
+            for sh in self._shards:
+                st = sh.wal.stats()
+                st["shard"] = sh.idx
+                st["lanes"] = [sh.lo, sh.hi]
+                st["confirm_lag_steps"] = \
+                    self.step_seq - sh.confirmed_step
+                shards.append(st)
+        return {"engine": eng, "shards": shards}
+
+    # -- checkpoint / recovery ----------------------------------------------
 
     def checkpoint(self, engine, timeout: float = 30.0) -> str:
-        while self._inflight:
-            self._drain_one()
         deadline = time.monotonic() + timeout
-        # wait in slices: WAL thread death never notifies the condition,
-        # and an out-of-sequence signal needs a resend, not more waiting
+        self.drain_all(timeout)
         while True:
-            self._maybe_resend()
-            self.wal.flush()
             with self._cond:
-                self._cond.wait_for(
-                    lambda: self.confirmed_step >= self.step_seq
-                    or self._resend_above is not None
-                    or not self.wal.alive,
-                    min(0.5, max(0.0, deadline - time.monotonic())))
-                done = self.confirmed_step >= self.step_seq
+                done = all(sh.confirmed_step >= self.step_seq
+                           for sh in self._shards)
             if done:
                 break
-            if not self.wal.alive:
-                raise WalDown("checkpoint: WAL died; wal.restart() and "
-                              "retry")
+            for sh in self._shards:
+                if not sh.wal.alive:
+                    raise WalDown("checkpoint: WAL shard died; "
+                                  "wal.restart() and retry")
+                try:
+                    sh.wal.flush(min(5.0, max(
+                        0.1, deadline - time.monotonic())))
+                except TimeoutError:
+                    pass
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: all(sh.confirmed_step >= self.step_seq
+                                for sh in self._shards),
+                    min(0.5, max(0.0, deadline - time.monotonic())))
+            self._raise_shard_error()
             if time.monotonic() > deadline:
                 raise TimeoutError("checkpoint: WAL confirms stalled")
         path = os.path.join(self.dir, "ckpt.npz")
         engine.save(path)
-        meta = {"step": self.step_seq}
+        meta = {"step": self.step_seq, "wal_shards": self.wal_shards}
         tmp = path + ".meta.tmp"
         with open(tmp, "w") as f:
             json.dump(meta, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.dir, "ckpt.meta.json"))
-        # roll the current WAL file so its (now-covered) records become
-        # prunable, then drop every covered file
-        self.wal.rollover()
-        self.wal.flush()
-        self.retirer.prune(self.step_seq)
+        # roll every shard's current file so its (now-covered) records
+        # become prunable, then drop every covered file
+        for sh in self._shards:
+            sh.wal.rollover()
+            sh.wal.flush()
+            sh.retirer.prune(self.step_seq)
+        self._prune_legacy()
         return path
+
+    def _prune_legacy(self) -> None:
+        files, self._legacy_files = self._legacy_files, []
+        dirs = set()
+        for path in files:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            dirs.add(os.path.dirname(path))
+        for d in dirs:
+            parent = os.path.dirname(d)
+            try:
+                os.rmdir(d)
+                if os.path.basename(parent).startswith("shard"):
+                    os.rmdir(parent)
+            except OSError:
+                pass
+        self._legacy_tables = []
+
+    def recovered_pieces(self, base_step: int) -> dict:
+        """step -> [(lane_lo, hi, n_app, n_acc, rows)] merged from every
+        shard's recovered WAL tables plus foreign-layout leftovers."""
+        pieces: dict = {}
+        tabs = [sh.wal.recovered_table(UID) for sh in self._shards]
+        tabs += [t.get(UID, {}) for t in self._legacy_tables]
+        for tbl in tabs:
+            for s, (_t, blk) in tbl.items():
+                if s <= base_step:
+                    continue
+                pieces.setdefault(s, []).append(decode_block(blk))
+        return pieces
 
     def close(self) -> None:
         try:
-            while self._inflight:
-                self._drain_one()
-            self.wal.flush()
-        except (WalDown, TimeoutError):
-            pass  # best-effort: a dead WAL must not block cleanup
-        self.wal.close()
+            self.drain_all(timeout=10.0)
+        except Exception:  # noqa: BLE001 — a dead WAL must not block cleanup
+            pass
+        for sh in self._shards:
+            try:
+                sh.wal.flush()
+            except (WalDown, TimeoutError):
+                pass
+        for sh in self._shards:
+            sh.stop()
+            sh.wal.close()
+
+
+def _assemble_blocks(pieces: dict, n_lanes: int, ckpt_tail: np.ndarray):
+    """Stitch per-slice step pieces into full-lane step blocks.
+
+    Lanes with no piece at a step (their shard crashed before recording
+    it, or a foreign layout covered other slices) carry their tail
+    forward with ``n_app=0`` — nothing was durably recorded for them at
+    that step, and the merged per-lane confirm rule guarantees nothing
+    beyond their last record was ever reported committed."""
+    blocks = []
+    cur_hi = ckpt_tail.astype(np.int32).copy()
+    for s in sorted(pieces):
+        ps = pieces[s]
+        kmax = max(p[4].shape[1] for p in ps)
+        c = ps[0][4].shape[2]
+        hi = cur_hi.copy()
+        n_app = np.zeros((n_lanes,), np.int32)
+        n_acc = np.zeros((n_lanes,), np.int32)
+        rows = np.zeros((n_lanes, kmax, c), ps[0][4].dtype)
+        for lane_lo, phi, papp, pacc, prows in ps:
+            sl = slice(lane_lo, lane_lo + phi.shape[0])
+            hi[sl] = phi
+            n_app[sl] = papp
+            n_acc[sl] = pacc
+            if prows.shape[1]:
+                rows[sl, :prows.shape[1]] = prows
+        blocks.append((s, hi, n_app, n_acc, rows))
+        cur_hi = hi
+    return blocks
 
 
 def _final_logs(blocks: list, ckpt_tail: np.ndarray):
@@ -370,16 +726,19 @@ def _final_logs(blocks: list, ckpt_tail: np.ndarray):
 
 def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
                 *, sync_mode: int = 1, write_strategy: str = "default",
-                max_pending: int = 8,
+                max_pending: int = 8, wal_shards: int = 1,
+                wal_batch_bytes: int = 4 * 1024 * 1024,
+                wal_batch_interval_ms: Optional[float] = None,
                 settle_limit: int = 10_000, **engine_kwargs):
     """Create-or-recover a durable LockstepEngine at ``data_dir``.
 
-    Fresh directory: a new engine wired to a new WAL.  Existing data:
-    restore the checkpoint, replay surviving WAL records through the
-    jitted step (recomputing machine state with the same apply fold),
-    and resume in durable mode.  Matches the recovery contract of
-    SURVEY.md §3.4 at engine scale: recovery = checkpoint + WAL re-read,
-    deduped by the overwrite rule, applied with effects suppressed."""
+    Fresh directory: a new engine wired to ``wal_shards`` new WAL
+    shards.  Existing data: restore the checkpoint, merge the surviving
+    shard records (any layout), replay them through the jitted step
+    (recomputing machine state with the same apply fold), and resume in
+    durable mode.  Matches the recovery contract of SURVEY.md §3.4 at
+    engine scale: recovery = checkpoint + WAL re-read, deduped by the
+    overwrite rule, applied with effects suppressed."""
     import jax
     import jax.numpy as jnp
 
@@ -393,21 +752,20 @@ def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
         with open(meta_path) as f:
             base_step = json.load(f).get("step", 0)
 
-    # the bridge's Wal scans surviving files once on construction
-    # (scan_wal_file dedups per-index overwrites); its recovered table
-    # is the step-block source for replay.  No engine writes happen
-    # until attach, so constructing it up front is safe.
+    # the bridge's shard Wals scan surviving files once on construction
+    # (scan_wal_file dedups per-index overwrites); the merged piece
+    # tables are the step-block source for replay.  No engine writes
+    # happen until attach, so constructing it up front is safe.
     dur = EngineDurability(data_dir, n_lanes, sync_mode=sync_mode,
                            write_strategy=write_strategy,
-                           max_pending=max_pending)
-    steps = {s: blk for s, (_t, blk)
-             in dur.wal.recovered_table(UID).items() if s > base_step}
+                           max_pending=max_pending,
+                           wal_shards=wal_shards,
+                           wal_batch_bytes=wal_batch_bytes,
+                           wal_batch_interval_ms=wal_batch_interval_ms)
+    pieces = dur.recovered_pieces(base_step)
 
-    blocks = []
-    for s in sorted(steps):
-        hi, n_app, n_acc, rows = decode_block(steps[s])
-        blocks.append((s, hi, n_app, n_acc, rows))
-    kmax = max((r.shape[1] for *_x, r in blocks), default=0)
+    kmax = max((p[4].shape[1] for ps in pieces.values() for p in ps),
+               default=0)
     if kmax:
         # the replay apply window must cover the widest recovered block,
         # or ring backpressure would silently clip replayed entries
@@ -462,6 +820,7 @@ def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
     leader = np.asarray(st.leader_slot)
     ckpt_tail = np.asarray(st.last_index)[lane, leader].astype(np.int32)
 
+    blocks = _assemble_blocks(pieces, n_lanes, ckpt_tail)
     surv, trimmed_tail, final_hi = _final_logs(blocks, ckpt_tail)
 
     if (trimmed_tail < ckpt_tail).any():
@@ -505,7 +864,7 @@ def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
     st = eng.state
     leader = np.asarray(st.leader_slot)
     tail = np.asarray(st.last_index)[lane, leader].astype(np.int32)
-    last_step = max(steps) if steps else base_step
+    last_step = max(pieces) if pieces else base_step
     dur.seed(tail, last_step)
     eng.attach_durability(dur)
     return eng

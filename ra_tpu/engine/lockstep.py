@@ -481,6 +481,33 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
                           query_agreed=query_agreed, mac=mac)
     aux = {"appended_hi": new_leader_last, "n_acc": n_acc,
            "n_app": total_app}
+    if durable:
+        # -- 6. on-device payload compaction for the WAL readback ---------
+        # The WAL record stores only the ACCEPTED host rows (lane-major,
+        # n_acc per lane); reading back the full [N,K,C] batch and
+        # masking on the host moves every rejected/empty slot over the
+        # host link first.  Instead a prefix-sum gather compacts the
+        # accepted rows into a dense [N*K, C] buffer on device: output
+        # row j's source lane is a length-preserving repeat of the lane
+        # ids by their accept counts (jnp.repeat lowers to a cumsum +
+        # gather — measured 3x cheaper than the searchsorted form and
+        # 6x cheaper than a scatter on CPU), so the host pulls exactly
+        # rows [0, csum[-1]) — the copy shrinks by the rejection/
+        # occupancy factor.
+        K = payloads.shape[1]
+        C = payloads.shape[2]
+        csum = jnp.cumsum(n_acc).astype(jnp.int32)           # [N]
+        j = jnp.arange(N * K, dtype=jnp.int32)
+        src_lane = jnp.repeat(jnp.arange(N, dtype=jnp.int32), n_acc,
+                              total_repeat_length=N * K)
+        row_base = csum[src_lane] - n_acc[src_lane]          # [N*K]
+        k_off = jnp.clip(j - row_base, 0, max(K - 1, 0))
+        flat_src = src_lane * K + k_off
+        flat = jnp.take(payloads.reshape(N * K, C).astype(ring.dtype),
+                        flat_src, axis=0)
+        valid = j < (csum[-1] if N else jnp.int32(0))
+        aux["flat_rows"] = jnp.where(valid[:, None], flat, 0)
+        aux["row_csum"] = csum
     return new_state, aux
 
 
@@ -592,9 +619,10 @@ class LockstepEngine:
     def step(self, n_new, payloads, elect_mask=None,
              query_mask=None) -> None:
         """Advance every lane one round.  n_new: int32[N]; payloads:
-        [N, K, C] with K <= max_step_cmds.  In durable mode, pass host
-        (numpy) payloads — the step's accepted entries are fed through
-        the fan-in WAL and commits gate on the fsync confirm."""
+        [N, K, C] with K <= max_step_cmds.  In durable mode the step's
+        accepted entries are compacted on device, read back off-thread
+        by the WAL shards, and commits gate on the fsync confirm — host
+        or device payloads both work (no host-side copy is taken)."""
         fail = (jnp.asarray(self._fail_host)
                 if self._fail_host.any() else self._zero_fail)
         elect = self._zero_elect if elect_mask is None \
@@ -609,15 +637,17 @@ class LockstepEngine:
             return
         with trace.span("engine.backpressure", "engine"):
             self._dur.backpressure()
-        payload_host = np.asarray(payloads)
         confirm = jnp.asarray(self._dur.confirm_upto)
         with trace.span("engine.step", "engine", durable=True):
             self.state, aux = self._step(self.state, jnp.asarray(n_new),
                                          jnp.asarray(payloads), fail, elect,
                                          confirm, query)
         with trace.span("engine.wal_submit", "engine"):
-            self._dur.submit(aux, payload_host)
-        if elect_mask is not None and np.asarray(elect_mask).any():
+            # no host payload copy here: the WAL shards read back the
+            # device-compacted flat rows off-thread (see durable.py)
+            self._dur.submit(aux)
+        if elect_mask is not None and \
+                np.asarray(elect_mask).any():  # ra02-ok: host-side mask
             # elections truncate+reuse indexes: drain now so the next
             # dispatch reads a confirm horizon clamped at the new base
             self._dur.drain_all()
@@ -857,7 +887,7 @@ class LockstepEngine:
 
     def overview(self, lane: int = 0) -> dict:
         s = self.state
-        return {
+        out = {
             "term": int(s.term[lane]),
             "leader_slot": int(s.leader_slot[lane]),
             "last_index": np.asarray(s.last_index[lane]).tolist(),
@@ -867,3 +897,9 @@ class LockstepEngine:
             "active": np.asarray(s.active[lane]).tolist(),
             "total_committed": int(s.total_committed[lane]),
         }
+        if self._dur is not None:
+            # durability-plane health (ENGINE_WAL_FIELDS + per-shard
+            # WAL_FIELDS/stats), the key_metrics merge of PR 2's
+            # RPC_FIELDS pattern
+            out["wal"] = self._dur.wal_overview()
+        return out

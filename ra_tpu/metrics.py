@@ -68,8 +68,25 @@ RPC_FIELDS = (
 
 #: node-wide WAL counter fields (ra_log_wal.erl:32-43 — same names,
 #: plus ``syncs``: fsync count, the number the reference exposes through
-#: ra_file_handle instead)
-WAL_FIELDS = ("wal_files", "batches", "writes", "bytes_written", "syncs")
+#: ra_file_handle instead, and ``sync_time_us``: cumulative durability-
+#: syscall wall time, the wal_sync_time gauge role).  Each WAL *shard*
+#: owns one counter dict (the sharded engine bridge runs S of them);
+#: ``Wal.stats()`` adds the derived fsync latency p50/p99 and
+#: records-per-fsync from a bounded latency reservoir.
+WAL_FIELDS = ("wal_files", "batches", "writes", "bytes_written", "syncs",
+              "sync_time_us")
+
+#: engine durability-bridge counter fields (ra_tpu/engine/durable.py),
+#: mirroring the RPC_FIELDS pattern: plain int dict, merged into the
+#: engine overview.  ``readback_bytes`` is what the compacted device->
+#: host readback actually moved for WAL encode; ``readback_bytes_full``
+#: is what the pre-compaction full-ring readback would have moved on the
+#: same steps (the ratio is the compaction win).  The overview adds
+#: ``confirm_lag_steps`` — dispatched-but-unconfirmed steps on the
+#: laggiest shard — as a DERIVED gauge sampled at overview time, not a
+#: counter field.
+ENGINE_WAL_FIELDS = ("readback_bytes", "readback_bytes_full",
+                     "encoded_blocks", "encoded_bytes")
 
 #: node-wide segment-writer counter fields (ra_log_segment_writer.erl:
 #: 37-52 — same names)

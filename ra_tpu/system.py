@@ -66,6 +66,8 @@ class RaSystem:
                  wal_max_size: int = DEFAULT_MAX_SIZE,
                  wal_max_batch: int = DEFAULT_MAX_BATCH,
                  wal_max_entries: int = 0,
+                 wal_max_batch_bytes: int = 0,
+                 wal_max_batch_interval_ms: float = 0.0,
                  segment_max_count: int = 4096,
                  wal_supervise: bool = True) -> None:
         self.name = name
@@ -76,9 +78,13 @@ class RaSystem:
         self._lock = threading.Lock()
         self.directory = Directory(data_dir)
         self.segment_writer = SegmentWriter(resolve=self._resolve)
+        # group-commit tunables ride through to the node-wide WAL (flush
+        # on bytes OR interval; 0/0 keeps the drain-the-mailbox policy)
         self.wal = Wal(data_dir, sync_mode=wal_sync_mode,
                        max_size=wal_max_size, max_batch=wal_max_batch,
                        max_entries=wal_max_entries,
+                       max_batch_bytes=wal_max_batch_bytes,
+                       max_batch_interval_ms=wal_max_batch_interval_ms,
                        segment_writer=self.segment_writer)
         # Recovered WAL entries are purged at boot ONLY for uids with an
         # explicit force-delete tombstone.  Absence from the registry is
@@ -294,9 +300,11 @@ class RaSystem:
             self._logs.clear()
 
     def counters(self) -> dict:
-        """Node-wide infra counters: the WAL's (ra_log_wal.erl:32-43) and
-        the segment writer's (ra_log_segment_writer.erl:37-52)."""
-        return {"wal": dict(self.wal.counters),
+        """Node-wide infra counters: the WAL's (ra_log_wal.erl:32-43,
+        plus derived fsync latency p50/p99 and records-per-fsync from
+        Wal.stats) and the segment writer's
+        (ra_log_segment_writer.erl:37-52)."""
+        return {"wal": self.wal.stats(),
                 "segment_writer": dict(self.segment_writer.counters)}
 
     def overview(self) -> dict:

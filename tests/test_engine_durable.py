@@ -60,13 +60,44 @@ def test_block_roundtrip():
     n_app = n_acc + rng.integers(0, 2, N).astype(np.int32)
     ph = rng.integers(0, 1000, (N, K, 1)).astype(np.int32)
     blk = encode_block(hi, n_app, n_acc, ph)
-    hi2, n_app2, n_acc2, rows = decode_block(blk)
+    lane_lo, hi2, n_app2, n_acc2, rows = decode_block(blk)
+    assert lane_lo == 0
     np.testing.assert_array_equal(hi, hi2)
     np.testing.assert_array_equal(n_app, n_app2)
     np.testing.assert_array_equal(n_acc, n_acc2)
     for i in range(N):
         np.testing.assert_array_equal(rows[i, :n_acc[i]], ph[i, :n_acc[i]])
         assert (rows[i, n_acc[i]:] == 0).all()  # noop rows zero-filled
+
+
+def test_flat_encode_matches_legacy_bytes():
+    """The device-compaction encode path (flat accepted rows in) must be
+    byte-identical to the legacy host-mask path — the wal_shards=1
+    format-compat guarantee."""
+    from ra_tpu.engine.durable import encode_block_flat
+    rng = np.random.default_rng(1)
+    hi = rng.integers(1, 100, N).astype(np.int32)
+    n_acc = rng.integers(0, K, N).astype(np.int32)
+    n_app = n_acc + rng.integers(0, 2, N).astype(np.int32)
+    ph = rng.integers(0, 1000, (N, K, 1)).astype(np.int32)
+    mask = np.arange(K)[None, :] < n_acc[:, None]
+    flat = ph[mask]
+    assert encode_block_flat(hi, n_app, n_acc, flat) == \
+        encode_block(hi, n_app, n_acc, ph)
+
+
+def test_sharded_block_carries_lane_offset():
+    from ra_tpu.engine.durable import encode_block_flat
+    hi = np.array([7, 9], np.int32)
+    n_app = np.array([2, 1], np.int32)
+    n_acc = np.array([2, 1], np.int32)
+    flat = np.array([[1], [2], [3]], np.int32)
+    blk = encode_block_flat(hi, n_app, n_acc, flat, lane_lo=8)
+    lane_lo, hi2, n_app2, n_acc2, rows = decode_block(blk)
+    assert lane_lo == 8
+    np.testing.assert_array_equal(hi2, hi)
+    np.testing.assert_array_equal(rows[0, :2, 0], [1, 2])
+    np.testing.assert_array_equal(rows[1, :1, 0], [3])
 
 
 def test_final_logs_truncation():
@@ -245,7 +276,8 @@ from ra_tpu.models import CounterMachine
 
 N, P, K = 16, 3, 8
 eng = open_engine(CounterMachine(), sys.argv[1], N, P,
-                  sync_mode=1, ring_capacity=256, max_step_cmds=K)
+                  sync_mode=1, ring_capacity=256, max_step_cmds=K,
+                  wal_shards=int(sys.argv[3]))
 report = sys.argv[2]
 n_new = np.full((N,), 4, np.int32)
 payloads = np.ones((N, K, 1), np.int32)
@@ -266,14 +298,22 @@ for i in range(10_000):
 """
 
 
-def test_kill9_recovers_all_reported_commits(tmp_path):
+@pytest.mark.parametrize("shards", [1, 4])
+def test_kill9_recovers_all_reported_commits(tmp_path, shards):
     """SIGKILL mid-bench: every entry ever reported committed (which the
-    engine only does after its WAL block is fsynced) survives recovery."""
+    engine only does after its WAL block is fsynced) survives recovery —
+    for the single-shard compat layout AND the sharded WAL plane (a
+    crash can tear one shard mid-write; recovery merges the ragged
+    per-shard coverage).  The recovered machine state must equal the
+    never-crashed oracle at the recovered apply frontier: with no
+    elections every applied entry is a +1 command, so the oracle
+    counter at applied index a is exactly a."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     data = str(tmp_path / "data")
     report = str(tmp_path / "report.json")
     child = subprocess.Popen(
-        [sys.executable, "-c", _CHILD.format(repo=repo), data, report],
+        [sys.executable, "-c", _CHILD.format(repo=repo), data, report,
+         str(shards)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         # PYTHONPATH= : the axon site hook must not register a PJRT
         # plugin whose discovery blocks on a dead tunnel (same guard as
@@ -311,17 +351,20 @@ def test_kill9_recovers_all_reported_commits(tmp_path):
         reported = np.array(json.load(f), np.int32)
     assert reported.sum() > 0
 
-    eng = make_engine(tmp_path / "data", sync_mode=1)
+    eng = make_engine(tmp_path / "data", sync_mode=1, wal_shards=shards)
     lane = np.arange(N)
     st = eng.state
     com = np.asarray(st.commit)[lane, np.asarray(st.leader_slot)]
     assert (com >= reported).all(), (com, reported)
-    # machine state is consistent with the recovered commit frontier:
-    # counter value == number of applied +1 commands
-    mac = np.asarray(st.mac)[lane, np.asarray(st.leader_slot)]
-    app = np.asarray(st.applied)[lane, np.asarray(st.leader_slot)]
-    assert (mac <= app).all()
-    assert (mac >= reported - 1).all()  # at most the term noop is a gap
+    # oracle equivalence: the replayed lane state equals what a
+    # never-crashed run holds at the recovered apply frontier — the
+    # workload is pure +1 commands (no elections, no noops), so the
+    # oracle counter at applied index a is exactly a, on every member
+    mac = np.asarray(st.mac)
+    app = np.asarray(st.applied)
+    act = np.asarray(st.active)
+    assert (mac[act] == app[act]).all(), (mac, app)
+    assert (mac[lane, np.asarray(st.leader_slot)] >= reported).all()
     eng.close()
 
 

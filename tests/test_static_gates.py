@@ -89,6 +89,47 @@ def test_api_module_is_ra01_clean():
     assert "RA01" not in r.stdout, r.stdout
 
 
+def test_checker_forbids_host_syncs_in_engine_hot_loop(tmp_path):
+    """RA02: np.asarray/.item() inside the engine step hot-loop
+    functions force a device->host sync that serializes the XLA
+    pipeline.  Applies to files named lockstep.py/durable.py only;
+    `# ra02-ok:` allowlists a documented readback point."""
+    bad = tmp_path / "lockstep.py"
+    bad.write_text(textwrap.dedent("""\
+        import numpy as np
+
+        def step(self, n_new):
+            host = np.asarray(n_new)
+            flag = self.state.term[0].item()
+            return host, flag
+
+        def _step(state, n_new):
+            ok = np.asarray(n_new)  # ra02-ok: host-provided mask
+            return ok
+
+        def overview(self):
+            return np.asarray(self.state.commit)  # not a hot-loop fn
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA02") == 2, r.stdout
+    assert "np.asarray" in r.stdout and ".item()" in r.stdout
+    # the same content under a non-engine module name is not gated
+    other = tmp_path / "helpers.py"
+    other.write_text(bad.read_text())
+    r = run_lint(str(other))
+    assert "RA02" not in r.stdout
+
+
+def test_engine_modules_are_ra02_clean():
+    """The real engine hot loop passes the host-sync gate (covered by
+    the repo-wide run too; pinned separately so a regression names the
+    rule)."""
+    for mod in ("lockstep.py", "durable.py"):
+        r = run_lint(os.path.join(REPO, "ra_tpu", "engine", mod))
+        assert "RA02" not in r.stdout, (mod, r.stdout)
+
+
 def test_checker_false_positive_guards(tmp_path):
     ok = tmp_path / "ok.py"
     ok.write_text(textwrap.dedent("""\

@@ -23,6 +23,12 @@ Checks (cheap, high-signal, zero-config):
                 a direct one-shot `.send(...)`/`.remote_call(...)`
                 inside a lifecycle function is the silent-loss bug
                 class ISSUE 2 removed — route through node_call
+  RA02          (engine lockstep.py/durable.py only) no
+                `np.asarray(...)`/`.item()` host syncs inside the step
+                hot-loop functions (step/_step/submit/uniform_step) —
+                a forced device sync there serializes the XLA
+                pipeline; documented readback points carry an
+                `# ra02-ok: <why>` line comment
 
 Usage: ``python tools/lint.py [paths...]`` (defaults to the repo's
 source roots).  Exits nonzero with one line per finding.
@@ -78,6 +84,47 @@ _LIFECYCLE_VERBS = frozenset({
 _ONE_SHOT_SENDS = frozenset({"send", "remote_call"})
 
 
+#: RA02 — engine step hot loop (files named lockstep.py/durable.py):
+#: functions on the per-step dispatch path must never force a device->
+#: host sync.  `np.asarray(...)` or `.item()` on a device array there
+#: serializes the XLA pipeline (a ~35-70ms stall per step on tunneled
+#: backends) — the bug class the round-5 profile work removed.  The
+#: documented readback points (the durability bridge's encode workers,
+#: overview/readback helpers) run off-thread or out of the loop; a
+#: deliberate host-side conversion inside the loop carries an
+#: `# ra02-ok: <why>` comment on its line.
+_HOT_STEP_FUNCS = frozenset({"step", "_step", "submit", "uniform_step"})
+_ENGINE_HOT_FILES = frozenset({"lockstep.py", "durable.py"})
+
+
+def _check_engine_hot_sync(tree: ast.Module, err) -> None:
+    """RA02: forbid np.asarray/.item() host syncs inside the engine
+    step hot-loop functions (allowlist via `# ra02-ok:` line comment)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in _HOT_STEP_FUNCS:
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr == "asarray" and \
+                    isinstance(fn.value, ast.Name) and \
+                    fn.value.id == "np":
+                err(sub, "RA02",
+                    f"np.asarray() in hot-loop {node.name}() forces a "
+                    "device->host sync; move it to a documented "
+                    "readback point or mark the line '# ra02-ok: why'")
+            elif fn.attr == "item" and not sub.args:
+                err(sub, "RA02",
+                    f".item() in hot-loop {node.name}() forces a "
+                    "device->host sync; move it to a documented "
+                    "readback point or mark the line '# ra02-ok: why'")
+
+
 def _check_lifecycle_rpc(tree: ast.Module, err) -> None:
     """RA01: inside lifecycle verbs, forbid direct one-shot transport
     calls (they must go through the reliable RPC layer)."""
@@ -119,6 +166,15 @@ def check_file(path: str) -> list:
 
     if os.path.basename(path) == "api.py":
         _check_lifecycle_rpc(tree, err)
+    if os.path.basename(path) in _ENGINE_HOT_FILES:
+        ra02_ok = {i + 1 for i, line in enumerate(src.splitlines())
+                   if "ra02-ok" in line}
+
+        def err_ra02(node: ast.AST, code: str, msg: str) -> None:
+            if getattr(node, "lineno", 0) not in ra02_ok:
+                err(node, code, msg)
+
+        _check_engine_hot_sync(tree, err_ra02)
 
     # -- F401: unused module-level imports ------------------------------
     if os.path.basename(path) != "__init__.py":
