@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import collections
 import json
+import logging
 import os
 import struct
 import threading
@@ -69,10 +70,17 @@ from typing import Optional
 import numpy as np
 
 from .. import trace
+from ..log import faults
 from ..log.wal import Wal, WalDown, scan_wal_file
 from ..metrics import ENGINE_WAL_FIELDS
 
 UID = "__engine__"
+
+#: shard-WAL supervisor restart intensity: (max restarts, window s) —
+#: the engine twin of system.WAL_RESTART_INTENSITY; beyond it the
+#: supervisor backs off for the window instead of hot-looping against a
+#: dead disk
+SHARD_RESTART_INTENSITY = (10, 5.0)
 MAGIC = b"RTB1"
 MAGIC2 = b"RTB2"          # RTB1 + lane_lo:u32 (sharded lane slice)
 _BLK = struct.Struct("<4sII8sI")
@@ -313,6 +321,13 @@ class _WalShard:
             # an election truncation reuses indexes: the durable horizon
             # drops to the step's base until this block itself confirms
             self.confirm_upto = np.minimum(self.confirm_upto, base)
+        # sync with any new WAL incarnation BEFORE submitting: a fresh
+        # writer accepts any first step, so writing this block ahead of
+        # the unconfirmed backlog would leave a step gap in the new file
+        # if the WAL dies again before the backlog resends (recovery
+        # also guards against the remaining race — _assemble_blocks
+        # drops gapped pieces)
+        self._maybe_resend()
         try:
             self.wal.write(UID, step, 1, blk)
         except WalDown:
@@ -363,7 +378,8 @@ class EngineDurability:
                  wal_max_size: int = 256 * 1024 * 1024,
                  wal_shards: int = 1,
                  wal_batch_bytes: int = 4 * 1024 * 1024,
-                 wal_batch_interval_ms: Optional[float] = None) -> None:
+                 wal_batch_interval_ms: Optional[float] = None,
+                 wal_supervise: bool = True) -> None:
         os.makedirs(data_dir, exist_ok=True)
         if not 1 <= wal_shards <= n_lanes:
             raise ValueError(
@@ -420,12 +436,59 @@ class EngineDurability:
                 try:
                     scan_wal_file(path, tables)
                 except Exception:
-                    import logging
                     logging.getLogger("ra_tpu").warning(
                         "wal recovery: truncated/corrupt tail in %s",
                         path)
                 self._legacy_files.append(path)
             self._legacy_tables.append(tables)
+        # per-shard WAL supervisor (the ra_log_wal_sup role for the
+        # sharded plane): a dead shard batch thread is restarted under
+        # an intensity window, the shard worker detects the generation
+        # bump and resends its unconfirmed blocks — the merged confirm
+        # vector never advanced past them, so nothing reported committed
+        # depends on the crashed incarnation (disabled by tests that
+        # assert raw WalDown freeze behaviour)
+        self._sup_stop = threading.Event()
+        self._shard_restarts: collections.deque = collections.deque()
+        self._sup_thread: Optional[threading.Thread] = None
+        if wal_supervise:
+            self._sup_thread = threading.Thread(
+                target=self._supervise_shards, daemon=True,
+                name="ra-engine-wal-sup")
+            self._sup_thread.start()
+
+    def _supervise_shards(self) -> None:
+        max_r, period = SHARD_RESTART_INTENSITY
+        log = logging.getLogger("ra_tpu")
+        while not self._sup_stop.wait(0.02):
+            for sh in self._shards:
+                wal = sh.wal
+                if wal._stop or wal.alive:
+                    continue
+                now = time.monotonic()
+                while self._shard_restarts and \
+                        now - self._shard_restarts[0] > period:
+                    self._shard_restarts.popleft()
+                if len(self._shard_restarts) >= max_r:
+                    log.error("engine wal supervisor: restart intensity "
+                              "exceeded (%d in %.0fs); backing off",
+                              max_r, period)
+                    if self._sup_stop.wait(period):
+                        return
+                    continue
+                self._shard_restarts.append(now)
+                log.warning("engine wal supervisor: restarting dead "
+                            "WAL shard %d", sh.idx)
+                try:
+                    wal.restart()
+                except Exception:
+                    log.exception("engine wal supervisor: restart of "
+                                  "shard %d failed; will retry", sh.idx)
+                    continue
+                with self._cond:
+                    # wake the shard worker: _maybe_resend sees the
+                    # generation bump and replays unconfirmed blocks
+                    self._cond.notify_all()
 
     @staticmethod
     def _discover_wal_dirs(data_dir: str) -> list:
@@ -569,7 +632,8 @@ class EngineDurability:
                 st["confirm_lag_steps"] = \
                     self.step_seq - sh.confirmed_step
                 shards.append(st)
-        return {"engine": eng, "shards": shards}
+        return {"engine": eng, "shards": shards,
+                "disk_faults": faults.disk_fault_counters()}
 
     # -- checkpoint / recovery ----------------------------------------------
 
@@ -650,6 +714,9 @@ class EngineDurability:
         return pieces
 
     def close(self) -> None:
+        self._sup_stop.set()
+        if self._sup_thread is not None:
+            self._sup_thread.join(timeout=5)
         try:
             self.drain_all(timeout=10.0)
         except Exception:  # noqa: BLE001 — a dead WAL must not block cleanup
@@ -671,7 +738,16 @@ def _assemble_blocks(pieces: dict, n_lanes: int, ckpt_tail: np.ndarray):
     it, or a foreign layout covered other slices) carry their tail
     forward with ``n_app=0`` — nothing was durably recorded for them at
     that step, and the merged per-lane confirm rule guarantees nothing
-    beyond their last record was ever reported committed."""
+    beyond their last record was ever reported committed.
+
+    Contiguity guard (the engine twin of the classic log's recovery
+    clamp): a piece whose append BASE exceeds a lane's carried tail
+    records appends above a step gap — a post-restart write that beat
+    the unconfirmed-backlog resend into the new WAL file before a
+    second crash.  Those appends were never confirmable (the shard's
+    confirm slice froze below the gap), so the lane skips the piece
+    and carries its tail forward instead of replaying a holed log the
+    engine could never converge on."""
     blocks = []
     cur_hi = ckpt_tail.astype(np.int32).copy()
     for s in sorted(pieces):
@@ -684,11 +760,19 @@ def _assemble_blocks(pieces: dict, n_lanes: int, ckpt_tail: np.ndarray):
         rows = np.zeros((n_lanes, kmax, c), ps[0][4].dtype)
         for lane_lo, phi, papp, pacc, prows in ps:
             sl = slice(lane_lo, lane_lo + phi.shape[0])
-            hi[sl] = phi
-            n_app[sl] = papp
-            n_acc[sl] = pacc
+            ok = (phi - papp) <= cur_hi[sl]
+            if not ok.all():
+                logging.getLogger("ra_tpu").warning(
+                    "engine recovery: step %d piece at lanes [%d,%d) "
+                    "appends above a gap on %d lane(s); skipped",
+                    s, lane_lo, lane_lo + phi.shape[0],
+                    int((~ok).sum()))
+            hi[sl] = np.where(ok, phi, hi[sl])
+            n_app[sl] = np.where(ok, papp, n_app[sl])
+            n_acc[sl] = np.where(ok, pacc, n_acc[sl])
             if prows.shape[1]:
-                rows[sl, :prows.shape[1]] = prows
+                dst = rows[sl]
+                dst[ok, :prows.shape[1]] = prows[ok]
         blocks.append((s, hi, n_app, n_acc, rows))
         cur_hi = hi
     return blocks
@@ -729,6 +813,7 @@ def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
                 max_pending: int = 8, wal_shards: int = 1,
                 wal_batch_bytes: int = 4 * 1024 * 1024,
                 wal_batch_interval_ms: Optional[float] = None,
+                wal_supervise: bool = True,
                 settle_limit: int = 10_000, **engine_kwargs):
     """Create-or-recover a durable LockstepEngine at ``data_dir``.
 
@@ -761,7 +846,8 @@ def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
                            max_pending=max_pending,
                            wal_shards=wal_shards,
                            wal_batch_bytes=wal_batch_bytes,
-                           wal_batch_interval_ms=wal_batch_interval_ms)
+                           wal_batch_interval_ms=wal_batch_interval_ms,
+                           wal_supervise=wal_supervise)
     pieces = dur.recovered_pieces(base_step)
 
     kmax = max((p[4].shape[1] for ps in pieces.values() for p in ps),

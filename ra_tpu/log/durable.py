@@ -36,8 +36,8 @@ from ..core.types import (Entry, IdxTerm, ReplyMode, SnapshotMeta,
                           UserCommand, WalUpEvent, WrittenEvent,
                           strip_local_handles)
 from ..metrics import LOG_FIELDS
-from ..native import IO
 from ..utils.flru import Flru
+from .faults import IO, note as _fault_note
 from .segment import DEFAULT_MAX_COUNT, SegmentFile
 from .snapshot import DEFAULT_SNAPSHOT_MODULE
 
@@ -88,23 +88,38 @@ def decode_command(payload: bytes) -> Any:
 
 
 def _write_snapshot_file(path: str, meta: SnapshotMeta, data: bytes) -> None:
+    """Pending-dir discipline: the container is written+fsynced to a
+    ``.partial`` sibling and only then renamed into the slot, so a torn
+    write can NEVER shadow a good snapshot — on any I/O error the
+    OSError propagates before the rename and the old container stays
+    authoritative.  Writes ride the storage I/O shim (fault-injectable,
+    log/faults.py)."""
     meta_b = pickle.dumps(meta)
     body = struct.pack("<I", len(meta_b)) + meta_b + data
     crc = IO.crc32(body)
     tmp = path + ".partial"
-    with open(tmp, "wb") as f:
-        f.write(_SNAP_HDR.pack(SNAP_MAGIC, 1, crc) + body)
-        f.flush()
-        os.fsync(f.fileno())
+    fd = IO.random_open(tmp, truncate=True)
+    try:
+        IO.pwrite(fd, _SNAP_HDR.pack(SNAP_MAGIC, 1, crc) + body, 0)
+        IO.sync(fd, 2)
+    finally:
+        IO.close(fd)
     os.replace(tmp, path)
 
 
-def _read_snapshot_file(path: str) -> Optional[tuple]:
-    """Returns (meta, data) or None when invalid (validate,
-    ra_log_snapshot.erl:112+)."""
+def _drop_partial(path: str) -> None:
+    """Remove the ``.partial`` leftover of a failed container write."""
     try:
-        with open(path, "rb") as f:
-            raw = f.read()
+        os.unlink(path + ".partial")
+    except OSError:
+        # safe to swallow: a stranded .partial can never shadow a real
+        # container (recovery only reads fully-renamed files) — it only
+        # leaks bytes until the next write truncates it
+        _fault_note("swallowed_oserrors")
+
+
+def _parse_snapshot_bytes(raw: bytes) -> Optional[tuple]:
+    try:
         magic, _version, crc = _SNAP_HDR.unpack_from(raw, 0)
         body = raw[_SNAP_HDR.size:]
         if magic != SNAP_MAGIC or IO.crc32(body) != crc:
@@ -112,6 +127,27 @@ def _read_snapshot_file(path: str) -> Optional[tuple]:
         (mlen,) = struct.unpack_from("<I", body, 0)
         meta = pickle.loads(body[4:4 + mlen])
         return meta, body[4 + mlen:]
+    except Exception:
+        return None
+
+
+def _read_snapshot_file(path: str) -> Optional[tuple]:
+    """Returns (meta, data) or None when invalid (validate,
+    ra_log_snapshot.erl:112+).  A crc failure is retried ONCE with a
+    fresh read: transient read-side corruption must not discard a good
+    container (the fallback would silently rewind machine state to an
+    older image)."""
+    try:
+        got = _parse_snapshot_bytes(IO.read_file(path))
+        if got is None:
+            got = _parse_snapshot_bytes(IO.read_file(path))
+            if got is not None:
+                # the fresh read validated: transient read-side
+                # corruption caught by the container crc — a container
+                # that fails BOTH reads is genuinely invalid (torn
+                # write) and is not fault telemetry
+                _fault_note("crc_catches")
+        return got
     except Exception:
         return None
 
@@ -219,6 +255,10 @@ class DurableLog:
         self.counters: dict[str, int] = {f: 0 for f in LOG_FIELDS}
         #: in-flight chunked snapshot accept stream (begin_accept)
         self._accept: Optional[dict] = None
+        #: WAL incarnation this log has resent its unconfirmed tail to
+        #: (the new-wal-pid check of ra_log.erl:778-793, kept per-put so
+        #: no append can race the supervisor's resend hook)
+        self._wal_generation = wal.generation
         self._recover_state()
         wal.register(uid, self._wal_notify)
 
@@ -332,6 +372,38 @@ class DurableLog:
             self._mem_bytes[idx] = payload
             if idx >= last:
                 last, last_term = idx, term
+        # contiguity clamp: a Raft log can never have holes.  A crash
+        # that lost an unconfirmed torn batch while later entries
+        # reached a newer WAL file would otherwise recover a
+        # committed-LOOKING tail over a missing middle — a log whose
+        # last_index could win elections it must lose.  Those covered
+        # indexes were never acknowledged by this node (confirmation is
+        # contiguous by construction), so dropping everything above the
+        # first gap presents an honest, strictly-shorter log that the
+        # current leader simply back-fills.
+        covered = set(self._memtable)
+        for seg in self._segments:
+            covered.update(seg.index)
+        probe = snap_idx
+        while probe + 1 in covered:
+            probe += 1
+        if probe < last:
+            import logging
+            logging.getLogger("ra_tpu").warning(
+                "%s: recovery found a log hole above %d (tail was %d); "
+                "truncating to the contiguous prefix", self.uid, probe,
+                last)
+            for k in [k for k in self._memtable if k > probe]:
+                self._memtable.pop(k, None)
+                self._mem_bytes.pop(k, None)
+            last = probe
+            if probe == snap_idx and self._snapshot is not None:
+                last_term = self._snapshot[0].term
+            elif probe in self._memtable:
+                last_term = self._memtable[probe][0]
+            else:
+                got = self._segment_read(probe) if probe else None
+                last_term = got[0] if got else 0
         if snap_idx > last:
             last, last_term = snap_idx, self._snapshot[0].term
         self._last_index, self._last_term = last, last_term
@@ -347,8 +419,28 @@ class DurableLog:
         with self._lock:
             if lo is None:
                 # resend_from: re-submit memtable entries above hi
-                # (ra_log.erl:1125+)
-                for idx in range(hi + 1, self._last_index + 1):
+                # (ra_log.erl:1125+).  Floor-clamped to last_written:
+                # entries at/below it are durable in an EARLIER file or
+                # segment and must not be re-written — a duplicate of a
+                # durable entry in a LATER wal file trips the recovery
+                # overwrite-dedup ("a lower index invalidates higher
+                # ones") and, if that later file tears, wipes durable
+                # entries from the recovered table (found by the ISSUE 4
+                # poison/rollover chaos).
+                if term == -2 and self._last_written.index > hi:
+                    # unsynced-confirm rewind (the sync_after_notify
+                    # poison path): confirms above ``hi`` rode a
+                    # durability syscall that then FAILED, so they are
+                    # not durable anywhere but the poisoned file — pull
+                    # last_written back so the floor clamp below
+                    # re-writes that suffix into the fresh file instead
+                    # of trusting the poisoned one (the entries are
+                    # still memtable-resident: pruning only happens at
+                    # segment flush, which is gated on last_written)
+                    self._last_written = IdxTerm(
+                        hi, self.fetch_term(hi) or 0)
+                start = max(hi, self._last_written.index) + 1
+                for idx in range(start, self._last_index + 1):
                     ent = self._memtable.get(idx)
                     raw = self._mem_bytes.get(idx)
                     if ent is not None and raw is not None:
@@ -368,19 +460,31 @@ class DurableLog:
         The whole collect+resend runs under the log lock — _put submits
         under the same lock, so no live append can reach the new queue
         ahead of these resends and advance last_written over a hole."""
-        from .wal import WalDown
         with self._lock:
-            lw = self._last_written.index
-            items = [(i, self._memtable[i][0], self._mem_bytes[i])
-                     for i in sorted(self._mem_bytes)
-                     if lw < i <= self._last_index]
-            try:
-                for idx, term, raw in items:
-                    self.counters["write_resends"] += 1
-                    self.wal.write(self.uid, idx, term, raw)
-            except WalDown:
-                return  # died again mid-resend; the supervisor retries us
+            if not self._resend_unconfirmed_locked():
+                return  # died again mid-resend; the supervisor retries
             self._events.append(WalUpEvent(self.wal.generation))
+
+    def _resend_unconfirmed_locked(self) -> bool:
+        """Resend every memtable entry above last_written to the current
+        WAL incarnation and record its generation as synced-with.  MUST
+        run under self._lock.  Returns False when the WAL died again
+        mid-resend (the generation stays unsynced, so the next caller
+        retries)."""
+        from .wal import WalDown
+        self._wal_generation = self.wal.generation
+        lw = self._last_written.index
+        items = [(i, self._memtable[i][0], self._mem_bytes[i])
+                 for i in sorted(self._mem_bytes)
+                 if lw < i <= self._last_index]
+        try:
+            for idx, term, raw in items:
+                self.counters["write_resends"] += 1
+                self.wal.write(self.uid, idx, term, raw)
+        except WalDown:
+            self._wal_generation = -1  # resend incomplete: retry later
+            return False
+        return True
 
     # ------------------------------------------------------------------
     # log contract (same as MemoryLog)
@@ -453,7 +557,18 @@ class DurableLog:
             # submit under the log lock (queue.put only — no blocking):
             # wal_restarted() holds the same lock across its resend batch,
             # so a live append can never slip into the restarted WAL's
-            # queue AHEAD of the resends of a durability hole below it
+            # queue AHEAD of the resends of a durability hole below it.
+            # Generation guard: a restarted WAL resets the per-writer
+            # sequence check, so a first write racing the SUPERVISOR'S
+            # resend hook would be accepted ABOVE a durability hole — if
+            # the WAL then dies again before the resend lands, the
+            # on-disk log has a committed-looking tail over a missing
+            # middle (a log that could win elections it must lose).
+            # Resend-before-submit closes the window; the supervisor's
+            # later call is an idempotent no-op for covered entries.
+            if getattr(self, "_wal_generation", None) != \
+                    self.wal.generation:
+                self._resend_unconfirmed_locked()
             self.wal.write(self.uid, entry.index, entry.term, payload,
                            truncate=truncate)
 
@@ -613,8 +728,11 @@ class DurableLog:
         with open(tmp, "wb") as f:
             f.write(data)
             if sync:
+                # rides the storage shim ("meta" fault class); an EIO
+                # here MUST propagate — a vote reply over an unsynced
+                # term/voted_for is the double-vote hazard
                 f.flush()
-                os.fsync(f.fileno())
+                IO.sync(f.fileno(), 2, path_class="meta")
         os.replace(tmp, os.path.join(self.dir, "meta"))
 
     def fetch_meta(self, key: str, default: Any = None) -> Any:
@@ -634,11 +752,33 @@ class DurableLog:
                                if i <= up_to and i > snap_idx
                                and i <= self._last_index)
                 seq_before = self._seg_seq
-            nbytes = 0
+            # skip entries already segment-durable with an AGREEING term
+            # (e.g. recovered duplicates from a retained stale WAL file):
+            # re-appending one at a lower index would trip the segment's
+            # overwrite-invalidation (append ≤ existing wipes everything
+            # above) and destroy durable entries the memtable no longer
+            # holds.  A term MISMATCH is a genuine overwrite and must
+            # still go through — invalidating the stale tail is then the
+            # point.  (Inline segment scan: _io_lock is already held.)
+            write_items = items
             if items:
+                def _seg_term(idx: int):
+                    for seg in reversed(self._segments):
+                        r = seg.range()
+                        if r and r[0] <= idx <= r[1]:
+                            got = seg.read(idx)
+                            if got is not None:
+                                return got[0]
+                    return None
+                seg_hi = max((seg.range()[1] for seg in self._segments
+                              if seg.range() is not None), default=0)
+                write_items = [(i, p, t) for i, p, t in items
+                               if i > seg_hi or _seg_term(i) != t]
+            nbytes = 0
+            if write_items:
                 seg = self._current_segment()
                 self._open_segments.touch(seg.path, seg)
-                for idx, payload, term in items:
+                for idx, payload, term in write_items:
                     if not seg.append(idx, term, payload):
                         seg.flush()
                         seg = self._new_segment()
@@ -648,11 +788,14 @@ class DurableLog:
                 seg.flush()
             with self._lock:
                 # ra swaps memtable for segment refs (:534-574): drop both
-                # copies; reads now resolve via the segment files
+                # copies; reads now resolve via the segment files.  The
+                # skipped duplicates prune too — they are ALREADY durable
+                # in a segment, which is what the prune asserts.
                 for idx, _, _ in items:
                     self._mem_bytes.pop(idx, None)
                     self._memtable.pop(idx, None)
-                return (len(items), nbytes, self._seg_seq - seq_before)
+                return (len(write_items), nbytes,
+                        self._seg_seq - seq_before)
 
     def _current_segment(self) -> SegmentFile:
         with self._lock:
@@ -711,7 +854,15 @@ class DurableLog:
         path = os.path.join(self.dir, "snapshot",
                             f"snap_{idx:016d}_{term:010d}.rtsn")
         data = self.snapshot_module.encode(machine_state)
-        _write_snapshot_file(path, meta, data)
+        try:
+            _write_snapshot_file(path, meta, data)
+        except OSError:
+            # degradation: the release cursor simply does not advance —
+            # the old snapshot and the full log stay intact (pending-dir
+            # discipline), and a later release point retries
+            _fault_note("snapshot_write_failures")
+            _drop_partial(path)
+            return []
         self.counters["snapshots_written"] += 1
         self.counters["snapshot_bytes_written"] += len(data)
         old = self._snapshot
@@ -736,7 +887,14 @@ class DurableLog:
         path = os.path.join(self.dir, "checkpoints",
                             f"cp_{idx:016d}_{term:010d}.rtsn")
         data = self.snapshot_module.encode(machine_state)
-        _write_snapshot_file(path, meta, data)
+        try:
+            _write_snapshot_file(path, meta, data)
+        except OSError:
+            # a checkpoint is purely a replay shortcut: skipping a
+            # failed one loses nothing (the log is untouched)
+            _fault_note("snapshot_write_failures")
+            _drop_partial(path)
+            return []
         self.counters["checkpoints_written"] += 1
         self.counters["checkpoint_bytes_written"] += len(data)
         with self._lock:
@@ -783,7 +941,14 @@ class DurableLog:
     def install_snapshot(self, meta: SnapshotMeta, data: bytes) -> None:
         path = os.path.join(self.dir, "snapshot",
                             f"snap_{meta.index:016d}_{meta.term:010d}.rtsn")
-        _write_snapshot_file(path, meta, data)
+        try:
+            _write_snapshot_file(path, meta, data)
+        except OSError:
+            # the install must FAIL loudly (the leader retries the
+            # transfer); the torn .partial never reached the slot
+            _fault_note("snapshot_write_failures")
+            _drop_partial(path)
+            raise
         self._post_install(meta, path)
 
     def _post_install(self, meta: SnapshotMeta, path: str) -> None:
@@ -855,10 +1020,19 @@ class DurableLog:
             return False
         self._accept = None
         f, meta = a["f"], a["meta"]
-        f.seek(8)  # crc field of _SNAP_HDR (<4sII)
-        f.write(struct.pack("<I", a["crc"]))
-        f.flush()
-        os.fsync(f.fileno())
+        try:
+            f.seek(8)  # crc field of _SNAP_HDR (<4sII)
+            f.write(struct.pack("<I", a["crc"]))
+            f.flush()
+            IO.sync(f.fileno(), 2, path_class="snapshot")
+        except OSError:
+            # the stream never reached the snapshot slot: drop the
+            # .partial and report failure — the leader restarts the
+            # transfer from chunk 1
+            _fault_note("snapshot_write_failures")
+            self._accept = a
+            self.abort_accept()
+            return False
         f.close()
         path = os.path.join(self.dir, "snapshot",
                             f"snap_{meta.index:016d}_{meta.term:010d}.rtsn")
@@ -875,11 +1049,17 @@ class DurableLog:
             try:
                 a["f"].close()
             except OSError:
-                pass
+                # safe to swallow: the stream is being abandoned — its
+                # bytes are garbage by definition (the leader restarts
+                # the transfer), so a failed close loses nothing
+                _fault_note("swallowed_oserrors")
             try:
                 os.unlink(a["path"])
             except OSError:
-                pass
+                # safe to swallow: a stranded accept.partial can never
+                # shadow a real snapshot (recovery unlinks it at boot,
+                # _recover_state) — it only leaks bytes until then
+                _fault_note("swallowed_oserrors")
 
     def recover_snapshot_state(self) -> Optional[tuple]:
         if self._snapshot is None:

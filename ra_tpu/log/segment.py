@@ -23,10 +23,11 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import queue
 from typing import Callable, Optional
 
-from ..native import IO
+from .faults import IO, note as _fault_note
 
 MAGIC = b"RTSG"
 _HDR = struct.Struct("<4sIII")
@@ -145,16 +146,27 @@ class SegmentFile:
         slots = bytearray()
         off = self._next_off
         base_slot = self._count
+        staged = []
         for idx, term, payload in self._pending:
             crc = IO.crc32(payload)
-            self.index[idx] = (term, off, len(payload), crc)
+            staged.append((idx, (term, off, len(payload), crc)))
             slots += _SLOT.pack(idx, term, off, len(payload), crc)
             data += payload
             off += len(payload)
+        # NB: on an I/O error below, NO in-memory bookkeeping changes —
+        # index/_count/_next_off/_pending stay exactly retry-shaped, so
+        # a retried flush re-issues the SAME pwrites at the same offsets
+        # (idempotent) and re-dirties the pages a failed fsync may have
+        # dropped — which is why retrying the fsync here, unlike on a
+        # WAL fd, is safe.  The index commits only AFTER the fsync:
+        # readers (and the flush-side already-durable filter) must never
+        # see written-but-unsynced slots as durable entries.
         IO.pwrite(self.fd, bytes(data), self._next_off)
         IO.pwrite(self.fd, bytes(slots),
                   _HDR.size + base_slot * _SLOT.size)
-        os.fsync(self.fd)
+        IO.sync(self.fd, 2)
+        for idx, ent in staged:
+            self.index[idx] = ent
         self._count += len(self._pending)
         self._next_off = off
         self._pending.clear()
@@ -180,8 +192,8 @@ class SegmentFile:
         for k, term, payload in survivors:
             fresh.append(k, term, payload)
         fresh.flush()
-        os.fsync(fresh.fd)   # flush() early-returns when there are no
-        fresh.close()        # survivors; the header must still be durable
+        IO.sync(fresh.fd, 2)  # flush() early-returns when there are no
+        fresh.close()         # survivors; the header must still be durable
         self.close_fd()
         os.replace(tmp_path, self.path)
         self.fd = IO.random_open(self.path)
@@ -195,14 +207,22 @@ class SegmentFile:
 
     def read(self, idx: int) -> Optional[tuple]:
         """Returns (term, payload) with crc verification
-        (ra_log_segment.erl:268-335)."""
+        (ra_log_segment.erl:268-335).  A crc mismatch is retried ONCE
+        with a fresh pread — transient read-side corruption (bit rot in
+        flight, an injected fault) must not take down a reader when the
+        on-disk bytes are fine; a second mismatch is real damage and
+        raises."""
         ent = self.index.get(idx)
         if ent is None:
             return None
         term, off, ln, crc = ent
         payload = IO.pread(self._ensure_open(), ln, off)
         if IO.crc32(payload) != crc:
-            raise ValueError(f"segment crc mismatch at {idx} in {self.path}")
+            _fault_note("crc_catches")
+            payload = IO.pread(self._ensure_open(), ln, off)
+            if IO.crc32(payload) != crc:
+                raise ValueError(
+                    f"segment crc mismatch at {idx} in {self.path}")
         return term, payload
 
     def range(self) -> Optional[tuple]:
@@ -281,10 +301,21 @@ class SegmentWriter:
     same uid); the WAL-file deletion barrier is preserved — a file is
     unlinked only after every uid's flush in its job completed."""
 
+    #: per-uid flush attempts before escalation (first try + retries)
+    FLUSH_ATTEMPTS = 3
+    #: base backoff between flush retries (doubles per attempt)
+    FLUSH_BACKOFF_S = 0.05
+
     def __init__(self, resolve: Optional[Callable] = None,
-                 flush_workers: int = 4) -> None:
+                 flush_workers: int = 4,
+                 on_escalate: Optional[Callable] = None) -> None:
         #: resolve(uid) -> DurableLog | None (set by the node/log registry)
         self.resolve = resolve or (lambda uid: None)
+        #: escalation hook: called as on_escalate(uid, exc) when a uid's
+        #: flush exhausted its retry budget — the "server exit +
+        #: supervisor restart" rung of the degradation ladder (the WAL
+        #: file is kept either way, so the entries stay recoverable)
+        self.on_escalate = on_escalate
         #: node-wide counters (ra_log_segment_writer.erl:37-52 names)
         from ..metrics import SEGMENT_WRITER_FIELDS
         self.counters: dict[str, int] = {f: 0
@@ -361,22 +392,52 @@ class SegmentWriter:
                 continue
             jobs.append((uid, log, hi))
         # fan the per-uid flushes over the pool (partition_parallel role)
-        futures = [(uid, self._pool.submit(log.flush_mem_to_segments, hi))
+        futures = [(uid, log, hi,
+                    self._pool.submit(log.flush_mem_to_segments, hi))
                    for uid, log, hi in jobs]
-        for uid, fut in futures:
+        for uid, log, hi, fut in futures:
             try:
                 self._count_flush(fut.result())
-            except Exception:
-                import logging
-                logging.getLogger("ra_tpu").exception(
-                    "segment flush failed for %s", uid)
-                unresolved = True  # keep the WAL file: entries recoverable
+            except Exception as exc:
+                if not self._retry_flush(uid, log, hi, exc):
+                    unresolved = True  # keep WAL file: still recoverable
         if not unresolved:
             # all servers flushed: the WAL file is redundant (:206-214)
             try:
                 os.unlink(wal_path)
             except FileNotFoundError:
                 pass
+
+    def _retry_flush(self, uid: str, log, hi, exc: Exception) -> bool:
+        """Retry-with-backoff rung of the flush degradation ladder
+        (retry -> escalate).  flush() leaves its bookkeeping
+        retry-shaped (same pwrites, re-dirtied pages), so re-running the
+        whole memtable drain is idempotent.  Returns True when a retry
+        succeeded; on exhaustion fires the escalation hook and returns
+        False — the caller keeps the WAL file, so the entries remain
+        recoverable from disk whatever the escalation does."""
+        import logging
+        log_ = logging.getLogger("ra_tpu")
+        log_.warning("segment flush failed for %s (%s); retrying",
+                     uid, exc)
+        _fault_note("faults_hit")
+        for attempt in range(1, self.FLUSH_ATTEMPTS):
+            time.sleep(self.FLUSH_BACKOFF_S * (2 ** (attempt - 1)))
+            _fault_note("flush_retries")
+            try:
+                self._count_flush(log.flush_mem_to_segments(hi))
+                return True
+            except Exception as retry_exc:  # noqa: BLE001 — ladder rung
+                exc = retry_exc
+        _fault_note("flush_escalations")
+        log_.error("segment flush for %s exhausted %d attempts (%s); "
+                   "escalating", uid, self.FLUSH_ATTEMPTS, exc)
+        if self.on_escalate is not None:
+            try:
+                self.on_escalate(uid, exc)
+            except Exception:  # noqa: BLE001 — hook must not kill writer
+                log_.exception("flush escalation hook failed for %s", uid)
+        return False
 
     def _retire_job(self, uids: list, wal_files: list,
                     attempt: int = 0) -> None:
@@ -397,18 +458,17 @@ class SegmentWriter:
         for uid in uids:
             log = self.resolve(uid)
             if log is not None:
-                futures.append(self._pool.submit(
+                futures.append((uid, log, self._pool.submit(
                     lambda lg=log: lg.flush_mem_to_segments(
-                        lg.last_written().index)))
+                        lg.last_written().index))))
         failed = False
-        for fut in futures:
+        for uid, log, fut in futures:
             try:
                 self._count_flush(fut.result())
-            except Exception:
-                import logging
-                logging.getLogger("ra_tpu").exception(
-                    "segment retire flush failed")
-                failed = True
+            except Exception as exc:  # noqa: BLE001 — enters retry ladder
+                if not self._retry_flush(uid, log,
+                                         log.last_written().index, exc):
+                    failed = True
         if failed:
             return  # keep the recovered files: entries still needed
         for path in wal_files:

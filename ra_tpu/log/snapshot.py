@@ -18,7 +18,14 @@ Module contract (all callbacks pure, stateless):
   (:129-143): yield the data as transfer chunks.  Default: plain byte
   slices; override for formats with natural chunk boundaries.
 * ``validate(data) -> bool`` — extra format-level validation on top of
-  the container crc (:157-160)
+  the container crc (:157-160).  Fault-model note (INTERNALS §6.3):
+  the container layer already catches read-side bit corruption by crc
+  (with one fresh-read retry) and torn writes by the pending-dir
+  rename discipline, so ``validate`` only needs to reject
+  *format*-level mismatches (e.g. a module change without migration) —
+  it must NOT silently accept-and-reinterpret foreign bytes, which
+  recover_snapshot_state treats as a loud failure rather than a
+  fallback.
 
 The follower's accept side (begin_accept/accept_chunk/complete_accept,
 :144-149) is chunk-format-agnostic by construction: chunks are

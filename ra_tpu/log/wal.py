@@ -39,7 +39,7 @@ import time
 from typing import Callable, Optional
 
 from .. import trace
-from ..native import IO
+from .faults import IO, note as _fault_note
 
 MAGIC = b"RTW2"
 MAGIC_V1 = b"RTW1"   # payload-only entry crc (read-compatible)
@@ -60,6 +60,12 @@ def _entry_crc(header: bytes, payload: bytes) -> int:
 DEFAULT_MAX_SIZE = 64 * 1024 * 1024   # ra.hrl:191 uses 256MB; scaled down
 DEFAULT_MAX_BATCH = 8192              # ra.hrl:192
 
+#: consecutive faulted batches before the poison/rollover ladder gives
+#: up and escalates to thread death (supervisor restart + intensity
+#: window) — a persistent fault (dead disk, full volume) must not
+#: hot-loop file rollovers
+MAX_POISON_STREAK = 3
+
 #: notify(uid, lo, hi, term) — lo None => resend_from(hi)
 NotifyFn = Callable[[str, Optional[int], int, int], None]
 
@@ -72,31 +78,33 @@ class WalDown(RuntimeError):
     until the supervisor restarts the WAL."""
 
 
-def scan_wal_file(path: str, tables: dict) -> None:
-    """Parse one WAL file into per-uid tables (idx -> (term, payload)),
-    deduping overwrites; raises on a torn/corrupt tail (callers keep the
-    prefix parsed so far).  Shared by live recovery and offline replay
-    (ra_dbg)."""
-    with open(path, "rb") as f:
-        data = f.read()
+def _parse_wal_bytes(data: bytes) -> tuple:
+    """Parse raw WAL bytes -> (records, err): the prefix of records up
+    to the first damage point, and the ValueError describing it (None
+    when the file parses clean).  Records are ("reg", wid, uid) and
+    ("ent", wid, idx, term, payload) — pure parsing, no table mutation,
+    so a corrupt read can be retried without double-applying."""
+    records: list = []
     if data[:4] not in (MAGIC, MAGIC_V1):
-        return
+        return records, None
     header_crc = data[:4] == MAGIC
     pos = 4
-    wid_to_uid: dict[int, str] = {}
     while pos + 1 <= len(data):
         rtype = data[pos]
         if rtype == 1:
             if pos + _REG.size > len(data):
-                raise ValueError("torn registration")
+                return records, ValueError("torn registration")
             _, wid, ulen = _REG.unpack_from(data, pos)
             pos += _REG.size
-            uid = data[pos:pos + ulen].decode()
+            try:
+                uid = data[pos:pos + ulen].decode()
+            except UnicodeDecodeError:
+                return records, ValueError("corrupt registration uid")
             pos += ulen
-            wid_to_uid[wid] = uid
+            records.append(("reg", wid, uid))
         elif rtype == 2:
             if pos + _ENT.size > len(data):
-                raise ValueError("torn entry header")
+                return records, ValueError("torn entry header")
             _, wid, idx, term, plen, crc = _ENT.unpack_from(data, pos)
             pos += _ENT.size
             payload = data[pos:pos + plen]
@@ -104,19 +112,51 @@ def scan_wal_file(path: str, tables: dict) -> None:
             want = _entry_crc(_ENT_HDR.pack(2, wid, idx, term, plen),
                               payload) if header_crc else IO.crc32(payload)
             if len(payload) < plen or want != crc:
-                raise ValueError("crc mismatch")  # torn tail: stop
-            uid = wid_to_uid.get(wid)
-            if uid is None:
-                continue
-            tbl = tables.setdefault(uid, {})
-            if idx in tbl or any(k > idx for k in tbl):
-                # overwrite invalidates higher indexes (dedup,
-                # ra_log_wal recovery semantics :871-955)
-                for k in [k for k in tbl if k > idx]:
-                    del tbl[k]
-            tbl[idx] = (term, payload)
+                return records, ValueError("crc mismatch")  # torn tail
+            records.append(("ent", wid, idx, term, payload))
         else:
             break
+    return records, None
+
+
+def scan_wal_file(path: str, tables: dict) -> None:
+    """Parse one WAL file into per-uid tables (idx -> (term, payload)),
+    deduping overwrites; raises on a torn/corrupt tail (callers keep the
+    prefix parsed so far).  A parse failure is retried ONCE with a fresh
+    read — the crc caught the damage either way (counted as a
+    crc_catch), but transient read-side corruption (a flipped bit in
+    flight, not on the platter) must not truncate recovery when a
+    second read comes back clean.  Shared by live recovery and offline
+    replay (ra_dbg)."""
+    records, err = _parse_wal_bytes(IO.read_file(path))
+    if err is not None:
+        retry, retry_err = _parse_wal_bytes(IO.read_file(path))
+        if retry_err is None or len(retry) > len(records):
+            # the fresh read parsed further: the damage was transient
+            # read-side corruption (a bit flipped in flight), not a
+            # torn tail on the platter — only THIS case is a crc catch;
+            # an identical re-parse is an ordinary torn tail (every
+            # kill-9 recovery) and is not fault telemetry
+            _fault_note("crc_catches")
+            records, err = retry, retry_err
+    wid_to_uid: dict[int, str] = {}
+    for rec in records:
+        if rec[0] == "reg":
+            wid_to_uid[rec[1]] = rec[2]
+            continue
+        _kind, wid, idx, term, payload = rec
+        uid = wid_to_uid.get(wid)
+        if uid is None:
+            continue
+        tbl = tables.setdefault(uid, {})
+        if idx in tbl or any(k > idx for k in tbl):
+            # overwrite invalidates higher indexes (dedup,
+            # ra_log_wal recovery semantics :871-955)
+            for k in [k for k in tbl if k > idx]:
+                del tbl[k]
+        tbl[idx] = (term, payload)
+    if err is not None:
+        raise err
 
 
 class _Writer:
@@ -192,6 +232,9 @@ class Wal:
         self._file_ranges: dict[str, list] = {}  # uid -> [lo, hi] this file
         self._registered_in_file: set = set()
         self._stop = False
+        #: consecutive batches that hit an I/O fault (reset on the first
+        #: clean batch) — drives the poison -> rollover -> escalate ladder
+        self._poison_streak = 0
         #: bumped by restart(); lets observers detect "new WAL incarnation"
         #: (the reference's new-wal-pid check, ra_log.erl:778-793)
         self.generation = 0
@@ -368,6 +411,7 @@ class Wal:
             for w in self._writers.values():
                 w.last_idx = None  # writers resend; fresh sequence check
         self._retire_current_file()
+        self._poison_streak = 0  # fresh incarnation, fresh ladder
         self.generation += 1
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ra-wal")
@@ -417,22 +461,31 @@ class Wal:
                 c[2] = term
         deferred_sync = False
         if buf:
-            # IO first, bookkeeping after: if the write throws (the
-            # let-it-crash path the supervisor recovers), last_idx and
-            # _file_ranges still describe only bytes the file really
-            # holds — restart() hands _file_ranges to the segment writer,
-            # which flushes and then DELETES the file, so overstating the
-            # ranges would silently drop acknowledged entries
-            if self.write_strategy == "o_sync":
-                # O_SYNC fd: the write IS the durability point
-                n = IO.write_batch(self._fd, bytes(buf), 0)
-            elif self.write_strategy == "sync_after_notify":
-                n = IO.write_batch(self._fd, bytes(buf), 0)
-                deferred_sync = self.sync_mode != 0
-            else:
-                n = IO.write_batch(self._fd, bytes(buf), 0)
-                if self.sync_mode:
-                    self._timed_sync()
+            # IO first, bookkeeping after: if the write throws, last_idx
+            # and _file_ranges still describe only bytes the file really
+            # holds — rollover/restart hand _file_ranges to the segment
+            # writer, which flushes and then DELETES the file, so
+            # overstating the ranges would silently drop acknowledged
+            # entries
+            try:
+                if self.write_strategy == "o_sync":
+                    # O_SYNC fd: the write IS the durability point
+                    n = IO.write_batch(self._fd, bytes(buf), 0)
+                elif self.write_strategy == "sync_after_notify":
+                    n = IO.write_batch(self._fd, bytes(buf), 0)
+                    deferred_sync = self.sync_mode != 0
+                else:
+                    n = IO.write_batch(self._fd, bytes(buf), 0)
+                    if self.sync_mode:
+                        self._timed_sync()
+            except OSError as exc:
+                # nothing was confirmed: bookkeeping and notify are
+                # skipped, the batch's entries stay memtable-resident,
+                # and the degradation ladder (poison -> rollover ->
+                # resend, escalate after a streak) takes over
+                self._on_batch_io_error(exc, flushes)
+                return
+            self._poison_streak = 0
             self._file_size += n
             self._file_entries += n_entries
             self.counters["batches"] += 1
@@ -459,7 +512,17 @@ class Wal:
         if deferred_sync:
             # sync_after_notify: durability syscall AFTER the confirms
             # (complete_batch with post-notify sync, ra_log_wal.erl:66-96)
-            self._timed_sync()
+            try:
+                self._timed_sync()
+            except OSError as exc:
+                # the documented weaker window of this strategy: the
+                # batch was already confirmed but may not be durable.
+                # Poison + rollover; passing the batch's confirm window
+                # makes the resend reach BELOW last_idx and re-write the
+                # confirmed-but-unsynced suffix into the fresh file,
+                # closing the window going forward.
+                self._on_batch_io_error(exc, flushes, confirmed=confirms)
+                return
         if roll or self._file_size >= self.max_size or \
                 (self.max_entries and
                  self._file_entries >= self.max_entries):
@@ -468,6 +531,64 @@ class Wal:
         # handed to the segment writer (callers chain await_idle after)
         for done in flushes:
             done.set()
+
+    def _on_batch_io_error(self, exc: OSError, flushes: list,
+                           confirmed: Optional[dict] = None) -> None:
+        """Degradation policy for a failed batch write or durability
+        syscall — the fsyncgate discipline made supervision-shaped:
+
+        * the current file is POISONED: its fd is never fsynced again
+          (after a failed fsync the kernel may have dropped the dirty
+          pages, so a retried fsync can report success over lost data).
+          The file is retired exactly like a rollover — its confirmed
+          ranges go to the segment writer, which flushes them from the
+          MEMTABLES, so nothing acknowledged depends on the bad file.
+        * every registered writer gets a resend_from signal at its last
+          accepted index: unconfirmed entries re-enter the queue and
+          land in the fresh file (writers re-register on first write).
+        * flush barriers are RE-QUEUED, not released — a durability
+          barrier may only trip once the resends are really on disk.
+        * MAX_POISON_STREAK consecutive faulted batches escalate to
+          thread death: the supervisor restarts the WAL under its
+          intensity window instead of this thread hot-looping rollovers
+          against a dead disk.
+        """
+        import logging
+        logging.getLogger("ra_tpu").warning(
+            "wal batch I/O error (%s): poisoning %s",
+            exc, self._file_path)
+        _fault_note("faults_hit")
+        _fault_note("poisoned_files")
+        self._poison_streak += 1
+        if self._poison_streak >= MAX_POISON_STREAK:
+            _fault_note("wal_escalations")
+            raise exc
+        _fault_note("fault_rollovers")
+        self._retire_current_file()
+        with self._lock:
+            # last_idx None (a writer that never confirmed through this
+            # incarnation, e.g. right after a supervised restart) means
+            # "resend everything memtable-resident": hi=0 — duplicates
+            # are harmless (overwrite dedup + stale-confirm clamping).
+            # ``confirmed`` (the sync_after_notify failure path) pulls
+            # the resend floor below entries that were confirmed ahead
+            # of the durability syscall that then failed; those resends
+            # carry term=-2 ("unsynced-confirm rewind") so a writer that
+            # floor-clamps its resends to its own confirm watermark
+            # (DurableLog does) knows to pull that watermark back first
+            # instead of trusting the poisoned file for the suffix.
+            resends = []
+            for w in self._writers.values():
+                last = w.last_idx if w.last_idx is not None else 0
+                term = -1
+                if confirmed and w.uid in confirmed:
+                    last = min(last, confirmed[w.uid][0] - 1)
+                    term = -2
+                resends.append((w.notify, w.uid, max(0, last), term))
+        for notify, uid, last, term in resends:
+            notify(uid, None, last, term)
+        for done in flushes:
+            self._queue.put(("__flush__", 0, 0, b"", done))
 
     def _timed_sync(self) -> None:
         """Durability syscall with latency accounting (the reference
@@ -532,7 +653,11 @@ class Wal:
         try:
             IO.close(old_fd)
         except OSError:
-            pass
+            # safe to swallow: the fd is retiring and is never read or
+            # synced again — its confirmed entries are covered by the
+            # memtable + segment-flush barrier, and a poisoned fd may
+            # legitimately surface its deferred EIO here
+            _fault_note("swallowed_oserrors")
         self._open_new_file()
         if ranges and self.segment_writer is not None:
             self.segment_writer.accept_ranges(ranges, old_path)
@@ -540,7 +665,10 @@ class Wal:
             try:
                 os.unlink(old_path)
             except OSError:
-                pass
+                # safe to swallow: an empty (magic-only) file that fails
+                # to unlink leaks bytes, not data — recovery re-reads it
+                # as a no-op
+                _fault_note("swallowed_oserrors")
 
     def _recover(self) -> None:
         files = sorted(f for f in os.listdir(self.dir)

@@ -93,6 +93,31 @@ ENGINE_WAL_FIELDS = ("readback_bytes", "readback_bytes_full",
 SEGMENT_WRITER_FIELDS = ("mem_tables", "segments", "entries",
                          "bytes_written")
 
+#: storage-plane fault observability (ra_tpu/log/faults.py): one
+#: node-wide dict, the disk twin of RPC_FIELDS.  Plan-side:
+#: ``faults_injected`` counts DiskFaultPlan decisions that injected a
+#: fault (per-kind detail lives on the plan's own counters).  Policy
+#: side: ``faults_hit`` is every I/O error the log layer *handled*
+#: (poison/rollover/retry/skip — not thread death), ``crc_catches``
+#: read-side corruption caught by a crc check, ``poisoned_files`` WAL
+#: files poisoned by a failed durability syscall (fsyncgate: the fd is
+#: never fsynced again), ``fault_rollovers`` the rollovers that poison
+#: forced, ``wal_escalations`` consecutive-poison cap overflows that
+#: escalate to thread death (supervisor restart), ``flush_retries``/
+#: ``flush_escalations`` the segment-flush backoff ladder,
+#: ``snapshot_write_failures`` failed container writes (pending-dir
+#: discipline: the old snapshot stays), ``swallowed_oserrors`` the
+#: audited allow-listed swallow sites (each carries a why-safe
+#: comment), and ``fsync_retries_after_failure`` fsyncgate-discipline
+#: violations — an fsync re-issued on a failed fd with no intervening
+#: rewrite of its data; MUST stay 0.
+DISK_FAULT_FIELDS = (
+    "faults_injected", "faults_hit", "crc_catches", "poisoned_files",
+    "fault_rollovers", "wal_escalations", "flush_retries",
+    "flush_escalations", "snapshot_write_failures",
+    "swallowed_oserrors", "fsync_retries_after_failure",
+)
+
 
 class Counters:
     """Named counter groups (the seshat role)."""

@@ -1,4 +1,14 @@
-"""Pallas TPU kernel for the fused quorum/commit step.
+"""Pallas TPU kernel for the fused quorum/commit step — a DOCUMENTED
+EXPERIMENT, off by default.
+
+Status (round-5 measurement, tpu_rows_r05/): the kernel LOSES to the
+plain-XLA sort-median lowering on the headline config — 101.4M cmds/s
+vs 112.4M (~10% slower).  A hand kernel that trails the compiler is
+negative value on the hottest path, so ``auto`` resolution now picks
+XLA everywhere; the kernel stays only as a measured baseline for a
+future fused quorum+credit+clamp attempt.  Opt back in with
+``RA_TPU_ENABLE_PALLAS_QUORUM=1`` (or an explicit ``impl="pallas"``).
+The measured gap is recorded in docs/BENCHMARKS.md.
 
 The hot per-step arithmetic of the lockstep engine is
 ``evaluate_quorum`` (ra_tpu.ops.quorum): a voter-masked majority median
@@ -94,13 +104,20 @@ def evaluate_quorum_pallas(commit_index: Array, match_index: Array,
 
 def make_evaluate_quorum(impl: str = "auto"):
     """Resolve the quorum implementation: 'xla' (jnp sort-median oracle),
-    'pallas' (this kernel), or 'auto' (pallas on TPU backends, xla
-    elsewhere)."""
+    'pallas' (this kernel), or 'auto'.  'auto' resolves to XLA — the
+    kernel measured ~10% SLOWER than the compiler on the headline
+    config (101.4M vs 112.4M cmds/s, round 5), so it is demoted to an
+    env-gated experiment: set RA_TPU_ENABLE_PALLAS_QUORUM=1 to let
+    'auto' pick it on TPU backends again (an explicit 'pallas' always
+    wins)."""
+    import os
+
     from .quorum import evaluate_quorum as xla_impl
 
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() in ("tpu", "axon") \
-            else "xla"
+        gate = os.environ.get("RA_TPU_ENABLE_PALLAS_QUORUM", "")
+        impl = "pallas" if gate not in ("", "0") and \
+            jax.default_backend() in ("tpu", "axon") else "xla"
     if impl == "pallas":
         # off-TPU the kernel only runs under the interpreter; resolve at
         # build time so an explicit 'pallas' choice works on a dev box

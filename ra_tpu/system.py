@@ -77,7 +77,15 @@ class RaSystem:
         self._logs: dict[str, DurableLog] = {}
         self._lock = threading.Lock()
         self.directory = Directory(data_dir)
-        self.segment_writer = SegmentWriter(resolve=self._resolve)
+        #: flush-escalation handler: called as fn(uid, exc) when a
+        #: server's segment flush exhausted its retry budget (the
+        #: server-restart rung of the degradation ladder — a node that
+        #: hosts the server can install a kill+restart hook here;
+        #: the default just records the event, which is safe: the WAL
+        #: file is kept, so the entries stay recoverable)
+        self.on_flush_escalation = None
+        self.segment_writer = SegmentWriter(resolve=self._resolve,
+                                            on_escalate=self._escalate)
         # group-commit tunables ride through to the node-wide WAL (flush
         # on bytes OR interval; 0/0 keeps the drain-the-mailbox policy)
         self.wal = Wal(data_dir, sync_mode=wal_sync_mode,
@@ -170,6 +178,22 @@ class RaSystem:
     def _resolve(self, uid: str) -> Optional[DurableLog]:
         with self._lock:
             return self._logs.get(uid)
+
+    def _escalate(self, uid: str, exc: BaseException) -> None:
+        """Segment-flush escalation (retry budget exhausted).  With no
+        installed handler this only logs: the flush job kept the WAL
+        file, so every entry remains recoverable from disk — the
+        degraded state is 'WAL files accumulate', not data loss.  A
+        node-level handler (on_flush_escalation) may stop+restart the
+        owning server so it re-recovers from memtable + segments, the
+        reference's supervisor semantics."""
+        handler = self.on_flush_escalation
+        if handler is not None:
+            handler(uid, exc)
+        else:
+            logging.getLogger("ra_tpu").error(
+                "segment flush escalation for %s (%s): WAL file kept, "
+                "no restart handler installed", uid, exc)
 
     @staticmethod
     def validate_uid(uid: str) -> bool:
@@ -302,10 +326,13 @@ class RaSystem:
     def counters(self) -> dict:
         """Node-wide infra counters: the WAL's (ra_log_wal.erl:32-43,
         plus derived fsync latency p50/p99 and records-per-fsync from
-        Wal.stats) and the segment writer's
-        (ra_log_segment_writer.erl:37-52)."""
+        Wal.stats), the segment writer's
+        (ra_log_segment_writer.erl:37-52), and the storage-plane fault
+        counters (metrics.DISK_FAULT_FIELDS)."""
+        from .log import faults
         return {"wal": self.wal.stats(),
-                "segment_writer": dict(self.segment_writer.counters)}
+                "segment_writer": dict(self.segment_writer.counters),
+                "disk_faults": faults.disk_fault_counters()}
 
     def overview(self) -> dict:
         with self._lock:

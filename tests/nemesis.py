@@ -8,6 +8,13 @@ traffic between a chosen split for a while, ``heal`` removes all blocks,
 transport hook there is the inet_tcp_proxy dist carrier; here it is
 LocalRouter.block/heal, which the node runtime consults on every send —
 the same "links silently drop" failure model.
+
+The storage plane composes with the wire: ``disk_faults`` installs a
+seeded :class:`ra_tpu.log.faults.DiskFaultPlan` (the node-wide storage
+I/O shim consults it), ``disk_heal`` clears it, and ``wal_kill``
+crashes a named system's WAL batch thread mid-schedule — together with
+partitions this is the combined transport+disk+crash chaos the
+linearizability suite drives.
 """
 from __future__ import annotations
 
@@ -16,16 +23,20 @@ import time
 from typing import Iterable, Optional
 
 from ra_tpu.core.types import ServerId
+from ra_tpu.log import faults
 from ra_tpu.node import LocalRouter, RaNode
 
 
 class Nemesis:
-    """Interprets fault schedules against a router + set of RaNodes."""
+    """Interprets fault schedules against a router + set of RaNodes
+    (plus, optionally, their RaSystems for storage-plane ops)."""
 
     def __init__(self, router: LocalRouter, nodes: Iterable[RaNode],
-                 seed: int = 0) -> None:
+                 seed: int = 0, systems: Optional[dict] = None) -> None:
         self.router = router
         self.nodes = {n.name: n for n in nodes}
+        #: node name -> RaSystem (for wal_kill); optional
+        self.systems = dict(systems or {})
         self.rng = random.Random(seed)
         self.history: list = []
 
@@ -82,6 +93,25 @@ class Nemesis:
             node = self.nodes.get(sid.node)
             if node is not None and sid.name in node.shells:
                 node.kill_server(sid.name)
+
+    # -- storage plane ------------------------------------------------------
+
+    def _op_disk_faults(self, plan: faults.DiskFaultPlan) -> None:
+        """Install a seeded DiskFaultPlan on the node-wide storage I/O
+        shim (every co-hosted system shares it — the same blast radius
+        a sick disk has)."""
+        faults.install_plan(plan)
+
+    def _op_disk_heal(self) -> None:
+        faults.clear_plan()
+
+    def _op_wal_kill(self, node_name: str) -> None:
+        """Crash the named system's WAL batch thread (the supervisor is
+        expected to bring it back; servers park in await_condition
+        meanwhile)."""
+        system = self.systems.get(node_name)
+        if system is not None and system.wal.alive:
+            system.wal.kill()
 
 
 def current_leader(router: LocalRouter,

@@ -1,0 +1,749 @@
+"""Storage-plane fault injection + crash-degradation policy (ISSUE 4).
+
+The recovery matrix the acceptance criteria pin: for each fault class
+(fsync-EIO, ENOSPC, short/torn write, read-side bit corruption) x each
+storage plane (WAL, segment, snapshot), the degradation ladder
+(poison -> rollover -> resend; retry -> escalate; pending-dir skip)
+keeps the system live, recovery replays to oracle-exact state, and no
+acknowledged index ever exceeds what a cold restart can recover — the
+fsynced watermark (asserted via DISK_FAULT_FIELDS + the confirm-vector
+checks).  The fsyncgate discipline is pinned throughout:
+``fsync_retries_after_failure`` must stay 0.
+
+Plus: plan determinism, the WAL escalation ladder, the segment-flush
+escalation hook, and the combined transport+disk+crash nemesis run
+checked by the linearizability checker under a fixed seed.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+import ra_tpu
+from ra_tpu import LocalRouter, RaNode, RaSystem
+from ra_tpu.core.machine import SimpleMachine
+from ra_tpu.core.types import Entry, ServerConfig, ServerId, \
+    UserCommand, WrittenEvent
+from ra_tpu.log import faults
+from ra_tpu.log.faults import DiskFaultPlan, DiskFaultSpec
+
+from nemesis import Nemesis, await_leader
+
+# injected faults legitimately kill the WAL batch thread on escalation —
+# that is the ladder's last rung, not a test failure
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.clear_plan()
+    faults.reset_disk_fault_counters()
+    yield
+    faults.clear_plan()
+    faults.reset_disk_fault_counters()
+
+
+def mk_log(system, uid="u1"):
+    cfg = ServerConfig(server_id=None, uid=uid, cluster_name="c",
+                       initial_members=(), machine=None)
+    return system.log_factory(cfg)
+
+
+def drain(log, upto, timeout=10.0):
+    """Pump written events until last_written reaches ``upto`` (faulted
+    batches confirm late: resends ride the fresh post-rollover file)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for e in log.take_events():
+            if isinstance(e, WrittenEvent):
+                log.handle_written(e)
+        if log.last_written().index >= upto:
+            return
+        time.sleep(0.005)
+    raise TimeoutError(
+        f"log never confirmed up to {upto} "
+        f"(at {log.last_written().index}); {faults.disk_fault_counters()}")
+
+
+def append_range(log, lo, hi):
+    for i in range(lo, hi + 1):
+        log.append(Entry(i, 1, UserCommand(i)))
+
+
+def verify_oracle(tmp_path, uid, hi, snap_idx=0):
+    """Cold restart: every entry above the snapshot floor is present
+    with its oracle value — the recovery-replays-to-oracle-exact check."""
+    sys2 = RaSystem(str(tmp_path), wal_supervise=False)
+    try:
+        log2 = mk_log(sys2, uid)
+        assert log2.last_index_term().index >= hi
+        for i in range(max(1, snap_idx + 1), hi + 1):
+            ent = log2.fetch(i)
+            assert ent is not None, i
+            assert ent.command.data == i, (i, ent.command.data)
+    finally:
+        sys2.close()
+
+
+WRITE_FAULTS = {
+    "fsync_eio": dict(fsync_eio=1.0, limit=2),
+    "enospc": dict(enospc=1.0, limit=2),
+    "short_write": dict(short_write=1.0, limit=2),
+}
+
+
+# ---------------------------------------------------------------------------
+# plan determinism + shim basics
+# ---------------------------------------------------------------------------
+
+def test_plan_streams_are_deterministic():
+    def draws(plan):
+        return [plan.decide("wal", "fsync", "/d/00000001.wal")[0]
+                for _ in range(32)]
+
+    spec = DiskFaultSpec(fsync_eio=0.5)
+    a = draws(DiskFaultPlan(seed=7, by_class={"wal": spec}))
+    b = draws(DiskFaultPlan(seed=7, by_class={"wal": spec}))
+    assert a == b
+    assert "fsync_eio" in a
+    c = draws(DiskFaultPlan(seed=8, by_class={"wal": spec}))
+    assert a != c  # a different seed is a different schedule
+    # streams are independent: draining another stream first must not
+    # perturb this one
+    p = DiskFaultPlan(seed=7, by_class={"wal": spec,
+                                        "segment": spec})
+    for _ in range(100):
+        p.decide("segment", "fsync", "/d/x.segment")
+    p2 = DiskFaultPlan(seed=7, by_class={"wal": spec})
+    assert draws(p) == draws(p2)
+
+
+def test_plan_limit_and_rules_resolution():
+    spec = DiskFaultSpec(enospc=1.0, limit=3)
+    plan = DiskFaultPlan(seed=1, rules=[
+        ("wal", DiskFaultSpec(enospc=1.0, limit=1,
+                              path_match="shard03")),
+        ("wal", spec),
+    ])
+    # the shard03 rule wins for matching paths and spends only ITS limit
+    assert plan.decide("wal", "write", "/d/shard03/wal/1.wal")[0] == \
+        "enospc"
+    assert plan.decide("wal", "write", "/d/shard03/wal/1.wal")[0] == "ok"
+    # other wal paths resolve to the broad rule (its own 3-fault budget)
+    kinds = [plan.decide("wal", "write", "/d/wal/1.wal")[0]
+             for _ in range(5)]
+    assert kinds == ["enospc"] * 3 + ["ok", "ok"]
+    # unmatched classes fall through to the quiet default
+    assert plan.decide("segment", "write", "/d/s.segment")[0] == "ok"
+
+
+def test_classify_path():
+    cp = faults.classify_path
+    assert cp("/d/wal/00000001.wal") == "wal"
+    assert cp("/d/u1/00000003.segment") == "segment"
+    assert cp("/d/u1/00000003.segment.trunc") == "segment"
+    assert cp("/d/u1/snapshot/snap_1_1.rtsn") == "snapshot"
+    assert cp("/d/u1/snapshot/snap_1_1.rtsn.partial") == "snapshot"
+    assert cp("/d/u1/snapshot/accept.partial") == "snapshot"
+    assert cp("/d/u1/checkpoints/cp_1_1.rtsn") == "snapshot"
+    assert cp("/d/u1/meta") == "meta"
+    assert cp("/d/u1/meta.partial") == "meta"
+    assert cp("/d/whatever.bin") == "other"
+
+
+# ---------------------------------------------------------------------------
+# WAL plane
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fault", sorted(WRITE_FAULTS))
+def test_wal_write_fault_matrix(tmp_path, fault):
+    """A failed WAL batch write/fsync poisons the file, rolls over, and
+    resends — confirmation is withheld until the entries are really
+    durable, nothing acknowledged is lost across a cold restart, and
+    the fsyncgate discipline holds (no fsync retried on a failed fd)."""
+    sys_ = RaSystem(str(tmp_path), wal_supervise=True)
+    try:
+        log = mk_log(sys_)
+        append_range(log, 1, 10)
+        drain(log, 10)
+
+        faults.install_plan(DiskFaultPlan(
+            seed=3, by_class={"wal": DiskFaultSpec(**WRITE_FAULTS[fault])}))
+        append_range(log, 11, 30)
+        drain(log, 30)
+        faults.clear_plan()
+
+        ctr = faults.disk_fault_counters()
+        assert ctr["faults_injected"] >= 1, ctr
+        assert ctr["faults_hit"] >= 1, ctr
+        assert ctr["poisoned_files"] >= 1, ctr
+        # the ladder rolled over (or escalated to a supervised restart)
+        assert ctr["fault_rollovers"] + ctr["wal_escalations"] >= 1, ctr
+        # fsyncgate: the policy NEVER re-syncs a failed fd
+        assert ctr["fsync_retries_after_failure"] == 0, ctr
+        observed_lw = log.last_written().index
+        assert observed_lw == 30
+    finally:
+        faults.clear_plan()
+        sys_.close()
+    # the fsynced-watermark check: everything ever confirmed must be
+    # recoverable from disk alone
+    verify_oracle(tmp_path, "u1", 30)
+
+
+def test_wal_recovery_read_corruption_caught_by_crc(tmp_path):
+    """Read-side bit rot during WAL recovery: the record crc catches it
+    (crc_catches), the scan retries with a fresh read, and recovery is
+    oracle-exact."""
+    sys_ = RaSystem(str(tmp_path), wal_supervise=False)
+    log = mk_log(sys_)
+    append_range(log, 1, 30)
+    drain(log, 30)
+    sys_.close()
+
+    faults.install_plan(DiskFaultPlan(
+        seed=5, by_class={"wal": DiskFaultSpec(corrupt_read=1.0,
+                                               limit=1)}))
+    try:
+        verify_oracle(tmp_path, "u1", 30)
+    finally:
+        faults.clear_plan()
+    ctr = faults.disk_fault_counters()
+    assert ctr["faults_injected"] >= 1, ctr
+    assert ctr["crc_catches"] >= 1, ctr
+
+
+def test_wal_escalation_ladder_hands_off_to_supervisor(tmp_path):
+    """MAX_POISON_STREAK consecutive faulted batches escalate to thread
+    death; the system supervisor restarts the WAL and the writers
+    resend — the last two rungs of the ladder compose."""
+    from ra_tpu.log.wal import MAX_POISON_STREAK
+
+    sys_ = RaSystem(str(tmp_path), wal_supervise=True)
+    try:
+        log = mk_log(sys_)
+        append_range(log, 1, 5)
+        drain(log, 5)
+        # unbounded fsync failure: rollover cannot outrun it, so the
+        # ladder must escalate within MAX_POISON_STREAK batches
+        faults.install_plan(DiskFaultPlan(
+            seed=11, by_class={"wal": DiskFaultSpec(
+                fsync_eio=1.0, limit=2 * MAX_POISON_STREAK)}))
+        append_range(log, 6, 20)
+        drain(log, 20, timeout=15.0)
+        faults.clear_plan()
+        ctr = faults.disk_fault_counters()
+        assert ctr["wal_escalations"] >= 1, ctr
+        assert ctr["fsync_retries_after_failure"] == 0, ctr
+    finally:
+        faults.clear_plan()
+        sys_.close()
+    verify_oracle(tmp_path, "u1", 20)
+
+
+def test_sync_after_notify_fault_rewrites_confirmed_suffix(tmp_path):
+    """sync_after_notify's documented weaker window: a batch is
+    confirmed BEFORE its durability syscall.  When that syscall fails,
+    the poison path must pull the resend floor below the already-
+    confirmed suffix so it is re-written into the fresh file — on disk
+    the full log survives even though the poisoned file's tail never
+    fsynced."""
+    from ra_tpu.log.wal import Wal, scan_wal_file
+
+    sent: dict = {}
+    confirmed: list = []
+
+    wal = Wal(str(tmp_path), sync_mode=1,
+              write_strategy="sync_after_notify")
+    try:
+        def notify(uid, lo, hi, term):
+            if lo is None:
+                # resend_from protocol: the writer re-submits above hi
+                for i in sorted(sent):
+                    if i > hi:
+                        wal.write(uid, i, 1, sent[i])
+            else:
+                confirmed.append((lo, hi))
+
+        wal.register("u1", notify)
+        faults.install_plan(DiskFaultPlan(
+            seed=27, by_class={"wal": DiskFaultSpec(fsync_eio=1.0,
+                                                    limit=1)}))
+        for i in range(1, 21):
+            sent[i] = f"v-{i}".encode()
+            wal.write("u1", i, 1, sent[i])
+        wal.flush(timeout=10.0)
+        faults.clear_plan()
+        ctr = faults.disk_fault_counters()
+        assert ctr["poisoned_files"] >= 1, ctr
+        assert ctr["fsync_retries_after_failure"] == 0, ctr
+        assert confirmed and max(hi for _lo, hi in confirmed) == 20
+    finally:
+        faults.clear_plan()
+        wal.close()
+    tables: dict = {}
+    wdir = os.path.join(str(tmp_path), "wal")
+    for f in sorted(os.listdir(wdir)):
+        if f.endswith(".wal"):
+            scan_wal_file(os.path.join(wdir, f), tables)
+    got = tables.get("u1", {})
+    assert sorted(got) == list(range(1, 21)), sorted(got)
+    assert got[20][1] == b"v-20"
+
+
+def test_sync_after_notify_rewind_reaches_durable_log(tmp_path):
+    """Contract pin for the term=-2 resend signal (the sync_after_notify
+    poison path): a DurableLog floor-clamps plain resends (term=-1) to
+    its last_written, so a confirm processed BEFORE the failed
+    durability syscall would leave the confirmed suffix only in the
+    poisoned (never-fsynced) file.  The -2 signal must pull last_written
+    back to the floor and re-write the memtable-resident suffix into
+    the current (fresh) file."""
+    from ra_tpu.log.durable import DurableLog
+    from ra_tpu.log.wal import Wal, scan_wal_file
+
+    wal = Wal(str(tmp_path), sync_mode=1)
+    try:
+        log = DurableLog("u1", str(tmp_path), wal)
+        append_range(log, 1, 25)
+        drain(log, 25)
+        assert log.last_written().index == 25
+        base_resends = log.counters["write_resends"]
+
+        # a PLAIN resend_from(10) is floor-clamped: everything <= 25 is
+        # (as far as this writer knows) durable, nothing is re-written
+        log._wal_notify("u1", None, 10, -1)
+        assert log.counters["write_resends"] == base_resends
+        assert log.last_written().index == 25
+
+        # the rewind signal: confirms above 10 rode a failed syscall.
+        # last_written pulls back and [11..25] re-enter the WAL queue.
+        wal.rollover()   # fresh file, as the poison path produces
+        log._wal_notify("u1", None, 10, -2)
+        assert log.last_written().index == 10
+        assert log.counters["write_resends"] == base_resends + 15
+        drain(log, 25)   # the resends confirm again
+        wal.flush()
+    finally:
+        wal.close()
+    # the LAST file alone re-covers the rewound suffix
+    wdir = os.path.join(str(tmp_path), "wal")
+    last = sorted(f for f in os.listdir(wdir) if f.endswith(".wal"))[-1]
+    tables: dict = {}
+    scan_wal_file(os.path.join(wdir, last), tables)
+    got = tables.get("u1", {})
+    assert set(range(11, 26)) <= set(got), sorted(got)
+    # and cold recovery over all files is oracle-exact
+    verify_oracle(tmp_path, "u1", 25)
+
+
+# ---------------------------------------------------------------------------
+# segment plane
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fault", sorted(WRITE_FAULTS))
+def test_segment_flush_fault_matrix(tmp_path, fault):
+    """Segment-flush I/O errors ride the retry-with-backoff rung
+    (flush() bookkeeping is retry-shaped: identical pwrites, re-dirtied
+    pages) and the memtable keeps every entry until the flush really
+    lands — reads and a cold restart stay oracle-exact."""
+    sys_ = RaSystem(str(tmp_path), wal_supervise=False)
+    try:
+        log = mk_log(sys_)
+        append_range(log, 1, 40)
+        drain(log, 40)
+        faults.install_plan(DiskFaultPlan(
+            seed=9, by_class={
+                "segment": DiskFaultSpec(**WRITE_FAULTS[fault])}))
+        sys_.wal.rollover()
+        sys_.wal.flush()   # barrier: ranges handed to the segment writer
+        sys_.segment_writer.await_idle()
+        faults.clear_plan()
+        ctr = faults.disk_fault_counters()
+        assert ctr["faults_injected"] >= 1, ctr
+        assert ctr["flush_retries"] >= 1, ctr
+        assert ctr["flush_escalations"] == 0, ctr  # budget was enough
+        assert ctr["fsync_retries_after_failure"] == 0, ctr
+        # flushed out of the memtable and readable from segments
+        for i in (1, 20, 40):
+            assert log.fetch(i).command.data == i
+    finally:
+        faults.clear_plan()
+        sys_.close()
+    verify_oracle(tmp_path, "u1", 40)
+
+
+def test_segment_read_corruption_caught_by_crc(tmp_path):
+    sys_ = RaSystem(str(tmp_path), wal_supervise=False)
+    try:
+        log = mk_log(sys_)
+        append_range(log, 1, 40)
+        drain(log, 40)
+        sys_.wal.rollover()
+        sys_.wal.flush()   # barrier: ranges handed to the segment writer
+        sys_.segment_writer.await_idle()
+        assert log.overview()["num_mem_entries"] == 0  # segment-resident
+        faults.install_plan(DiskFaultPlan(
+            seed=13, by_class={"segment": DiskFaultSpec(
+                corrupt_read=1.0, limit=1)}))
+        # the corrupt pread is caught by the entry crc and retried
+        for i in range(1, 41):
+            assert log.fetch(i).command.data == i
+        faults.clear_plan()
+        ctr = faults.disk_fault_counters()
+        assert ctr["crc_catches"] >= 1, ctr
+    finally:
+        faults.clear_plan()
+        sys_.close()
+
+
+def test_segment_flush_escalation_hook_fires(tmp_path):
+    """Retry budget exhausted -> flush_escalations + the system hook;
+    the WAL file is KEPT, so a cold restart still recovers everything
+    acknowledged (degraded means 'WAL files accumulate', never loss)."""
+    escalated = []
+    sys_ = RaSystem(str(tmp_path), wal_supervise=False)
+    try:
+        sys_.on_flush_escalation = lambda uid, exc: escalated.append(uid)
+        log = mk_log(sys_)
+        append_range(log, 1, 20)
+        drain(log, 20)
+        wal_dir = sys_.wal.dir
+        # enough budget to outlast every retry attempt
+        faults.install_plan(DiskFaultPlan(
+            seed=17, by_class={"segment": DiskFaultSpec(fsync_eio=1.0)}))
+        sys_.wal.rollover()
+        sys_.wal.flush()   # barrier: ranges handed to the segment writer
+        sys_.segment_writer.await_idle(timeout=30.0)
+        faults.clear_plan()
+        ctr = faults.disk_fault_counters()
+        assert ctr["flush_escalations"] >= 1, ctr
+        assert escalated == ["u1"], escalated
+        # the rolled WAL file survived the failed flush
+        rolled = [f for f in os.listdir(wal_dir) if f.endswith(".wal")]
+        assert len(rolled) >= 2, rolled
+    finally:
+        faults.clear_plan()
+        sys_.close()
+    verify_oracle(tmp_path, "u1", 20)
+
+
+def test_flush_skips_already_segment_durable_duplicates(tmp_path):
+    """Regression pin (found by the poison/rollover chaos): a memtable
+    duplicate of an entry already durable in a segment (same term) must
+    NOT be re-appended at its lower index — the segment's overwrite-
+    invalidation would wipe every durable entry above it.  A term
+    MISMATCH is a genuine overwrite and must still invalidate."""
+    from ra_tpu.core.types import UserCommand as UC
+    from ra_tpu.log.durable import encode_command
+
+    sys_ = RaSystem(str(tmp_path), wal_supervise=False)
+    try:
+        log = mk_log(sys_)
+        append_range(log, 1, 20)
+        drain(log, 20)
+        sys_.wal.rollover()
+        sys_.wal.flush()
+        sys_.segment_writer.await_idle()
+        assert log.overview()["num_mem_entries"] == 0
+        # a recovered duplicate re-enters the memtable (same term/value)
+        with log._lock:
+            log._memtable[5] = (1, UC(5))
+            log._mem_bytes[5] = encode_command(UC(5))
+        log.flush_mem_to_segments(20)
+        # nothing above 5 was wiped; the duplicate pruned (it IS durable)
+        assert log.overview()["num_mem_entries"] == 0
+        for i in range(1, 21):
+            assert log.fetch(i).command.data == i, i
+        # term mismatch = real overwrite: the stale tail must go
+        with log._lock:
+            log._memtable[5] = (2, UC(500))
+            log._mem_bytes[5] = encode_command(UC(500))
+            log._last_index, log._last_term = 5, 2
+            log._last_written = type(log._last_written)(4, 1)
+        log.flush_mem_to_segments(5)
+        assert log.fetch(5).command.data == 500
+        assert log._segment_read(6) is None  # invalidated with the tail
+    finally:
+        sys_.close()
+
+
+def test_recovery_contiguity_clamp_on_holed_disk(tmp_path):
+    """Regression pin: a disk state whose WAL files cover [1..10] and
+    [15..20] (a lost middle from a crashed unconfirmed batch) must
+    recover as the honest contiguous prefix [1..10] — never a
+    last_index of 20 over a hole, which could win elections it must
+    lose."""
+    from ra_tpu.log.durable import encode_command
+    from ra_tpu.core.types import UserCommand as UC
+    from ra_tpu.log.wal import Wal
+
+    # file 1: entries 1..10, clean
+    wal = Wal(str(tmp_path), sync_mode=1)
+    wal.register("u1", lambda *a: None)
+    for i in range(1, 11):
+        wal.write("u1", i, 1, encode_command(UC(i)))
+    wal.flush()
+    wal.close()
+    # file 2: a fresh incarnation accepts 15.. (no gap check on a fresh
+    # writer) — the crash-window disk shape the live path now prevents
+    wal2 = Wal(str(tmp_path), sync_mode=1)
+    wal2.register("u1", lambda *a: None)
+    for i in range(15, 21):
+        wal2.write("u1", i, 1, encode_command(UC(i)))
+    wal2.flush()
+    wal2.close()
+
+    sys_ = RaSystem(str(tmp_path), wal_supervise=False)
+    try:
+        log = mk_log(sys_)
+        assert log.last_index_term().index == 10
+        assert log.last_written().index == 10
+        for i in range(1, 11):
+            assert log.fetch(i).command.data == i
+        assert log.fetch(15) is None
+    finally:
+        sys_.close()
+
+
+# ---------------------------------------------------------------------------
+# snapshot plane
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fault", sorted(WRITE_FAULTS))
+def test_snapshot_write_fault_matrix(tmp_path, fault):
+    """Pending-dir discipline: a torn/failed container write can never
+    shadow a good snapshot — the release cursor simply does not
+    advance, the log stays intact, and a clean retry succeeds."""
+    sys_ = RaSystem(str(tmp_path), wal_supervise=False)
+    try:
+        log = mk_log(sys_)
+        append_range(log, 1, 20)
+        drain(log, 20)
+        faults.install_plan(DiskFaultPlan(
+            seed=21, by_class={
+                "snapshot": DiskFaultSpec(**WRITE_FAULTS[fault])}))
+        log.update_release_cursor(10, (), 0, {"count": 10})
+        ctr = faults.disk_fault_counters()
+        assert ctr["snapshot_write_failures"] >= 1, ctr
+        # no torn container reached the slot; the full log is intact
+        assert log.snapshot_index_term().index == 0
+        for i in (1, 10, 20):
+            assert log.fetch(i).command.data == i
+        faults.clear_plan()
+        # clean retry truncates below the snapshot as usual
+        log.update_release_cursor(10, (), 0, {"count": 10})
+        assert log.snapshot_index_term().index == 10
+        assert log.first_index() == 11
+    finally:
+        faults.clear_plan()
+        sys_.close()
+    verify_oracle(tmp_path, "u1", 20, snap_idx=10)
+
+
+def test_snapshot_read_corruption_caught_by_crc(tmp_path):
+    sys_ = RaSystem(str(tmp_path), wal_supervise=False)
+    log = mk_log(sys_)
+    append_range(log, 1, 20)
+    drain(log, 20)
+    log.update_release_cursor(12, (), 0, {"count": 12})
+    sys_.close()
+
+    faults.install_plan(DiskFaultPlan(
+        seed=23, by_class={"snapshot": DiskFaultSpec(corrupt_read=1.0,
+                                                     limit=1)}))
+    sys2 = RaSystem(str(tmp_path), wal_supervise=False)
+    try:
+        log2 = mk_log(sys2)
+        # the container crc caught the flipped bit; the fresh re-read
+        # recovered the good bytes instead of rewinding machine state
+        assert log2.snapshot_index_term().index == 12
+        got = log2.recover_snapshot_state()
+        assert got is not None and got[1] == {"count": 12}
+        for i in range(13, 21):
+            assert log2.fetch(i).command.data == i
+        ctr = faults.disk_fault_counters()
+        assert ctr["crc_catches"] >= 1, ctr
+    finally:
+        faults.clear_plan()
+        sys2.close()
+
+
+# ---------------------------------------------------------------------------
+# combined transport + disk + crash chaos, linearizability-checked
+# ---------------------------------------------------------------------------
+
+def _start_durable_cluster(tmp_path, sids, router):
+    systems = {s.node: RaSystem(str(tmp_path / s.node)) for s in sids}
+    nodes = {s.node: RaNode(s.node, router=router,
+                            log_factory=systems[s.node].log_factory)
+             for s in sids}
+    for sid in sids:
+        nodes[sid.node].start_server(ServerConfig(
+            server_id=sid, uid=f"uid_{sid.name}", cluster_name="dzchaos",
+            initial_members=tuple(sids),
+            machine=SimpleMachine(lambda c, s: c, 0),
+            election_timeout_ms=120, tick_interval_ms=50))
+    return systems, nodes
+
+
+def test_combined_transport_disk_crash_chaos_linearizable(tmp_path):
+    """The acceptance soak: concurrent register writes + linearizable
+    reads against a durable 3-node cluster while a FIXED-SEED nemesis
+    schedule composes partitions (transport plane), a DiskFaultPlan
+    episode (storage plane) and a WAL crash (process plane) — the full
+    history passes the Wing & Gong linearizability check."""
+    from test_linearizability import check_register_linearizable
+
+    router = LocalRouter()
+    sids = [ServerId(f"dz{i}", f"dzn{i}") for i in (1, 2, 3)]
+    systems, nodes = _start_durable_cluster(tmp_path, sids, router)
+    history: list = []
+    hlock = threading.Lock()
+    stop = threading.Event()
+
+    def record(op, value, invoke, complete):
+        with hlock:
+            history.append({"op": op, "value": value,
+                            "invoke": invoke, "complete": complete})
+
+    try:
+        ra_tpu.trigger_election(sids[0], router)
+        await_leader(router, sids)
+
+        def writer(tid):
+            v = tid * 1000
+            for _ in range(25):
+                if stop.is_set():
+                    break
+                v += 1
+                t0 = time.monotonic()
+                try:
+                    ra_tpu.process_command(sids[tid % 3], v,
+                                           router=router, timeout=2)
+                    record("write", v, t0, time.monotonic())
+                except Exception:
+                    record("write", v, t0, None)   # indeterminate
+                time.sleep(0.03)
+
+        def reader():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    r = ra_tpu.consistent_query(sids[1], lambda s: s,
+                                                router=router, timeout=2)
+                    record("read", r.reply, t0, time.monotonic())
+                except Exception:
+                    pass                            # failed read: no info
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in (1, 2)] + [threading.Thread(target=reader)]
+        for th in threads:
+            th.start()
+
+        plan = DiskFaultPlan(seed=42, by_class={
+            "wal": DiskFaultSpec(fsync_eio=0.25, short_write=0.1,
+                                 limit=8),
+            "segment": DiskFaultSpec(fsync_eio=0.3, limit=4),
+        })
+        Nemesis(router, nodes.values(), seed=42,
+                systems=systems).run([
+            ("wait", 0.4),
+            ("disk_faults", plan),
+            ("part", (("dzn1", "dzn2"), ("dzn3",)), 0.5),
+            ("wal_kill", "dzn2"),
+            ("wait", 0.6),
+            ("disk_heal",),
+            ("part", (("dzn1",), ("dzn2",)), 0.4),
+            ("heal",),
+            ("wait", 0.5),
+        ])
+        stop.set()
+        for th in threads:
+            th.join(timeout=15)
+        assert len(history) >= 20, len(history)
+        determinate = [h for h in history if h["complete"] is not None]
+        assert any(h["op"] == "read" for h in determinate)
+        assert check_register_linearizable(history), history
+        ctr = faults.disk_fault_counters()
+        assert ctr["fsync_retries_after_failure"] == 0, ctr
+        # the killed WAL came back under supervision
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and \
+                not systems["dzn2"].wal.alive:
+            time.sleep(0.02)
+        assert systems["dzn2"].wal.alive
+    finally:
+        stop.set()
+        faults.clear_plan()
+        for n in nodes.values():
+            n.stop()
+        for s in systems.values():
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# soak entry point (tools/soak.py --disk-faults SEED)
+# ---------------------------------------------------------------------------
+
+def run_disk_chaos(seed: int, data_dir: str) -> None:
+    """One seeded disk-chaos episode over the classic storage plane:
+    a random DiskFaultPlan + a mid-run WAL kill, then a cold restart
+    that must be oracle-exact.  Raises on any violation; driven over
+    fresh seed ranges by ``tools/soak.py --disk-faults``."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    spec = DiskFaultSpec(
+        fsync_eio=rng.uniform(0.0, 0.4),
+        enospc=rng.uniform(0.0, 0.2),
+        short_write=rng.uniform(0.0, 0.2),
+        limit=rng.randint(2, 8))
+    plan = DiskFaultPlan(seed=seed, by_class={
+        "wal": spec,
+        "segment": DiskFaultSpec(fsync_eio=rng.uniform(0.0, 0.5),
+                                 limit=rng.randint(1, 4)),
+    })
+    faults.reset_disk_fault_counters()
+    sys_ = RaSystem(data_dir, wal_supervise=True)
+    try:
+        log = mk_log(sys_, "soak")
+        append_range(log, 1, 10)
+        drain(log, 10)
+        faults.install_plan(plan)
+        append_range(log, 11, 40)
+        if rng.random() < 0.5 and sys_.wal.alive:
+            sys_.wal.kill()  # crash plane: supervisor must recover it
+        append_done = 40
+        try:
+            append_range(log, 41, 50)
+            append_done = 50
+        except Exception:
+            # WalDown while the supervisor races us: entries 41+ were
+            # never accepted into the log — the oracle ends at 40
+            pass
+        drain(log, append_done, timeout=20.0)
+        faults.clear_plan()
+        ctr = faults.disk_fault_counters()
+        assert ctr["fsync_retries_after_failure"] == 0, ctr
+        observed = log.last_written().index
+    finally:
+        faults.clear_plan()
+        sys_.close()
+    sys2 = RaSystem(data_dir, wal_supervise=False)
+    try:
+        log2 = mk_log(sys2, "soak")
+        assert log2.last_index_term().index >= observed
+        for i in range(1, observed + 1):
+            ent = log2.fetch(i)
+            assert ent is not None and ent.command.data == i, i
+    finally:
+        sys2.close()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_disk_chaos_pinned_seeds(tmp_path, seed):
+    run_disk_chaos(seed, str(tmp_path / f"s{seed}"))

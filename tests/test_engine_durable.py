@@ -140,7 +140,9 @@ def test_commits_gate_on_wal_confirm(tmp_path):
 @pytest.mark.filterwarnings(
     "ignore::pytest.PytestUnhandledThreadExceptionWarning")
 def test_commits_freeze_when_wal_dies(tmp_path):
-    eng = make_engine(tmp_path)
+    # wal_supervise=False: this test asserts the RAW frozen state and
+    # restarts by hand — the default supervisor would race the asserts
+    eng = make_engine(tmp_path, wal_supervise=False)
     drive(eng, 6)
     settle(eng, 5)
     before = eng.committed_total()

@@ -68,3 +68,25 @@ def test_make_evaluate_quorum_resolution():
         np.testing.assert_array_equal(
             np.asarray(fn2(commit, match, voter, tstart)),
             np.ones(8, np.int32))
+
+
+def test_auto_resolves_to_xla_unless_env_gated(monkeypatch):
+    """The kernel is a demoted experiment (VERDICT weak #5: 101.4M vs
+    112.4M cmds/s on the same config): 'auto' resolves to the XLA
+    oracle on EVERY backend unless RA_TPU_ENABLE_PALLAS_QUORUM opts
+    back in."""
+    from ra_tpu.ops.quorum import evaluate_quorum as xla_impl
+
+    monkeypatch.delenv("RA_TPU_ENABLE_PALLAS_QUORUM", raising=False)
+    assert make_evaluate_quorum("auto") is xla_impl
+    monkeypatch.setenv("RA_TPU_ENABLE_PALLAS_QUORUM", "0")
+    assert make_evaluate_quorum("auto") is xla_impl
+    monkeypatch.setenv("RA_TPU_ENABLE_PALLAS_QUORUM", "1")
+    fn = make_evaluate_quorum("auto")
+    if jax.default_backend() in ("tpu", "axon"):
+        assert fn is not xla_impl     # env gate re-enables the kernel
+    else:
+        assert fn is xla_impl         # off-TPU auto stays on the oracle
+    # an explicit 'pallas' choice always wins, gate or no gate
+    monkeypatch.delenv("RA_TPU_ENABLE_PALLAS_QUORUM", raising=False)
+    assert make_evaluate_quorum("pallas") is not xla_impl

@@ -97,6 +97,18 @@ def test_custom_module_snapshot_and_recovery(tmp_path):
     for _ in range(40):
         execute(srv.handle(CommandEvent(UserCommand(2))))
         drain()
+    # settle before asserting: WAL confirms are async, so keep draining
+    # until every appended entry is written AND applied — the release
+    # cursor only fires on APPLYING index 16/32, and state_now must not
+    # be a racy partial value
+    import time as _t
+    deadline = _t.monotonic() + 10.0
+    while _t.monotonic() < deadline and (
+            log.last_written().index < log.last_index_term().index or
+            srv.last_applied < log.last_index_term().index):
+        drain()
+    assert srv.last_applied == log.last_index_term().index, \
+        (srv.last_applied, log.last_index_term())
     assert srv.machine_state > 0
     snap = log.snapshot_index_term()
     assert snap.index >= 16, snap
@@ -127,8 +139,10 @@ def test_custom_module_snapshot_and_recovery(tmp_path):
             for evt in evts:
                 srv2.handle(evt)
     srv2.handle(ElectionTimeout())
-    drain2()
-    assert srv2.machine_state == state_now, srv2.machine_state
+    deadline = _t.monotonic() + 10.0
+    while _t.monotonic() < deadline and srv2.machine_state != state_now:
+        drain2()
+    assert srv2.machine_state == state_now, (srv2.machine_state, state_now)
     sys2.close()
 
 

@@ -130,6 +130,63 @@ def test_engine_modules_are_ra02_clean():
         assert "RA02" not in r.stdout, (mod, r.stdout)
 
 
+def test_checker_forbids_swallowed_io_errors_in_log_layer(tmp_path):
+    """RA03: pass-only except OSError/Exception around durability I/O
+    (fsync/pwrite/write/sync) in log/ files is the silent-loss bug
+    class ISSUE 4 removed; `# ra03-ok:` allowlists an audited site.
+    Applies to files inside a directory named log/ only."""
+    logdir = tmp_path / "log"
+    logdir.mkdir()
+    bad = logdir / "wal.py"
+    bad.write_text(textwrap.dedent("""\
+        import os
+
+        def flush(fd, buf):
+            try:
+                os.write(fd, buf)
+                os.fsync(fd)
+            except OSError:
+                pass
+
+        def sync2(io, fd):
+            try:
+                io.sync(fd, 2)
+            except Exception:  # ra03-ok: audited, counter bumped in caller
+                pass
+
+        def close_quiet(fd):
+            try:
+                os.close(fd)       # not durability-bearing: no finding
+            except OSError:
+                pass
+
+        def handled(fd, buf):
+            try:
+                os.pwrite(fd, buf, 0)
+            except OSError:
+                raise RuntimeError("escalate")  # routed, not swallowed
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA03") == 1, r.stdout
+    assert ":7:" in r.stdout, r.stdout  # the except line of flush()
+    # the same content outside a log/ directory is not gated
+    other = tmp_path / "wal.py"
+    other.write_text(bad.read_text())
+    r = run_lint(str(other))
+    assert "RA03" not in r.stdout
+
+
+def test_log_layer_is_ra03_clean():
+    """The real log layer passes the swallowed-IO-error gate (covered
+    by the repo-wide run too; pinned separately so a regression names
+    the rule)."""
+    for mod in ("wal.py", "segment.py", "durable.py", "snapshot.py",
+                "faults.py", "memory.py"):
+        r = run_lint(os.path.join(REPO, "ra_tpu", "log", mod))
+        assert "RA03" not in r.stdout, (mod, r.stdout)
+
+
 def test_checker_false_positive_guards(tmp_path):
     ok = tmp_path / "ok.py"
     ok.write_text(textwrap.dedent("""\

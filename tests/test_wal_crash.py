@@ -6,6 +6,7 @@ Scenario shapes follow the reference's coordination_SUITE
 restart cases: kill the WAL under load, supervisor restarts it, writers
 resend above last_written (ra_log.erl:778-793), servers ride it out in
 await_condition(wal_down) instead of dying (ra_server.erl:538-554)."""
+import os
 import time
 
 import pytest
@@ -257,6 +258,71 @@ def test_parked_leader_resumes_leadership_after_wal_restart(tmp_path):
     assert res.reply >= 5 + 9
     node.stop()
     system.close()
+
+
+def test_wal_rollover_after_poison_under_load_no_committed_loss(tmp_path):
+    """The ISSUE 4 cluster-level pin: fsync-EIO + torn writes injected
+    on ONE node's WAL under live traffic — the poison/rollover/resend
+    ladder (not thread death) absorbs them, every acknowledged command
+    survives a full cold restart, and the fsyncgate discipline holds."""
+    from ra_tpu.log import faults
+    from ra_tpu.log.faults import DiskFaultPlan, DiskFaultSpec
+
+    faults.reset_disk_fault_counters()
+    router = LocalRouter()
+    sids = [ServerId(f"p{i}", f"pn{i}") for i in (1, 2, 3)]
+    systems, nodes = _start_cluster(tmp_path, sids, router)
+    try:
+        ra_tpu.trigger_election(sids[0], router)
+        leader = await_leader(router, sids)
+        acked = 0
+        for v in range(1, 11):
+            _commit_with_retry(leader, v, router)
+            acked += v
+        # target ONE node's wal dir (path_match) — the blast radius of
+        # a single sick disk, while the other nodes stay clean
+        victim = sids[0].node
+        faults.install_plan(DiskFaultPlan(seed=19, rules=[
+            ("wal", DiskFaultSpec(fsync_eio=0.4, short_write=0.2,
+                                  limit=6,
+                                  path_match=os.path.sep + victim +
+                                  os.path.sep))]))
+        for v in range(11, 31):
+            leader = await_leader(router, sids)
+            _commit_with_retry(leader, v, router)
+            acked += v
+        faults.clear_plan()
+        ctr = faults.disk_fault_counters()
+        assert ctr["faults_injected"] >= 1, ctr
+        assert ctr["fsync_retries_after_failure"] == 0, ctr
+        leader = await_leader(router, sids)
+        res = ra_tpu.consistent_query(leader, lambda s: s, router=router)
+        assert res.reply == acked
+    finally:
+        faults.clear_plan()
+        for n in nodes.values():
+            n.stop()
+        for s in systems.values():
+            s.close()
+    # cold restart of every node from disk: acknowledged state intact
+    router2 = LocalRouter()
+    systems2 = {s.node: RaSystem(str(tmp_path / s.node)) for s in sids}
+    nodes2 = {s.node: RaNode(s.node, router=router2,
+                             log_factory=systems2[s.node].log_factory)
+              for s in sids}
+    try:
+        for s in sids:
+            systems2[s.node].recover_servers(
+                nodes2[s.node], lambda cluster, name: counter())
+        leader2 = await_leader(router2, sids)
+        res = ra_tpu.consistent_query(leader2, lambda s: s,
+                                      router=router2)
+        assert res.reply == acked
+    finally:
+        for n in nodes2.values():
+            n.stop()
+        for s in systems2.values():
+            s.close()
 
 
 # -- write strategies (ra_log_wal.erl:66-96) --------------------------------

@@ -9,6 +9,9 @@ before-commit contract over its lane slice, and the merged per-lane
 confirm vector feeds the engine's quorum gate exactly as before.
 """
 import os
+import signal
+import subprocess
+import sys
 import threading
 import time
 
@@ -16,10 +19,17 @@ import numpy as np
 import pytest
 
 from ra_tpu.engine import open_engine
+from ra_tpu.log import faults
+from ra_tpu.log.faults import DiskFaultPlan, DiskFaultSpec
 from ra_tpu.log.wal import Wal
 from ra_tpu.models import CounterMachine
 
 N, P, K = 16, 3, 8
+
+# the poison->escalate ladder may legitimately kill a shard's batch
+# thread under injected faults; the shard supervisor restarts it
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
 
 
 def make(tmp_path, shards, **kw):
@@ -242,6 +252,180 @@ def test_wal_overview_reports_shard_health(tmp_path):
         assert st["bytes_written"] > 0
         assert st["syncs"] > 0
     assert w["engine"]["confirm_lag_steps"] == 0  # settled
+    eng.close()
+
+
+def test_poisoned_shard_holds_back_confirms(tmp_path):
+    """fsync-EIO on ONE shard (shard03): its confirm slice freezes at
+    the durable horizon, so the merged confirm vector — and therefore
+    the fsync-gated commit — provably never advances past unfsynced
+    entries; once the fault clears, the poison/rollover resend path
+    catches the shard back up and recovery is oracle-exact (the
+    per-shard confirm hold-back of ISSUE 4)."""
+    faults.reset_disk_fault_counters()
+    eng = make(tmp_path, 4, sync_mode=1)
+    try:
+        drive(eng, 4)
+        settle(eng, 6)
+        torn = eng._dur._shards[3]
+        faults.install_plan(DiskFaultPlan(seed=31, rules=[
+            ("wal", DiskFaultSpec(fsync_eio=1.0, limit=3,
+                                  path_match="shard03"))]))
+        n_new = np.full((N,), 2, np.int32)
+        payloads = np.ones((N, K, 1), np.int32)
+        from ra_tpu.log.wal import WalDown
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                eng.step(n_new, payloads)
+            except WalDown:
+                pass  # supervisor races the ladder's rung 3
+            # the acceptance invariant, sampled every step: commit is
+            # gated on the MERGED confirm vector
+            lane = np.arange(N)
+            st = eng.state
+            com = np.asarray(st.commit)[lane, np.asarray(st.leader_slot)]
+            assert (com <= eng._dur.confirm_upto).all(), \
+                (com, eng._dur.confirm_upto)
+            time.sleep(0.05)  # let the batch thread reach its fsync
+            if faults.disk_fault_counters()["poisoned_files"] >= 1:
+                break
+        faults.clear_plan()
+        ctr = faults.disk_fault_counters()
+        assert ctr["faults_injected"] >= 1, ctr
+        assert ctr["poisoned_files"] >= 1, ctr
+        assert ctr["fsync_retries_after_failure"] == 0, ctr
+        # fault cleared: the shard catches up and commits resume
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                (not torn.wal.alive or
+                 torn.confirmed_step < eng._dur.step_seq):
+            try:
+                settle(eng, 2)
+            except (WalDown, TimeoutError):
+                time.sleep(0.05)
+        assert torn.wal.alive
+        com = leader_view(eng, "commit").copy()
+        assert (com > 0).all()
+        assert (com <= eng._dur.confirm_upto).all()
+    finally:
+        faults.clear_plan()
+        eng.close()
+    # cold reopen: oracle-exact at the apply frontier
+    eng2 = make(tmp_path, 4, sync_mode=1)
+    com2 = leader_view(eng2, "commit")
+    assert (com2 >= com).all()
+    mac = np.asarray(eng2.state.mac)
+    app = np.asarray(eng2.state.applied)
+    act = np.asarray(eng2.state.active)
+    assert (mac[act] == app[act]).all()
+    eng2.close()
+
+
+_FAULT_CHILD = r"""
+import os, sys, json
+import numpy as np
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+from ra_tpu.utils import force_platform_from_env
+force_platform_from_env()
+from ra_tpu.engine import open_engine
+from ra_tpu.log import faults
+from ra_tpu.log.faults import DiskFaultPlan, DiskFaultSpec
+from ra_tpu.models import CounterMachine
+
+# the ISSUE 4 kill-9 matrix plan: torn writes on shard 0, fsync-EIO on
+# shard 3 — active for the child's WHOLE life, including its recovery
+faults.install_plan(DiskFaultPlan(seed=97, rules=[
+    ("wal", DiskFaultSpec(short_write=0.10, limit=6,
+                          path_match="shard00")),
+    ("wal", DiskFaultSpec(fsync_eio=0.15, limit=6,
+                          path_match="shard03")),
+]))
+
+N, P, K = 16, 3, 8
+eng = open_engine(CounterMachine(), sys.argv[1], N, P,
+                  sync_mode=1, ring_capacity=256, max_step_cmds=K,
+                  wal_shards=4)
+report = sys.argv[2]
+n_new = np.full((N,), 4, np.int32)
+payloads = np.ones((N, K, 1), np.int32)
+lane = np.arange(N)
+from ra_tpu.log.wal import WalDown
+import time as _time
+for i in range(10_000):
+    try:
+        eng.step(n_new, payloads)
+    except WalDown:
+        _time.sleep(0.05)  # shard supervisor races the escalation rung
+        continue
+    if i % 5 == 4:
+        # report the fsync-confirmed commit frontier crash-safely; the
+        # min() with confirm_upto is the fsynced-watermark clamp
+        st = eng.state
+        com = np.asarray(st.commit)[lane, np.asarray(st.leader_slot)]
+        com = np.minimum(com, eng._dur.confirm_upto)
+        tmp = report + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump([int(x) for x in com], f)
+            f.flush(); os.fsync(f.fileno())
+        os.replace(tmp, report)
+        print("REPORTED", i, flush=True)
+"""
+
+
+def test_kill9_with_active_disk_faults_recovers_reported(tmp_path):
+    """The kill-9 matrix under an ACTIVE DiskFaultPlan (torn write on
+    shard 0, fsync-EIO on shard 3): SIGKILL mid-bench while the
+    degradation ladder is live, then recover with NO faults — every
+    commit the child ever reported (clamped to the fsynced watermark)
+    survives, and the replayed state is oracle-exact."""
+    import json
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    data = str(tmp_path / "data")
+    report = str(tmp_path / "report.json")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _FAULT_CHILD.format(repo=repo), data,
+         report],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+             "PYTHONPATH": ""})
+    import select
+    deadline = time.time() + 360
+    reports = 0
+    fd = child.stdout.fileno()
+    buf = b""
+    while time.time() < deadline and reports < 4:
+        ready, _, _ = select.select([fd], [], [],
+                                    max(0.0, deadline - time.time()))
+        if not ready:
+            break
+        chunk = os.read(fd, 65536)
+        if not chunk:
+            break
+        buf += chunk
+        reports = sum(1 for line in buf.split(b"\n")[:-1]
+                      if line.startswith(b"REPORTED"))
+    child.send_signal(signal.SIGKILL)
+    child.wait(timeout=30)
+    assert reports >= 4, child.stderr.read()
+
+    with open(report) as f:
+        reported = np.array(json.load(f), np.int32)
+    assert reported.sum() > 0
+
+    eng = make(tmp_path / "data", 4, sync_mode=1)
+    lane = np.arange(N)
+    st = eng.state
+    com = np.asarray(st.commit)[lane, np.asarray(st.leader_slot)]
+    assert (com >= reported).all(), (com, reported)
+    # oracle equivalence at the recovered apply frontier (+1 workload)
+    mac = np.asarray(st.mac)
+    app = np.asarray(st.applied)
+    act = np.asarray(st.active)
+    assert (mac[act] == app[act]).all(), (mac, app)
+    assert (mac[lane, np.asarray(st.leader_slot)] >= reported).all()
     eng.close()
 
 

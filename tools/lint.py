@@ -29,6 +29,15 @@ Checks (cheap, high-signal, zero-config):
                 a forced device sync there serializes the XLA
                 pipeline; documented readback points carry an
                 `# ra02-ok: <why>` line comment
+  RA03          (files in a `log/` directory only) no swallow-only
+                `except OSError:`/`except Exception:` (body is just
+                `pass`) around durability-bearing I/O calls (fsync/
+                fdatasync/pwrite/write/write_batch/sync) — a silently
+                eaten disk error there is the confirmed-but-not-durable
+                bug class ISSUE 4 removed; each site must either feed
+                the DiskFaultPlan degradation ladder or carry an
+                `# ra03-ok: <why>` comment (plus a
+                DISK_FAULT_FIELDS counter)
 
 Usage: ``python tools/lint.py [paths...]`` (defaults to the repo's
 source roots).  Exits nonzero with one line per finding.
@@ -125,6 +134,56 @@ def _check_engine_hot_sync(tree: ast.Module, err) -> None:
                     "readback point or mark the line '# ra02-ok: why'")
 
 
+#: RA03 — durability-bearing I/O calls: an exception from one of these
+#: inside the log layer carries a durability verdict and must never be
+#: swallowed bare (fsyncgate: a confirmed write whose fsync error was
+#: eaten is silent data loss)
+_DURABILITY_CALLS = frozenset({"fsync", "fdatasync", "pwrite", "write",
+                               "write_batch", "sync"})
+_SWALLOWED_EXCS = frozenset({"OSError", "Exception", "IOError",
+                             "EnvironmentError"})
+
+
+def _handler_names(handler: ast.ExceptHandler) -> set:
+    t = handler.type
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return set(names)
+
+
+def _check_log_io_swallow(tree: ast.Module, err) -> None:
+    """RA03: in log-layer files, forbid pass-only except OSError/
+    Exception handlers whose try body performs durability-bearing I/O
+    (allowlist via `# ra03-ok:` on the except line)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        io_calls = set()
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    fn = sub.func
+                    name = fn.attr if isinstance(fn, ast.Attribute) \
+                        else fn.id if isinstance(fn, ast.Name) else None
+                    if name in _DURABILITY_CALLS:
+                        io_calls.add(name)
+        if not io_calls:
+            continue
+        for handler in node.handlers:
+            if not (_handler_names(handler) & _SWALLOWED_EXCS):
+                continue
+            body = handler.body
+            if len(body) == 1 and isinstance(body[0], ast.Pass):
+                err(handler, "RA03",
+                    "swallow-only except around durability I/O "
+                    f"({'/'.join(sorted(io_calls))}); route the error "
+                    "through the degradation ladder or mark the line "
+                    "'# ra03-ok: why' with a DISK_FAULT_FIELDS counter")
+
+
 def _check_lifecycle_rpc(tree: ast.Module, err) -> None:
     """RA01: inside lifecycle verbs, forbid direct one-shot transport
     calls (they must go through the reliable RPC layer)."""
@@ -166,6 +225,15 @@ def check_file(path: str) -> list:
 
     if os.path.basename(path) == "api.py":
         _check_lifecycle_rpc(tree, err)
+    if os.path.basename(os.path.dirname(path)) == "log":
+        ra03_ok = {i + 1 for i, line in enumerate(src.splitlines())
+                   if "ra03-ok" in line}
+
+        def err_ra03(node: ast.AST, code: str, msg: str) -> None:
+            if getattr(node, "lineno", 0) not in ra03_ok:
+                err(node, code, msg)
+
+        _check_log_io_swallow(tree, err_ra03)
     if os.path.basename(path) in _ENGINE_HOT_FILES:
         ra02_ok = {i + 1 for i, line in enumerate(src.splitlines())
                    if "ra02-ok" in line}
