@@ -17,9 +17,19 @@ with an explicit ``"error": "tpu_unavailable"`` marker plus a CPU smoke
 datapoint (run with the axon site hook stripped so backend init cannot
 hang).  Always prints ONE JSON line; always exits 0.
 
-Latency is measured honestly: per sample, the host clock runs from command
-enqueue until the commit is observable in a device readback (not a
-step-time proxy).
+Latency is measured honestly AND without serializing the dispatch
+pipeline (ISSUE 5): per sample, the batch is enqueued at step E and the
+engine's per-lane committed watermark is harvested through ASYNC
+readbacks only — the first readback step O whose cumulative count
+covers the batch is the observed-commit step, and p50/p99 derive from
+(O - E + 1) x the sample's measured per-step time.  Host syncs happen
+only at sample window boundaries (lint rule RA04 polices this).
+
+Superstep mode (``--superstep [K]`` or RA_TPU_BENCH_SUPERSTEP): the
+throughput phase fuses K engine rounds per XLA dispatch and drives them
+through the dispatch-ahead staging driver (see
+ra_tpu/engine/lockstep.py), reporting the single-step reference value
+and the realized speedup alongside.
 """
 from __future__ import annotations
 
@@ -127,6 +137,17 @@ def _child_main() -> None:
     measure_s = float(os.environ.get("RA_TPU_BENCH_SECONDS", "5.0"))
     quorum_impl = os.environ.get("RA_TPU_QUORUM_IMPL", "xla")
     machine_name = os.environ.get("RA_TPU_BENCH_MACHINE", "counter")
+    # fused-dispatch config (ISSUE 5): K rounds per XLA dispatch + the
+    # dispatch-ahead staging depth; "auto" resolves the system-level
+    # tunables (ra_tpu.system.engine_pipeline_defaults)
+    from ra_tpu.system import engine_pipeline_defaults
+    pipe_defaults = engine_pipeline_defaults()
+    ss_env = os.environ.get("RA_TPU_BENCH_SUPERSTEP", "0")
+    superstep_k = pipe_defaults["superstep_k"] if ss_env == "auto" \
+        else int(ss_env)
+    da_env = os.environ.get("RA_TPU_BENCH_DISPATCH_AHEAD", "auto")
+    dispatch_ahead = pipe_defaults["dispatch_ahead"] if da_env == "auto" \
+        else int(da_env)
 
     # BASELINE.md rows: counter (north star), fifo (5k x 5 enqueue/
     # dequeue), kv (2k mixed put/get with jittable apply)
@@ -184,6 +205,11 @@ def _child_main() -> None:
                           write_strategy=wal_strategy, ring_capacity=1024,
                           max_step_cmds=cmds, apply_window=cmds + 2,
                           wal_shards=wal_shards,
+                          # superstep: step_seq advances K per dispatch,
+                          # so the unconfirmed-step window must cover a
+                          # few dispatches or backpressure serializes
+                          # the fused pipeline
+                          max_pending=max(8, 4 * superstep_k),
                           quorum_impl=quorum_impl)
         import atexit
         atexit.register(lambda: shutil.rmtree(dur_dir, ignore_errors=True))
@@ -234,27 +260,66 @@ def _child_main() -> None:
             eng.step(n_new, payloads)
             n += 1
             if n % 20 == 0:
-                eng.block_until_ready()
+                eng.block_until_ready()  # ra04-ok: 20-step window boundary
                 if time.perf_counter() - t_start >= seconds:
                     break
         eng.block_until_ready()
         return n, time.perf_counter() - t_start
 
-    start_committed = eng.committed_total()
-    readbacks: "_collections.deque" = _collections.deque()
-    if durable:
-        steps, elapsed = run_unbounded(measure_s)
-    else:
-        steps = 0
-        t0 = time.perf_counter()
-        while time.perf_counter() - t0 < measure_s:
+    def run_single_step(seconds: float):
+        """The single-step measurement protocol: window-bounded async
+        readbacks (volatile) or max_pending backpressure (durable)."""
+        if durable:
+            return run_unbounded(seconds)
+        readbacks: "_collections.deque" = _collections.deque()
+        n = 0
+        t_start = time.perf_counter()
+        while time.perf_counter() - t_start < seconds:
             eng.step(n_new, payloads)
-            steps += 1
+            n += 1
             readbacks.append(eng.committed_lanes_async())
             while len(readbacks) > window:
-                np.asarray(readbacks.popleft())  # block: bounds window
+                np.asarray(readbacks.popleft())  # ra04-ok: window boundary
         eng.block_until_ready()
+        return n, time.perf_counter() - t_start
+
+    single_step_ref = None
+    driver = None
+    if superstep_k:
+        # single-step reference at the same config first, so the fused
+        # row carries its own dispatch-amortization evidence
+        base_ref = eng.committed_total()
+        ref_steps, ref_el = run_single_step(min(measure_s, 2.0))
+        single_step_ref = {
+            "value": round((eng.committed_total() - base_ref) / ref_el, 1),
+            "steps": ref_steps,
+            "elapsed_s": round(ref_el, 3),
+        }
+        # fused phase: K rounds per dispatch, host staging one block
+        # ahead of device execution (the dispatch-ahead driver)
+        from ra_tpu.engine import DispatchAheadDriver
+        n_new_host = np.asarray(n_new)
+        pay_host = np.asarray(payloads)
+        n_new_blk = np.broadcast_to(n_new_host,
+                                    (superstep_k,) + n_new_host.shape)
+        pay_blk = np.broadcast_to(pay_host,
+                                  (superstep_k,) + pay_host.shape)
+        driver = DispatchAheadDriver(eng, max_in_flight=dispatch_ahead)
+        for _ in range(2):
+            driver.submit(n_new_blk, pay_blk)
+        driver.drain()
+        start_committed = eng.committed_total()
+        dispatches = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < measure_s:
+            driver.submit(n_new_blk, pay_blk)
+            dispatches += 1
+        driver.drain()  # run-end window boundary
         elapsed = time.perf_counter() - t0
+        steps = dispatches * superstep_k
+    else:
+        start_committed = eng.committed_total()
+        steps, elapsed = run_single_step(measure_s)
     committed = eng.committed_total() - start_committed
     value = committed / elapsed
 
@@ -274,48 +339,109 @@ def _child_main() -> None:
                     "value instead (docs/BENCHMARKS.md)",
         }
 
-    # -- latency phase: honest enqueue->commit clock ----------------------
-    # A sample enqueues one pipelined batch on every lane, then drives
-    # empty rounds until the batch is committed (observable via the
-    # total_committed readback, which forces a device sync).  The clock
-    # covers dispatch + append + write-confirm + quorum + readback — what
-    # a pipelining client actually waits for a commit notification
-    # (ra_bench.erl:153-190 measures the same edge via applied events).
+    # -- latency phase: on-device step stamping (ISSUE 5) -----------------
+    # The old protocol spun on committed_total() — a blocking device
+    # sync per spin that serialized the very pipeline the superstep
+    # path builds.  Now a sample enqueues its batch at step E, drives
+    # empty rounds each starting one ASYNC per-lane committed readback,
+    # and syncs only at sample window boundaries.  The observed-commit
+    # step O is the first readback whose cumulative count covers the
+    # batch (inner-step resolution in superstep mode via the stacked
+    # [K, N] watermark), and the sample's latency derives from step
+    # counts x measured step time:
+    #   latency = sample_elapsed * O / steps_in_sample.
+    # The enqueue->commit edge in STEPS is exact; the milliseconds come
+    # from the sample's own pipelined step rate.
     expected_per_sample = n_lanes * cmds
     lats = []
     truncated = 0
+    spin = 32 if durable else 8  # durable: confirm lag is real
+    max_windows = 4 if durable else 2
+    if superstep_k:
+        zp_host = np.asarray(zero_p)
+        zero_nb = np.zeros((superstep_k, n_lanes), np.int32)
+        zero_pb = np.zeros((superstep_k,) + zp_host.shape, zp_host.dtype)
+        batch_nb = zero_nb.copy()
+        batch_nb[0] = np.asarray(n_new)
+        batch_pb = zero_pb.copy()
+        batch_pb[0] = np.asarray(payloads)
     for _ in range(40):
-        before = eng.committed_total()
+        before = eng.committed_total()  # ra04-ok: pre-sample baseline
+        handles = []  # (steps covered through, watermark readback)
+        obs_step = None
+        steps_done = 0
+        checked = 0
+        elapsed_sample = 0.0
         t1 = time.perf_counter()
-        eng.step(n_new, payloads)
-        eng.step(zero_n, zero_p)  # write-confirm + quorum round
-        spins = 0
-        spin_limit = 32 if durable else 8  # durable: confirm lag is real
-        committed_ok = True
-        while eng.committed_total() - before < expected_per_sample:
-            eng.step(zero_n, zero_p)
-            spins += 1
-            if spins > spin_limit:  # never spin forever on a wedged backend
-                committed_ok = False
-                break
-        if committed_ok:
-            lats.append(time.perf_counter() - t1)
+        if superstep_k:
+            aux = eng.superstep(batch_nb, batch_pb)
+            steps_done += superstep_k
+            handles.append((steps_done, aux["committed_lanes"] + 0))
         else:
+            eng.step(n_new, payloads)
+            steps_done += 1
+            handles.append((steps_done, eng.committed_lanes_async()))
+        for _w in range(max_windows):
+            if superstep_k:
+                for _ in range(max(1, spin // superstep_k)):
+                    aux = eng.superstep(zero_nb, zero_pb)
+                    steps_done += superstep_k
+                    handles.append((steps_done,
+                                    aux["committed_lanes"] + 0))
+            else:
+                for _ in range(spin):
+                    eng.step(zero_n, zero_p)
+                    steps_done += 1
+                    handles.append((steps_done,
+                                    eng.committed_lanes_async()))
+            eng.block_until_ready()  # ra04-ok: sample window boundary
+            elapsed_sample = time.perf_counter() - t1
+            while checked < len(handles) and obs_step is None:
+                hi_step, h = handles[checked]
+                arr = np.asarray(h).astype(np.int64)  # ra04-ok: post-boundary harvest (already synced)
+                if arr.ndim == 2:  # stacked [K, N]: inner-step resolution
+                    cums = arr.sum(axis=1) - before
+                    for k_in in range(arr.shape[0]):
+                        if cums[k_in] >= expected_per_sample:
+                            obs_step = hi_step - arr.shape[0] + k_in + 1
+                            break
+                elif int(arr.sum()) - before >= expected_per_sample:
+                    obs_step = hi_step
+                checked += 1
+            if obs_step is not None:
+                break
+        if obs_step is None:
             # a sample whose commit was never observed must not pollute
-            # the distribution with a bogus-low wall time
+            # the distribution with a bogus-low value
             truncated += 1
+        else:
+            lats.append(elapsed_sample * obs_step / steps_done)
     lats.sort()
     p50 = lats[len(lats) // 2] if lats else -1.0
     p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] if lats else -1.0
 
+    overview = eng.overview()
     print(json.dumps({
         "value": round(value, 1),
         "committed": int(committed),
         "steps": steps,
         "elapsed_s": round(elapsed, 3),
-        # durable: the 8-step max_pending WAL backpressure is the bound
-        "in_flight_window_steps": "max_pending" if durable else window,
+        # durable: the max_pending WAL backpressure is the bound
+        "in_flight_window_steps": "max_pending" if durable else (
+            f"dispatch_ahead*{superstep_k}" if superstep_k else window),
+        # fused-dispatch stamps (ISSUE 5): K=0 means the classic
+        # single-step path; the pipeline dict carries the realized
+        # dispatch/inner-step counters and driver sync counts
+        "superstep_k": superstep_k,
+        "dispatch_ahead": dispatch_ahead if superstep_k else 0,
+        "pipeline": overview["pipeline"],
+        **({"single_step_ref": single_step_ref,
+            "speedup_vs_single_step":
+                round(value / single_step_ref["value"], 3)
+                if single_step_ref["value"] else -1.0}
+           if single_step_ref else {}),
         **({"unbounded_ceiling": ceiling} if ceiling else {}),
+        "latency_mode": "step_stamped",
         "p50_commit_latency_ms": round(1000.0 * p50, 3),
         "p99_commit_latency_ms": round(1000.0 * p99, 3),
         "latency_samples": len(lats),
@@ -332,7 +458,7 @@ def _child_main() -> None:
         **({"sync_mode": sync_mode,
             "wal_strategy": wal_strategy,
             "wal_shards": wal_shards,
-            "wal": eng.overview()["wal"]} if durable else {}),
+            "wal": overview["wal"]} if durable else {}),
     }))
 
 
@@ -393,7 +519,7 @@ def _frontier_main() -> None:
             eng.step(n_new, payloads)
         for _ in range(4):
             eng.step(zero_n, payloads)  # settle: warmup entries commit
-        eng.block_until_ready()
+        eng.block_until_ready()  # ra04-ok: per-point warmup boundary
         # solo (unpipelined) step-time tail at this config: with a
         # window of W, the oldest in-flight batch is W rounds from its
         # readback, so W * step_p99 is the p99 floor THIS BACKEND can
@@ -407,13 +533,15 @@ def _frontier_main() -> None:
         for _ in range(12):
             ts = time.perf_counter()
             eng.step(n_new, payloads)
-            eng.block_until_ready()
+            eng.block_until_ready()  # ra04-ok: solo step-time probe,
+            # deliberately synchronous — it measures the UNPIPELINED
+            # step tail the effective p99 bar is derived from
             stimes.append(time.perf_counter() - ts)
         step_p99_ms = round(1000 * sorted(stimes)[-1], 3)
         for _ in range(4):
             eng.step(zero_n, payloads)  # settle the probe's appends
-        eng.block_until_ready()
-        base = eng.committed_total()
+        eng.block_until_ready()  # ra04-ok: pre-measurement boundary
+        base = eng.committed_total()  # ra04-ok: pre-measurement baseline
 
         per_batch = n_lanes * cmds
         batches = collections.deque()    # (target_cum, t_dispatch)
@@ -430,7 +558,7 @@ def _frontier_main() -> None:
                 if not block and not tc.is_ready():
                     return
                 readbacks.popleft()
-                cum = int(np.asarray(tc).astype(np.int64).sum()) - base
+                cum = int(np.asarray(tc).astype(np.int64).sum()) - base  # ra04-ok: ready (or window boundary)
                 t_obs = time.perf_counter()
                 if cum > obs_cum:
                     obs_cum = cum
@@ -465,7 +593,7 @@ def _frontier_main() -> None:
             harvest(block=True)
             flush_spins += 1
         elapsed = time.perf_counter() - t0
-        committed = eng.committed_total() - base
+        committed = eng.committed_total() - base  # ra04-ok: post-flush readback
         # The flush loop is capped, so batches may remain unflushed:
         # their dispatch time would sit in the denominator (plus up to
         # 64 spins of flush time) with their commands missing from the
@@ -527,6 +655,11 @@ def _frontier_main() -> None:
         "default_point": default_point,
         "p99_bar_ms": round(bar, 3),
         "points": points,
+        # the frontier sweeps the BATCHING axis (cmds_per_step) on the
+        # single-step path; the fused-dispatch axis (superstep_k) is
+        # covered by the throughput child's --superstep row — see
+        # docs/BENCHMARKS.md "choosing superstep_k vs cmds_per_step"
+        "superstep_k": 0,
         "sync_rtt_ms": sync_rtt_ms,
         "note": "observed-commit latency floor ~= sync_rtt_ms on "
                 "tunneled backends; p99 bar is max(25ms, 3*rtt)",
@@ -589,7 +722,20 @@ def _probe_platform() -> str | None:
     return None
 
 
+def _parse_flags(argv) -> None:
+    """--superstep [K]: turn on the fused-dispatch throughput row (K
+    defaults to "auto" = the system-level superstep_k tunable).  Set as
+    env so measurement children inherit it."""
+    if "--superstep" in argv:
+        i = argv.index("--superstep")
+        k = "auto"
+        if i + 1 < len(argv) and argv[i + 1].isdigit():
+            k = argv[i + 1]
+        os.environ["RA_TPU_BENCH_SUPERSTEP"] = k
+
+
 def main() -> None:
+    _parse_flags(sys.argv[1:])
     if os.environ.get("RA_TPU_BENCH_CHILD"):
         if os.environ.get("RA_TPU_BENCH_MODE") == "frontier":
             _frontier_main()

@@ -319,7 +319,13 @@ def _host_meta() -> dict:
 
 
 def main() -> None:
-    detail: dict = {"host": _host_meta(), "errors": {}}
+    # the classic plane co-hosts with the lane engine on one node, so
+    # the round JSON records the system-level dispatch-pipeline tunables
+    # (superstep_k/dispatch_ahead) the lane plane would resolve on this
+    # host — cross-round comparisons need both planes' config in one doc
+    from ra_tpu.system import engine_pipeline_defaults
+    detail: dict = {"host": _host_meta(), "errors": {},
+                    "engine_pipeline": engine_pipeline_defaults()}
     for name, phase in (("local", _phase_local), ("tcp", _phase_tcp)):
         try:
             detail[name] = phase()

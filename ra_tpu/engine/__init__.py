@@ -1,4 +1,5 @@
-from .lockstep import LaneState, LockstepEngine
+from .lockstep import DispatchAheadDriver, LaneState, LockstepEngine
 from .durable import EngineDurability, open_engine
 
-__all__ = ["LaneState", "LockstepEngine", "EngineDurability", "open_engine"]
+__all__ = ["DispatchAheadDriver", "LaneState", "LockstepEngine",
+           "EngineDurability", "open_engine"]

@@ -556,6 +556,34 @@ class EngineDurability:
                 sh.unprocessed += 1
             self._cond.notify_all()
 
+    #: stacked-aux leaves a WAL record needs per inner step (the extra
+    #: superstep watermarks — committed_lanes/applied_lanes — are host-
+    #: pipelining aids, not durability data)
+    _BLOCK_KEYS = ("appended_hi", "n_app", "n_acc", "row_csum",
+                   "flat_rows")
+
+    def submit_block(self, aux: dict, k: int) -> None:
+        """Queue one fused superstep dispatch's STACKED aux (leading
+        [K] axis per leaf, see lockstep._superstep) as ``k``
+        consecutive per-inner-step encode jobs on every shard.  The
+        leading-axis slices are taken here as device ops (async, no
+        host readback — this runs on the engine dispatch thread), so
+        each job carries exactly the single-step aux shape and the
+        shard workers, WAL record format and confirm protocol are
+        unchanged: one RTB block per inner step per shard, confirms
+        advance per inner step as each block fsyncs."""
+        subs = []
+        for j in range(k):
+            subs.append({key: aux[key][j] for key in self._BLOCK_KEYS})
+        with self._cond:
+            for sub in subs:
+                self.step_seq += 1
+                step = self.step_seq
+                for sh in self._shards:
+                    sh._jobs.append((step, sub))
+                    sh.unprocessed += 1
+            self._cond.notify_all()
+
     def flush_all(self, timeout: float = 5.0) -> None:
         """Durability barrier on every shard: drains the encode workers
         first so steps still queued there are written, then flushes

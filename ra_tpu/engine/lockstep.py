@@ -40,6 +40,7 @@ cross-lane collectives (see ra_tpu.parallel.mesh).
 """
 from __future__ import annotations
 
+import collections
 import functools
 from typing import Any, NamedTuple, Optional
 
@@ -49,6 +50,7 @@ import numpy as np
 
 from .. import trace
 from ..core.machine import JitMachine
+from ..metrics import ENGINE_PIPELINE_FIELDS
 from ..ops.exact import split16_matmul
 from ..ops.quorum import (election_quorum, evaluate_quorum, pipeline_credit,
                           query_quorum, update_match_next)
@@ -511,6 +513,45 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
     return new_state, aux
 
 
+def _superstep(state: LaneState, n_new_blk: Array, payloads_blk: Array,
+               fail_mask: Array, elect_blk: Array, confirm_upto: Array,
+               query_blk: Array, **step_kwargs):
+    """K lockstep rounds fused into ONE XLA dispatch via ``lax.scan``
+    (the tentpole of ISSUE 5).  The scan consumes a device-staged
+    ``[K, ...]`` schedule — per-inner-step command counts, payload
+    blocks and elect/query masks — while the failure mask and the
+    durability confirm horizon are dispatch-constant: failures are
+    host-detected between dispatches, and the per-shard WAL confirm
+    watermark is sampled ONCE per dispatch, so within a superstep
+    confirms can only lag real fsyncs, never lead them (the
+    write_delay/confirm contract of step 3 is preserved verbatim —
+    the inner step body IS `_step`).
+
+    Returns ``(new_state, aux)`` with every aux leaf stacked along a
+    leading ``[K]`` axis (one entry per inner step), so the durable
+    readback contract is unchanged: each inner step still yields the
+    exact per-step WAL record inputs.  Two extra per-inner-step
+    watermarks ride along for host pipelining: ``committed_lanes``
+    (cumulative committed per lane — the on-device latency stamp the
+    bench derives observed-commit steps from) and ``applied_lanes``
+    (the lane apply frontier over active members)."""
+    big = jnp.int32(2 ** 30)
+
+    def body(st, xs):
+        n_new, payloads, elect, query = xs
+        new_st, aux = _step(st, n_new, payloads, fail_mask, elect,
+                            confirm_upto, query, **step_kwargs)
+        aux["committed_lanes"] = new_st.total_committed
+        applied = jnp.min(jnp.where(new_st.active, new_st.applied, big),
+                          axis=-1)
+        aux["applied_lanes"] = jnp.where(
+            jnp.any(new_st.active, axis=-1), applied, 0)
+        return new_st, aux
+
+    return jax.lax.scan(body, state,
+                        (n_new_blk, payloads_blk, elect_blk, query_blk))
+
+
 #: shared jitted step fns (see _compile_step)
 _STEP_JIT_CACHE: dict = {}
 
@@ -523,12 +564,23 @@ class LockstepEngine:
                  apply_window: Optional[int] = None,
                  pipeline_window: int = 4096, max_append_batch: int = 128,
                  write_delay: int = 0, ring_io: str = "auto",
-                 donate: bool = False, quorum_impl: str = "xla") -> None:
-        # donate=False by default: buffer donation costs ~35ms/step on
-        # tunneled PJRT backends (a per-step sync), vs ~0.05ms/step
-        # without — XLA's allocator handles the transient double
-        # buffering fine at these state sizes.  Flip on for
-        # memory-constrained local deployments.
+                 donate: bool = False, quorum_impl: str = "xla",
+                 superstep_donate: Optional[bool] = None) -> None:
+        # donate=False by default ON THE SINGLE-STEP PATH: buffer
+        # donation costs ~35ms/step on tunneled PJRT backends (a
+        # per-step sync), vs ~0.05ms/step without — XLA's allocator
+        # handles the transient double buffering fine at these state
+        # sizes.  Flip on for memory-constrained local deployments.
+        #
+        # The SUPERSTEP path defaults donation ON (superstep_donate
+        # None -> True): any per-dispatch donation overhead amortizes
+        # over the K fused rounds, while donating saves the full-state
+        # double buffer per dispatch.  Re-measured for ISSUE 5 (CPU,
+        # jax 0.4.37 — donation is real there, the donated input is
+        # invalidated; 512 lanes x 5, K=8, 32 cmds/step, 3x2s reps):
+        # median 4.91M cmds/s donated vs 4.71M not, parity exact — a
+        # wash to slightly positive, so the memory win decides.  See
+        # docs/INTERNALS.md §8 for the dataflow.
         self.machine = machine
         self.n_lanes = n_lanes
         self.n_members = n_members
@@ -568,14 +620,20 @@ class LockstepEngine:
                                  quorum_fn=make_evaluate_quorum(quorum_impl))
         self._quorum_impl = quorum_impl
         self._donate = donate
+        self._superstep_donate = superstep_donate \
+            if superstep_donate is not None else True
         self._dur = None
+        self._driver = None
+        #: host-side dispatch-pipeline bookkeeping (ENGINE_PIPELINE_FIELDS)
+        self.pipeline_counters = {f: 0 for f in ENGINE_PIPELINE_FIELDS}
+        self._superstep_k_last = 0
         self._compile_step(durable=False)
         self._zero_fail = jnp.zeros((n_lanes, n_members), bool)
         self._zero_elect = jnp.zeros((n_lanes,), bool)
         self._zero_confirm = jnp.zeros((n_lanes,), jnp.int32)
         self._fail_host = np.zeros((n_lanes, n_members), bool)
 
-    def _compile_step(self, durable: bool) -> None:
+    def _build_jit(self, fn, durable: bool, donate: bool, tag: str):
         # share the jitted step across same-config engines: jax.jit
         # caches by function identity, so a per-instance partial forces
         # a full recompile for every engine construction (a fuzz seed,
@@ -586,25 +644,26 @@ class LockstepEngine:
         m = self.machine
         attrs = [(k, v) for k, v in sorted(m.__dict__.items())
                  if not k.startswith("_")]
+        partial = functools.partial(fn, durable=durable,
+                                    **self._step_kwargs)
         if all(isinstance(v, (int, float, str, bool)) for _k, v in attrs):
-            key = (type(m), tuple(attrs), durable, self._donate,
+            key = (type(m), tuple(attrs), tag, durable, donate,
                    self._quorum_impl,
                    tuple(sorted((k, v)
                                 for k, v in self._step_kwargs.items()
                                 if k not in ("machine", "quorum_fn"))))
-            fn = _STEP_JIT_CACHE.get(key)
-            if fn is None:
-                step = functools.partial(_step, durable=durable,
-                                         **self._step_kwargs)
-                fn = jax.jit(step,
-                             donate_argnums=(0,) if self._donate else ())
-                _STEP_JIT_CACHE[key] = fn
-            self._step = fn
-            return
-        step = functools.partial(_step, durable=durable,
-                                 **self._step_kwargs)
-        self._step = jax.jit(step,
-                             donate_argnums=(0,) if self._donate else ())
+            jitted = _STEP_JIT_CACHE.get(key)
+            if jitted is None:
+                jitted = jax.jit(partial,
+                                 donate_argnums=(0,) if donate else ())
+                _STEP_JIT_CACHE[key] = jitted
+            return jitted
+        return jax.jit(partial, donate_argnums=(0,) if donate else ())
+
+    def _compile_step(self, durable: bool) -> None:
+        self._step = self._build_jit(_step, durable, self._donate, "step")
+        self._sstep = self._build_jit(_superstep, durable,
+                                      self._superstep_donate, "superstep")
 
     def attach_durability(self, dur) -> None:
         """Switch the engine into durable mode: ``dur`` (an
@@ -616,19 +675,36 @@ class LockstepEngine:
 
     # -- driving -----------------------------------------------------------
 
+    def _host_mask(self, mask):
+        """Coerce a HOST-side mask (election/query requests originate on
+        the host failure detector) and record whether any lane is set —
+        the host-side bookkeeping that lets the hot step path skip the
+        post-dispatch ``np.asarray(elect_mask).any()`` device sync the
+        old code paid on every masked step (ISSUE 5 satellite).  Callers
+        must pass host data (numpy/list); a device array here would
+        reintroduce the sync it exists to remove."""
+        arr = np.asarray(mask)
+        return jnp.asarray(arr), bool(arr.any())
+
     def step(self, n_new, payloads, elect_mask=None,
              query_mask=None) -> None:
         """Advance every lane one round.  n_new: int32[N]; payloads:
         [N, K, C] with K <= max_step_cmds.  In durable mode the step's
         accepted entries are compacted on device, read back off-thread
         by the WAL shards, and commits gate on the fsync confirm — host
-        or device payloads both work (no host-side copy is taken)."""
+        or device payloads both work (no host-side copy is taken).
+        Masks are host data (see _host_mask)."""
         fail = (jnp.asarray(self._fail_host)
                 if self._fail_host.any() else self._zero_fail)
-        elect = self._zero_elect if elect_mask is None \
-            else jnp.asarray(elect_mask)
+        elect_any = False
+        if elect_mask is None:
+            elect = self._zero_elect
+        else:
+            elect, elect_any = self._host_mask(elect_mask)
         query = self._zero_elect if query_mask is None \
             else jnp.asarray(query_mask)
+        self.pipeline_counters["dispatches"] += 1
+        self.pipeline_counters["inner_steps"] += 1
         if self._dur is None:
             with trace.span("engine.step", "engine"):
                 self.state, _ = self._step(self.state, jnp.asarray(n_new),
@@ -646,11 +722,66 @@ class LockstepEngine:
             # no host payload copy here: the WAL shards read back the
             # device-compacted flat rows off-thread (see durable.py)
             self._dur.submit(aux)
-        if elect_mask is not None and \
-                np.asarray(elect_mask).any():  # ra02-ok: host-side mask
+        if elect_any:
             # elections truncate+reuse indexes: drain now so the next
             # dispatch reads a confirm horizon clamped at the new base
+            # (elect_any is host bookkeeping — no device readback here)
             self._dur.drain_all()
+
+    def superstep(self, n_new_blk, payloads_blk, elect_blk=None,
+                  query_blk=None) -> dict:
+        """Advance every lane K rounds in ONE XLA dispatch (the fused
+        `lax.scan` path, ISSUE 5).  Inputs carry a leading inner-step
+        axis: ``n_new_blk`` int32[K, N]; ``payloads_blk`` [K, N, Kc, C];
+        optional elect/query schedules bool[K, N] (host data) for
+        mid-superstep elections/reads.  The failure mask and — in
+        durable mode — the WAL confirm horizon are sampled once per
+        dispatch: within the superstep confirms only lag real fsyncs.
+
+        Returns the stacked per-inner-step aux (device arrays, one [K]
+        leading axis per leaf): ``committed_lanes`` [K, N] is the
+        cumulative committed watermark after each inner step — start an
+        async readback of it to observe commit progress without ever
+        blocking the dispatch pipeline (what DispatchAheadDriver and
+        the bench's step-stamped latency mode do)."""
+        k = int(n_new_blk.shape[0]) if hasattr(n_new_blk, "shape") \
+            else len(n_new_blk)
+        fail = (jnp.asarray(self._fail_host)
+                if self._fail_host.any() else self._zero_fail)
+        elect_any = False
+        if elect_blk is None:
+            elect = jnp.broadcast_to(self._zero_elect,
+                                     (k, self.n_lanes))
+        else:
+            elect, elect_any = self._host_mask(elect_blk)
+        query = jnp.broadcast_to(self._zero_elect, (k, self.n_lanes)) \
+            if query_blk is None else jnp.asarray(query_blk)
+        self.pipeline_counters["dispatches"] += 1
+        self.pipeline_counters["superstep_dispatches"] += 1
+        self.pipeline_counters["inner_steps"] += k
+        self._superstep_k_last = k
+        if self._dur is None:
+            with trace.span("engine.superstep", "engine", k=k):
+                self.state, aux = self._sstep(
+                    self.state, jnp.asarray(n_new_blk),
+                    jnp.asarray(payloads_blk), fail, elect,
+                    self._zero_confirm, query)
+            return aux
+        with trace.span("engine.backpressure", "engine"):
+            self._dur.backpressure()
+        # confirm horizon sampled ONCE per dispatch — the scan's
+        # (constant) confirm schedule; write_delay semantics preserved:
+        # confirms may only lag, never lead fsync
+        confirm = jnp.asarray(self._dur.confirm_upto)
+        with trace.span("engine.superstep", "engine", durable=True, k=k):
+            self.state, aux = self._sstep(
+                self.state, jnp.asarray(n_new_blk),
+                jnp.asarray(payloads_blk), fail, elect, confirm, query)
+        with trace.span("engine.wal_submit", "engine", k=k):
+            self._dur.submit_block(aux, k)
+        if elect_any:
+            self._dur.drain_all()
+        return aux
 
     def checkpoint(self) -> str:
         """Durable mode: quiesce the WAL, snapshot the full lane state,
@@ -672,6 +803,16 @@ class LockstepEngine:
         n_new = jnp.full((N,), min(cmds_per_lane, K), jnp.int32)
         payloads = jnp.full((N, K, C), payload_value, self.payload_dtype)
         self.step(n_new, payloads)
+
+    def uniform_superstep(self, k: int, cmds_per_lane: int,
+                          payload_value=1) -> dict:
+        """Bench/soak helper: one fused dispatch of ``k`` rounds, every
+        lane's leader receiving the same command count each round."""
+        N, K, C = self.n_lanes, self.max_step_cmds, self.payload_width
+        n_new = jnp.full((k, N), min(cmds_per_lane, K), jnp.int32)
+        payloads = jnp.full((k, N, K, C), payload_value,
+                            self.payload_dtype)
+        return self.superstep(n_new, payloads)
 
     # -- failure injection / elections ------------------------------------
 
@@ -897,9 +1038,115 @@ class LockstepEngine:
             "active": np.asarray(s.active[lane]).tolist(),
             "total_committed": int(s.total_committed[lane]),
         }
+        # dispatch-pipeline stamp (ISSUE 5): last fused K, the attached
+        # driver's stage-ahead depth + live in-flight count, and the
+        # host-side pipeline counters
+        out["pipeline"] = {
+            "superstep_k": self._superstep_k_last,
+            "dispatch_ahead": (self._driver.max_in_flight
+                               if self._driver is not None else 0),
+            "dispatches_in_flight": (self._driver.in_flight()
+                                     if self._driver is not None else 0),
+            **self.pipeline_counters,
+        }
         if self._dur is not None:
             # durability-plane health (ENGINE_WAL_FIELDS + per-shard
             # WAL_FIELDS/stats), the key_metrics merge of PR 2's
             # RPC_FIELDS pattern
             out["wal"] = self._dur.wal_overview()
         return out
+
+
+class DispatchAheadDriver:
+    """Dispatch-ahead host pipeline for the superstep path (ISSUE 5).
+
+    Double-buffered staging: :meth:`submit` starts the host->device
+    transfer (``device_put``) of THIS block, then dispatches the
+    PREVIOUSLY staged block — so the host-side encode + H2D copy of
+    block i+1 overlaps the device execution of dispatch i.  No
+    ``block_until_ready`` anywhere in the loop: the in-flight cap is
+    enforced with asynchronous commit readbacks (one per dispatch, of
+    the superstep's last inner-step committed watermark), and only when
+    more than ``max_in_flight`` dispatches are unobserved does the
+    driver await the OLDEST readback — the window-boundary sync, the
+    single blocking point (counted in ``window_syncs``; lint rule RA04
+    polices the bench loops this feeds).
+
+    ``shardings`` (optional, from
+    :func:`ra_tpu.parallel.mesh.superstep_block_shardings`) places the
+    staged ``n_new``/``payloads`` blocks on a device mesh so a sharded
+    engine's fused dispatch consumes them without a resharding copy.
+    Elect schedules are NOT staged: they are host data by the
+    `_host_mask` contract (the any-election bookkeeping runs on the
+    host), so the driver hands them to :meth:`LockstepEngine.superstep`
+    untouched.
+    """
+
+    def __init__(self, engine: "LockstepEngine", max_in_flight: int = 2,
+                 shardings: Optional[dict] = None) -> None:
+        if max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1")
+        self.engine = engine
+        self.max_in_flight = max_in_flight
+        self.shardings = shardings or {}
+        self._staged = None
+        self._handles: collections.deque = collections.deque()
+        self.last_committed: Optional[np.ndarray] = None
+        engine._driver = self
+
+    def in_flight(self) -> int:
+        return len(self._handles)
+
+    def _stage(self, n_new_blk, payloads_blk, elect_blk=None) -> None:
+        put = jax.device_put
+        n = put(np.asarray(n_new_blk, np.int32),
+                self.shardings.get("n_new"))
+        p = put(np.asarray(payloads_blk), self.shardings.get("payloads"))
+        self.engine.pipeline_counters["blocks_staged"] += 1
+        self._staged = (n, p, elect_blk)
+
+    def submit(self, n_new_blk, payloads_blk, elect_blk=None):
+        """Stage this block (async H2D), dispatch the previous one.
+        Returns the previous dispatch's async committed-watermark
+        handle, or None on the first call (nothing dispatched yet)."""
+        prev = self._staged
+        self._stage(n_new_blk, payloads_blk, elect_blk)
+        return self._dispatch(prev) if prev is not None else None
+
+    def _dispatch(self, blk):
+        aux = self.engine.superstep(blk[0], blk[1], elect_blk=blk[2])
+        # the `+ 0` copy decouples the readback from buffer donation by
+        # the next dispatch (same contract as committed_lanes_async)
+        h = aux["committed_lanes"][-1] + 0
+        try:
+            h.copy_to_host_async()
+        except AttributeError:  # pragma: no cover — older jax arrays
+            pass
+        self._handles.append(h)
+        while len(self._handles) > self.max_in_flight:
+            # window boundary: await the OLDEST dispatch's watermark.
+            # Only a harvest that actually had to WAIT counts as a
+            # window_sync — a ready readback popped in passing is the
+            # pipeline working, not blocking (the counter backs the
+            # "window_syncs << dispatches" health rule, so it must
+            # distinguish the two)
+            oldest = self._handles.popleft()
+            try:
+                waited = not oldest.is_ready()
+            except AttributeError:  # pragma: no cover — older jax arrays
+                waited = True
+            if waited:
+                self.engine.pipeline_counters["window_syncs"] += 1
+            self.last_committed = np.asarray(oldest)
+        return h
+
+    def drain(self) -> Optional[np.ndarray]:
+        """Dispatch any staged block and await every in-flight
+        readback; returns the newest observed per-lane committed
+        watermark (np.int32[N])."""
+        if self._staged is not None:
+            blk, self._staged = self._staged, None
+            self._dispatch(blk)
+        while self._handles:
+            self.last_committed = np.asarray(self._handles.popleft())
+        return self.last_committed

@@ -88,6 +88,21 @@ WAL_FIELDS = ("wal_files", "batches", "writes", "bytes_written", "syncs",
 ENGINE_WAL_FIELDS = ("readback_bytes", "readback_bytes_full",
                      "encoded_blocks", "encoded_bytes")
 
+#: engine dispatch-pipeline counter fields (ra_tpu/engine/lockstep.py),
+#: host-side ints stamped into ``engine.overview()["pipeline"]`` and the
+#: bench JSON (ISSUE 5).  ``dispatches`` counts XLA dispatches (single
+#: steps AND fused supersteps each count 1); ``inner_steps`` counts
+#: engine rounds (a superstep of K adds K — dividing the two gives the
+#: realized fusion factor); ``superstep_dispatches`` the fused subset;
+#: ``blocks_staged`` host->device staging transfers started by the
+#: dispatch-ahead driver; ``window_syncs`` the driver's in-flight-cap
+#: waits — the ONLY host blocking points in a dispatch-ahead loop, so
+#: window_syncs << dispatches is the proof the pipeline actually ran
+#: ahead (the gauge twin of lint rule RA04's static guarantee).
+ENGINE_PIPELINE_FIELDS = ("dispatches", "inner_steps",
+                          "superstep_dispatches", "blocks_staged",
+                          "window_syncs")
+
 #: node-wide segment-writer counter fields (ra_log_segment_writer.erl:
 #: 37-52 — same names)
 SEGMENT_WRITER_FIELDS = ("mem_tables", "segments", "entries",

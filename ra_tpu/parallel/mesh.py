@@ -76,3 +76,27 @@ def shard_engine_state(engine, mesh: Optional[Mesh] = None):
     shardings = state_shardings(mesh, engine.state)
     engine.state = jax.device_put(engine.state, shardings)
     return mesh
+
+
+def superstep_block_shardings(mesh: Mesh) -> dict:
+    """Shardings for the ``[K, ...]`` superstep staging block (the
+    dispatch-ahead driver's device_put targets, ISSUE 5).  The leading
+    inner-step axis is TIME, not data — it is never sharded; lanes
+    shard as everywhere else, so a fused dispatch over a sharded
+    engine consumes the staged block with zero resharding copies:
+
+      n_new    int32[K, N]        -> P(None, 'lanes')
+      payloads [K, N, Kc, C]      -> P(None, 'lanes', None, None)
+      query    bool[K, N]         -> P(None, 'lanes')
+
+    No ``elect`` entry on purpose: elect schedules are HOST data —
+    the engine keeps any-election bookkeeping on the host
+    (``LockstepEngine._host_mask``) so the hot path never reads the
+    mask back from device; pre-staging it would reintroduce exactly
+    that sync."""
+    vec = NamedSharding(mesh, P(None, "lanes"))
+    return {
+        "n_new": vec,
+        "payloads": NamedSharding(mesh, P(None, "lanes", None, None)),
+        "query": vec,
+    }

@@ -51,6 +51,32 @@ def _config_snapshot(cfg: ServerConfig) -> dict:
     }
 
 
+#: System-level lane-engine dispatch-pipeline tunables (ISSUE 5).
+#: ``superstep_k`` is how many engine rounds fuse into one XLA dispatch
+#: (the lax.scan superstep, ra_tpu/engine/lockstep.py) and
+#: ``dispatch_ahead`` how many dispatches the host may keep in flight
+#: before the staging driver waits on a commit watermark.  These are
+#: deployment knobs, not per-engine constants: a node co-hosting the
+#: classic plane and the lane engine sizes them against the SAME host
+#: budget that sizes wal shards/batching, which is why they live here
+#: with the other system tunables.  Resolution order: explicit RaSystem
+#: kwarg > RA_TPU_SUPERSTEP_K / RA_TPU_DISPATCH_AHEAD env > defaults.
+ENGINE_SUPERSTEP_K = 8
+ENGINE_DISPATCH_AHEAD = 2
+
+
+def engine_pipeline_defaults() -> dict:
+    """The system-level superstep/dispatch-ahead defaults after env
+    overrides — what bench.py's ``--superstep auto`` and embedding
+    nodes resolve against."""
+    return {
+        "superstep_k": int(os.environ.get("RA_TPU_SUPERSTEP_K",
+                                          ENGINE_SUPERSTEP_K)),
+        "dispatch_ahead": int(os.environ.get("RA_TPU_DISPATCH_AHEAD",
+                                             ENGINE_DISPATCH_AHEAD)),
+    }
+
+
 #: WAL supervisor restart intensity: (max restarts, window seconds).
 #: Beyond it the supervisor backs off for the window instead of
 #: hot-looping (OTP's intensity/period shape, ra_log_sup.erl:26-51 — but
@@ -69,9 +95,20 @@ class RaSystem:
                  wal_max_batch_bytes: int = 0,
                  wal_max_batch_interval_ms: float = 0.0,
                  segment_max_count: int = 4096,
-                 wal_supervise: bool = True) -> None:
+                 wal_supervise: bool = True,
+                 superstep_k: Optional[int] = None,
+                 dispatch_ahead: Optional[int] = None) -> None:
         self.name = name
         self.data_dir = data_dir
+        # lane-engine pipeline tunables carried by the system so an
+        # embedding node configures both planes in one place (surfaced
+        # in overview(); the engine/bench read them via
+        # engine_pipeline_defaults when not set explicitly)
+        defaults = engine_pipeline_defaults()
+        self.superstep_k = defaults["superstep_k"] \
+            if superstep_k is None else superstep_k
+        self.dispatch_ahead = defaults["dispatch_ahead"] \
+            if dispatch_ahead is None else dispatch_ahead
         os.makedirs(data_dir, exist_ok=True)
         self.segment_max_count = segment_max_count
         self._logs: dict[str, DurableLog] = {}
@@ -343,4 +380,6 @@ class RaSystem:
                             for uid, log in self._logs.items()},
                 "directory": self.directory.overview(),
                 "counters": self.counters(),
+                "engine_pipeline": {"superstep_k": self.superstep_k,
+                                    "dispatch_ahead": self.dispatch_ahead},
             }

@@ -61,6 +61,61 @@ def test_child_fifo_machine_contract():
     assert doc["machine"] == "fifo"
 
 
+def test_child_superstep_mode_contract():
+    """The fused-dispatch throughput row (ISSUE 5): K engine rounds per
+    XLA dispatch through the dispatch-ahead driver.  Exercised in CI so
+    the superstep path can't silently rot while only the classic path
+    is benchmarked — the contract pins the pipeline stamps (realized
+    fusion factor, driver sync counts) and the single-step reference +
+    speedup fields the acceptance criterion reads."""
+    doc = run_child({"RA_TPU_BENCH_SUPERSTEP": "4",
+                     "RA_TPU_BENCH_DISPATCH_AHEAD": "2"})
+    assert doc["value"] > 0
+    assert doc["superstep_k"] == 4 and doc["dispatch_ahead"] == 2
+    pipe = doc["pipeline"]
+    assert pipe["superstep_dispatches"] > 0
+    # realized fusion: the fused phase adds K inner steps per dispatch
+    assert pipe["inner_steps"] >= 4 * pipe["superstep_dispatches"]
+    assert pipe["blocks_staged"] > 0
+    # dispatch-ahead ran ahead: window syncs are a small fraction of
+    # dispatches (the in-flight cap, not a per-dispatch block)
+    assert pipe["window_syncs"] <= pipe["superstep_dispatches"] + 2
+    ref = doc["single_step_ref"]
+    assert ref["value"] > 0 and ref["steps"] > 0
+    assert doc["speedup_vs_single_step"] > 0
+    assert doc["latency_mode"] == "step_stamped"
+    assert doc["p50_commit_latency_ms"] > 0
+
+
+def test_child_superstep_durable_mode_contract():
+    """Fused dispatches over the durable engine: confirms stay
+    fsync-gated (the WAL stats ride along) and the mode completes with
+    a sane latency distribution."""
+    doc = run_child({"RA_TPU_BENCH_SUPERSTEP": "4",
+                     "RA_TPU_BENCH_DURABLE": "1"})
+    assert doc["value"] > 0
+    assert doc["durable"] is True and doc["superstep_k"] == 4
+    assert doc["pipeline"]["superstep_dispatches"] > 0
+    assert "wal" in doc
+
+
+def test_superstep_flag_sets_env():
+    """`bench.py --superstep [K]` resolves to the child env contract
+    ("auto" = the system-level superstep_k tunable)."""
+    import bench
+    env = {}
+    try:
+        os.environ.pop("RA_TPU_BENCH_SUPERSTEP", None)
+        bench._parse_flags(["--superstep", "4"])
+        env["explicit"] = os.environ.get("RA_TPU_BENCH_SUPERSTEP")
+        os.environ.pop("RA_TPU_BENCH_SUPERSTEP", None)
+        bench._parse_flags(["--superstep"])
+        env["auto"] = os.environ.get("RA_TPU_BENCH_SUPERSTEP")
+    finally:
+        os.environ.pop("RA_TPU_BENCH_SUPERSTEP", None)
+    assert env == {"explicit": "4", "auto": "auto"}
+
+
 def test_child_frontier_mode_contract():
     doc = run_child({"RA_TPU_BENCH_MODE": "frontier",
                      "RA_TPU_BENCH_SIZES": "1,8",

@@ -266,6 +266,67 @@ def test_recover_with_election_truncation(tmp_path):
     eng2.close()
 
 
+# -- superstep durable contracts (ISSUE 5) ----------------------------------
+
+def test_superstep_durable_parity(tmp_path):
+    """A durable run driven in K-fused supersteps converges to the SAME
+    state as a single-step durable run over the same schedule: identical
+    WAL records per inner step, identical commits/applies/machine state
+    once both settle (the stacked-aux submit_block path feeds the shard
+    workers exactly what K step() calls would)."""
+    a = make_engine(tmp_path / "a", wal_shards=2, max_pending=32)
+    b = make_engine(tmp_path / "b", wal_shards=2, max_pending=32)
+    rng = np.random.default_rng(42)
+    SK = 4
+    for _ in range(3):
+        n_new = rng.integers(0, K + 1, (SK, N)).astype(np.int32)
+        pay = rng.integers(1, 5, (SK, N, K, 1)).astype(np.int32)
+        for j in range(SK):
+            a.step(n_new[j], pay[j])
+        b.superstep(n_new, pay)
+    settle(a, 20)
+    settle(b, 20)
+    for f in ("commit", "applied", "total_committed"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.state, f)),
+            np.asarray(getattr(b.state, f)), err_msg=f)
+    np.testing.assert_array_equal(np.asarray(a.state.mac),
+                                  np.asarray(b.state.mac))
+    # both runs recover to equal durable state too
+    a.close()
+    b.close()
+    a2 = make_engine(tmp_path / "a", wal_shards=2)
+    b2 = make_engine(tmp_path / "b", wal_shards=2)
+    np.testing.assert_array_equal(np.asarray(a2.state.mac),
+                                  np.asarray(b2.state.mac))
+    a2.close()
+    b2.close()
+
+
+def test_superstep_confirms_only_lag_fsync(tmp_path):
+    """The confirm horizon is sampled ONCE per fused dispatch: no entry
+    may commit inside a superstep beyond what was already WAL-confirmed
+    when the dispatch launched (write_delay semantics — confirms lag,
+    never lead).  Checked against the horizon captured BEFORE each
+    dispatch, which is strictly stronger than the settled-state gate."""
+    eng = make_engine(tmp_path, max_pending=64)
+    lane = np.arange(N)
+    rng = np.random.default_rng(7)
+    for _ in range(6):
+        confirm_before = eng._dur.confirm_upto.copy()
+        n_new = rng.integers(0, K + 1, (4, N)).astype(np.int32)
+        pay = rng.integers(1, 5, (4, N, K, 1)).astype(np.int32)
+        eng.superstep(n_new, pay)
+        st = eng.state
+        com = np.asarray(st.commit)[lane, np.asarray(st.leader_slot)]
+        assert (com <= confirm_before).all(), (com, confirm_before)
+    # ...and the horizon does advance once the WAL drains, so the gate
+    # above is hold-back, not a frozen pipeline
+    settle(eng, 20)
+    assert eng.committed_total() > 0
+    eng.close()
+
+
 _CHILD = r"""
 import os, sys, json
 import numpy as np
@@ -277,15 +338,25 @@ from ra_tpu.engine import open_engine
 from ra_tpu.models import CounterMachine
 
 N, P, K = 16, 3, 8
+mode = sys.argv[4] if len(sys.argv) > 4 else "step"
 eng = open_engine(CounterMachine(), sys.argv[1], N, P,
                   sync_mode=1, ring_capacity=256, max_step_cmds=K,
-                  wal_shards=int(sys.argv[3]))
+                  wal_shards=int(sys.argv[3]),
+                  # superstep: step_seq advances SK per dispatch, so the
+                  # unconfirmed window must cover a few fused dispatches
+                  max_pending=32 if mode == "superstep" else 8)
 report = sys.argv[2]
 n_new = np.full((N,), 4, np.int32)
 payloads = np.ones((N, K, 1), np.int32)
+SK = 4
+n_new_blk = np.broadcast_to(n_new, (SK, N)).copy()
+pay_blk = np.broadcast_to(payloads, (SK, N, K, 1)).copy()
 lane = np.arange(N)
 for i in range(10_000):
-    eng.step(n_new, payloads)
+    if mode == "superstep":
+        eng.superstep(n_new_blk, pay_blk)
+    else:
+        eng.step(n_new, payloads)
     if i % 5 == 4:
         # report the fsync-confirmed commit frontier crash-safely
         st = eng.state
@@ -300,22 +371,26 @@ for i in range(10_000):
 """
 
 
-@pytest.mark.parametrize("shards", [1, 4])
-def test_kill9_recovers_all_reported_commits(tmp_path, shards):
+@pytest.mark.parametrize("shards,mode", [(1, "step"), (4, "step"),
+                                         (4, "superstep")])
+def test_kill9_recovers_all_reported_commits(tmp_path, shards, mode):
     """SIGKILL mid-bench: every entry ever reported committed (which the
     engine only does after its WAL block is fsynced) survives recovery —
     for the single-shard compat layout AND the sharded WAL plane (a
     crash can tear one shard mid-write; recovery merges the ragged
-    per-shard coverage).  The recovered machine state must equal the
-    never-crashed oracle at the recovered apply frontier: with no
-    elections every applied entry is a +1 command, so the oracle
+    per-shard coverage), and for a run driven in FUSED SUPERSTEP mode
+    (ISSUE 5: the kill lands mid-block — some of a dispatch's K
+    per-inner-step WAL records written, some not — and recovery still
+    honours every fsync-gated report).  The recovered machine state must
+    equal the never-crashed oracle at the recovered apply frontier: with
+    no elections every applied entry is a +1 command, so the oracle
     counter at applied index a is exactly a."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     data = str(tmp_path / "data")
     report = str(tmp_path / "report.json")
     child = subprocess.Popen(
         [sys.executable, "-c", _CHILD.format(repo=repo), data, report,
-         str(shards)],
+         str(shards), mode],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         # PYTHONPATH= : the axon site hook must not register a PJRT
         # plugin whose discovery blocks on a dead tunnel (same guard as

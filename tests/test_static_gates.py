@@ -187,6 +187,64 @@ def test_log_layer_is_ra03_clean():
         assert "RA03" not in r.stdout, (mod, r.stdout)
 
 
+def test_checker_forbids_host_syncs_in_bench_dispatch_loops(tmp_path):
+    """RA04: block_until_ready/.item()/np.asarray/committed_total inside
+    a bench/soak dispatch loop serializes the measured pipeline (ISSUE
+    5).  Applies to files named bench.py/bench_classic.py/soak.py only;
+    `# ra04-ok:` allowlists window-boundary syncs; loops that dispatch
+    nothing are not gated."""
+    bad = tmp_path / "bench.py"
+    bad.write_text(textwrap.dedent("""\
+        import time
+        import numpy as np
+
+        def run(eng, n_new, payloads):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 1.0:
+                eng.step(n_new, payloads)
+                eng.block_until_ready()
+                total = eng.committed_total()
+                flag = eng.state.term[0].item()
+                host = np.asarray(eng.state.commit)
+            return total, flag, host
+
+        def run_windowed(eng, n_new, payloads, rb):
+            for _ in range(100):
+                eng.superstep(n_new, payloads)
+                while len(rb) > 4:
+                    np.asarray(rb.popleft())  # ra04-ok: window boundary
+            eng.block_until_ready()
+
+        def postprocess(rows):
+            # no dispatch in this loop: host-side math is not gated
+            out = []
+            for r in rows:
+                out.append(np.asarray(r).sum().item())
+            return out
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA04") == 4, r.stdout
+    for frag in (".block_until_ready()", ".committed_total()",
+                 ".item()", "np.asarray()"):
+        assert frag in r.stdout, (frag, r.stdout)
+    # the same content under a non-bench module name is not gated
+    other = tmp_path / "helpers.py"
+    other.write_text(bad.read_text())
+    r = run_lint(str(other))
+    assert "RA04" not in r.stdout
+
+
+def test_bench_files_are_ra04_clean():
+    """The real bench/soak measured loops pass the dispatch-loop sync
+    gate (covered by the repo-wide run too; pinned separately so a
+    regression names the rule)."""
+    for mod in ("bench.py", "bench_classic.py",
+                os.path.join("tools", "soak.py")):
+        r = run_lint(os.path.join(REPO, mod))
+        assert "RA04" not in r.stdout, (mod, r.stdout)
+
+
 def test_checker_false_positive_guards(tmp_path):
     ok = tmp_path / "ok.py"
     ok.write_text(textwrap.dedent("""\

@@ -1,0 +1,264 @@
+"""Superstep parity tests (ISSUE 5): a K-round fused dispatch
+(`LockstepEngine.superstep`, lax.scan over the step body) must be
+ORACLE-EXACT against K single steps — same LaneState bit for bit — for
+every machine flavour (batch-apply counter/kv AND the sequential-window
+fifo), including mid-superstep election masks, member failures and ring
+backpressure.  Durable-mode behaviour (confirm hold-back, kill-9
+recovery of a superstep-driven run) lives in test_engine_durable.py /
+test_wal_shards.py; this file pins the pure state-transition algebra.
+
+Also the soak entry point: ``run_superstep_fuzz`` explores fresh random
+schedules (tools/soak.py --superstep).
+"""
+import numpy as np
+import pytest
+
+from ra_tpu.engine import DispatchAheadDriver, LockstepEngine
+from ra_tpu.models import CounterMachine, JitFifoMachine, JitKvMachine
+
+N, P, KC = 8, 3, 4  # lanes, members, max cmds/step
+
+
+def _machine(name):
+    if name == "jit_kv":
+        return JitKvMachine(n_keys=16)
+    if name == "jit_fifo":
+        return JitFifoMachine(capacity=16, checkout_slots=4)
+    return CounterMachine()
+
+
+def _payloads(name, rng, k):
+    """Random valid [k, N, KC, C] command blocks for the machine."""
+    if name == "jit_kv":
+        p = np.zeros((k, N, KC, 4), np.int32)
+        p[..., 0] = rng.integers(1, 5, (k, N, KC))     # put/get/del/cas
+        p[..., 1] = rng.integers(0, 16, (k, N, KC))    # key
+        p[..., 2] = rng.integers(0, 100, (k, N, KC))   # value
+        p[..., 3] = rng.integers(-1, 5, (k, N, KC))    # cas expected
+        return p
+    if name == "jit_fifo":
+        p = np.zeros((k, N, KC, 3), np.int32)
+        p[..., 0] = rng.integers(1, 3, (k, N, KC))     # enqueue/dequeue
+        p[..., 1] = rng.integers(1, 9, (k, N, KC))
+        return p
+    return rng.integers(1, 9, (k, N, KC, 1)).astype(np.int32)
+
+
+def _mk(name, **kw):
+    kw.setdefault("ring_capacity", 64)
+    kw.setdefault("max_step_cmds", KC)
+    kw.setdefault("write_delay", 1)
+    return LockstepEngine(_machine(name), N, P, **kw)
+
+
+def _assert_state_equal(a, b, ctx=""):
+    for f in a.state._fields:
+        if f == "mac":
+            continue
+        xa, xb = np.asarray(getattr(a.state, f)), \
+            np.asarray(getattr(b.state, f))
+        np.testing.assert_array_equal(xa, xb, err_msg=f"{ctx}: {f}")
+    import jax
+    for pa, pb in zip(jax.tree.leaves(a.state.mac),
+                      jax.tree.leaves(b.state.mac)):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb),
+                                      err_msg=f"{ctx}: mac")
+
+
+@pytest.mark.parametrize("machine_name", ["counter", "jit_kv", "jit_fifo"])
+@pytest.mark.parametrize("k", [1, 2, 8])
+def test_superstep_oracle_exact(machine_name, k):
+    """K fused rounds == K single rounds, bit for bit, through normal
+    traffic, a member failure and a mid-superstep election (the elect
+    schedule fires at an INNER step, so candidate selection, the
+    term-opening noop and the same-round follower clamp all run inside
+    the scan)."""
+    a = _mk(machine_name)
+    b = _mk(machine_name)
+    rng = np.random.default_rng(100 + k)
+    for rnd in range(3):
+        n_new = rng.integers(0, KC + 1, (k, N)).astype(np.int32)
+        pay = _payloads(machine_name, rng, k)
+        elect = np.zeros((k, N), bool)
+        if rnd == 1:
+            # fail lane 2's leader, then request the election at a
+            # mid-superstep inner index
+            leader = int(np.asarray(a.state.leader_slot)[2])
+            a.fail_member(2, leader)
+            b.fail_member(2, leader)
+            elect[min(1, k - 1), 2] = True
+        for j in range(k):
+            a.step(n_new[j], pay[j], elect_mask=elect[j])
+        b.superstep(n_new, pay, elect_blk=elect)
+        _assert_state_equal(a, b, f"{machine_name} k={k} round={rnd}")
+
+
+def test_superstep_aux_watermarks_are_per_inner_step():
+    """The stacked aux carries the cumulative committed and applied
+    watermarks after EACH inner step — monotone, ending exactly at the
+    engine's final state (what the dispatch-ahead driver and the bench
+    latency stamping read)."""
+    eng = _mk("counter")
+    rng = np.random.default_rng(0)
+    eng.superstep(np.full((4, N), 2, np.int32),
+                  _payloads("counter", rng, 4))
+    aux = eng.uniform_superstep(4, 2)
+    com = np.asarray(aux["committed_lanes"]).astype(np.int64)
+    app = np.asarray(aux["applied_lanes"]).astype(np.int64)
+    assert com.shape == (4, N) and app.shape == (4, N)
+    assert (np.diff(com, axis=0) >= 0).all()
+    assert (np.diff(app, axis=0) >= 0).all()
+    np.testing.assert_array_equal(
+        com[-1], np.asarray(eng.state.total_committed))
+
+
+def test_superstep_ring_backpressure_parity():
+    """Bursts beyond ring headroom inside the fused loop clip exactly
+    like the single-step path (n_acc per inner step)."""
+    a = _mk("counter", ring_capacity=16, max_step_cmds=8,
+            apply_window=4)
+    b = _mk("counter", ring_capacity=16, max_step_cmds=8,
+            apply_window=4)
+    rng = np.random.default_rng(7)
+    for _ in range(4):
+        n_new = np.full((4, N), 8, np.int32)
+        pay = rng.integers(1, 5, (4, N, 8, 1)).astype(np.int32)
+        for j in range(4):
+            a.step(n_new[j], pay[j])
+        b.superstep(n_new, pay)
+        _assert_state_equal(a, b, "backpressure")
+
+
+def test_dispatch_ahead_driver_matches_plain_supersteps():
+    """The staging driver is a pure pipelining layer: the final engine
+    state equals driving the same blocks through superstep() directly,
+    and its in-flight cap is honoured."""
+    a = _mk("counter")
+    b = _mk("counter")
+    rng = np.random.default_rng(3)
+    blocks = [(np.full((4, N), 2, np.int32), _payloads("counter", rng, 4))
+              for _ in range(6)]
+    for nb, pb in blocks:
+        a.superstep(nb, pb)
+    drv = DispatchAheadDriver(b, max_in_flight=2)
+    for nb, pb in blocks:
+        drv.submit(nb, pb)
+        assert drv.in_flight() <= 2
+    final = drv.drain()
+    _assert_state_equal(a, b, "driver")
+    np.testing.assert_array_equal(final,
+                                  np.asarray(b.state.total_committed))
+    assert b.pipeline_counters["superstep_dispatches"] == 6
+    assert b.pipeline_counters["inner_steps"] == 24
+    assert b.overview(0)["pipeline"]["dispatch_ahead"] == 2
+
+
+def test_driver_stages_blocks_under_mesh_shardings():
+    """A sharded engine + a driver built with
+    superstep_block_shardings: staged n_new/payloads land lane-sharded
+    over the mesh (no resharding copy at dispatch) and the fused run
+    stays parity-exact with an unsharded engine.  conftest forces 8
+    host devices, so the mesh is real."""
+    import jax
+    from ra_tpu.parallel.mesh import (shard_engine_state,
+                                      superstep_block_shardings)
+    if len(jax.devices()) < 2:
+        pytest.skip("single-device backend")
+    a = _mk("counter")
+    b = _mk("counter")
+    mesh = shard_engine_state(b)
+    sh = superstep_block_shardings(mesh)
+    assert set(sh) == {"n_new", "payloads", "query"}  # elect is host data
+    drv = DispatchAheadDriver(b, max_in_flight=2, shardings=sh)
+    rng = np.random.default_rng(23)
+    blocks = [(np.full((4, N), 2, np.int32),
+               _payloads("counter", rng, 4)) for _ in range(4)]
+    for nb, pb in blocks:
+        a.superstep(nb, pb)
+        drv.submit(nb, pb)
+    assert drv._staged is not None
+    for arr, key in ((drv._staged[0], "n_new"),
+                     (drv._staged[1], "payloads")):
+        assert arr.sharding.is_equivalent_to(sh[key], arr.ndim), key
+    drv.drain()
+    _assert_state_equal(a, b, "mesh driver")
+    assert b.pipeline_counters["blocks_staged"] == 4
+
+
+def test_window_syncs_count_only_real_waits():
+    """window_syncs backs the 'window_syncs << dispatches' health rule,
+    so a readback that was already ready when harvested must NOT count:
+    on this backend the tiny dispatches complete long before the host
+    loops back, so a healthy dispatch-ahead run reports (near-)zero
+    syncs while dispatches climb."""
+    eng = _mk("counter")
+    drv = DispatchAheadDriver(eng, max_in_flight=2)
+    nb = np.full((4, N), 2, np.int32)
+    pb = np.ones((4, N, KC, 1), np.int32)
+    import time
+    for _ in range(20):
+        drv.submit(nb, pb)
+        time.sleep(0.002)  # device finishes: harvests find ready handles
+    drv.drain()
+    pc = eng.pipeline_counters
+    assert pc["superstep_dispatches"] == 20
+    assert pc["window_syncs"] <= 2, pc
+
+
+def test_superstep_donation_parity():
+    """Donating the state buffer into the fused dispatch (the superstep
+    default) changes nothing observable vs donate-off."""
+    a = _mk("counter", superstep_donate=False)
+    b = _mk("counter", superstep_donate=True)
+    rng = np.random.default_rng(11)
+    for _ in range(3):
+        nb = rng.integers(0, KC + 1, (8, N)).astype(np.int32)
+        pb = _payloads("counter", rng, 8)
+        a.superstep(nb, pb)
+        b.superstep(nb, pb)
+        _assert_state_equal(a, b, "donation")
+
+
+def test_superstep_consistent_read_still_linearizable():
+    """consistent_read interleaves with superstep driving: the
+    certified state reflects every committed fused round."""
+    eng = _mk("counter")
+    eng.uniform_superstep(4, 2)
+    eng.uniform_superstep(4, 0)  # settle the write-delay confirms
+    mac = eng.consistent_read(range(N))
+    per_lane = np.asarray(eng.state.total_committed)
+    np.testing.assert_array_equal(np.asarray(mac) >= 2 * 4, True)
+    assert (np.asarray(mac) <= per_lane * 2).all()
+
+
+def run_superstep_fuzz(seed, rounds=4):
+    """Soak entry (tools/soak.py --superstep): random K/schedules with
+    failures + elections, exact-parity checked every round."""
+    rng = np.random.default_rng(seed)
+    name = ["counter", "jit_kv", "jit_fifo"][seed % 3]
+    a = _mk(name)
+    b = _mk(name)
+    failed: set = set()
+    for rnd in range(rounds):
+        k = int(rng.choice([1, 2, 4, 8]))
+        n_new = rng.integers(0, KC + 1, (k, N)).astype(np.int32)
+        pay = _payloads(name, rng, k)
+        elect = np.zeros((k, N), bool)
+        if rng.random() < 0.5:
+            lane = int(rng.integers(0, N))
+            leader = int(np.asarray(a.state.leader_slot)[lane])
+            if (lane, leader) not in failed and \
+                    sum(1 for (ln, _s) in failed if ln == lane) < P // 2:
+                a.fail_member(lane, leader)
+                b.fail_member(lane, leader)
+                failed.add((lane, leader))
+                elect[int(rng.integers(0, k)), lane] = True
+        for j in range(k):
+            a.step(n_new[j], pay[j], elect_mask=elect[j])
+        b.superstep(n_new, pay, elect_blk=elect)
+        _assert_state_equal(a, b, f"fuzz seed={seed} round={rnd} k={k}")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 5])
+def test_superstep_fuzz_anchor_seeds(seed):
+    run_superstep_fuzz(seed)

@@ -29,6 +29,16 @@ Checks (cheap, high-signal, zero-config):
                 a forced device sync there serializes the XLA
                 pipeline; documented readback points carry an
                 `# ra02-ok: <why>` line comment
+  RA04          (bench.py/bench_classic.py/soak.py only) no host
+                syncs inside the measured region of a bench/soak
+                dispatch loop: a loop that dispatches engine work
+                (`.step(...)`/`.superstep(...)`/`.uniform_*`/a
+                driver `.submit(...)`) must not call
+                `block_until_ready`/`.item()`/`np.asarray(...)`/
+                `committed_total()` — each forces a device->host sync
+                that serializes the pipeline the measurement claims
+                to measure; window-boundary syncs carry an
+                `# ra04-ok: <why>` line comment
   RA03          (files in a `log/` directory only) no swallow-only
                 `except OSError:`/`except Exception:` (body is just
                 `pass`) around durability-bearing I/O calls (fsync/
@@ -102,7 +112,9 @@ _ONE_SHOT_SENDS = frozenset({"send", "remote_call"})
 #: overview/readback helpers) run off-thread or out of the loop; a
 #: deliberate host-side conversion inside the loop carries an
 #: `# ra02-ok: <why>` comment on its line.
-_HOT_STEP_FUNCS = frozenset({"step", "_step", "submit", "uniform_step"})
+_HOT_STEP_FUNCS = frozenset({"step", "_step", "submit", "uniform_step",
+                             "superstep", "_superstep", "submit_block",
+                             "uniform_superstep"})
 _ENGINE_HOT_FILES = frozenset({"lockstep.py", "durable.py"})
 
 
@@ -132,6 +144,57 @@ def _check_engine_hot_sync(tree: ast.Module, err) -> None:
                     f".item() in hot-loop {node.name}() forces a "
                     "device->host sync; move it to a documented "
                     "readback point or mark the line '# ra02-ok: why'")
+
+
+#: RA04 — bench/soak measured loops (files named bench.py/
+#: bench_classic.py/soak.py): a loop that dispatches engine work must
+#: never force a device->host sync between dispatches — a
+#: block_until_ready/.item()/np.asarray/committed_total there
+#: serializes the XLA pipeline and the "measured" number quietly
+#: becomes a dispatch-latency benchmark (the regression class the
+#: ISSUE 5 dispatch-ahead work removed).  Window-boundary syncs (the
+#: in-flight cap, a sample boundary, a solo-step probe) carry an
+#: `# ra04-ok: <why>` comment on their line.
+_BENCH_FILES = frozenset({"bench.py", "bench_classic.py", "soak.py"})
+_DISPATCH_ATTRS = frozenset({"step", "superstep", "uniform_step",
+                             "uniform_superstep", "submit"})
+_SYNC_ATTRS = frozenset({"block_until_ready", "committed_total", "item"})
+
+
+def _check_bench_loop_sync(tree: ast.Module, err) -> None:
+    """RA04: forbid host syncs inside bench/soak dispatch loops
+    (allowlist via `# ra04-ok:` line comment)."""
+    seen: set = set()  # dedup: nested loops walk the same call twice
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            continue
+        body = list(node.body) + list(node.orelse)
+        calls = [sub for stmt in body for sub in ast.walk(stmt)
+                 if isinstance(sub, ast.Call)
+                 and isinstance(sub.func, ast.Attribute)]
+        if not any(c.func.attr in _DISPATCH_ATTRS for c in calls):
+            continue
+        for c in calls:
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            attr = c.func.attr
+            if attr in ("item", "committed_total") and c.args:
+                continue  # item(k)/... with args is not the sync form
+            if attr in _SYNC_ATTRS:
+                err(c, "RA04",
+                    f".{attr}() inside a bench dispatch loop forces a "
+                    "device->host sync that serializes the measured "
+                    "pipeline; harvest async readbacks instead or mark "
+                    "the line '# ra04-ok: why' (window boundary)")
+            elif attr == "asarray" and \
+                    isinstance(c.func.value, ast.Name) and \
+                    c.func.value.id == "np":
+                err(c, "RA04",
+                    "np.asarray() inside a bench dispatch loop forces "
+                    "a device->host sync that serializes the measured "
+                    "pipeline; harvest async readbacks instead or mark "
+                    "the line '# ra04-ok: why' (window boundary)")
 
 
 #: RA03 — durability-bearing I/O calls: an exception from one of these
@@ -243,6 +306,15 @@ def check_file(path: str) -> list:
                 err(node, code, msg)
 
         _check_engine_hot_sync(tree, err_ra02)
+    if os.path.basename(path) in _BENCH_FILES:
+        ra04_ok = {i + 1 for i, line in enumerate(src.splitlines())
+                   if "ra04-ok" in line}
+
+        def err_ra04(node: ast.AST, code: str, msg: str) -> None:
+            if getattr(node, "lineno", 0) not in ra04_ok:
+                err(node, code, msg)
+
+        _check_bench_loop_sync(tree, err_ra04)
 
     # -- F401: unused module-level imports ------------------------------
     if os.path.basename(path) != "__init__.py":

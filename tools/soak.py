@@ -4,11 +4,17 @@ lists anchor known bug-finding seeds; this explores NEW schedules).
 
 Usage:  python tools/soak.py [seeds_per_family] [offset]
         python tools/soak.py --disk-faults SEED [n]
+        python tools/soak.py --superstep SEED [n]
 
 ``--disk-faults`` runs the storage-plane chaos family instead
 (tests/test_disk_faults.run_disk_chaos): ``n`` seeded episodes starting
 at SEED, each a random DiskFaultPlan + WAL crash over a live durable
 log with a cold-restart oracle check.
+
+``--superstep`` runs the fused-dispatch parity family
+(tests/test_superstep.run_superstep_fuzz): ``n`` seeded episodes of
+random K/elect schedules + member failures, each exact-parity checked
+against the single-step oracle every round (ISSUE 5).
 
 Prints one line per family with pass/fail counts; exits nonzero on the
 first failing seed (which should then be added to the in-suite list).
@@ -64,9 +70,33 @@ def _disk_fault_main(argv: list) -> int:
     return 1 if failed else 0
 
 
+def _superstep_main(argv: list) -> int:
+    """--superstep SEED [n]: fresh fused-dispatch parity schedules."""
+    import test_superstep as tss
+
+    seed = int(argv[0]) if argv else 0
+    n = int(argv[1]) if len(argv) > 1 else 50
+    t0 = time.time()
+    failed = []
+    for s in range(seed, seed + n):
+        try:
+            tss.run_superstep_fuzz(s)
+        except Exception:  # noqa: BLE001 — report seed + continue
+            failed.append(s)
+            if len(failed) == 1:
+                traceback.print_exc()
+    print(f"superstep: {n - len(failed)}/{n} ok in "
+          f"{time.time() - t0:.1f}s"
+          + (f"  FAILED seeds: {failed[:10]}" if failed else ""),
+          flush=True)
+    return 1 if failed else 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--disk-faults":
         return _disk_fault_main(sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1] == "--superstep":
+        return _superstep_main(sys.argv[2:])
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 400
     off = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
     families = [
