@@ -219,6 +219,17 @@ def _child_main() -> None:
                              apply_window=cmds + 2, write_delay=1,
                              quorum_impl=quorum_impl)
 
+    # device-resident telemetry plane (ISSUE 6): ON by default at the
+    # standard cadence — the headline number carries the observability
+    # cost real deployments pay (<3% bound is test-pinned), and the
+    # final Observatory snapshot lands in the JSON tail so cross-round
+    # comparisons stop hand-collecting fsync/pipeline fields
+    sampler = observatory = None
+    if os.environ.get("RA_TPU_BENCH_TELEMETRY", "1") != "0":
+        from ra_tpu.telemetry import Observatory, TelemetrySampler
+        sampler = TelemetrySampler(eng)
+        observatory = Observatory.for_engine(eng, sampler=sampler)
+
     if durable:
         # host-resident batches: the per-step H2D copy is the honest
         # ingestion path (entries arrive from the host), and the durable
@@ -420,6 +431,8 @@ def _child_main() -> None:
     p50 = lats[len(lats) // 2] if lats else -1.0
     p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] if lats else -1.0
 
+    if sampler is not None:
+        sampler.drain()  # ra04-ok: run-end barrier, after measurement
     overview = eng.overview()
     print(json.dumps({
         "value": round(value, 1),
@@ -459,7 +472,18 @@ def _child_main() -> None:
             "wal_strategy": wal_strategy,
             "wal_shards": wal_shards,
             "wal": overview["wal"]} if durable else {}),
+        # the unified snapshot (telemetry summary + sampler health +
+        # pipeline + per-shard WAL stats) — ISSUE 6's one-stop tail
+        **({"observatory": observatory.snapshot()}
+           if observatory is not None else {}),
     }))
+    sys.stdout.flush()
+    # join the WAL plane's worker/supervisor threads before interpreter
+    # teardown: a daemon thread still inside an XLA readback while the
+    # CPU client destructs aborts the whole child ("terminate called
+    # without an active exception") — rarely, but the driver runs this
+    # unattended and a dead child costs the round its measurement
+    eng.close()
 
 
 # ---------------------------------------------------------------------------

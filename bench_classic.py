@@ -191,6 +191,10 @@ def _phase_local() -> dict:
         row["members"] = 3
         row["transport"] = "in-process"
         row["durable"] = True
+        # unified Observatory snapshot of the leader's system (WAL
+        # fsync p50/p99 + queue depth, segment writer, disk faults) —
+        # the classic-plane half of ISSUE 6's one-stop JSON tail
+        row["observatory"] = systems[leader.node].observatory().snapshot()
         return row
     finally:
         for n in nodes.values():

@@ -2136,6 +2136,16 @@ class RaServer:
         return _filter_follower_effects(effects) \
             if self.raft_state != RaftState.LEADER else effects
 
+    def flush_applied_watermark(self) -> None:
+        """Persist the lazy last_applied watermark NOW — the clean-stop
+        path (the reference's dets ra_log_meta flushes on close too).
+        Recovery after a clean stop then suppresses every already-seen
+        machine effect instead of replaying the up-to-2.5s-stale
+        suffix; a crash still only costs effect-dedup precision."""
+        if self.last_applied > self._persisted_last_applied:
+            self.log.store_meta(sync=False, last_applied=self.last_applied)
+            self._persisted_last_applied = self.last_applied
+
     def _next_snapshot_token(self) -> int:
         self._snapshot_token = getattr(self, "_snapshot_token", 0) + 1
         return self._snapshot_token

@@ -659,6 +659,11 @@ class EngineDurability:
                 st["lanes"] = [sh.lo, sh.hi]
                 st["confirm_lag_steps"] = \
                     self.step_seq - sh.confirmed_step
+                # encode-queue backlog: steps dispatched but not yet
+                # picked up by this shard's encode worker — with
+                # Wal.stats' queue_depth this completes the per-shard
+                # pipeline-depth picture the Observatory/ra_top render
+                st["jobs_pending"] = len(sh._jobs)
                 shards.append(st)
         return {"engine": eng, "shards": shards,
                 "disk_faults": faults.disk_fault_counters()}

@@ -50,7 +50,7 @@ import numpy as np
 
 from .. import trace
 from ..core.machine import JitMachine
-from ..metrics import ENGINE_PIPELINE_FIELDS
+from ..metrics import ENGINE_PIPELINE_FIELDS, TELEMETRY_FIELDS
 from ..ops.exact import split16_matmul
 from ..ops.quorum import (election_quorum, evaluate_quorum, pipeline_credit,
                           query_quorum, update_match_next)
@@ -111,6 +111,39 @@ def _ring_read_window(ring: Array, idx_lane: Array, *, impl: str) -> Array:
         axis=1)
 
 
+class LaneTelemetry(NamedTuple):
+    """Device-resident per-lane telemetry accumulators (ISSUE 6): the
+    ``[lanes]``-shaped int32 pytree updated by every jitted step —
+    which of 100k lanes is stuck, churning leaders or lagging commit,
+    answerable without a host-syncing readback.  Field meanings are the
+    registry's (metrics.TELEMETRY_FIELDS, parity pinned by tests);
+    aggregation to histograms/top-K happens in :func:`_telemetry_summary`
+    at sampling cadence, NOT per step.  All fields share int32[N] so the
+    pytree donates, shards (lanes axis) and checkpoints exactly like
+    the rest of LaneState — it rides inside it."""
+
+    elections_requested: Array  # int32[N] host-requested vote rounds
+    elections_won: Array        # int32[N] rounds that seated a leader
+    leader_changes: Array       # int32[N] leader moved to another slot
+    leader_age: Array           # int32[N] steps since last leader change
+    commit_lag: Array           # int32[N] leader tail - leader commit
+    apply_lag: Array            # int32[N] leader commit - apply frontier
+    stall_steps: Array          # int32[N] consecutive no-progress rounds
+                                #          with a nonempty commit backlog
+    steps: Array                # int32[N] engine rounds observed
+
+
+assert LaneTelemetry._fields == TELEMETRY_FIELDS  # registry parity
+
+
+def _init_telemetry(n_lanes: int) -> LaneTelemetry:
+    # one zeros() PER field: sharing a single array across the fields
+    # would alias one device buffer 8 ways, and the donating superstep
+    # path rejects a donated buffer appearing twice in an Execute()
+    return LaneTelemetry(*(jnp.zeros((n_lanes,), jnp.int32)
+                           for _ in LaneTelemetry._fields))
+
+
 class LaneState(NamedTuple):
     """SoA state for N lanes × P member slots (ra_server_state() flattened —
     the per-lane scalars and per-lane×peer fields listed in SURVEY.md §7.1)."""
@@ -135,6 +168,7 @@ class LaneState(NamedTuple):
     peer_query: Array     # int32[N,P] per-member confirmed query index
                           #            (#heartbeat_reply, :3101-3170)
     query_agreed: Array   # int32[N]   majority-confirmed query index
+    telem: Any            # LaneTelemetry pytree, int32[N] per field
     mac: Any              # machine state pytree, leading dims [N,P]
 
 
@@ -161,6 +195,7 @@ def _init_state(n_lanes: int, n_members: int, ring_capacity: int,
         query_index=z(N),
         peer_query=z(N, P),
         query_agreed=z(N),
+        telem=_init_telemetry(N),
         mac=mac_state,
     )
 
@@ -472,6 +507,38 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
                         jnp.minimum(apply_to, (base + A)[:, None])),
             applied0)
 
+    # -- 5b. per-lane telemetry accumulators (device-resident, ISSUE 6) --
+    # A handful of [N] vector ops next to the step's [N,P]/[N,R,C] work:
+    # the observability plane rides the dispatch it observes, so no
+    # extra dispatch, readback or host sync is ever needed to know which
+    # lane is stuck.  Aggregation (histograms/top-K) happens at sampling
+    # cadence in _telemetry_summary, not here.
+    tel = state.telem
+    one = jnp.int32(1)
+    leader_commit_new = leader_commit0 + delta
+    lane_applied = jnp.min(jnp.where(active, applied, big), axis=-1)
+    lane_applied = jnp.where(jnp.any(active, axis=-1), lane_applied, 0)
+    lead_changed = leader_slot != state.leader_slot
+    backlog = new_leader_last > leader_commit_new
+    telem = LaneTelemetry(
+        elections_requested=tel.elections_requested +
+        jnp.where(elect_mask, one, 0),
+        elections_won=tel.elections_won + jnp.where(elect_ok, one, 0),
+        leader_changes=tel.leader_changes +
+        jnp.where(lead_changed, one, 0),
+        # reset only when the leader actually MOVED: an incumbent
+        # re-elected at a higher term is still a stable leader, and
+        # leader_age must agree with leader_changes, not elections_won
+        leader_age=jnp.where(lead_changed, 0, tel.leader_age + 1),
+        commit_lag=new_leader_last - leader_commit_new,
+        apply_lag=leader_commit_new - lane_applied,
+        # a stall is a lane that HAS a commit backlog and made no commit
+        # progress this round (a leader cut from its quorum, a wedged
+        # confirm path); idle lanes (no backlog) never count
+        stall_steps=jnp.where((delta > 0) | ~backlog, 0,
+                              tel.stall_steps + 1),
+        steps=tel.steps + 1)
+
     new_state = LaneState(term=term, leader_slot=leader_slot,
                           term_start=term_start, last_index=last_index,
                           last_written=last_written, match=match,
@@ -480,7 +547,7 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
                           ring=ring, ring_base=ring_base,
                           total_committed=total_committed,
                           query_index=query_index, peer_query=peer_query,
-                          query_agreed=query_agreed, mac=mac)
+                          query_agreed=query_agreed, telem=telem, mac=mac)
     aux = {"appended_hi": new_leader_last, "n_acc": n_acc,
            "n_app": total_app}
     if durable:
@@ -550,6 +617,72 @@ def _superstep(state: LaneState, n_new_blk: Array, payloads_blk: Array,
 
     return jax.lax.scan(body, state,
                         (n_new_blk, payloads_blk, elect_blk, query_blk))
+
+
+def _telemetry_summary(telem: LaneTelemetry, total_committed: Array, *,
+                       top_k: int, hist_buckets: int,
+                       stall_threshold: int) -> dict:
+    """Aggregate the per-lane telemetry pytree ON DEVICE into a
+    fixed-size snapshot: scalar rollups, a log2-bucket commit-lag
+    histogram, and a ``lax.top_k`` offender summary.  Output size is
+    O(top_k + hist_buckets) regardless of lane count — the readback the
+    async sampler starts is a few hundred bytes, not [lanes].  Under a
+    sharded mesh the jit lowers the reductions/top_k to cross-device
+    collectives, so one call covers every device's lane slice."""
+    f32 = jnp.float32
+    lag = telem.commit_lag
+    stalled = telem.stall_steps >= stall_threshold
+    # offender score: any stalled lane outranks any merely-laggy lane;
+    # both components clipped so the packed int32 score cannot overflow
+    score = (jnp.clip(telem.stall_steps, 0, (1 << 15) - 1) * (1 << 15)
+             + jnp.clip(lag + telem.apply_lag, 0, (1 << 15) - 1))
+    _top_score, top_idx = jax.lax.top_k(score, top_k)
+    # log2 bucketing: bucket b holds lanes with lag in [2^(b-1), 2^b)
+    # (bucket 0 = lag 0); the last bucket absorbs the tail
+    bucket = jnp.clip(
+        jnp.ceil(jnp.log2(jnp.maximum(lag, 0).astype(f32) + 1.0))
+        .astype(jnp.int32), 0, hist_buckets - 1)
+    hist = jnp.sum(
+        (bucket[:, None] == jnp.arange(hist_buckets)[None, :])
+        .astype(jnp.int32), axis=0)
+    return {
+        "steps": jnp.max(telem.steps),
+        "elections_requested": jnp.sum(
+            telem.elections_requested.astype(f32)),
+        "elections_won": jnp.sum(telem.elections_won.astype(f32)),
+        "leader_changes": jnp.sum(telem.leader_changes.astype(f32)),
+        "stalled_lanes": jnp.sum(stalled.astype(jnp.int32)),
+        "commit_lag_max": jnp.max(lag),
+        "commit_lag_mean": jnp.mean(lag.astype(f32)),
+        "apply_lag_max": jnp.max(telem.apply_lag),
+        "apply_lag_mean": jnp.mean(telem.apply_lag.astype(f32)),
+        "leader_age_min": jnp.min(telem.leader_age),
+        "commit_lag_hist": hist,
+        "top_lanes": top_idx,
+        "top_commit_lag": jnp.take(lag, top_idx),
+        "top_apply_lag": jnp.take(telem.apply_lag, top_idx),
+        "top_stall_steps": jnp.take(telem.stall_steps, top_idx),
+        # float32: the node-wide sum can exceed int32; the Observatory
+        # ring differentiates this into per-window commit rates
+        "committed_total": jnp.sum(total_committed.astype(f32)),
+    }
+
+
+#: shared jitted telemetry-summary fns, keyed by aggregation geometry
+#: (pure in (telem, total_committed) given the static config)
+_SUMMARY_JIT_CACHE: dict = {}
+
+
+def telemetry_summary_fn(top_k: int = 8, hist_buckets: int = 16,
+                         stall_threshold: int = 8):
+    key = (top_k, hist_buckets, stall_threshold)
+    fn = _SUMMARY_JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(functools.partial(
+            _telemetry_summary, top_k=top_k, hist_buckets=hist_buckets,
+            stall_threshold=stall_threshold))
+        _SUMMARY_JIT_CACHE[key] = fn
+    return fn
 
 
 #: shared jitted step fns (see _compile_step)
@@ -624,6 +757,7 @@ class LockstepEngine:
             if superstep_donate is not None else True
         self._dur = None
         self._driver = None
+        self._telemetry = None  # attached TelemetrySampler (or None)
         #: host-side dispatch-pipeline bookkeeping (ENGINE_PIPELINE_FIELDS)
         self.pipeline_counters = {f: 0 for f in ENGINE_PIPELINE_FIELDS}
         self._superstep_k_last = 0
@@ -710,6 +844,8 @@ class LockstepEngine:
                 self.state, _ = self._step(self.state, jnp.asarray(n_new),
                                            jnp.asarray(payloads), fail,
                                            elect, self._zero_confirm, query)
+            if self._telemetry is not None:
+                self._telemetry.tick(1)
             return
         with trace.span("engine.backpressure", "engine"):
             self._dur.backpressure()
@@ -727,6 +863,10 @@ class LockstepEngine:
             # dispatch reads a confirm horizon clamped at the new base
             # (elect_any is host bookkeeping — no device readback here)
             self._dur.drain_all()
+        if self._telemetry is not None:
+            # after dispatch, never blocking: the sampler only starts
+            # async device work/readbacks on this path (rule RA04)
+            self._telemetry.tick(1)
 
     def superstep(self, n_new_blk, payloads_blk, elect_blk=None,
                   query_blk=None) -> dict:
@@ -766,6 +906,8 @@ class LockstepEngine:
                     self.state, jnp.asarray(n_new_blk),
                     jnp.asarray(payloads_blk), fail, elect,
                     self._zero_confirm, query)
+            if self._telemetry is not None:
+                self._telemetry.tick(k)
             return aux
         with trace.span("engine.backpressure", "engine"):
             self._dur.backpressure()
@@ -781,6 +923,8 @@ class LockstepEngine:
             self._dur.submit_block(aux, k)
         if elect_any:
             self._dur.drain_all()
+        if self._telemetry is not None:
+            self._telemetry.tick(k)
         return aux
 
     def checkpoint(self) -> str:
@@ -980,16 +1124,37 @@ class LockstepEngine:
     def restore(self, path: str) -> None:
         """Load a .npz written by :meth:`save` into this engine.  Engine
         geometry (lanes/members/ring) must match construction — the
-        snapshot is state, not config."""
+        snapshot is state, not config.
+
+        Archives written before the telemetry plane existed (LaneState
+        without ``telem``) restore with zero-filled telemetry: the
+        accumulators are health counters, not consensus state, so an
+        upgraded node must not strand a durable dir behind a format
+        bump."""
         with np.load(path) as z:
             flat, treedef = jax.tree.flatten(self.state)
             n = len(flat)
-            loaded = [jnp.asarray(z[f"a{i}"]) for i in range(n)]
-            for want, got in zip(flat, loaded):
-                if want.shape != got.shape:
+            n_arch = sum(1 for k in z.files if k != "__meta__")
+            n_tel = len(LaneTelemetry._fields)
+            tel_at = len(jax.tree.flatten(
+                tuple(self.state[:LaneState._fields.index("telem")]))[0])
+            legacy = n_arch == n - n_tel
+            if not legacy and n_arch != n:
+                raise ValueError(
+                    f"checkpoint leaf count mismatch: archive has "
+                    f"{n_arch} arrays, engine state needs {n}")
+            loaded, j = [], 0
+            for i in range(n):
+                if legacy and tel_at <= i < tel_at + n_tel:
+                    loaded.append(jnp.zeros_like(flat[i]))
+                    continue
+                got = jnp.asarray(z[f"a{j}"])
+                j += 1
+                if flat[i].shape != got.shape:
                     raise ValueError(
                         f"checkpoint geometry mismatch: {got.shape} "
-                        f"!= {want.shape}")
+                        f"!= {flat[i].shape}")
+                loaded.append(got)
             self.state = jax.tree.unflatten(treedef, loaded)
 
     # -- readback ----------------------------------------------------------

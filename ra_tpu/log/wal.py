@@ -622,6 +622,11 @@ class Wal:
         # write count would read as extreme amortization otherwise
         d["records_per_fsync"] = round(
             d["writes"] / d["syncs"], 2) if d["syncs"] else -1.0
+        # live write-queue backlog: the group-commit pipeline depth
+        # gauge the Observatory/ra_top surface next to fsync latency —
+        # a climbing depth with flat p50 means the writer is starved,
+        # a climbing depth with climbing p99 means the disk is
+        d["queue_depth"] = self._queue.qsize()
         return d
 
     # -- files / rollover / recovery ---------------------------------------

@@ -133,6 +133,59 @@ DISK_FAULT_FIELDS = (
     "swallowed_oserrors", "fsync_retries_after_failure",
 )
 
+#: device-resident per-lane telemetry accumulators (ISSUE 6): the
+#: ``[lanes]``-shaped int32 pytree carried through the jitted step
+#: (ra_tpu/engine/lockstep.py LaneTelemetry — field parity is pinned by
+#: tests).  Counters: ``elections_requested`` host-requested election
+#: rounds, ``elections_won`` vote rounds that seated a leader,
+#: ``leader_changes`` the subset that moved the leader to a different
+#: slot (churn), ``steps`` engine rounds observed.  Gauges (rewritten
+#: every step): ``leader_age`` steps since the lane's leader last
+#: changed (stability), ``commit_lag`` leader tail minus leader commit
+#: in entries, ``apply_lag`` leader commit minus the lane apply
+#: frontier, ``stall_steps`` consecutive rounds with a nonempty commit
+#: backlog and zero commit progress — a lane is flagged STALLED when it
+#: crosses the sampler's ``stall_threshold``.
+TELEMETRY_FIELDS = (
+    "elections_requested", "elections_won", "leader_changes",
+    "leader_age", "commit_lag", "apply_lag", "stall_steps", "steps",
+)
+
+#: the on-device aggregation of TELEMETRY_FIELDS (lockstep's jitted
+#: telemetry summary): scalar rollups plus the fixed-size lag histogram
+#: and the lax.top_k offender slots.  ``stalled_lanes`` lanes at or
+#: past the stall threshold; ``commit_lag_hist`` log2-bucket counts of
+#: per-lane commit lag; ``top_lanes`` the K worst lane ids by
+#: (stall, lag) offender score with their ``top_commit_lag``/
+#: ``top_apply_lag``/``top_stall_steps`` gauges; ``committed_total``
+#: cumulative committed commands (float32 — the per-window rate
+#: substrate the Observatory ring derives throughput from).
+TELEMETRY_SUMMARY_FIELDS = (
+    "steps", "elections_requested", "elections_won", "leader_changes",
+    "stalled_lanes", "commit_lag_max", "commit_lag_mean",
+    "apply_lag_max", "apply_lag_mean", "leader_age_min",
+    "commit_lag_hist", "top_lanes", "top_commit_lag", "top_apply_lag",
+    "top_stall_steps", "committed_total",
+)
+
+#: the complete field-group registry (rule RA05): every counter-field
+#: tuple in this module MUST be listed here, covered by the registry
+#: parity test (tests/test_telemetry.py) and documented in
+#: docs/OBSERVABILITY.md — tools/lint.py statically enforces both.
+FIELD_REGISTRY = {
+    "log": LOG_FIELDS,
+    "server": SERVER_FIELDS,
+    "metric": METRIC_FIELDS,
+    "rpc": RPC_FIELDS,
+    "wal": WAL_FIELDS,
+    "engine_wal": ENGINE_WAL_FIELDS,
+    "engine_pipeline": ENGINE_PIPELINE_FIELDS,
+    "segment_writer": SEGMENT_WRITER_FIELDS,
+    "disk_faults": DISK_FAULT_FIELDS,
+    "telemetry": TELEMETRY_FIELDS,
+    "telemetry_summary": TELEMETRY_SUMMARY_FIELDS,
+}
+
 
 class Counters:
     """Named counter groups (the seshat role)."""
@@ -140,6 +193,12 @@ class Counters:
     def __init__(self) -> None:
         self._groups: dict[str, dict] = {}
         self._lock = threading.Lock()
+        #: increments addressed to an unknown group or field.  The old
+        #: behaviour silently dropped them — a typo'd field name lost
+        #: its events with no trace; now every drop is itself counted
+        #: (the seshat-style self-metric; asserted 0 under the normal
+        #: workloads in tests).
+        self.dropped = 0
 
     def new(self, name: str, fields=SERVER_FIELDS) -> dict:
         with self._lock:
@@ -151,8 +210,17 @@ class Counters:
 
     def incr(self, name: str, field: str, n: int = 1) -> None:
         g = self._groups.get(name)
-        if g is not None and field in g:
-            g[field] += n
+        if g is None or field not in g:
+            self.dropped += 1
+            return
+        g[field] += n
+
+    def self_metrics(self) -> dict:
+        """The registry's own health: ``telemetry_dropped`` counts
+        increments lost to unknown group/field names (MUST stay 0 — a
+        nonzero value means an instrumentation site and the field
+        registry disagree)."""
+        return {"telemetry_dropped": self.dropped}
 
     def fetch(self, name: str) -> Optional[dict]:
         g = self._groups.get(name)

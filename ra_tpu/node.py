@@ -241,6 +241,14 @@ class RaNode:
             shell = self.shells.pop(name, None)
         if shell is not None:
             shell.stopped = True
+            # clean stop: persist the lazy apply watermark so recovery
+            # dedups every effect the subscriber already saw (a kill
+            # keeps the crash semantics — see kill_server)
+            try:
+                shell.server.flush_applied_watermark()
+            except Exception:  # noqa: BLE001 — a closed log must not block stop
+                logger.exception("ra_tpu node %s: apply-watermark flush "
+                                 "on stop of %s failed", self.name, name)
 
     #: supervised-restart intensity: allow this many crashes within the
     #: period before giving up (the ra_server_sup transient strategy —
@@ -395,6 +403,14 @@ class RaNode:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=5)
+        # clean node shutdown: persist every live server's lazy apply
+        # watermark (the event loop is joined, nothing applies anymore)
+        for shell in list(self.shells.values()):
+            try:
+                shell.server.flush_applied_watermark()
+            except Exception:  # noqa: BLE001 — a closed log must not block stop
+                logger.exception("ra_tpu node %s: apply-watermark flush "
+                                 "on node stop failed", self.name)
         self.router.unregister(self)
 
     # -- ingress ------------------------------------------------------------
@@ -699,9 +715,11 @@ class RaNode:
                 c.incr(key, "pre_vote_elections")
             elif state_after == RaftState.CANDIDATE:
                 c.incr(key, "elections")
-            elif state_before == RaftState.RECEIVE_SNAPSHOT and \
-                    state_after == RaftState.FOLLOWER:
-                c.incr(key, "snapshot_installed")
+            # NB: no snapshot_installed increment here — that field is
+            # LOG_FIELDS, owned and counted by the log facade on actual
+            # container install; an incr against this SERVER_FIELDS
+            # group was silently dropped before telemetry_dropped
+            # existed and would now (correctly) flag the mismatch
         self._execute(shell, effects)
         # drain WAL confirms produced by this event
         for evt in server.log.take_events():

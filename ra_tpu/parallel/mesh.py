@@ -63,6 +63,16 @@ def state_shardings(mesh: Mesh, state: LaneState) -> LaneState:
         if name == "mac":
             continue
         leaf = getattr(state, name)
+        if name == "telem":
+            # the telemetry plane is a nested pytree of [N] accumulators
+            # (LaneTelemetry): each leaf shards over 'lanes' like any
+            # per-lane vector — the device holding a lane holds its
+            # telemetry, so the jitted summary's reductions/top_k lower
+            # to cross-device collectives (the per-device aggregation +
+            # cross-device merge of the sharded observability path)
+            specs[name] = jax.tree.map(
+                lambda l: by_shape(l, member_axis=False), leaf)
+            continue
         member_axis = name != "ring"
         specs[name] = by_shape(leaf, member_axis=member_axis)
     return LaneState(mac=mac_specs, **specs)

@@ -371,6 +371,16 @@ class RaSystem:
                 "segment_writer": dict(self.segment_writer.counters),
                 "disk_faults": faults.disk_fault_counters()}
 
+    def observatory(self, *, counters=None, ring_capacity: int = 256):
+        """The unified host-side observability surface for this system
+        (ra_tpu.telemetry.Observatory): one merged snapshot of WAL/
+        segment-writer/disk-fault counters + the pipeline tunables,
+        optionally a node's Counters registry; Prometheus exposition
+        and the bounded per-window time-series ring ride on it."""
+        from .telemetry import Observatory
+        return Observatory.for_system(self, counters=counters,
+                                      ring_capacity=ring_capacity)
+
     def overview(self) -> dict:
         with self._lock:
             return {

@@ -1,0 +1,532 @@
+"""Device-resident per-lane telemetry plane + the unified Observatory.
+
+The reference answers "how is my cluster doing" with ra_counters
+(seshat atomics, sampled off the event loop) and ra:key_metrics; this
+module is the lane-engine equivalent at 100k-lane scale (ISSUE 6):
+
+* :class:`TelemetrySampler` — drains the engine's ``LaneTelemetry``
+  accumulators (the ``[lanes]`` int32 pytree that rides inside
+  ``LaneState`` through every jitted step) on a step cadence.  The
+  aggregation to a fixed-size snapshot (scalar rollups, log2 commit-lag
+  histogram, ``lax.top_k`` offenders) happens ON DEVICE
+  (``lockstep._telemetry_summary``); the sampler only starts an ASYNC
+  host copy of the few-hundred-byte result and harvests it on a later
+  tick once ready.  The dispatch loop never blocks: the same readback
+  discipline as the dispatch-ahead driver (lint rule RA04 gates this
+  file's tick path, see tools/lint.py).
+* :class:`Observatory` — the host-side unification: one merged snapshot
+  of engine telemetry + dispatch-pipeline counters + WAL/disk-fault
+  stats + :class:`~ra_tpu.metrics.Counters` groups, with (a) Prometheus
+  text exposition, (b) a bounded time-series ring yielding per-window
+  rates and percentiles (the substrate a future SLO autotuner reads),
+  and (c) JSONL-ring export for ``tools/ra_top.py``.
+
+Nothing here is on the step's critical path: a sampler at the default
+cadence adds one tiny extra XLA dispatch per ``cadence_steps`` engine
+rounds and zero blocking syncs (pinned by tests/test_telemetry.py).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import re
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from . import trace
+
+logger = logging.getLogger("ra_tpu.telemetry")
+
+#: default sampling cadence in ENGINE ROUNDS (inner steps, not
+#: dispatches): one on-device aggregation + async readback per window.
+#: At 64 rounds the sampler's extra dispatch is amortized to <0.2% of
+#: dispatch count even on the single-step path.
+DEFAULT_CADENCE_STEPS = 64
+
+#: a lane is flagged STALLED once it has sat this many consecutive
+#: rounds with a commit backlog and zero commit progress
+DEFAULT_STALL_THRESHOLD = 8
+
+
+def _host_scalar(x) -> Any:
+    """Device/np scalar -> python int/float; small vectors -> lists.
+    Callers pass only READY arrays (the harvest path is is_ready-gated,
+    drain is an explicit barrier), so the conversions cannot block."""
+    arr = np.asarray(x)  # ra04-ok: callers gate on is_ready (or drain)
+    if arr.ndim == 0:
+        v = arr.item()  # ra04-ok: host np scalar, already off device
+        return round(v, 4) if isinstance(v, float) else v
+    return arr.tolist()
+
+
+class TelemetrySampler:
+    """Async drain of a :class:`LockstepEngine`'s telemetry plane.
+
+    Attach one per engine (construction attaches, like
+    ``DispatchAheadDriver``); the engine calls :meth:`tick` after every
+    dispatch.  Every ``cadence_steps`` engine rounds the sampler
+    dispatches the jitted on-device summary over the CURRENT state and
+    starts an async device->host copy; ready copies are harvested on
+    later ticks (never blocking — an unready sample simply waits, and
+    if more than ``max_pending`` samples are in flight the oldest is
+    dropped, counted in ``samples_dropped``).  ``last`` always holds
+    the newest harvested snapshot as plain host data."""
+
+    def __init__(self, engine, *, cadence_steps: int = DEFAULT_CADENCE_STEPS,
+                 top_k: int = 8, hist_buckets: int = 16,
+                 stall_threshold: int = DEFAULT_STALL_THRESHOLD,
+                 max_pending: int = 4) -> None:
+        from .engine.lockstep import telemetry_summary_fn
+        self.engine = engine
+        self.cadence_steps = max(1, int(cadence_steps))
+        self.top_k = min(int(top_k), engine.n_lanes)
+        self.hist_buckets = int(hist_buckets)
+        self.stall_threshold = int(stall_threshold)
+        self.max_pending = max(1, int(max_pending))
+        self._fn = telemetry_summary_fn(self.top_k, self.hist_buckets,
+                                        self.stall_threshold)
+        self._pending: collections.deque = collections.deque()
+        self._steps_since = 0
+        #: newest harvested snapshot (plain dict), or None
+        self.last: Optional[dict] = None
+        #: sampler health (host ints): ``samples_started`` device
+        #: aggregations dispatched, ``samples_harvested`` snapshots
+        #: landed, ``samples_dropped`` in-flight overflow evictions,
+        #: ``blocking_waits`` forced waits — stays 0 on the tick path
+        #: (only :meth:`drain` blocks; the RA04 gauge twin)
+        self.counters = {"samples_started": 0, "samples_harvested": 0,
+                         "samples_dropped": 0, "blocking_waits": 0,
+                         "observer_errors": 0}
+        self._observers: list = []
+        engine._telemetry = self
+
+    # -- dispatch-loop path (called by the engine; must never block) ------
+
+    def tick(self, k: int = 1) -> None:
+        """Advance the cadence by ``k`` engine rounds (the engine calls
+        this after each dispatch: k=1 single step, k=K superstep) and
+        harvest any READY samples.  No host sync happens here."""
+        self._steps_since += k
+        if self._steps_since >= self.cadence_steps:
+            # keep the overshoot: a superstep whose K does not divide
+            # the cadence would otherwise stretch the effective window
+            # (48-round ticks at cadence 64 -> samples every 96), and
+            # the stall-detection "within one window" bound with it
+            self._steps_since %= self.cadence_steps
+            self._start_sample()
+        self._harvest(block=False)
+
+    def _start_sample(self) -> None:
+        st = self.engine.state
+        out = self._fn(st.telem, st.total_committed)
+        for v in out.values():
+            try:
+                v.copy_to_host_async()
+            except AttributeError:  # pragma: no cover — older jax arrays
+                pass
+        self.counters["samples_started"] += 1
+        self._pending.append((time.time(),
+                              self.engine.pipeline_counters["inner_steps"],
+                              out))
+        while len(self._pending) > self.max_pending:
+            # never block on a slow readback: evict the oldest sample
+            # instead (the snapshot is a health gauge, not a ledger)
+            self._pending.popleft()
+            self.counters["samples_dropped"] += 1
+
+    def _is_ready(self, out: dict) -> bool:
+        for v in out.values():
+            try:
+                if not v.is_ready():
+                    return False
+            except AttributeError:  # pragma: no cover — older jax arrays
+                pass
+        return True
+
+    def _harvest(self, block: bool) -> None:
+        while self._pending:
+            ts, steps, out = self._pending[0]
+            if not self._is_ready(out):
+                if not block:
+                    return
+                self.counters["blocking_waits"] += 1
+            self._pending.popleft()
+            snap = {k: _host_scalar(v) for k, v in out.items()}  # ra04-ok: is_ready-gated (or an explicit drain barrier)
+            snap["ts"] = ts
+            snap["inner_steps_at_sample"] = steps
+            snap["stall_threshold"] = self.stall_threshold
+            self.last = snap
+            self.counters["samples_harvested"] += 1
+            self._feed_tracer(snap)
+            for fn in self._observers:
+                # observability must never crash the plane it observes:
+                # the harvest path rides the engine's dispatch loop, so
+                # a failing export (ENOSPC on a JSONL ring, a vanished
+                # directory) is counted and logged, never raised
+                try:
+                    fn(snap)
+                except Exception:  # noqa: BLE001 — observer fault isolation
+                    self.counters["observer_errors"] += 1
+                    logger.exception("telemetry observer failed; "
+                                     "snapshot dropped from this export")
+
+    # -- out-of-loop API ---------------------------------------------------
+
+    def add_observer(self, fn: Callable[[dict], None]) -> None:
+        """Call ``fn(snapshot)`` for every harvested sample (the
+        Observatory ring and the soak JSONL export ride this).
+
+        Observers run SYNCHRONOUSLY on the harvest path, which the
+        engine's dispatch loop drives via :meth:`tick` — keep them
+        cheap: host dict work, a tracer counter, or a single buffered
+        append (``append_jsonl_ring`` is O(1) writes by design; no
+        fsync, no readbacks).  Anything slower belongs on its own
+        thread fed from a queue, or the sampler's no-stall contract
+        quietly becomes the observer's problem."""
+        self._observers.append(fn)
+
+    def drain(self) -> Optional[dict]:
+        """Force a sample of the CURRENT state and block until it (and
+        any older in-flight samples) land.  A window-boundary/run-end
+        operation — never call from a dispatch loop."""
+        self._steps_since = 0
+        self._start_sample()
+        self._harvest(block=True)
+        return self.last
+
+    def _feed_tracer(self, snap: dict) -> None:
+        """Feed the installed Tracer a lane-health counter track so
+        Chrome traces carry telemetry alongside the spans (the lg
+        counter-track role; no tracer installed = no cost)."""
+        t = trace.get_tracer()
+        if t is None:
+            return
+        t.counter("lane_health",
+                  stalled_lanes=snap.get("stalled_lanes", 0),
+                  commit_lag_max=snap.get("commit_lag_max", 0),
+                  apply_lag_max=snap.get("apply_lag_max", 0),
+                  leader_changes=snap.get("leader_changes", 0))
+
+
+# ---------------------------------------------------------------------------
+# Observatory: the merged host-side surface
+# ---------------------------------------------------------------------------
+
+class Observatory:
+    """One merged snapshot of everything observable, plus derived
+    per-window series.
+
+    Sources are named zero-arg callables returning plain dicts of HOST
+    data (no device syncs — the engine source reads the sampler's last
+    harvested snapshot and host-side counter dicts only, so periodic
+    snapshots are safe next to a running dispatch loop).  Snapshots
+    land in a bounded ring; :meth:`window_rates` differentiates
+    monotone counters into per-second rates between the last two ring
+    entries and :meth:`percentile` reads a distribution over the ring
+    — the substrate the SLO autotuner (ROADMAP item 4) will read."""
+
+    def __init__(self, *, ring_capacity: int = 256) -> None:
+        self._sources: dict[str, Callable[[], dict]] = {}
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(2, ring_capacity))
+        self._seq = 0
+
+    # -- wiring ------------------------------------------------------------
+
+    def add_source(self, name: str, fn: Callable[[], dict]) -> "Observatory":
+        self._sources[name] = fn
+        return self
+
+    @classmethod
+    def for_engine(cls, engine, *, sampler: Optional[TelemetrySampler] = None,
+                   system=None, counters=None,
+                   ring_capacity: int = 256) -> "Observatory":
+        """The standard wiring: engine telemetry + pipeline + WAL plane,
+        optionally a RaSystem's node-wide counters and a Counters
+        registry (a node's per-server groups + the telemetry_dropped
+        self-metric)."""
+        obs = cls(ring_capacity=ring_capacity)
+        sampler = sampler or getattr(engine, "_telemetry", None)
+
+        def engine_src() -> dict:
+            out: dict = {"lanes": engine.n_lanes,
+                         "members": engine.n_members}
+            out["pipeline"] = {
+                "superstep_k": engine._superstep_k_last,
+                "dispatches_in_flight": (engine._driver.in_flight()
+                                         if engine._driver is not None
+                                         else 0),
+                **engine.pipeline_counters,
+            }
+            s = sampler or getattr(engine, "_telemetry", None)
+            if s is not None:
+                out["sampler"] = dict(s.counters)
+                if s.last is not None:
+                    out["telemetry"] = s.last
+            if engine._dur is not None:
+                out["wal"] = engine._dur.wal_overview()
+            return out
+
+        obs.add_source("engine", engine_src)
+        cls._wire_host_sources(obs, system, counters)
+        return obs
+
+    @classmethod
+    def for_system(cls, system, *, counters=None,
+                   ring_capacity: int = 256) -> "Observatory":
+        """Classic-plane wiring (no lane engine): system counters +
+        an optional node Counters registry."""
+        obs = cls(ring_capacity=ring_capacity)
+        cls._wire_host_sources(obs, system, counters)
+        return obs
+
+    @staticmethod
+    def _wire_host_sources(obs: "Observatory", system, counters) -> None:
+        """The system/counters source wiring shared by both factories —
+        one definition keeps the engine-path and classic-path snapshots
+        field-for-field comparable."""
+        if system is not None:
+            obs.add_source("system", lambda: {
+                "counters": system.counters(),
+                "engine_pipeline": {"superstep_k": system.superstep_k,
+                                    "dispatch_ahead": system.dispatch_ahead},
+            })
+        if counters is not None:
+            obs.add_source("counters", lambda: {
+                **counters.overview(), "self": counters.self_metrics()})
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Merge every source into one dict, append the numeric
+        flattening to the time-series ring, and return the snapshot.
+        A failing source contributes an ``error`` entry instead of
+        killing the export (observability must not crash the plane it
+        observes)."""
+        self._seq += 1
+        snap: dict = {"seq": self._seq, "ts": time.time()}
+        for name, fn in self._sources.items():
+            try:
+                snap[name] = fn()
+            except Exception as exc:  # noqa: BLE001 — degrade, don't die
+                snap[name] = {"error": repr(exc)[:200]}
+        self._ring.append((snap["ts"], _flatten_numeric(snap)))
+        return snap
+
+    def ring(self) -> list:
+        """The (ts, flat-numeric-dict) time series, oldest first."""
+        return list(self._ring)
+
+    def window_rates(self) -> dict:
+        """Per-second deltas of every numeric key between the last two
+        snapshots.  Monotone counters (committed_total, dispatches,
+        wal writes...) read as true rates; gauges read as drift —
+        callers pick their keys from the field registry
+        (docs/OBSERVABILITY.md).
+
+        ``engine_telemetry_*`` keys rate over the SAMPLER's own sample
+        window (the embedded sample's ``ts``): snapshots taken faster
+        than the harvest cadence re-embed the same sample, and the
+        snapshot-ts delta would read a running engine as 0 cmds/s.
+        With no fresh sample between the two snapshots those keys are
+        omitted entirely — absent beats misleadingly zero."""
+        if len(self._ring) < 2:
+            return {}
+        (t0, a), (t1, b) = self._ring[-2], self._ring[-1]
+        dt = max(t1 - t0, 1e-9)
+        ts_key = "engine_telemetry_ts"
+        tdt = (b[ts_key] - a[ts_key]
+               if ts_key in a and ts_key in b else 0.0)
+        out: dict = {}
+        for k in b:
+            if k not in a:
+                continue
+            if k.startswith("engine_telemetry_"):
+                if tdt > 1e-9 and k != ts_key:
+                    out[k] = round((b[k] - a[k]) / tdt, 4)
+                continue
+            out[k] = round((b[k] - a[k]) / dt, 4)
+        return out
+
+    def series(self, key: str) -> list:
+        return [v[key] for _t, v in self._ring if key in v]
+
+    def percentile(self, key: str, q: float) -> Optional[float]:
+        """q in [0,1] percentile of ``key`` over the ring window."""
+        s = sorted(self.series(key))
+        if not s:
+            return None
+        return s[min(len(s) - 1, int(len(s) * q))]
+
+    # -- exports -----------------------------------------------------------
+
+    def prometheus(self, snap: Optional[dict] = None) -> str:
+        """Prometheus text exposition of a snapshot (fresh one by
+        default): scalars flatten to ``ra_tpu_<path>``, the commit-lag
+        histogram becomes a cumulative ``_bucket{le=...}`` family, and
+        the top-K offender arrays become lane-labelled gauges.
+        Round-trip pinned by tests/test_telemetry.py via
+        :func:`parse_prometheus`."""
+        snap = snap if snap is not None else self.snapshot()
+        lines = ["# ra-tpu Observatory exposition",
+                 f"# seq {snap.get('seq', 0)}"]
+        flat = _flatten_numeric(snap)
+        for key in sorted(flat):
+            lines.append(f"ra_tpu_{key} {_fmt_num(flat[key])}")
+        tel = snap.get("engine", {}).get("telemetry")
+        if tel:
+            hist = tel.get("commit_lag_hist")
+            if hist:
+                # log2 buckets: bucket 0 = lag 0, bucket b = lag <
+                # 2^b; cumulative counts per the exposition format
+                cum = 0
+                for b, count in enumerate(hist):
+                    cum += count
+                    le = "0" if b == 0 else (
+                        "+Inf" if b == len(hist) - 1 else str(2 ** b - 1))
+                    lines.append(
+                        'ra_tpu_engine_commit_lag_bucket{le="%s"} %d'
+                        % (le, cum))
+                lines.append(f"ra_tpu_engine_commit_lag_count {cum}")
+            lanes = tel.get("top_lanes") or []
+            for rank, lane in enumerate(lanes):
+                for field in ("top_commit_lag", "top_apply_lag",
+                              "top_stall_steps"):
+                    vals = tel.get(field) or []
+                    if rank < len(vals):
+                        lines.append(
+                            'ra_tpu_engine_%s{lane="%d",rank="%d"} %s'
+                            % (field, lane, rank, _fmt_num(vals[rank])))
+        return "\n".join(lines) + "\n"
+
+    def to_jsonl(self, path: str, *, max_lines: int = 512) -> dict:
+        """Append a fresh snapshot to a bounded JSONL ring at ``path``
+        (compacted back to ``max_lines`` once it doubles) — what
+        ``tools/soak.py --obs`` writes and ``tools/ra_top.py`` follows."""
+        snap = self.snapshot()
+        append_jsonl_ring(path, snap, max_lines=max_lines)
+        return snap
+
+
+# ---------------------------------------------------------------------------
+# helpers: flattening, exposition formatting, parsing, JSONL ring
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _flatten_numeric(obj: Any, prefix: str = "") -> dict:
+    """Nested dicts -> {'a_b_c': float} for scalar numeric leaves.
+    Lists of dicts flatten with their index (``wal_shards_0_...`` —
+    the per-shard fsync stats must reach the exposition and the ring);
+    lists of scalars and strings are skipped (histograms and top-K
+    arrays get their own labelled exposition families)."""
+    out: dict = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = _NAME_RE.sub("_", str(k))
+            out.update(_flatten_numeric(v, f"{prefix}{key}_"))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            if isinstance(v, dict):
+                out.update(_flatten_numeric(v, f"{prefix}{i}_"))
+    elif isinstance(obj, bool):
+        out[prefix[:-1]] = float(obj)
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out[prefix[:-1]] = float(obj)
+    return out
+
+
+def _fmt_num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+#: exposition line: name{labels} value — the value token is validated
+#: by float() below, which accepts every form the format allows
+#: (negative exponents like 5e-05, +Inf, NaN) without a lookalike
+#: character-class regex drifting out of sync with it
+_PROM_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse Prometheus text exposition into {(name, labels): float}.
+    Raises ValueError on any malformed non-comment line — the
+    round-trip test runs every Observatory export through this."""
+    out: dict = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        if m is None:
+            raise ValueError(f"unparsable exposition line: {raw!r}")
+        name, labels, val = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            out[(name, labels)] = float(val)
+        except ValueError:
+            raise ValueError(
+                f"unparsable exposition value: {raw!r}") from None
+    return out
+
+
+#: per-path line-count cache so the steady-state append is ONE
+#: buffered write — re-reading the whole ring per append would put
+#: O(file) disk reads on the harvest path that observers (and through
+#: them the dispatch loop) ride
+_RING_LINES: dict = {}
+
+
+def append_jsonl_ring(path: str, obj: dict, *, max_lines: int = 512) -> None:
+    """Append one JSON line; once the file exceeds ``2*max_lines``
+    lines, atomically compact it down to the newest ``max_lines`` (a
+    bounded ring that tail-followers can read mid-compaction).  The
+    line count is tracked in memory per path: the common call is one
+    buffered append (no fsync, no re-read); the file is only read back
+    at first touch of an existing ring and at compaction."""
+    line = json.dumps(obj, separators=(",", ":"))
+    count = _RING_LINES.get(path)
+    if count is None:
+        try:
+            with open(path) as f:
+                count = sum(1 for _ in f)
+        except OSError:
+            count = 0
+    with open(path, "a") as f:
+        f.write(line + "\n")
+    count += 1
+    if count > 2 * max_lines:
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            _RING_LINES[path] = count
+            return
+        tmp = path + ".compact"
+        with open(tmp, "w") as f:
+            f.writelines(lines[-max_lines:])
+        os.replace(tmp, path)
+        count = min(len(lines), max_lines)
+    _RING_LINES[path] = count
+
+
+def read_jsonl_tail(path: str, n: int = 1) -> list:
+    """Newest ``n`` parsable snapshots from a JSONL ring (oldest first
+    within the result); tolerant of a torn last line mid-append."""
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return []
+    out = []
+    for raw in lines[-(n + 1):]:
+        try:
+            out.append(json.loads(raw))
+        except ValueError:
+            continue
+    return out[-n:]

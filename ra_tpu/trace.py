@@ -55,6 +55,7 @@ class Tracer:
         self.capacity = capacity
         self._events: list = []
         self._head = 0          # ring cursor once the buffer is full
+        self._dropped = 0       # events overwritten after the ring wrapped
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
@@ -70,6 +71,7 @@ class Tracer:
             else:
                 self._events[self._head] = evt
                 self._head = (self._head + 1) % self.capacity
+                self._dropped += 1
 
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "ra", **args: Any) -> Iterator[None]:
@@ -102,6 +104,16 @@ class Tracer:
                 return list(self._events)
             return (self._events[self._head:] + self._events[:self._head])
 
+    @property
+    def wrapped(self) -> bool:
+        """True once the ring has overwritten at least one event —
+        the buffer no longer holds the full history."""
+        return self._dropped > 0
+
+    @property
+    def dropped_events(self) -> int:
+        return self._dropped
+
     def dump_chrome_trace(self, path: str) -> str:
         """Write the buffer as Chrome trace-event JSON (atomic replace);
         load in chrome://tracing or ui.perfetto.dev."""
@@ -117,7 +129,10 @@ class Tracer:
 
     def summary(self) -> dict:
         """Per-span-name {count, total_us, max_us} rollup — the quick
-        console profile when a full timeline is overkill."""
+        console profile when a full timeline is overkill.  The ``_meta``
+        entry reports whether the ring wrapped (``wrapped: True`` +
+        ``dropped_events``): a truncated trace's counts cover only the
+        newest ``capacity`` events and must not be read as totals."""
         out: dict[str, dict] = {}
         for e in self.events():
             if e.get("ph") != "X":
@@ -127,6 +142,8 @@ class Tracer:
             s["count"] += 1
             s["total_us"] += e["dur"]
             s["max_us"] = max(s["max_us"], e["dur"])
+        out["_meta"] = {"wrapped": self.wrapped,
+                        "dropped_events": self._dropped}
         return out
 
 

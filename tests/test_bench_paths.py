@@ -191,3 +191,39 @@ def test_classic_bench_contract():
         assert row["value"] > 0, (phase, row)
         assert row["durable"] is True
         assert row["p50_applied_latency_ms"] > 0
+    # ISSUE 6 satellite: the local phase stamps the leader system's
+    # Observatory snapshot (WAL fsync p50/p99 + queue depth)
+    wal = detail["local"]["observatory"]["system"]["counters"]["wal"]
+    assert "fsync_p50_ms" in wal and "queue_depth" in wal
+
+
+def test_bench_tail_carries_observatory_snapshot():
+    """ISSUE 6 satellite: the throughput tail stamps the final
+    Observatory snapshot — telemetry summary, sampler health, and the
+    per-shard WAL fsync p50/p99 + queue depths — so cross-round
+    comparisons stop hand-collecting fields."""
+    doc = run_child({"RA_TPU_BENCH_DURABLE": "1",
+                     "RA_TPU_BENCH_WAL_SHARDS": "2"})
+    eng = doc["observatory"]["engine"]
+    tel = eng["telemetry"]
+    assert tel["steps"] > 0
+    assert tel["committed_total"] > 0
+    assert tel["stall_threshold"] > 0
+    assert eng["sampler"]["samples_harvested"] >= 1
+    assert eng["sampler"]["samples_started"] >= 1
+    shards = eng["wal"]["shards"]
+    assert len(shards) == 2
+    for sh in shards:
+        assert "fsync_p50_ms" in sh and "fsync_p99_ms" in sh
+        assert "queue_depth" in sh and "jobs_pending" in sh
+    # pipeline counters ride in the snapshot too (the SLO-autotuner
+    # substrate: rate fields next to the knobs that move them)
+    assert eng["pipeline"]["dispatches"] > 0
+
+
+def test_bench_telemetry_opt_out():
+    """RA_TPU_BENCH_TELEMETRY=0 runs the legacy tail (no sampler, no
+    observatory key) — the A side of the overhead comparison."""
+    doc = run_child({"RA_TPU_BENCH_TELEMETRY": "0"})
+    assert doc["value"] > 0
+    assert "observatory" not in doc

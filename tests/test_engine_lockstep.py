@@ -232,6 +232,54 @@ def test_engine_save_restore_roundtrip(tmp_path):
         bad.restore(path)
 
 
+def test_engine_restore_pre_telemetry_checkpoint(tmp_path):
+    """An archive written before LaneState grew the telem pytree (the
+    PR5-era index-flattened format) restores with zero-filled
+    telemetry: a durable dir must never be stranded behind a health-
+    counter format bump."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ra_tpu.engine import LockstepEngine
+    from ra_tpu.engine.lockstep import LaneState, LaneTelemetry
+    from ra_tpu.models import CounterMachine
+
+    N, K = 8, 4
+    eng = LockstepEngine(CounterMachine(), N, 3, ring_capacity=64,
+                         max_step_cmds=K, donate=False)
+    n_new = jnp.full((N,), K, jnp.int32)
+    pay = jnp.ones((N, K, 1), jnp.int32)
+    for _ in range(5):
+        eng.step(n_new, pay)
+    eng.block_until_ready()
+    path = str(tmp_path / "lanes.npz")
+    eng.save(path)
+
+    # rewrite the archive exactly as the pre-telemetry save wrote it:
+    # drop the telem leaves and close the a{i} index gap
+    n_tel = len(LaneTelemetry._fields)
+    tel_at = len(jax.tree.flatten(
+        tuple(eng.state[:LaneState._fields.index("telem")]))[0])
+    with np.load(path) as z:
+        meta = z["__meta__"]
+        n_arch = sum(1 for k in z.files if k != "__meta__")
+        arrays = [z[f"a{i}"] for i in range(n_arch)]
+    legacy = arrays[:tel_at] + arrays[tel_at + n_tel:]
+    np.savez(path, __meta__=meta,
+             **{f"a{i}": a for i, a in enumerate(legacy)})
+
+    eng2 = LockstepEngine(CounterMachine(), N, 3, ring_capacity=64,
+                          max_step_cmds=K, donate=False)
+    eng2.restore(path)
+    assert eng2.committed_total() == eng.committed_total()
+    assert (np.asarray(eng2.state.mac) == np.asarray(eng.state.mac)).all()
+    # telemetry restarts from zero and keeps accumulating
+    assert int(np.asarray(eng2.state.telem.steps).sum()) == 0
+    eng2.step(n_new, pay)
+    eng2.block_until_ready()
+    assert int(np.asarray(eng2.state.telem.steps).sum()) == N
+
+
 def test_committed_lanes_async_readback():
     """Non-blocking readback path used by the bench frontier: the async
     copy must survive buffer donation by subsequent steps and match the

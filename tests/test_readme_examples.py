@@ -19,8 +19,8 @@ def _blocks():
         text = f.read()
     return re.findall(r"```python\n(.*?)```", text, re.DOTALL)
 
-def test_readme_has_three_python_blocks():
-    assert len(_blocks()) == 3
+def test_readme_has_four_python_blocks():
+    assert len(_blocks()) == 4
 
 def test_classic_quickstart_block(tmp_path):
     src = _blocks()[0]
@@ -65,3 +65,24 @@ def test_trace_quickstart_block():
         assert isinstance(ns["t"].summary(), dict)
     finally:
         trace.set_tracer(None)
+
+
+def test_telemetry_quickstart_block(tmp_path):
+    src = _blocks()[3]
+    assert "TelemetrySampler" in src and "Observatory" in src
+    ring = str(tmp_path / "obs.jsonl")
+    src = _patch(src, '"obs.jsonl"', "ring")
+    from ra_tpu.engine import LockstepEngine
+    from ra_tpu.models import CounterMachine
+
+    eng = LockstepEngine(CounterMachine(), 8, 3, ring_capacity=64,
+                         max_step_cmds=4, donate=False)
+    ns: dict = {"engine": eng, "ring": ring}
+    exec(compile(src, "README.md[telemetry]", "exec"), ns)  # noqa: S102
+    for _ in range(4):
+        eng.uniform_step(2)
+    ns["sampler"].drain()
+    snap = ns["obs"].snapshot()
+    assert snap["engine"]["telemetry"]["steps"] == 4
+    import os
+    assert os.path.exists(ring)

@@ -264,3 +264,77 @@ def test_checker_false_positive_guards(tmp_path):
     """))
     r = run_lint(str(ok))
     assert r.returncode == 0, r.stdout
+
+
+def test_checker_enforces_field_registry(tmp_path):
+    """RA05: a counter-field tuple missing from FIELD_REGISTRY, or with
+    fields undocumented in docs/OBSERVABILITY.md, is flagged at the
+    definition site.  Applies to files named metrics.py only."""
+    bad = tmp_path / "metrics.py"
+    bad.write_text(textwrap.dedent("""\
+        WAL_FIELDS = ("syncs", "batches")
+
+        ORPHAN_FIELDS = ("zz_not_documented_anywhere",)
+
+        FIELD_REGISTRY = {"wal": WAL_FIELDS}
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA05") == 2, r.stdout
+    assert "ORPHAN_FIELDS is not listed" in r.stdout
+    assert "zz_not_documented_anywhere" in r.stdout
+    # WAL_FIELDS is registered and its fields are documented: clean
+    assert "WAL_FIELDS" not in r.stdout
+    # the same content under another module name is not gated
+    other = tmp_path / "helpers.py"
+    other.write_text(bad.read_text())
+    r = run_lint(str(other))
+    assert "RA05" not in r.stdout
+
+
+def test_metrics_module_is_ra05_clean():
+    """The real registry passes the parity gate: every *_FIELDS tuple
+    is in FIELD_REGISTRY and documented in docs/OBSERVABILITY.md."""
+    r = run_lint(os.path.join(REPO, "ra_tpu", "metrics.py"))
+    assert "RA05" not in r.stdout, r.stdout
+
+
+def test_checker_gates_telemetry_sampler_path(tmp_path):
+    """RA04 (sampler extension): blocking syncs inside the telemetry
+    sampler's tick-path functions (tick/_start_sample/_harvest) are
+    flagged — the sampler rides the dispatch loop, so its tick path
+    obeys the same no-host-sync contract as the bench loops.  Applies
+    to files named telemetry.py only."""
+    bad = tmp_path / "telemetry.py"
+    bad.write_text(textwrap.dedent("""\
+        import numpy as np
+
+        class S:
+            def tick(self):
+                self.engine.block_until_ready()
+                v = self.handle.item()
+                return v
+
+            def _harvest(self):
+                host = np.asarray(self.handle)  # ra04-ok: ready-gated
+                return host
+
+            def drain(self):
+                return np.asarray(self.handle)  # not a tick-path fn
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA04") == 2, r.stdout
+    assert ".block_until_ready()" in r.stdout and ".item()" in r.stdout
+    assert "drain" not in r.stdout
+    # the same content under another module name is not gated
+    other = tmp_path / "other.py"
+    other.write_text(bad.read_text())
+    r = run_lint(str(other))
+    assert "RA04" not in r.stdout
+
+
+def test_telemetry_module_is_ra04_clean():
+    """The real sampler tick path passes the no-host-sync gate."""
+    r = run_lint(os.path.join(REPO, "ra_tpu", "telemetry.py"))
+    assert "RA04" not in r.stdout, r.stdout

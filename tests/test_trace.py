@@ -122,3 +122,42 @@ def test_wal_batch_instrumented(tmp_path):
         trace.set_tracer(None)
     s = t.summary()
     assert s.get("wal.batch", {}).get("count", 0) >= 1
+
+
+def test_ring_wrap_preserves_order_and_reports_drops():
+    """Satellite (ISSUE 6): after the ring wraps, events() stays in
+    oldest->newest order across the wrap seam and the tracer reports
+    how many events were overwritten — a truncated trace must not be
+    mistaken for a complete one."""
+    t = Tracer(capacity=8)
+    assert not t.wrapped and t.dropped_events == 0
+    for i in range(20):
+        t.instant(f"e{i}")
+    evts = t.events()
+    assert [e["name"] for e in evts] == [f"e{i}" for i in range(12, 20)]
+    ts = [e["ts"] for e in evts]
+    assert ts == sorted(ts)  # monotone across the seam
+    assert t.wrapped and t.dropped_events == 12
+    # keep recording after the wrap: the ring keeps sliding
+    t.instant("late")
+    assert t.events()[-1]["name"] == "late"
+    assert t.dropped_events == 13
+
+
+def test_summary_carries_wrapped_indicator():
+    t = Tracer(capacity=4)
+    for i in range(3):
+        with t.span("a"):
+            pass
+    s = t.summary()
+    assert s["_meta"] == {"wrapped": False, "dropped_events": 0}
+    assert s["a"]["count"] == 3
+    for _ in range(6):
+        with t.span("b"):
+            pass
+    s = t.summary()
+    assert s["_meta"]["wrapped"] is True
+    assert s["_meta"]["dropped_events"] == 5
+    # post-wrap counts cover only the surviving window — the indicator
+    # is what stops them being read as totals
+    assert s["b"]["count"] == 4 and "a" not in s

@@ -38,7 +38,19 @@ Checks (cheap, high-signal, zero-config):
                 `committed_total()` — each forces a device->host sync
                 that serializes the pipeline the measurement claims
                 to measure; window-boundary syncs carry an
-                `# ra04-ok: <why>` line comment
+                `# ra04-ok: <why>` line comment.  ALSO gates the
+                telemetry sampler path (telemetry.py tick/
+                _start_sample/_harvest): the sampler rides the
+                dispatch loop, so its tick path obeys the same
+                no-blocking-sync contract
+  RA05          (metrics.py only) every module-level counter-field
+                tuple (`*_FIELDS`) must be listed in FIELD_REGISTRY
+                (the registry parity test iterates it) and every field
+                name documented in docs/OBSERVABILITY.md — a field the
+                registry or the doc does not know is a metric nobody
+                can interpret (the drop-silently bug class ISSUE 6's
+                telemetry_dropped self-metric removed, applied to the
+                registry itself)
   RA03          (files in a `log/` directory only) no swallow-only
                 `except OSError:`/`except Exception:` (body is just
                 `pass`) around durability-bearing I/O calls (fsync/
@@ -197,6 +209,106 @@ def _check_bench_loop_sync(tree: ast.Module, err) -> None:
                     "the line '# ra04-ok: why' (window boundary)")
 
 
+#: RA04 (sampler extension) — the telemetry sampler's dispatch-loop
+#: path (telemetry.py): ``tick`` is called by the engine after every
+#: dispatch, so it and the helpers it drives must start async work
+#: only — a block_until_ready/.item()/np.asarray there would hand the
+#: "zero new host syncs" guarantee back.  Out-of-loop conversions
+#: (a ready-gated harvest, the explicit ``drain`` barrier) carry an
+#: `# ra04-ok: <why>` line comment.
+_TELEMETRY_FILES = frozenset({"telemetry.py"})
+_SAMPLER_HOT_FUNCS = frozenset({"tick", "_start_sample", "_harvest"})
+
+
+def _sampler_hot_closure(tree: ast.Module) -> dict:
+    """Module functions reachable from the tick-path entry points via
+    same-module calls (``name(...)`` or ``self.name(...)``) — a host
+    sync moved into a helper must not escape the gate."""
+    funcs: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+    hot: dict = {}
+    queue = [n for n in _SAMPLER_HOT_FUNCS if n in funcs]
+    while queue:
+        name = queue.pop()
+        if name in hot:
+            continue
+        hot[name] = funcs[name]
+        for sub in ast.walk(funcs[name]):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            callee = None
+            if isinstance(fn, ast.Name):
+                callee = fn.id
+            elif isinstance(fn, ast.Attribute) and \
+                    isinstance(fn.value, ast.Name) and fn.value.id == "self":
+                callee = fn.attr
+            if callee in funcs:
+                queue.append(callee)
+    return hot
+
+
+def _check_sampler_sync(tree: ast.Module, err) -> None:
+    """RA04 on the telemetry sampler path: forbid host syncs in the
+    tick-path functions AND every same-module helper they reach
+    (allowlist via `# ra04-ok:` line comment)."""
+    for node in _sampler_hot_closure(tree).values():
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr in _SYNC_ATTRS and not sub.args:
+                err(sub, "RA04",
+                    f".{fn.attr}() in sampler tick-path {node.name}() "
+                    "blocks the dispatch loop the sampler rides; gate "
+                    "on is_ready() or mark the line '# ra04-ok: why'")
+            elif fn.attr == "asarray" and \
+                    isinstance(fn.value, ast.Name) and fn.value.id == "np":
+                err(sub, "RA04",
+                    f"np.asarray() in sampler tick-path {node.name}() "
+                    "blocks the dispatch loop the sampler rides; gate "
+                    "on is_ready() or mark the line '# ra04-ok: why'")
+
+
+#: RA05 — the field-group registry contract (metrics.py): a counter
+#: field that FIELD_REGISTRY does not list escapes the registry parity
+#: test, and one docs/OBSERVABILITY.md does not name is a number nobody
+#: can interpret — both are flagged at the definition site.
+def _check_field_registry(tree: ast.Module, err, doc_text) -> None:
+    groups: dict = {}
+    registry_names: set = set()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if name.endswith("_FIELDS") and isinstance(node.value, ast.Tuple):
+            fields = [e.value for e in node.value.elts
+                      if isinstance(e, ast.Constant)
+                      and isinstance(e.value, str)]
+            groups[name] = (node, fields)
+        elif name == "FIELD_REGISTRY" and isinstance(node.value, ast.Dict):
+            for v in node.value.values:
+                if isinstance(v, ast.Name):
+                    registry_names.add(v.id)
+    for name, (node, fields) in groups.items():
+        if name not in registry_names:
+            err(node, "RA05",
+                f"counter-field tuple {name} is not listed in "
+                "FIELD_REGISTRY; the registry parity test cannot "
+                "cover it")
+        if doc_text is not None:
+            missing = [f for f in fields if f"`{f}`" not in doc_text]
+            if missing:
+                err(node, "RA05",
+                    f"{name} fields undocumented in "
+                    f"docs/OBSERVABILITY.md: {missing[:6]}")
+
+
 #: RA03 — durability-bearing I/O calls: an exception from one of these
 #: inside the log layer carries a durability verdict and must never be
 #: swallowed bare (fsyncgate: a confirmed write whose fsync error was
@@ -306,7 +418,7 @@ def check_file(path: str) -> list:
                 err(node, code, msg)
 
         _check_engine_hot_sync(tree, err_ra02)
-    if os.path.basename(path) in _BENCH_FILES:
+    if os.path.basename(path) in (_BENCH_FILES | _TELEMETRY_FILES):
         ra04_ok = {i + 1 for i, line in enumerate(src.splitlines())
                    if "ra04-ok" in line}
 
@@ -314,7 +426,23 @@ def check_file(path: str) -> list:
             if getattr(node, "lineno", 0) not in ra04_ok:
                 err(node, code, msg)
 
-        _check_bench_loop_sync(tree, err_ra04)
+        if os.path.basename(path) in _BENCH_FILES:
+            _check_bench_loop_sync(tree, err_ra04)
+        else:
+            _check_sampler_sync(tree, err_ra04)
+    if os.path.basename(path) == "metrics.py":
+        # the documented-field half of RA05 reads the observability
+        # registry doc: prefer one next to the checked file (self-
+        # contained fixtures), else the repo's
+        doc = os.path.join(os.path.dirname(path), "docs",
+                           "OBSERVABILITY.md")
+        if not os.path.exists(doc):
+            doc = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+        doc_text = None
+        if os.path.exists(doc):
+            with open(doc, encoding="utf-8") as fdoc:
+                doc_text = fdoc.read()
+        _check_field_registry(tree, err, doc_text)
 
     # -- F401: unused module-level imports ------------------------------
     if os.path.basename(path) != "__init__.py":

@@ -1,0 +1,194 @@
+"""ra_top — live terminal view of the Observatory (ISSUE 6).
+
+Follows a JSONL snapshot ring (what ``tools/soak.py --obs`` or
+``Observatory.to_jsonl`` writes) and renders the lane-health heat
+summary, the top-K offender lanes, per-shard WAL fsync latency + queue
+depth, and the dispatch-pipeline counters.  stdlib-only, works over
+ssh; the htop role of the reference's `ra:key_metrics` console habit.
+
+Usage:
+    python tools/ra_top.py [path] [--interval S] [--once]
+
+``path`` defaults to ``obs.jsonl`` in the cwd.  ``--once`` prints a
+single frame without clearing the screen (what the tests drive; also
+handy for cron/log capture).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+#: log2 histogram sparkline glyphs, low->high occupancy
+_BARS = " .:-=+*#%@"
+
+
+def _read_tail(path: str, n: int = 2) -> list:
+    """Newest n parsable snapshots (oldest first); torn-tail tolerant."""
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return []
+    out = []
+    for raw in lines[-(n + 1):]:
+        try:
+            out.append(json.loads(raw))
+        except ValueError:
+            continue
+    return out[-n:]
+
+
+def _spark(hist: list) -> str:
+    top = max(hist) if hist else 0
+    if top <= 0:
+        return _BARS[0] * len(hist)
+    return "".join(
+        _BARS[min(len(_BARS) - 1, int(v / top * (len(_BARS) - 1) + 0.999))]
+        for v in hist)
+
+
+def _fmt_rate(v: float) -> str:
+    for div, suf in ((1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{suf}"
+    return f"{v:.1f}"
+
+
+def render(snap: dict, prev: dict | None = None) -> str:
+    """One frame of the dashboard as plain text."""
+    lines: list = []
+    eng = snap.get("engine", {})
+    tel = eng.get("telemetry") or {}
+    pipe = eng.get("pipeline", {})
+    sampler = eng.get("sampler", {})
+    ts = snap.get("ts", 0.0)
+    lines.append(
+        f"ra_top  seq={snap.get('seq', '?')}  "
+        f"{time.strftime('%H:%M:%S', time.localtime(ts))}  "
+        f"lanes={eng.get('lanes', '?')}x{eng.get('members', '?')}")
+    # -- commit rate over the last window ---------------------------------
+    if prev is not None:
+        p_tel = prev.get("engine", {}).get("telemetry") or {}
+        dt = max(ts - prev.get("ts", ts), 1e-9)
+        # commit rate over the SAMPLER's own window: a JSONL export
+        # faster than the harvest cadence re-embeds the same sample,
+        # and the snapshot-ts delta would then read a running engine
+        # as 0 cmds/s; "--" = no fresh sample between these snapshots
+        dt_tel = (tel.get("ts", 0.0) - p_tel.get("ts", 0.0)
+                  if tel.get("ts") and p_tel.get("ts") else 0.0)
+        dc = (tel.get("committed_total", 0.0)
+              - p_tel.get("committed_total", 0.0))
+        cmds = _fmt_rate(dc / dt_tel) if dt_tel > 1e-9 else "--"
+        di = (pipe.get("inner_steps", 0)
+              - prev.get("engine", {}).get("pipeline", {})
+              .get("inner_steps", 0))
+        lines.append(f"rate    {cmds} cmds/s   "
+                     f"{_fmt_rate(di / dt)} steps/s   window {dt:.1f}s")
+    # -- lane health -------------------------------------------------------
+    if tel:
+        stalled = tel.get("stalled_lanes", 0)
+        flag = " <<< STALLED LANES" if stalled else ""
+        lines.append(
+            f"lanes   stalled={stalled}{flag}  "
+            f"commit_lag max={tel.get('commit_lag_max', 0)} "
+            f"mean={tel.get('commit_lag_mean', 0)}  "
+            f"apply_lag max={tel.get('apply_lag_max', 0)}  "
+            f"leader_age_min={tel.get('leader_age_min', 0)}")
+        hist = tel.get("commit_lag_hist")
+        if hist:
+            lines.append(f"lag     [{_spark(hist)}]  log2 buckets "
+                         f"0..2^{len(hist) - 1}  n={sum(hist)}")
+        top = tel.get("top_lanes") or []
+        if top:
+            rows = []
+            for r, lane in enumerate(top[:8]):
+                cl = (tel.get("top_commit_lag") or [0] * len(top))[r]
+                st = (tel.get("top_stall_steps") or [0] * len(top))[r]
+                if cl == 0 and st == 0:
+                    continue
+                rows.append(f"#{lane}(lag={cl},stall={st})")
+            lines.append("top     " + (" ".join(rows) if rows
+                                       else "(all lanes healthy)"))
+    elif "telemetry" not in eng:
+        lines.append("lanes   (no telemetry sampler attached)")
+    if sampler:
+        lines.append(
+            f"sampler started={sampler.get('samples_started', 0)} "
+            f"harvested={sampler.get('samples_harvested', 0)} "
+            f"dropped={sampler.get('samples_dropped', 0)} "
+            f"blocking_waits={sampler.get('blocking_waits', 0)}")
+    # -- dispatch pipeline -------------------------------------------------
+    if pipe:
+        disp = pipe.get("dispatches", 0)
+        inner = pipe.get("inner_steps", 0)
+        fusion = f"{inner / disp:.1f}x" if disp else "-"
+        lines.append(
+            f"pipe    dispatches={disp} inner_steps={inner} "
+            f"fusion={fusion} "
+            f"in_flight={pipe.get('dispatches_in_flight', 0)} "
+            f"window_syncs={pipe.get('window_syncs', 0)}")
+    # -- WAL shards --------------------------------------------------------
+    wal = eng.get("wal") or {}
+    shards = wal.get("shards") or []
+    sys_wal = snap.get("system", {}).get("counters", {}).get("wal")
+    if not shards and sys_wal:
+        shards = [sys_wal]
+    for sh in shards[:8]:
+        sid = sh.get("shard", "-")
+        lines.append(
+            f"wal[{sid}] fsync p50={sh.get('fsync_p50_ms', -1)}ms "
+            f"p99={sh.get('fsync_p99_ms', -1)}ms "
+            f"rec/fsync={sh.get('records_per_fsync', -1)} "
+            f"queue={sh.get('queue_depth', 0)} "
+            f"jobs={sh.get('jobs_pending', 0)} "
+            f"lag={sh.get('confirm_lag_steps', 0)}")
+    df = (wal.get("disk_faults")
+          or snap.get("system", {}).get("counters", {}).get("disk_faults"))
+    if df and any(df.values()):
+        hot = " ".join(f"{k}={v}" for k, v in sorted(df.items()) if v)
+        lines.append(f"faults  {hot}")
+    # -- counters self-metric ---------------------------------------------
+    dropped = snap.get("counters", {}).get("self", {}) \
+        .get("telemetry_dropped")
+    if dropped:
+        lines.append(f"WARN    telemetry_dropped={dropped} "
+                     "(instrumentation/registry mismatch)")
+    return "\n".join(lines)
+
+
+def main(argv: list) -> int:
+    once = "--once" in argv
+    interval = 1.0
+    args: list = []
+    it = iter(argv)
+    for a in it:
+        if a == "--interval":
+            # consume the interval's VALUE operand too, or it would be
+            # mistaken for the snapshot path ("ra_top --interval 2")
+            interval = float(next(it, "1.0"))
+        elif not a.startswith("--"):
+            args.append(a)
+    path = args[0] if args else "obs.jsonl"
+    if once:
+        tail = _read_tail(path, 2)
+        if not tail:
+            print(f"ra_top: no snapshots at {path}")
+            return 1
+        print(render(tail[-1], tail[-2] if len(tail) > 1 else None))
+        return 0
+    try:
+        while True:
+            tail = _read_tail(path, 2)
+            frame = render(tail[-1], tail[-2] if len(tail) > 1 else None) \
+                if tail else f"ra_top: waiting for snapshots at {path} ..."
+            # ANSI home+clear-below: repaint without scrollback spam
+            sys.stdout.write("\x1b[H\x1b[J" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

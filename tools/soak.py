@@ -5,6 +5,7 @@ lists anchor known bug-finding seeds; this explores NEW schedules).
 Usage:  python tools/soak.py [seeds_per_family] [offset]
         python tools/soak.py --disk-faults SEED [n]
         python tools/soak.py --superstep SEED [n]
+        python tools/soak.py --obs SEED [n] [jsonl_path]
 
 ``--disk-faults`` runs the storage-plane chaos family instead
 (tests/test_disk_faults.run_disk_chaos): ``n`` seeded episodes starting
@@ -15,6 +16,15 @@ log with a cold-restart oracle check.
 (tests/test_superstep.run_superstep_fuzz): ``n`` seeded episodes of
 random K/elect schedules + member failures, each exact-parity checked
 against the single-step oracle every round (ISSUE 5).
+
+``--obs`` runs the telemetry-plane chaos family
+(tests/test_telemetry.run_stall_chaos): ``n`` seeded episodes that
+break a random lane's quorum under traffic and assert the stall is
+*detected* by the device-resident telemetry (stalled-lane count +
+top-K offenders, within one sampling window), not just recovered —
+while every harvested Observatory snapshot is appended to a JSONL
+ring (default ``obs.jsonl``; follow it live with
+``python tools/ra_top.py <path>``).
 
 Prints one line per family with pass/fail counts; exits nonzero on the
 first failing seed (which should then be added to the in-suite list).
@@ -92,11 +102,41 @@ def _superstep_main(argv: list) -> int:
     return 1 if failed else 0
 
 
+def _obs_main(argv: list) -> int:
+    """--obs SEED [n] [jsonl_path]: telemetry stall-detection chaos,
+    Observatory snapshots streamed to a JSONL ring for ra_top."""
+    import test_telemetry as tt
+
+    seed = int(argv[0]) if argv else 0
+    n = int(argv[1]) if len(argv) > 1 else 10
+    path = argv[2] if len(argv) > 2 else "obs.jsonl"
+    t0 = time.time()
+    failed = []
+    detect_windows = []
+    for s in range(seed, seed + n):
+        try:
+            res = tt.run_stall_chaos(s, obs_path=path)
+            detect_windows.append(res["detected_at"] - res["stall_from"])
+        except Exception:  # noqa: BLE001 — report seed + continue
+            failed.append(s)
+            if len(failed) == 1:
+                traceback.print_exc()
+    lag = (f"  detect_lag_steps p50={sorted(detect_windows)[len(detect_windows) // 2]}"
+           if detect_windows else "")
+    print(f"obs_stalls: {n - len(failed)}/{n} ok in "
+          f"{time.time() - t0:.1f}s{lag}  ring={path}"
+          + (f"  FAILED seeds: {failed[:10]}" if failed else ""),
+          flush=True)
+    return 1 if failed else 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--disk-faults":
         return _disk_fault_main(sys.argv[2:])
     if len(sys.argv) > 1 and sys.argv[1] == "--superstep":
         return _superstep_main(sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1] == "--obs":
+        return _obs_main(sys.argv[2:])
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 400
     off = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
     families = [
