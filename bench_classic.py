@@ -194,7 +194,9 @@ def _phase_local() -> dict:
         # unified Observatory snapshot of the leader's system (WAL
         # fsync p50/p99 + queue depth, segment writer, disk faults) —
         # the classic-plane half of ISSUE 6's one-stop JSON tail
-        row["observatory"] = systems[leader.node].observatory().snapshot()
+        obs = systems[leader.node].observatory()
+        row["observatory"] = obs.snapshot()
+        obs.close()
         return row
     finally:
         for n in nodes.values():
@@ -304,6 +306,15 @@ def _phase_tcp() -> dict:
         row["members"] = 3
         row["transport"] = "tcp (3 OS processes)"
         row["durable"] = True
+        # client-side Observatory: the reliable-RPC counters (retries,
+        # dedup hits, unreachable) ride the classic JSON tail like the
+        # WAL stats do on the local phase (ISSUE 7 satellite — the
+        # member systems live in worker processes, so the client
+        # router's control-plane view is what this process can stamp)
+        from ra_tpu.telemetry import Observatory
+        obs = Observatory.for_system(None, router=client)
+        row["observatory"] = obs.snapshot()
+        obs.close()
         return row
     finally:
         if client is not None:

@@ -34,6 +34,8 @@ from .core.types import (
     TransferLeadershipEvent,
     UserCommand,
 )
+from . import trace
+from .blackbox import record
 from .node import DEFAULT_ROUTER, Future, LocalRouter, RaNode
 
 
@@ -275,17 +277,26 @@ def _node_of(sid: ServerId, router: LocalRouter) -> RaNode:
 def _leader_call(seed: ServerId, make_event: Callable[["Future"], Any],
                  router: LocalRouter, timeout: float,
                  retry_reasons: tuple = (),
-                 timeout_msg: str = "ra: command not completed") -> Any:
+                 timeout_msg: str = "ra: command not completed",
+                 trace_ctx: Optional[str] = None) -> Any:
     """Shared redirect/retry loop for leader-targeted calls — the
     equivalent of ra_server_proc's leader_call redirect machinery
     (ra_server_proc.erl:242-263).  make_event builds the event to submit
     given the reply Future.  not_leader redirects follow the hinted
-    leader; reasons in retry_reasons back off and retry in place."""
+    leader; reasons in retry_reasons back off and retry in place.
+    ``trace_ctx`` records one ``cmd.submit`` hop event per attempt —
+    redirects and retries become visible in the command's timeline."""
     deadline = time.monotonic() + timeout
     target = seed
     last_err: Any = None
+    attempt = 0
     while time.monotonic() < deadline:
         node = router.nodes.get(target.node)
+        attempt += 1
+        if trace_ctx is not None:
+            record("cmd.submit", trace=trace_ctx, target=str(target),
+                   attempt=attempt,
+                   transport="local" if node is not None else "remote")
         if node is not None:
             fut = Future()
             if not node.submit(target.name, make_event(fut)):
@@ -328,9 +339,16 @@ def process_command(server_id: ServerId, data: Any,
                     router: Optional[LocalRouter] = None,
                     timeout: float = 5.0,
                     reply_mode: ReplyMode = ReplyMode.AWAIT_CONSENSUS,
-                    reply_from: Any = None) -> Any:
+                    reply_from: Any = None,
+                    trace_ctx: Optional[str] = None) -> Any:
     """Send a command and await consensus (ra:process_command/3 :804-828),
     following not_leader redirects like the reference's leader_call loop.
+
+    Every command gets a causal trace context at this ingress (ISSUE 7):
+    ``trace_ctx`` to supply one (a client session propagating its own),
+    else a deterministic id is minted here.  The context rides the
+    command object end to end; hop events land in the flight recorder
+    and ``tools/ra_trace.py`` reconstructs the timeline.
 
     ``reply_from`` picks which member answers (the reply_from command
     option, ra.erl:786-823): None/"leader" (default), ("member", sid),
@@ -342,6 +360,9 @@ def process_command(server_id: ServerId, data: Any,
     recovery replays suppress reply effects everywhere regardless."""
     from .core.types import CommandEvent
     router = router or DEFAULT_ROUTER
+    ctx = trace_ctx or trace.new_trace_ctx()
+    record("cmd.ingress", trace=ctx, op="process_command",
+           target=str(server_id))
     if reply_from == "local":
         # find ANY member of the seed's cluster hosted by one of this
         # process's nodes — the seed itself need not be local; shells
@@ -360,21 +381,30 @@ def process_command(server_id: ServerId, data: Any,
     return _leader_call(
         server_id,
         lambda fut: CommandEvent(UserCommand(data, reply_mode=reply_mode,
-                                             reply_from=reply_from),
+                                             reply_from=reply_from,
+                                             trace=ctx),
                                  from_=fut),
-        router, timeout, timeout_msg="ra: command not completed")
+        router, timeout, timeout_msg="ra: command not completed",
+        trace_ctx=ctx)
 
 
 def pipeline_command(server_id: ServerId, data: Any, correlation: Any = None,
                      notify_to: Any = None,
                      priority: Priority = Priority.LOW,
-                     router: Optional[LocalRouter] = None) -> None:
+                     router: Optional[LocalRouter] = None,
+                     trace_ctx: Optional[str] = None) -> None:
     """Fire-and-forget with applied-notification (ra:pipeline_command/4
-    :886-896).  notify_to receives [(correlation, reply)] batches."""
+    :886-896).  notify_to receives [(correlation, reply)] batches.
+    Like process_command, the ingress mints (or adopts) a trace context
+    that rides the command through the flight-recorder hop events."""
     router = router or DEFAULT_ROUTER
     node = _node_of(server_id, router)
+    ctx = trace_ctx or trace.new_trace_ctx()
+    record("cmd.ingress", trace=ctx, op="pipeline_command",
+           target=str(server_id))
     cmd = UserCommand(data, reply_mode=ReplyMode.NOTIFY,
-                      correlation=correlation, notify_to=notify_to)
+                      correlation=correlation, notify_to=notify_to,
+                      trace=ctx)
     node.submit_command(server_id.name, cmd, None, priority=priority)
 
 

@@ -25,6 +25,7 @@ from collections import deque
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Optional
 
+from ..blackbox import record
 from .machine import ApplyMeta, Machine
 from .types import (
     RA_PROTO_VERSION,
@@ -1542,6 +1543,11 @@ class RaServer:
         idx = self.log.next_index()
         entry = Entry(idx, self.current_term, cmd)
         self.log.append(entry)
+        if getattr(cmd, "trace", None) is not None:
+            # the trace ctx -> (uid, idx) join point: WAL/commit hop
+            # events are idx-keyed, ra_trace stitches them through this
+            record("cmd.append", trace=cmd.trace, uid=self.cfg.uid,
+                   idx=idx, term=self.current_term, server=str(self.id))
         reply_mode = getattr(cmd, "reply_mode", None)
         if reply_mode == ReplyMode.AFTER_LOG_APPEND and from_ is not None:
             effects.append(Reply(from_, CommandResult(idx, self.current_term,
@@ -1641,6 +1647,11 @@ class RaServer:
         t = self.log.fetch_term(potential)
         if t == self.current_term:
             self.commit_index = potential
+            # idx-keyed commit hop (one event per ADVANCE, not per
+            # entry): ra_trace resolves a command's commit time as the
+            # first advance at or past its append idx
+            record("cmd.commit", uid=self.cfg.uid, idx=potential,
+                   term=self.current_term)
 
     def _evaluate_quorum(self) -> list:
         ci0 = self.commit_index
@@ -1688,7 +1699,10 @@ class RaServer:
                 app_effs = []
             self.last_applied = idx
             if suppress:
-                return
+                return  # recovery replay: not a live apply hop
+            if cmd.trace is not None:
+                record("cmd.apply", trace=cmd.trace, uid=self.cfg.uid,
+                       idx=idx, server=str(self.id))
             effects.extend(app_effs)
             self._add_reply(cmd, idx, term, reply, effects, notifys)
             return

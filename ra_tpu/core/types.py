@@ -110,6 +110,12 @@ class UserCommand:
     #: ("member", ServerId) — the reply_from command option
     #: (ra.erl:786-823); useful when the caller sits nearer a follower
     reply_from: Any = None
+    #: causal trace context minted at ingress (ISSUE 7): a short string
+    #: id that rides the command through append/replication/WAL/apply
+    #: so the flight recorder's hop events join into one timeline.
+    #: None = untraced (the cost of the disabled path is one
+    #: ``is not None`` test per hop).
+    trace: Any = None
 
     kind = "usr"
 

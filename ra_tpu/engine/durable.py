@@ -70,6 +70,7 @@ from typing import Optional
 import numpy as np
 
 from .. import trace
+from ..blackbox import RECORDER, record, stamp_recovery
 from ..log import faults
 from ..log.wal import Wal, WalDown, scan_wal_file
 from ..metrics import ENGINE_WAL_FIELDS
@@ -238,6 +239,10 @@ class _WalShard:
             if hi <= self.confirmed_step:
                 return
             self.confirmed_step = hi
+            # (lane, submit_index)-keyed durable hop: the shard's step
+            # horizon advanced — ra_trace joins this against
+            # engine.submit by step range (docs/INTERNALS.md §10)
+            record("engine.confirm", shard=self.idx, step=hi)
             arr = self._appended.get(hi)
             if arr is not None:
                 # exact durable tail as of step hi — then re-apply the
@@ -274,6 +279,12 @@ class _WalShard:
             try:
                 self._process(*job)
             except Exception as exc:  # noqa: BLE001 — surfaced to callers
+                record("engine.crash", shard=self.idx,
+                       error=repr(exc)[:200])
+                RECORDER.dump("engine_shard_error",
+                              what=repr(exc)[:200],
+                              where=f"shard{self.idx}",
+                              data_dir=self.bridge.dir)
                 with cond:
                     self.error = exc
             finally:
@@ -403,7 +414,10 @@ class EngineDurability:
                           write_strategy=write_strategy,
                           max_size=wal_max_size,
                           max_batch_bytes=wal_batch_bytes,
-                          max_batch_interval_ms=wal_batch_interval_ms)
+                          max_batch_interval_ms=wal_batch_interval_ms,
+                          # every shard's post-mortem bundles land at
+                          # the BRIDGE's data dir, not one per shard
+                          blackbox_dir=data_dir)
         bounds = [round(i * n_lanes / wal_shards)
                   for i in range(wal_shards + 1)]
         self._shards: list = []
@@ -448,6 +462,22 @@ class EngineDurability:
         # vector never advanced past them, so nothing reported committed
         # depends on the crashed incarnation (disabled by tests that
         # assert raw WalDown freeze behaviour)
+        # post-mortem bundle sources: per-shard durable watermarks +
+        # the durability config (last engine wins the shared names; a
+        # closed bridge unhooks its own, see close())
+        self._bb_config = {
+            "data_dir": data_dir, "n_lanes": n_lanes,
+            "wal_shards": wal_shards, "sync_mode": sync_mode,
+            "write_strategy": write_strategy,
+            "max_pending": max_pending,
+            "wal_batch_bytes": wal_batch_bytes,
+            "wal_batch_interval_ms": wal_batch_interval_ms,
+            "wal_supervise": wal_supervise,
+        }
+        self._bb_watermarks = self._watermark_source
+        self._bb_config_src = lambda: self._bb_config
+        RECORDER.add_source("engine_wal_watermarks", self._bb_watermarks)
+        RECORDER.add_source("engine_wal_config", self._bb_config_src)
         self._sup_stop = threading.Event()
         self._shard_restarts: collections.deque = collections.deque()
         self._sup_thread: Optional[threading.Thread] = None
@@ -473,6 +503,13 @@ class EngineDurability:
                     log.error("engine wal supervisor: restart intensity "
                               "exceeded (%d in %.0fs); backing off",
                               max_r, period)
+                    record("sup.giveup", plane="engine_wal",
+                           shard=sh.idx)
+                    RECORDER.dump(
+                        "engine_wal_supervisor_giveup",
+                        what=f"shard restart intensity exceeded "
+                             f"({max_r} in {period:.0f}s)",
+                        where=f"shard{sh.idx}", data_dir=self.dir)
                     if self._sup_stop.wait(period):
                         return
                     continue
@@ -481,6 +518,8 @@ class EngineDurability:
                             "WAL shard %d", sh.idx)
                 try:
                     wal.restart()
+                    record("sup.restart", plane="engine_wal",
+                           shard=sh.idx)
                 except Exception:
                     log.exception("engine wal supervisor: restart of "
                                   "shard %d failed; will retry", sh.idx)
@@ -555,6 +594,11 @@ class EngineDurability:
                 sh._jobs.append((step, aux))
                 sh.unprocessed += 1
             self._cond.notify_all()
+        # host-side boundary event only (step counters — no device
+        # value is touched on this thread, rule RA04): commands are
+        # joined post-hoc by (lane, submit_index) against the
+        # on-device step stamps (docs/INTERNALS.md §10)
+        record("engine.submit", step_lo=step, step_hi=step, k=1)
 
     #: stacked-aux leaves a WAL record needs per inner step (the extra
     #: superstep watermarks — committed_lanes/applied_lanes — are host-
@@ -576,13 +620,16 @@ class EngineDurability:
         for j in range(k):
             subs.append({key: aux[key][j] for key in self._BLOCK_KEYS})
         with self._cond:
+            step_lo = self.step_seq + 1
             for sub in subs:
                 self.step_seq += 1
                 step = self.step_seq
                 for sh in self._shards:
                     sh._jobs.append((step, sub))
                     sh.unprocessed += 1
+            step_hi = self.step_seq
             self._cond.notify_all()
+        record("engine.submit", step_lo=step_lo, step_hi=step_hi, k=k)
 
     def flush_all(self, timeout: float = 5.0) -> None:
         """Durability barrier on every shard: drains the encode workers
@@ -643,6 +690,26 @@ class EngineDurability:
                 raise TimeoutError("WAL confirms stalled")
 
     # -- observability ------------------------------------------------------
+
+    def _watermark_source(self) -> dict:
+        """Per-shard durable watermarks for post-mortem bundles: host
+        ints/np arrays only (``confirm_upto`` lives on the host side of
+        the confirm protocol — no device sync here)."""
+        with self._cond:
+            return {
+                "step_seq": self.step_seq,
+                "shards": [{
+                    "shard": sh.idx,
+                    "lanes": [sh.lo, sh.hi],
+                    "confirmed_step": sh.confirmed_step,
+                    "jobs_pending": len(sh._jobs),
+                    "wal_alive": sh.wal.alive,
+                    "confirm_upto_min": int(sh.confirm_upto.min())
+                    if sh.confirm_upto.size else 0,
+                    "confirm_upto_max": int(sh.confirm_upto.max())
+                    if sh.confirm_upto.size else 0,
+                } for sh in self._shards],
+            }
 
     def wal_overview(self) -> dict:
         """ENGINE_WAL_FIELDS plus per-shard WAL stats (batch bytes,
@@ -747,6 +814,9 @@ class EngineDurability:
         return pieces
 
     def close(self) -> None:
+        RECORDER.remove_source("engine_wal_watermarks",
+                               self._bb_watermarks)
+        RECORDER.remove_source("engine_wal_config", self._bb_config_src)
         self._sup_stop.set()
         if self._sup_thread is not None:
             self._sup_thread.join(timeout=5)
@@ -986,4 +1056,17 @@ def open_engine(machine, data_dir: str, n_lanes: int, n_members: int = 3,
     last_step = max(pieces) if pieces else base_step
     dur.seed(tail, last_step)
     eng.attach_durability(dur)
+    if pieces or os.path.exists(ckpt):
+        # an actual recovery happened (checkpoint restore and/or WAL
+        # replay): stamp the join-able report next to any post-mortem
+        # bundle the crash left (ISSUE 7 — crash + recovery are one
+        # incident)
+        stamp_recovery(
+            {"plane": "engine", "base_step": base_step,
+             "replayed_steps": len(pieces),
+             "resumed_at_step": last_step,
+             "wal_shards": wal_shards,
+             "tail_min": int(tail.min()) if tail.size else 0,
+             "tail_max": int(tail.max()) if tail.size else 0},
+            data_dir=data_dir)
     return eng

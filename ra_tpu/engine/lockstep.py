@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import trace
+from ..blackbox import record
 from ..core.machine import JitMachine
 from ..metrics import ENGINE_PIPELINE_FIELDS, TELEMETRY_FIELDS
 from ..ops.exact import split16_matmul
@@ -961,6 +962,9 @@ class LockstepEngine:
     # -- failure injection / elections ------------------------------------
 
     def fail_member(self, lane: int, slot: int) -> None:
+        # host-initiated transitions are RARE and exactly what a
+        # post-mortem wants: flight events here, never per step
+        record("engine.fail", lane=int(lane), slot=int(slot))
         self._fail_host[lane, slot] = True
 
     def recover_member(self, lane: int, slot: int) -> None:
@@ -983,6 +987,7 @@ class LockstepEngine:
             raise ValueError(
                 f"slot {slot} is lane {lane}'s leader; recover the other "
                 "members, trigger_election, then recover this slot")
+        record("engine.recover", lane=int(lane), slot=int(slot))
         self._fail_host[lane, slot] = False
         self.state = self._snapshot_install(lane, slot)
 
@@ -1020,6 +1025,8 @@ class LockstepEngine:
         ra_server.erl:3218-3293): the new member is seeded from the
         leader's replica (snapshot install) and only counts toward
         quorum once promoted."""
+        record("engine.member", op="add", lane=int(lane),
+               slot=int(slot), voter=bool(voter))
         st = self._snapshot_install(lane, slot)
         self.state = st._replace(
             voter=st.voter.at[lane, slot].set(bool(voter)))
@@ -1027,6 +1034,8 @@ class LockstepEngine:
 
     def promote_member(self, lane: int, slot: int) -> None:
         """Nonvoter -> voter once caught up ('$ra_join' promotion)."""
+        record("engine.member", op="promote", lane=int(lane),
+               slot=int(slot))
         self.state = self.state._replace(
             voter=self.state.voter.at[lane, slot].set(True))
 
@@ -1041,6 +1050,8 @@ class LockstepEngine:
             raise ValueError(
                 f"slot {slot} is lane {lane}'s leader; "
                 "trigger_election first")
+        record("engine.member", op="remove", lane=int(lane),
+               slot=int(slot))
         st = self.state
         self.state = st._replace(
             active=st.active.at[lane, slot].set(False),
@@ -1049,6 +1060,8 @@ class LockstepEngine:
     def trigger_election(self, lanes) -> None:
         mask = np.zeros((self.n_lanes,), bool)
         mask[np.asarray(lanes)] = True
+        record("engine.elect",
+               lanes=np.atleast_1d(np.asarray(lanes)).tolist()[:64])
         N, K, C = self.n_lanes, self.max_step_cmds, self.payload_width
         self.step(jnp.zeros((N,), jnp.int32),
                   jnp.zeros((N, K, C), self.payload_dtype),

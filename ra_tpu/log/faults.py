@@ -54,6 +54,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from ..blackbox import RECORDER, record
 from ..metrics import DISK_FAULT_FIELDS
 from ..native import IO as _NATIVE
 
@@ -184,6 +185,12 @@ class DiskFaultPlan:
                 self._spent[key] = self._spent.get(key, 0) + 1
                 self.counters[kind] = self.counters.get(kind, 0) + 1
                 note("faults_injected")
+                # every injected storage fault names itself in the
+                # flight recorder: a post-mortem bundle can point at
+                # the exact faulted op, not just a counter
+                record("disk.fault", kind=kind, path_class=path_class,
+                       op=op, path=os.path.basename(path) if path
+                       else "")
                 if kind == "slow":
                     lo, hi = spec.slow_ms
                     return ("slow", rng.uniform(lo, hi) / 1000.0)
@@ -193,6 +200,27 @@ class DiskFaultPlan:
                     return ("corrupt_read", rng.random())
                 return (kind, 0)
         return ("ok", 0)
+
+    def overview(self) -> dict:
+        """Plan state for post-mortem bundles: seed, targeting rules
+        and per-kind injection counts — a bundle must NAME the chaos
+        that was active when the system died."""
+        def _spec(s: DiskFaultSpec) -> dict:
+            d = {f: getattr(s, f) for f in
+                 ("fsync_eio", "enospc", "short_write", "corrupt_read",
+                  "slow") if getattr(s, f)}
+            if s.limit:
+                d["limit"] = s.limit
+            if s.path_match:
+                d["path_match"] = s.path_match
+            return d
+
+        return {"seed": self.seed,
+                "default": _spec(self.default),
+                "by_class": {c: _spec(s)
+                             for c, s in self.by_class.items()},
+                "rules": [[c, _spec(s)] for c, s in self.rules],
+                "injected": dict(self.counters)}
 
 
 class FaultyIO:
@@ -372,6 +400,14 @@ class FaultyIO:
 
 #: the storage-plane I/O facade — ra_tpu.log modules import THIS
 IO = FaultyIO(_NATIVE)
+
+#: post-mortem bundles embed the ACTIVE DiskFaultPlan (None = no chaos
+#: installed) plus the node-wide fault counters
+RECORDER.add_source(
+    "disk_fault_plan",
+    lambda: {"plan": (IO.plan.overview() if IO.plan is not None
+                      else None),
+             "counters": disk_fault_counters()})
 
 
 def install_plan(plan: Optional[DiskFaultPlan]) -> None:

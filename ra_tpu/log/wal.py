@@ -39,6 +39,7 @@ import time
 from typing import Callable, Optional
 
 from .. import trace
+from ..blackbox import RECORDER, record
 from .faults import IO, note as _fault_note
 
 MAGIC = b"RTW2"
@@ -179,7 +180,8 @@ class Wal:
                  max_entries: int = 0,
                  max_batch_bytes: int = 0,
                  max_batch_interval_ms: float = 0.0,
-                 segment_writer=None) -> None:
+                 segment_writer=None,
+                 blackbox_dir: Optional[str] = None) -> None:
         """write_strategy (ra_log_wal.erl:66-96):
 
         * ``default`` — one write(2) for the batch, then the sync_mode
@@ -208,6 +210,10 @@ class Wal:
             raise ValueError(f"unknown write_strategy {write_strategy!r}")
         self.dir = os.path.join(data_dir, "wal")
         os.makedirs(self.dir, exist_ok=True)
+        #: where post-mortem bundles land (<dir>/blackbox): a sharded
+        #: plane points every shard at ONE home so an incident's
+        #: bundles sit together, not one per shard subdir
+        self._bb_dir = blackbox_dir or data_dir
         self.sync_mode = sync_mode
         self.write_strategy = write_strategy
         self.max_size = max_size
@@ -340,7 +346,11 @@ class Wal:
                 continue
             if first[0] == "__crash__":
                 # test hook: die like a real batch-thread crash (no
-                # cleanup, fd left open, queued writes abandoned)
+                # cleanup, fd left open, queued writes abandoned).
+                # A kill-9 of the WAL is a flight-recorder trigger:
+                # dump the post-mortem bundle before dying (the
+                # nemesis wal_kill / soak --blackbox path)
+                self._crash_dump()
                 raise RuntimeError("wal killed")
             batch = [first]
             # cap the batch at the remaining per-file entry budget so a
@@ -377,6 +387,7 @@ class Wal:
                 if item[0] == "__crash__":
                     # the crash hook must fire even when collected into
                     # an open group (interval mode)
+                    self._crash_dump()
                     raise RuntimeError("wal killed")
                 batch.append(item)
                 if item[0] in ("__flush__", "__roll__"):
@@ -394,6 +405,14 @@ class Wal:
         """Simulate a WAL crash (tests / fault injection)."""
         self._queue.put(("__crash__", 0, 0, b"", None))
         self._thread.join(timeout=5)
+
+    def _crash_dump(self) -> None:
+        """Flight-recorder trigger for an injected WAL kill: record the
+        event and write the post-mortem bundle next to the data dir."""
+        record("wal.kill", file=self._file_path,
+               queue_depth=self._queue.qsize())
+        RECORDER.dump("wal_kill", what="injected WAL batch-thread kill",
+                      where=self._file_path, data_dir=self._bb_dir)
 
     def restart(self) -> None:
         """Supervisor hook: revive a crashed WAL.
@@ -413,6 +432,7 @@ class Wal:
         self._retire_current_file()
         self._poison_streak = 0  # fresh incarnation, fresh ladder
         self.generation += 1
+        record("wal.restart", generation=self.generation)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ra-wal")
         self._thread.start()
@@ -441,6 +461,7 @@ class Wal:
                 if last is not None and index > last + 1 and not truncate:
                     # gap: out-of-sequence write — tell the writer to
                     # resend from its last accepted index (:457-481)
+                    record("wal.resend", uid=uid, frm=last, gap_at=index)
                     w.notify(uid, None, last, -1)
                     continue
                 if w.wid not in self._registered_in_file and \
@@ -491,6 +512,12 @@ class Wal:
             self.counters["batches"] += 1
             self.counters["writes"] += n_entries
             self.counters["bytes_written"] += n
+            # flight-recorder hop: the batch's per-uid index ranges are
+            # the (uid, idx) join key ra_trace resolves traced commands'
+            # WAL-write time through
+            record("wal.write", file=os.path.basename(self._file_path),
+                   n=n_entries, bytes=n,
+                   ranges={u: [c[0], c[1]] for u, c in confirms.items()})
             with self._lock:
                 self._registered_in_file |= new_regs
                 for uid, last in pending_last.items():
@@ -508,6 +535,7 @@ class Wal:
                          for uid, c in confirms.items()
                          if uid in self._writers]
         for notify, uid, (lo, hi, term) in notifiers:
+            record("wal.confirm", uid=uid, lo=lo, hi=hi)
             notify(uid, lo, hi, term)
         if deferred_sync:
             # sync_after_notify: durability syscall AFTER the confirms
@@ -560,8 +588,18 @@ class Wal:
         _fault_note("faults_hit")
         _fault_note("poisoned_files")
         self._poison_streak += 1
+        record("wal.poison", file=os.path.basename(self._file_path),
+               error=repr(exc)[:200], streak=self._poison_streak)
         if self._poison_streak >= MAX_POISON_STREAK:
             _fault_note("wal_escalations")
+            record("wal.escalate", streak=self._poison_streak,
+                   error=repr(exc)[:200])
+            # black-box trigger: the ladder is giving up this thread —
+            # capture the rings + fault-plan state before dying
+            RECORDER.dump("wal_escalation",
+                          what=f"poison streak {self._poison_streak} "
+                               "-> thread death",
+                          where=self._file_path, data_dir=self._bb_dir)
             raise exc
         _fault_note("fault_rollovers")
         self._retire_current_file()
@@ -598,6 +636,8 @@ class Wal:
         dt = time.monotonic() - t0
         self.counters["syncs"] += 1
         self.counters["sync_time_us"] += int(dt * 1e6)
+        record("wal.fsync", ms=round(dt * 1000, 3),
+               file=os.path.basename(self._file_path))
         with self._lock:
             # stats() iterates the reservoir from other threads; an
             # unguarded append would intermittently crash that read

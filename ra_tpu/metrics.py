@@ -172,6 +172,9 @@ TELEMETRY_SUMMARY_FIELDS = (
 #: tuple in this module MUST be listed here, covered by the registry
 #: parity test (tests/test_telemetry.py) and documented in
 #: docs/OBSERVABILITY.md — tools/lint.py statically enforces both.
+#: Its event-plane sibling is ra_tpu/blackbox.py's EVENT_REGISTRY
+#: (rule RA06): counters answer "how many", flight-recorder events
+#: answer "which one, when" — one registry discipline for both.
 FIELD_REGISTRY = {
     "log": LOG_FIELDS,
     "server": SERVER_FIELDS,

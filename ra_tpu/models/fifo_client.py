@@ -111,13 +111,22 @@ class FifoClient:
         status = "slow" if len(self.pending) >= self.soft_limit else "ok"
         return status, seqno
 
+    def _trace_ctx(self, seqno: int) -> str:
+        """Deterministic ingress trace id for one enqueue (ISSUE 7):
+        session tag + seqno, STABLE across resends — a post-leader-
+        change resend of the same seqno records under the same id, so
+        the duplicate committed entry the machine dedups is visible in
+        the command's timeline rather than a mystery second lifecycle."""
+        return f"{self.mailbox.name}/{seqno}"
+
     def _pipeline(self, seqno: int, msg: Any) -> None:
         target = self._leader_hint()
         try:
             api.pipeline_command(
                 target, ("enqueue", self.mailbox, seqno, msg),
                 correlation=seqno, notify_to=self._applied,
-                priority=Priority.LOW, router=self.router)
+                priority=Priority.LOW, router=self.router,
+                trace_ctx=self._trace_ctx(seqno))
         except RuntimeError:
             pass  # node down: stays pending, resend() recovers
 
@@ -131,7 +140,8 @@ class FifoClient:
         self.pending[seqno] = msg
         api.process_command(self._leader_hint(),
                             ("enqueue", self.mailbox, seqno, msg),
-                            router=self.router, timeout=timeout)
+                            router=self.router, timeout=timeout,
+                            trace_ctx=self._trace_ctx(seqno))
         self.pending.pop(seqno, None)
 
     def poll_applied(self) -> None:

@@ -30,6 +30,7 @@ import zlib
 from collections import deque
 from typing import Any, Callable, Optional
 
+from .blackbox import RECORDER, record
 from .core.server import RaServer
 from .core.types import (
     AuxCommandEvent,
@@ -271,6 +272,15 @@ class RaNode:
                 "ra_tpu node %s: server %s exceeded restart intensity "
                 "(%d in %.0fs); giving up", self.name, sid,
                 self.RESTART_INTENSITY, self.RESTART_PERIOD_S)
+            record("sup.giveup", plane="server", node=self.name,
+                   server=str(sid))
+            RECORDER.dump(
+                "server_restart_giveup",
+                what=f"server crash intensity exceeded "
+                     f"({self.RESTART_INTENSITY} in "
+                     f"{self.RESTART_PERIOD_S:.0f}s)",
+                where=str(sid),
+                data_dir=getattr(self.system, "data_dir", None))
             return False
         cfg = self._config_for(sid.name)
         if cfg is None:
@@ -293,6 +303,8 @@ class RaNode:
             return False
         logger.warning("ra_tpu node %s: server %s restarted after crash",
                        self.name, sid)
+        record("sup.restart", plane="server", node=self.name,
+               server=str(sid))
         return True
 
     def _config_for(self, name: str):
@@ -603,9 +615,18 @@ class RaNode:
                         self.name, shell.sid)
                     self._execute(shell, shell.server.enter_wal_down())
                     busy = True
-                except Exception:
+                except Exception as exc:
                     logger.exception("ra_tpu node %s: server %s crashed",
                                      self.name, shell.sid)
+                    # unhandled server crash: a black-box trigger — the
+                    # bundle captures what the whole node was doing at
+                    # the moment this core died
+                    record("srv.crash", node=self.name,
+                           server=str(shell.sid), error=repr(exc)[:200])
+                    RECORDER.dump(
+                        "server_crash", what=repr(exc)[:200],
+                        where=str(shell.sid),
+                        data_dir=getattr(self.system, "data_dir", None))
                     shell.stopped = True
                     # remove so clients get fast noproc instead of
                     # blocking on a dead inbox / stale leader state

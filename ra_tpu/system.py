@@ -16,6 +16,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from .blackbox import RECORDER, record, stamp_recovery
 from .core.types import (Membership, SNAPSHOT_TUNABLE_KEYS,
                          ServerConfig, ServerId)
 from .directory import Directory
@@ -138,6 +139,15 @@ class RaSystem:
         # fsync-acknowledged data conservatively — their recovered files
         # stay pinned until the server re-registers, matching the
         # reference's keep-unresolvable-WAL behaviour.
+        if self.wal._recovered_files:
+            # this boot re-read surviving WAL files: stamp a recovery
+            # report joining any post-mortem bundle the crash left
+            # (crash + recovery read as one incident, ISSUE 7)
+            stamp_recovery(
+                {"plane": "classic_wal", "system": name,
+                 "files": len(self.wal._recovered_files),
+                 "uids": sorted(self.wal._recovered)},
+                data_dir=data_dir)
         if not self.directory.load_failed:
             spent = set()
             for uid in self.directory.tombstones():
@@ -192,6 +202,12 @@ class RaSystem:
                 log.error("wal supervisor (%s): restart intensity "
                           "exceeded (%d in %.0fs); backing off %.0fs",
                           self.name, max_r, period, period)
+                record("sup.giveup", plane="wal", system=self.name)
+                RECORDER.dump(
+                    "wal_supervisor_giveup",
+                    what=f"WAL restart intensity exceeded ({max_r} in "
+                         f"{period:.0f}s)",
+                    where=self.name, data_dir=self.data_dir)
                 if self._sup_stop.wait(period):
                     return
                 continue
@@ -204,6 +220,7 @@ class RaSystem:
             # the window fills
             try:
                 wal.restart()
+                record("sup.restart", plane="wal", system=self.name)
                 with self._lock:
                     logs = list(self._logs.values())
                 for dlog in logs:
@@ -371,14 +388,18 @@ class RaSystem:
                 "segment_writer": dict(self.segment_writer.counters),
                 "disk_faults": faults.disk_fault_counters()}
 
-    def observatory(self, *, counters=None, ring_capacity: int = 256):
+    def observatory(self, *, counters=None, router=None,
+                    ring_capacity: int = 256):
         """The unified host-side observability surface for this system
         (ra_tpu.telemetry.Observatory): one merged snapshot of WAL/
         segment-writer/disk-fault counters + the pipeline tunables,
-        optionally a node's Counters registry; Prometheus exposition
-        and the bounded per-window time-series ring ride on it."""
+        optionally a node's Counters registry and a TcpRouter (whose
+        reliable-RPC counters then reach the exposition/ring);
+        Prometheus exposition and the bounded per-window time-series
+        ring ride on it."""
         from .telemetry import Observatory
         return Observatory.for_system(self, counters=counters,
+                                      router=router,
                                       ring_capacity=ring_capacity)
 
     def overview(self) -> dict:

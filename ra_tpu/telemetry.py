@@ -234,6 +234,16 @@ class Observatory:
         self._ring: collections.deque = collections.deque(
             maxlen=max(2, ring_capacity))
         self._seq = 0
+        # post-mortem bundles embed a fresh Observatory snapshot (the
+        # flight recorder fault-isolates a failing source, so a
+        # half-closed engine degrades to an ``error`` entry, not a
+        # failed dump); newest-constructed Observatory wins the name,
+        # and close() unhooks it — the stored bound-method ref is what
+        # makes the identity-guarded removal work (a fresh
+        # ``self.snapshot`` access is a NEW object every time)
+        from .blackbox import RECORDER
+        self._bb_src = self.snapshot
+        RECORDER.add_source("observatory", self._bb_src)
 
     # -- wiring ------------------------------------------------------------
 
@@ -241,14 +251,24 @@ class Observatory:
         self._sources[name] = fn
         return self
 
+    def close(self) -> None:
+        """Unhook this Observatory's flight-recorder bundle source (the
+        mirror of EngineDurability.close's source removal).  Call when
+        the observed engine/system is being torn down in a long-lived
+        process — otherwise the source closure pins the closed engine
+        (and its device buffers) for the rest of the process and every
+        later bundle embeds an ``error`` entry instead of live state."""
+        from .blackbox import RECORDER
+        RECORDER.remove_source("observatory", self._bb_src)
+
     @classmethod
     def for_engine(cls, engine, *, sampler: Optional[TelemetrySampler] = None,
-                   system=None, counters=None,
+                   system=None, counters=None, router=None,
                    ring_capacity: int = 256) -> "Observatory":
         """The standard wiring: engine telemetry + pipeline + WAL plane,
-        optionally a RaSystem's node-wide counters and a Counters
-        registry (a node's per-server groups + the telemetry_dropped
-        self-metric)."""
+        optionally a RaSystem's node-wide counters, a Counters registry
+        (a node's per-server groups + the telemetry_dropped
+        self-metric), and a router carrying the reliable-RPC counters."""
         obs = cls(ring_capacity=ring_capacity)
         sampler = sampler or getattr(engine, "_telemetry", None)
 
@@ -272,20 +292,21 @@ class Observatory:
             return out
 
         obs.add_source("engine", engine_src)
-        cls._wire_host_sources(obs, system, counters)
+        cls._wire_host_sources(obs, system, counters, router)
         return obs
 
     @classmethod
-    def for_system(cls, system, *, counters=None,
+    def for_system(cls, system, *, counters=None, router=None,
                    ring_capacity: int = 256) -> "Observatory":
         """Classic-plane wiring (no lane engine): system counters +
-        an optional node Counters registry."""
+        an optional node Counters registry and reliable-RPC router."""
         obs = cls(ring_capacity=ring_capacity)
-        cls._wire_host_sources(obs, system, counters)
+        cls._wire_host_sources(obs, system, counters, router)
         return obs
 
     @staticmethod
-    def _wire_host_sources(obs: "Observatory", system, counters) -> None:
+    def _wire_host_sources(obs: "Observatory", system, counters,
+                           router=None) -> None:
         """The system/counters source wiring shared by both factories —
         one definition keeps the engine-path and classic-path snapshots
         field-for-field comparable."""
@@ -298,6 +319,19 @@ class Observatory:
         if counters is not None:
             obs.add_source("counters", lambda: {
                 **counters.overview(), "self": counters.self_metrics()})
+        if router is not None and \
+                getattr(router, "rpc_counters", None) is not None:
+            # the reliable control plane's RPC_FIELDS (retry/dedup/
+            # unreachable...) flow through _flatten_numeric into the
+            # Prometheus exposition and the time-series ring exactly
+            # like the per-shard WAL stats (ISSUE 7 satellite; the
+            # round-trip is test-pinned)
+            obs.add_source("rpc", lambda: dict(router.rpc_counters))
+        from .blackbox import RECORDER
+        # the flight recorder's health + last incident ride every
+        # snapshot so a stalled soak is explainable from the live view
+        # (ra_top's incident footer reads this)
+        obs.add_source("blackbox", RECORDER.overview)
 
     # -- snapshots ---------------------------------------------------------
 

@@ -22,6 +22,7 @@ code wraps via ``trace.span``.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import os
 import threading
@@ -31,6 +32,34 @@ from typing import Any, Iterator, Optional
 #: the installed tracer, or None (tracing disabled).  Module attribute on
 #: purpose: instrumented call sites read it once per operation.
 _tracer: Optional["Tracer"] = None
+
+
+# -- causal trace context (ISSUE 7) ------------------------------------------
+#
+# Every classic-path command gets a trace id at ingress (api.py /
+# FifoClient / reliable RPC).  Ids are DETERMINISTIC given the run: a
+# process-wide counter under a settable origin prefix, so a seeded soak
+# replays the same ids (set_trace_origin("soak42")) while the default
+# prefix keeps ids unique across cooperating processes.  The context is
+# a plain short string — it rides command objects, RPC frames and
+# pickles untouched, and flight-recorder events join on it
+# (ra_tpu.blackbox / tools/ra_trace.py).
+
+_trace_seq = itertools.count(1)
+_trace_origin = f"p{os.getpid()}"
+
+
+def set_trace_origin(origin: str) -> None:
+    """Set the trace-id prefix AND restart the sequence — the knob a
+    seeded run uses to make its command trace ids reproducible."""
+    global _trace_seq, _trace_origin
+    _trace_origin = str(origin)
+    _trace_seq = itertools.count(1)
+
+
+def new_trace_ctx(origin: Optional[str] = None) -> str:
+    """Mint one trace context: ``<origin>-<seq>``."""
+    return f"{origin or _trace_origin}-{next(_trace_seq)}"
 
 
 def set_tracer(tracer: Optional["Tracer"]) -> None:

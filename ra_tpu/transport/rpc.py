@@ -40,9 +40,13 @@ import random
 import threading
 import time
 import uuid
+import weakref
 from collections import OrderedDict
 from dataclasses import dataclass, replace
 from typing import Any, Callable, Optional
+
+from .. import trace
+from ..blackbox import RECORDER, record
 
 __all__ = [
     "FaultPlan",
@@ -99,6 +103,11 @@ class RpcRequest:
     attempt: int = 1
     origin: tuple = ()        # sender's listen addr, filled by transport
     origin_router: str = ""   # sender's router id (wildcard-bind safe)
+    #: causal trace context (ISSUE 7): minted at the sender's ingress,
+    #: STABLE across retries like ``rid`` — a duplicate delivery dedups
+    #: receiver-side and records as a ``rpc.dup`` event under the same
+    #: trace id, so at-most-once execution is visible, not just true
+    trace_ctx: str = ""
 
 
 @dataclass(frozen=True)
@@ -144,6 +153,10 @@ class RpcReceiver:
                 # a retry of something we already saw: never re-execute
                 self._cache.move_to_end(req.rid)
                 self._note("rpc_dedup_hits")
+                # the duplicate delivery is VISIBLE under the same
+                # trace id while the verb still runs at most once
+                record("rpc.dup", trace=req.trace_ctx, rid=req.rid,
+                       op=req.op, attempt=req.attempt)
                 status, resp = ent
                 if status == "done":
                     self._note("rpc_responses_resent")
@@ -168,6 +181,8 @@ class RpcReceiver:
             # the sender's budget is spent: executing now could only
             # produce a zombie side effect nobody awaits
             self._note("rpc_expired")
+            record("rpc.expired", trace=req.trace_ctx, rid=req.rid,
+                   op=req.op)
             resp = RpcResponse(req.rid, ok=False, error="deadline_expired")
             with self._lock:
                 self._cache[req.rid] = ("done", resp)
@@ -181,6 +196,8 @@ class RpcReceiver:
             respond(resp)
 
         self._note("rpc_requests_executed")
+        record("rpc.recv", trace=req.trace_ctx, rid=req.rid, op=req.op,
+               attempt=req.attempt)
         try:
             started = self._execute(req, done)
         except Exception as exc:  # noqa: BLE001 — travels to the caller
@@ -223,12 +240,15 @@ def _attempt_wait(attempt: int) -> float:
 
 
 def reliable_node_call(router, node: str, op: str, args: dict,
-                       timeout: float = 60.0) -> Any:
+                       timeout: float = 60.0,
+                       trace_ctx: Optional[str] = None) -> Any:
     """Call ``op`` on ``node``'s control plane with retries, dedup and
     typed failures — the rpc:call-over-distribution role.  The router
     must provide the RPC transport surface (TcpRouter does); a router
     without it (LocalRouter reaching for a remote node) is Unreachable
-    by construction."""
+    by construction.  A trace context (minted here if the caller did
+    not propagate one) rides every attempt's frame: retries and
+    duplicate deliveries record under ONE id."""
     if getattr(router, "rpc_register", None) is None:
         raise Unreachable(
             f"node {node} is unreachable for {op}: router has no RPC "
@@ -240,6 +260,7 @@ def reliable_node_call(router, node: str, op: str, args: dict,
             "book")
     router.rpc_note("rpc_calls")
     rid = uuid.uuid4().hex
+    ctx = trace_ctx or trace.new_trace_ctx()
     rng = random.Random(rid)
     deadline = time.monotonic() + timeout
     fut = router.rpc_register(rid)
@@ -258,7 +279,9 @@ def reliable_node_call(router, node: str, op: str, args: dict,
                 router.rpc_invalidate_peer(node)
             req = RpcRequest(rid=rid, node=node, op=op, args=dict(args),
                              deadline_unix=time.time() + remaining,
-                             attempt=attempt)
+                             attempt=attempt, trace_ctx=ctx)
+            record("rpc.send", trace=ctx, rid=rid, op=op, node=node,
+                   attempt=attempt)
             router.rpc_send(node, req)
             try:
                 resp = fut.wait(min(_attempt_wait(attempt), remaining))
@@ -333,6 +356,14 @@ _DELIVER = FaultDecision()
 _DROP = FaultDecision(action="drop")
 
 
+#: live FaultPlans (weak: a dropped plan leaves the bundle) — the
+#: "active FaultPlan state" source every post-mortem bundle embeds
+_LIVE_PLANS: "weakref.WeakSet" = weakref.WeakSet()
+RECORDER.add_source(
+    "net_fault_plans",
+    lambda: [p.overview() for p in list(_LIVE_PLANS)])
+
+
 class FaultPlan:
     """Seeded fault schedule consulted by the transport.
 
@@ -367,6 +398,7 @@ class FaultPlan:
         #: injected-fault counters by kind (drop/delay/duplicate/
         #: reorder/partition), merged into the router overview
         self.counters: dict = {}
+        _LIVE_PLANS.add(self)  # post-mortem bundles name active plans
 
     # -- schedule control ---------------------------------------------------
 
@@ -391,12 +423,16 @@ class FaultPlan:
             return self.by_class[frame_class]
         return self.default
 
-    def _note(self, kind: str) -> None:
+    def _note(self, kind: str, peer: str = "",
+              frame_class: str = "") -> None:
         self.counters[kind] = self.counters.get(kind, 0) + 1
+        # every injected wire fault is a flight-recorder event: a
+        # post-mortem timeline shows WHICH frame the chaos hit
+        record("net.fault", kind=kind, peer=peer, cls=frame_class)
 
     def is_partitioned(self, peer: str) -> bool:
         if peer in self.partitioned:
-            self._note("partition")
+            self._note("partition", peer)
             return True
         return False
 
@@ -422,7 +458,7 @@ class FaultPlan:
                direction: str = "send",
                honor: frozenset = ALL_FAULTS) -> FaultDecision:
         if peer in self.partitioned:
-            self._note("partition")
+            self._note("partition", peer, frame_class)
             return _DROP
         spec = self._spec_for(peer, frame_class)
         if spec.drop == spec.delay == spec.duplicate == spec.reorder == 0:
@@ -447,7 +483,7 @@ class FaultPlan:
                 if kind not in honor:
                     return _DELIVER
                 self._spent[key] = self._spent.get(key, 0) + 1
-                self._note(kind)
+                self._note(kind, peer, frame_class)
                 if kind == "drop":
                     return _DROP
                 if kind == "delay":

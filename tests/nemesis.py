@@ -43,9 +43,15 @@ class Nemesis:
     # -- schedule interpreter ----------------------------------------------
 
     def run(self, schedule: Iterable[tuple]) -> None:
+        from ra_tpu.blackbox import record
         for step in schedule:
             self.history.append(step)
             op, args = step[0], step[1:]
+            # the chaos schedule narrates itself into the flight
+            # recorder: a post-mortem bundle shows WHICH nemesis op
+            # preceded the death, not just that one did
+            record("nemesis.op", op=op,
+                   args=repr(args)[:120] if args else "")
             getattr(self, f"_op_{op}")(*args)
 
     def _op_wait(self, seconds: float) -> None:

@@ -195,6 +195,11 @@ def test_classic_bench_contract():
     # Observatory snapshot (WAL fsync p50/p99 + queue depth)
     wal = detail["local"]["observatory"]["system"]["counters"]["wal"]
     assert "fsync_p50_ms" in wal and "queue_depth" in wal
+    # ISSUE 7 satellite: the tcp phase's client-side Observatory
+    # carries the reliable-RPC counters (RPC_FIELDS reach the
+    # snapshot/exposition like the WAL stats do)
+    rpc = detail["tcp"]["observatory"]["rpc"]
+    assert "rpc_calls" in rpc and "rpc_dedup_hits" in rpc
 
 
 def test_bench_tail_carries_observatory_snapshot():

@@ -338,3 +338,95 @@ def test_telemetry_module_is_ra04_clean():
     """The real sampler tick path passes the no-host-sync gate."""
     r = run_lint(os.path.join(REPO, "ra_tpu", "telemetry.py"))
     assert "RA04" not in r.stdout, r.stdout
+
+
+def test_checker_enforces_event_registry(tmp_path):
+    """RA06: an event type emitted via record()/blackbox.record/
+    RECORDER.record or a module-level trace.span/trace.instant that is
+    not a key of blackbox.EVENT_REGISTRY is flagged; Tracer OBJECT
+    spans (t.span) and non-constant types are exempt; tests are exempt
+    by path."""
+    bb = tmp_path / "blackbox.py"
+    bb.write_text('EVENT_REGISTRY = {"wal.fsync": "doc"}\n')
+    bad = tmp_path / "instrumented.py"
+    bad.write_text(textwrap.dedent("""\
+        from blackbox import RECORDER, record
+        import trace
+
+        def f(t, name):
+            record("wal.fsync", ms=1)        # registered: clean
+            record("zz.bogus", x=1)          # RA06
+            RECORDER.record("zz.worse")      # RA06
+            record(name)                     # non-constant: exempt
+            with trace.span("zz.span"):      # RA06
+                pass
+            with t.span("anything"):         # Tracer object: exempt
+                pass
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA06") == 3, r.stdout
+    assert "zz.bogus" in r.stdout and "zz.worse" in r.stdout
+    assert "zz.span" in r.stdout
+    # the same content under a tests/ path is exempt
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    (tdir / "helper.py").write_text(bad.read_text())
+    (tmp_path / "tests" / "blackbox.py").write_text(bb.read_text())
+    r = run_lint(str(tdir / "helper.py"))
+    assert "RA06" not in r.stdout, r.stdout
+
+
+def test_checker_enforces_event_registry_doc_half(tmp_path):
+    """RA06 (doc half): blackbox.py's EVENT_REGISTRY keys must be
+    backticked in docs/OBSERVABILITY.md (resolved next to the file
+    first, like RA05's doc resolution)."""
+    d = tmp_path / "docs"
+    d.mkdir()
+    (d / "OBSERVABILITY.md").write_text("only `wal.fsync` is here\n")
+    bb = tmp_path / "blackbox.py"
+    bb.write_text('EVENT_REGISTRY = {"wal.fsync": "d", '
+                  '"zz.undocumented": "d"}\n')
+    r = run_lint(str(bb))
+    assert r.returncode == 1
+    assert "RA06" in r.stdout and "zz.undocumented" in r.stdout
+
+
+def test_checker_gates_recorder_emit_path(tmp_path):
+    """RA04 extension: host syncs inside blackbox.py's record()
+    closure are flagged — the recorder rides dispatch loops."""
+    bad = tmp_path / "blackbox.py"
+    bad.write_text(textwrap.dedent("""\
+        import numpy as np
+
+        EVENT_REGISTRY = {"wal.fsync": "d"}
+
+        class R:
+            def record(self, etype, **fields):
+                self._stash(fields)
+                self.handle.block_until_ready()
+                return np.asarray(fields["x"])
+
+            def _stash(self, fields):
+                return fields["x"].item()
+
+            def dump(self):
+                return np.asarray(self.rings)  # not on the emit path
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA04") == 3, r.stdout
+    assert "dump" not in r.stdout
+
+
+def test_blackbox_module_is_ra06_and_ra04_clean():
+    """The real recorder and every instrumented module pass the gates
+    (covered by the repo-wide run too; pinned so a regression names
+    the rule)."""
+    r = run_lint(os.path.join(REPO, "ra_tpu", "blackbox.py"))
+    assert "RA06" not in r.stdout and "RA04" not in r.stdout, r.stdout
+    for mod in ("ra_tpu/api.py", "ra_tpu/core/server.py",
+                "ra_tpu/log/wal.py", "ra_tpu/transport/rpc.py",
+                "ra_tpu/engine/durable.py", "ra_tpu/engine/lockstep.py"):
+        r = run_lint(os.path.join(REPO, *mod.split("/")))
+        assert "RA06" not in r.stdout, (mod, r.stdout)

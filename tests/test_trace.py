@@ -161,3 +161,22 @@ def test_summary_carries_wrapped_indicator():
     # post-wrap counts cover only the surviving window — the indicator
     # is what stops them being read as totals
     assert s["b"]["count"] == 4 and "a" not in s
+
+
+# -- causal trace context (ISSUE 7) -----------------------------------------
+
+def test_trace_ctx_is_deterministic_under_set_origin():
+    trace.set_trace_origin("seeded")
+    a = [trace.new_trace_ctx() for _ in range(3)]
+    trace.set_trace_origin("seeded")
+    b = [trace.new_trace_ctx() for _ in range(3)]
+    assert a == b == ["seeded-1", "seeded-2", "seeded-3"]
+    assert trace.new_trace_ctx("other") == "other-4"
+
+
+def test_trace_ctx_default_origin_is_process_scoped():
+    import os
+
+    trace.set_trace_origin(f"p{os.getpid()}")
+    ctx = trace.new_trace_ctx()
+    assert ctx.startswith(f"p{os.getpid()}-")

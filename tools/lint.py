@@ -51,6 +51,19 @@ Checks (cheap, high-signal, zero-config):
                 can interpret (the drop-silently bug class ISSUE 6's
                 telemetry_dropped self-metric removed, applied to the
                 registry itself)
+  RA06          (repo source, tests exempt) every trace/flight-recorder
+                event type emitted anywhere — ``record("...")`` /
+                ``blackbox.record`` / ``RECORDER.record`` / module-level
+                ``trace.span("...")`` / ``trace.instant("...")`` — must
+                be a key of the central ``EVENT_REGISTRY``
+                (ra_tpu/blackbox.py), and, when linting blackbox.py
+                itself, every registry key must be documented
+                (backticked) in docs/OBSERVABILITY.md — the RA05
+                field-registry parity applied to events.  The RA04
+                no-host-sync gate also covers the recorder's emit path
+                (blackbox.py ``record`` closure): the recorder rides
+                dispatch loops and WAL threads, so a blocking sync
+                there is the same bug class as a sampler-tick sync
   RA03          (files in a `log/` directory only) no swallow-only
                 `except OSError:`/`except Exception:` (body is just
                 `pass`) around durability-bearing I/O calls (fsync/
@@ -218,10 +231,16 @@ def _check_bench_loop_sync(tree: ast.Module, err) -> None:
 #: `# ra04-ok: <why>` line comment.
 _TELEMETRY_FILES = frozenset({"telemetry.py"})
 _SAMPLER_HOT_FUNCS = frozenset({"tick", "_start_sample", "_harvest"})
+#: the flight recorder's emit path rides the same dispatch loops the
+#: sampler tick does — same no-host-sync contract (RA04 extension,
+#: ISSUE 7)
+_BLACKBOX_FILES = frozenset({"blackbox.py"})
+_RECORDER_HOT_FUNCS = frozenset({"record"})
 
 
-def _sampler_hot_closure(tree: ast.Module) -> dict:
-    """Module functions reachable from the tick-path entry points via
+def _sampler_hot_closure(tree: ast.Module,
+                         roots=_SAMPLER_HOT_FUNCS) -> dict:
+    """Module functions reachable from the given entry points via
     same-module calls (``name(...)`` or ``self.name(...)``) — a host
     sync moved into a helper must not escape the gate."""
     funcs: dict = {}
@@ -229,7 +248,7 @@ def _sampler_hot_closure(tree: ast.Module) -> dict:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             funcs.setdefault(node.name, node)
     hot: dict = {}
-    queue = [n for n in _SAMPLER_HOT_FUNCS if n in funcs]
+    queue = [n for n in roots if n in funcs]
     while queue:
         name = queue.pop()
         if name in hot:
@@ -250,11 +269,12 @@ def _sampler_hot_closure(tree: ast.Module) -> dict:
     return hot
 
 
-def _check_sampler_sync(tree: ast.Module, err) -> None:
+def _check_sampler_sync(tree: ast.Module, err,
+                        roots=_SAMPLER_HOT_FUNCS) -> None:
     """RA04 on the telemetry sampler path: forbid host syncs in the
     tick-path functions AND every same-module helper they reach
     (allowlist via `# ra04-ok:` line comment)."""
-    for node in _sampler_hot_closure(tree).values():
+    for node in _sampler_hot_closure(tree, roots).values():
         for sub in ast.walk(node):
             if not isinstance(sub, ast.Call):
                 continue
@@ -359,6 +379,89 @@ def _check_log_io_swallow(tree: ast.Module, err) -> None:
                     "'# ra03-ok: why' with a DISK_FAULT_FIELDS counter")
 
 
+#: RA06 — the event-type registry contract (ISSUE 7): an event type
+#: the registry does not know cannot be interpreted by ra_trace, the
+#: ra_top incident footer, or the docs — flagged at the emit site.
+#: Tests are exempt (fixtures emit throwaway span names); the real
+#: instrumentation lives in ra_tpu/ and tools/.
+
+def _event_registry_keys(path: str):
+    """Keys of blackbox.EVENT_REGISTRY: prefer a ``blackbox.py`` next
+    to the checked file (self-contained fixtures), else the repo's."""
+    cand = os.path.join(os.path.dirname(path), "blackbox.py")
+    if not os.path.exists(cand):
+        cand = os.path.join(REPO, "ra_tpu", "blackbox.py")
+    if not os.path.exists(cand):
+        return None
+    try:
+        with open(cand, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "EVENT_REGISTRY" and \
+                isinstance(node.value, ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return None
+
+
+def _check_event_registry_use(tree: ast.Module, err, keys: set) -> None:
+    """RA06: every string-constant event type passed to the recorder
+    (``record(...)``, ``blackbox.record``, ``RECORDER.record``) or to a
+    module-level tracer site (``trace.span``/``trace.instant``) must be
+    a registry key.  Tracer OBJECT spans (``t.span``) are exempt — user
+    code may span whatever it likes; the registry governs the repo's
+    own instrumentation vocabulary."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        via = None
+        if isinstance(fn, ast.Name) and fn.id == "record":
+            via = "record"
+        elif isinstance(fn, ast.Attribute) and fn.attr == "record" and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in ("blackbox", "RECORDER"):
+            via = f"{fn.value.id}.record"
+        elif isinstance(fn, ast.Attribute) and \
+                fn.attr in ("span", "instant") and \
+                isinstance(fn.value, ast.Name) and fn.value.id == "trace":
+            via = f"trace.{fn.attr}"
+        if via is None:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value not in keys:
+            err(node, "RA06",
+                f"event type {arg.value!r} emitted via {via}() is not "
+                "in blackbox.EVENT_REGISTRY; register and document it "
+                "(docs/OBSERVABILITY.md) or ra_trace/ra_top cannot "
+                "interpret it")
+
+
+def _check_event_registry_doc(tree: ast.Module, err, doc_text) -> None:
+    """RA06 (doc half, blackbox.py only): every EVENT_REGISTRY key must
+    be named (backticked) in docs/OBSERVABILITY.md."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "EVENT_REGISTRY" and \
+                isinstance(node.value, ast.Dict):
+            keys = [k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)]
+            if doc_text is not None:
+                missing = [k for k in keys if f"`{k}`" not in doc_text]
+                if missing:
+                    err(node, "RA06",
+                        "EVENT_REGISTRY keys undocumented in "
+                        f"docs/OBSERVABILITY.md: {missing[:6]}")
+
+
 def _check_lifecycle_rpc(tree: ast.Module, err) -> None:
     """RA01: inside lifecycle verbs, forbid direct one-shot transport
     calls (they must go through the reliable RPC layer)."""
@@ -430,6 +533,34 @@ def check_file(path: str) -> list:
             _check_bench_loop_sync(tree, err_ra04)
         else:
             _check_sampler_sync(tree, err_ra04)
+    if os.path.basename(path) in _BLACKBOX_FILES:
+        # the recorder's emit path rides dispatch loops: same RA04
+        # no-host-sync closure gate as the sampler tick path
+        ra04_ok = {i + 1 for i, line in enumerate(src.splitlines())
+                   if "ra04-ok" in line}
+
+        def err_ra04_bb(node: ast.AST, code: str, msg: str) -> None:
+            if getattr(node, "lineno", 0) not in ra04_ok:
+                err(node, code, msg)
+
+        _check_sampler_sync(tree, err_ra04_bb,
+                            roots=_RECORDER_HOT_FUNCS)
+        doc = os.path.join(os.path.dirname(path), "docs",
+                           "OBSERVABILITY.md")
+        if not os.path.exists(doc):
+            doc = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+        doc_text = None
+        if os.path.exists(doc):
+            with open(doc, encoding="utf-8") as fdoc:
+                doc_text = fdoc.read()
+        _check_event_registry_doc(tree, err, doc_text)
+    parts = set(os.path.normpath(path).split(os.sep))
+    in_tests = "tests" in parts or \
+        os.path.basename(path).startswith("test_")
+    if not in_tests:
+        keys = _event_registry_keys(path)
+        if keys is not None:
+            _check_event_registry_use(tree, err, keys)
     if os.path.basename(path) == "metrics.py":
         # the documented-field half of RA05 reads the observability
         # registry doc: prefer one next to the checked file (self-

@@ -154,6 +154,18 @@ def render(snap: dict, prev: dict | None = None) -> str:
     if dropped:
         lines.append(f"WARN    telemetry_dropped={dropped} "
                      "(instrumentation/registry mismatch)")
+    # -- last incident (flight recorder, ISSUE 7): a stalled soak must
+    # be explainable from the live view — what escalated, where, when,
+    # and which bundle to feed tools/ra_trace.py
+    inc = (snap.get("blackbox") or {}).get("last_incident")
+    if inc:
+        age = max(0.0, ts - inc.get("ts", ts))
+        bundle = inc.get("path") or ""
+        bundle = bundle.rsplit("/", 1)[-1]
+        lines.append(
+            f"incident {inc.get('reason', '?')} @ "
+            f"{inc.get('where', '?')}  {age:.0f}s ago  "
+            f"{(inc.get('what') or '')[:36]}  bundle={bundle}")
     return "\n".join(lines)
 
 

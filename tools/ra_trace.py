@@ -1,0 +1,318 @@
+"""ra_trace — reconstruct per-command timelines from flight-recorder
+bundles (ISSUE 7: the *why was THIS command slow* tool).
+
+Input: one or more post-mortem bundles (``ra_tpu.blackbox`` dumps) or
+raw event JSONL files (one ``[ts, etype, fields]`` line each).  Multiple
+bundles merge into one timeline — classic TCP nodes dump one bundle per
+process, and each appears as its own ``pid`` in the Chrome export via
+the trace context that crossed the wire.
+
+Joins (the causal model, docs/INTERNALS.md §10):
+
+* events carrying an explicit ``trace`` field (cmd.*, rpc.*) group
+  directly by trace id;
+* WAL-plane events are ``(uid, idx)``-keyed: a trace's ``cmd.append``
+  names ``(uid, idx)``, and the covering ``wal.write`` /
+  ``wal.confirm`` ranges plus the first ``cmd.commit`` advance at or
+  past idx complete the lifecycle;
+* engine-plane events are ``(lane, submit_index)``-keyed:
+  ``engine.submit`` step ranges pair with per-shard ``engine.confirm``
+  horizons (``--steps``); joining those against on-device step stamps
+  is the bench's job (``latency_mode: step_stamped``), not the host's.
+* fault events (``disk.fault`` / ``net.fault`` / ``wal.poison`` /
+  ``wal.kill``) inside a command's time window attach to its timeline
+  — the injected fault is visible next to the hop it delayed.
+
+Usage:
+    python tools/ra_trace.py BUNDLE [BUNDLE...] [--list]
+    python tools/ra_trace.py BUNDLE --explain TRACE_ID
+    python tools/ra_trace.py BUNDLE --explain auto
+    python tools/ra_trace.py BUNDLE --out trace.json   # chrome://tracing
+    python tools/ra_trace.py BUNDLE --steps            # engine step lat
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+#: event types that carry an explicit trace id
+_FAULT_TYPES = ("disk.fault", "net.fault", "wal.poison", "wal.kill",
+                "wal.escalate", "wal.resend")
+
+#: lifecycle order used for hop labelling (ties broken by timestamp)
+_HOP_ORDER = ("cmd.ingress", "rpc.send", "cmd.submit", "rpc.recv",
+              "rpc.dup", "cmd.append", "wal.fsync", "wal.write",
+              "wal.confirm", "cmd.commit", "cmd.apply")
+
+
+def load_events(paths: list) -> list:
+    """-> [(ts, etype, fields, origin)] merged + time-sorted from
+    bundles (ra-tpu-blackbox-1 JSON) and/or raw event JSONL files."""
+    out: list = []
+    for path in paths:
+        if path.endswith(".jsonl"):
+            with open(path) as f:
+                for raw in f:
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        ts, etype, fields = json.loads(raw)
+                    except ValueError:
+                        continue  # torn tail mid-append
+                    out.append((ts, etype, fields, path))
+            continue
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("format") != "ra-tpu-blackbox-1":
+            raise ValueError(f"not a blackbox bundle: {path}")
+        origin = doc.get("origin", path)
+        for _sub, evts in doc.get("events", {}).items():
+            for ts, etype, fields in evts:
+                out.append((ts, etype, fields, origin))
+    out.sort(key=lambda e: e[0])
+    return out
+
+
+def index_traces(events: list) -> dict:
+    """Group events into per-command timelines.
+
+    -> {trace_id: {"hops": [(ts, etype, fields, origin)],
+                   "uid": str|None, "idx": int|None,
+                   "faults": [(ts, etype, fields, origin)]}}
+    Direct hops come from the ``trace`` field; WAL hops join through
+    the (uid, idx) the trace's cmd.append names."""
+    traces: dict = {}
+    for ev in events:
+        tr = ev[2].get("trace")
+        if tr:
+            traces.setdefault(tr, {"hops": [], "uid": None,
+                                   "idx": None, "faults": []})
+            traces[tr]["hops"].append(ev)
+    for tl in traces.values():
+        app = next((e for e in tl["hops"] if e[1] == "cmd.append"), None)
+        if app is None:
+            continue
+        uid, idx, t_app = app[2].get("uid"), app[2].get("idx"), app[0]
+        tl["uid"], tl["idx"] = uid, idx
+        confirm_ts = None
+        fsyncs: list = []
+        for ev in events:
+            ts, etype, fields, _o = ev
+            if ts < t_app:
+                continue
+            if etype == "wal.write":
+                rng = (fields.get("ranges") or {}).get(uid)
+                if rng and rng[0] <= idx <= rng[1] \
+                        and confirm_ts is None:
+                    tl["hops"].append(ev)
+            elif etype == "wal.confirm" and fields.get("uid") == uid \
+                    and fields.get("lo", 1) <= idx <= fields.get("hi", 0):
+                if confirm_ts is None:
+                    confirm_ts = ts
+                    tl["hops"].append(ev)
+                    if fsyncs:
+                        # the batch's durability syscall: the last sync
+                        # before this entry's confirm
+                        tl["hops"].append(fsyncs[-1])
+            elif etype == "wal.fsync" and confirm_ts is None:
+                fsyncs.append(ev)
+            elif etype == "cmd.commit" and fields.get("uid") == uid \
+                    and fields.get("idx", -1) >= idx:
+                tl["hops"].append(ev)
+                break
+    # attach fault events falling inside each trace's window
+    for tl in traces.values():
+        if not tl["hops"]:
+            continue
+        tl["hops"].sort(key=lambda e: e[0])
+        t0, t1 = tl["hops"][0][0], tl["hops"][-1][0]
+        tl["faults"] = [e for e in events
+                        if e[1] in _FAULT_TYPES and t0 <= e[0] <= t1]
+    return traces
+
+
+def completeness(tl: dict) -> set:
+    return {e[1] for e in tl["hops"]}
+
+
+def pick_auto(traces: dict) -> str | None:
+    """The trace worth explaining unprompted: most complete lifecycle,
+    faulted ones first (the post-mortem question is 'show me a command
+    the fault touched')."""
+    best, best_key = None, (-1, -1)
+    for tid, tl in traces.items():
+        key = (len(tl["faults"]) > 0, len(completeness(tl)))
+        if key > best_key:
+            best, best_key = tid, key
+    return best
+
+
+def explain(trace_id: str, tl: dict) -> str:
+    """Hop-by-hop latency breakdown of one command's lifecycle."""
+    hops = sorted(tl["hops"], key=lambda e: e[0])
+    if not hops:
+        return f"trace {trace_id}: no events"
+    t0 = hops[0][0]
+    lines = [f"trace {trace_id}"
+             + (f"  (uid={tl['uid']}, idx={tl['idx']})"
+                if tl["uid"] else "")]
+    by_type: dict = {}
+    for ts, etype, fields, origin in hops:
+        by_type.setdefault(etype, ts)
+        detail = " ".join(
+            f"{k}={v}" for k, v in fields.items()
+            if k not in ("trace", "ranges") and not isinstance(v, dict))
+        lines.append(f"  +{(ts - t0) * 1000:9.3f}ms  {etype:<12} "
+                     f"{detail[:80]}  [{origin}]")
+    for ts, etype, fields, _o in sorted(tl["faults"],
+                                        key=lambda e: e[0]):
+        detail = " ".join(f"{k}={v}" for k, v in fields.items()
+                          if not isinstance(v, dict))
+        lines.append(f"  +{(ts - t0) * 1000:9.3f}ms  FAULT {etype:<12} "
+                     f"{detail[:74]}")
+
+    def dt(a: str, b: str):
+        if a in by_type and b in by_type:
+            return (by_type[b] - by_type[a]) * 1000
+        return None
+
+    parts = []
+    for label, a, b in (
+            ("client queue/redirect", "cmd.ingress", "cmd.submit"),
+            ("deliver+append", "cmd.submit", "cmd.append"),
+            ("wal write+fsync wait", "cmd.append", "wal.confirm"),
+            ("commit lag", "wal.confirm", "cmd.commit"),
+            ("commit lag", "cmd.append", "cmd.commit"),
+            ("apply", "cmd.commit", "cmd.apply")):
+        d = dt(a, b)
+        if d is not None and not any(p[0] == label for p in parts):
+            parts.append((label, d))
+    if parts:
+        lines.append("  breakdown: " + "  |  ".join(
+            f"{label} {d:.3f}ms" for label, d in parts))
+    if tl["faults"]:
+        kinds = sorted({e[2].get("kind", e[1]) for e in tl["faults"]})
+        lines.append(f"  faults in window: {', '.join(kinds)}")
+    return "\n".join(lines)
+
+
+def step_latencies(events: list) -> list:
+    """Engine-plane (submit_index)-join: pair engine.submit step ranges
+    with per-shard engine.confirm horizons -> [(step, submit_ts,
+    {shard: confirm_ts})].  Lane attribution within a step comes from
+    the on-device step stamps (INTERNALS §10), not host events."""
+    submits: dict = {}
+    for ts, etype, fields, _o in events:
+        if etype == "engine.submit":
+            for s in range(fields.get("step_lo", 0),
+                           fields.get("step_hi", -1) + 1):
+                submits.setdefault(s, [ts, {}])
+        elif etype == "engine.confirm":
+            sh = fields.get("shard", 0)
+            hi = fields.get("step", 0)
+            for s, rec in submits.items():
+                if s <= hi and sh not in rec[1]:
+                    rec[1][sh] = ts
+    return sorted((s, rec[0], rec[1]) for s, rec in submits.items())
+
+
+def to_chrome(events: list, traces: dict, out_path: str) -> str:
+    """Chrome trace-event JSON: every origin (process/bundle) is a
+    ``pid``, subsystems are ``tid``s, traced commands add one span row
+    per hop pair (load in chrome://tracing or ui.perfetto.dev)."""
+    if not events:
+        raise ValueError("no events to export")
+    t0 = events[0][0]
+    pids: dict = {}
+    tids: dict = {}
+    doc: list = []
+
+    def pid_of(origin: str) -> int:
+        if origin not in pids:
+            pids[origin] = len(pids) + 1
+            doc.append({"ph": "M", "name": "process_name",
+                        "pid": pids[origin], "tid": 0,
+                        "args": {"name": origin}})
+        return pids[origin]
+
+    def tid_of(sub: str) -> int:
+        return tids.setdefault(sub, len(tids) + 1)
+
+    for ts, etype, fields, origin in events:
+        doc.append({"ph": "i", "s": "t", "name": etype,
+                    "cat": etype.partition(".")[0],
+                    "ts": (ts - t0) * 1e6,
+                    "pid": pid_of(origin),
+                    "tid": tid_of(etype.partition(".")[0]),
+                    "args": {k: v for k, v in fields.items()
+                             if not isinstance(v, dict)}})
+    row = 1000
+    for tid_name, tl in sorted(traces.items()):
+        hops = sorted(tl["hops"], key=lambda e: e[0])
+        if len(hops) < 2:
+            continue
+        row += 1
+        doc.append({"ph": "M", "name": "thread_name", "pid": 0,
+                    "tid": row, "args": {"name": f"trace {tid_name}"}})
+        for a, b in zip(hops, hops[1:]):
+            doc.append({"ph": "X", "name": f"{a[1]} -> {b[1]}",
+                        "cat": "trace",
+                        "ts": (a[0] - t0) * 1e6,
+                        "dur": max((b[0] - a[0]) * 1e6, 0.1),
+                        "pid": 0, "tid": row,
+                        "args": {"trace": tid_name}})
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": doc, "displayTimeUnit": "ms"}, f)
+    return out_path
+
+
+def main(argv: list) -> int:
+    paths, out, explain_id = [], None, None
+    list_only = steps = False
+    it = iter(argv)
+    for a in it:
+        if a == "--out":
+            out = next(it, "trace.json")
+        elif a == "--explain":
+            explain_id = next(it, "auto")
+        elif a == "--list":
+            list_only = True
+        elif a == "--steps":
+            steps = True
+        elif not a.startswith("--"):
+            paths.append(a)
+    if not paths:
+        print(__doc__)
+        return 2
+    events = load_events(paths)
+    traces = index_traces(events)
+    if not (out or explain_id or steps) or list_only:
+        print(f"{len(events)} events, {len(traces)} traced commands")
+        for tid, tl in sorted(traces.items()):
+            hops = sorted(completeness(tl))
+            flag = "  FAULTED" if tl["faults"] else ""
+            print(f"  {tid:<24} {len(tl['hops'])} hops "
+                  f"[{', '.join(hops)}]{flag}")
+    if steps:
+        rows = step_latencies(events)
+        print(f"{len(rows)} engine steps (submit -> per-shard confirm)")
+        for s, sub_ts, confirms in rows[-16:]:
+            lat = " ".join(
+                f"s{sh}:{(ts - sub_ts) * 1000:.2f}ms"
+                for sh, ts in sorted(confirms.items())) or "unconfirmed"
+            print(f"  step {s:<8} {lat}")
+    if explain_id is not None:
+        tid = pick_auto(traces) if explain_id == "auto" else explain_id
+        if tid is None or tid not in traces:
+            print(f"ra_trace: no such trace {explain_id!r} "
+                  f"({len(traces)} known; --list to see them)")
+            return 1
+        print(explain(tid, traces[tid]))
+    if out:
+        print(f"wrote {to_chrome(events, traces, out)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

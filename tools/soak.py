@@ -6,6 +6,7 @@ Usage:  python tools/soak.py [seeds_per_family] [offset]
         python tools/soak.py --disk-faults SEED [n]
         python tools/soak.py --superstep SEED [n]
         python tools/soak.py --obs SEED [n] [jsonl_path]
+        python tools/soak.py --blackbox SEED [n]
 
 ``--disk-faults`` runs the storage-plane chaos family instead
 (tests/test_disk_faults.run_disk_chaos): ``n`` seeded episodes starting
@@ -16,6 +17,15 @@ log with a cold-restart oracle check.
 (tests/test_superstep.run_superstep_fuzz): ``n`` seeded episodes of
 random K/elect schedules + member failures, each exact-parity checked
 against the single-step oracle every round (ISSUE 5).
+
+``--blackbox`` runs the flight-recorder chaos family
+(tests/test_blackbox.run_blackbox_chaos): ``n`` seeded episodes, each
+a classic durable cluster taking traced traffic through a random
+DiskFaultPlan, then a kill-9 of the WAL under the ACTIVE plan —
+asserting the post-mortem bundle exists, parses, names the injected
+fault, and that ``tools/ra_trace.py`` reconstructs the complete
+lifecycle (ingress→submit→append→WAL write→fsync→confirm→commit→apply)
+of a command the fault touched (ISSUE 7 acceptance).
 
 ``--obs`` runs the telemetry-plane chaos family
 (tests/test_telemetry.run_stall_chaos): ``n`` seeded episodes that
@@ -130,7 +140,38 @@ def _obs_main(argv: list) -> int:
     return 1 if failed else 0
 
 
+def _blackbox_main(argv: list) -> int:
+    """--blackbox SEED [n]: the flight-recorder chaos family."""
+    import test_blackbox as tb
+
+    seed = int(argv[0]) if argv else 0
+    n = int(argv[1]) if len(argv) > 1 else 10
+    t0 = time.time()
+    failed = []
+    traces = faults_seen = 0
+    last = {}
+    for s in range(seed, seed + n):
+        with tempfile.TemporaryDirectory(prefix="soak_bb_") as d:
+            try:
+                last = tb.run_blackbox_chaos(s, d)
+                traces += last["n_traces"]
+                faults_seen += last["fault_events"]
+            except Exception:  # noqa: BLE001 — report seed + continue
+                failed.append(s)
+                if len(failed) == 1:
+                    traceback.print_exc()
+    print(f"blackbox: {n - len(failed)}/{n} ok in "
+          f"{time.time() - t0:.1f}s  traced_cmds={traces} "
+          f"injected_faults={faults_seen}"
+          + (f"  last_explained={last.get('trace')}" if last else "")
+          + (f"  FAILED seeds: {failed[:10]}" if failed else ""),
+          flush=True)
+    return 1 if failed else 0
+
+
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--blackbox":
+        return _blackbox_main(sys.argv[2:])
     if len(sys.argv) > 1 and sys.argv[1] == "--disk-faults":
         return _disk_fault_main(sys.argv[2:])
     if len(sys.argv) > 1 and sys.argv[1] == "--superstep":
