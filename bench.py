@@ -224,11 +224,47 @@ def _child_main() -> None:
     # cost real deployments pay (<3% bound is test-pinned), and the
     # final Observatory snapshot lands in the JSON tail so cross-round
     # comparisons stop hand-collecting fsync/pipeline fields
-    sampler = observatory = None
+    sampler = observatory = slo = tuner = None
     if os.environ.get("RA_TPU_BENCH_TELEMETRY", "1") != "0":
         from ra_tpu.telemetry import Observatory, TelemetrySampler
         sampler = TelemetrySampler(eng)
         observatory = Observatory.for_engine(eng, sampler=sampler)
+        # SLO engine over the Observatory ring (ISSUE 9): periodic
+        # snapshots during the measured phases feed the ring, and the
+        # verdicts land in the JSON tail next to the phase attribution
+        from ra_tpu.slo import SloEngine
+        slo = SloEngine(observatory)
+        if os.environ.get("RA_TPU_BENCH_AUTOTUNE") == "1":
+            # opt-in closed loop: the tuner ticks at snapshot cadence
+            # and its decisions/knobs ride the tail.  Knobs the loop
+            # cannot APPLY are frozen via bounds: cmds_per_step is
+            # baked into the staged payload buffers, and superstep_k
+            # is only re-stageable on the fused path — a recorded
+            # decision that changes nothing measured would make the
+            # tail's knob stamps a lie.  The wal batch interval always
+            # applies live (set_batch_interval_ms).
+            from ra_tpu.autotune import AutoTuner
+            k0 = max(1, superstep_k)
+            tuner = AutoTuner(slo, observatory,
+                              durability=eng._dur if durable else None,
+                              bounds={"cmds_per_step": (cmds, cmds),
+                                      "superstep_k": (1, 64)
+                                      if superstep_k else (k0, k0)},
+                              knobs={"superstep_k": k0,
+                                     "cmds_per_step": cmds})
+
+    # window-cadence observation: a host-only dict merge (the sources
+    # read harvested sampler data + host counters — no device sync, so
+    # the measured pipeline is untouched; the <3% A/B pin covers it)
+    _obs_last = [0.0]
+
+    def maybe_observe() -> None:
+        now = time.perf_counter()
+        if observatory is not None and now - _obs_last[0] >= 0.2:
+            _obs_last[0] = now
+            observatory.snapshot()
+            if tuner is not None:
+                tuner.tick()
 
     if durable:
         # host-resident batches: the per-step H2D copy is the honest
@@ -272,6 +308,7 @@ def _child_main() -> None:
             n += 1
             if n % 20 == 0:
                 eng.block_until_ready()  # ra04-ok: 20-step window boundary
+                maybe_observe()
                 if time.perf_counter() - t_start >= seconds:
                     break
         eng.block_until_ready()
@@ -291,6 +328,7 @@ def _child_main() -> None:
             readbacks.append(eng.committed_lanes_async())
             while len(readbacks) > window:
                 np.asarray(readbacks.popleft())  # ra04-ok: window boundary
+            maybe_observe()
         eng.block_until_ready()
         return n, time.perf_counter() - t_start
 
@@ -321,13 +359,26 @@ def _child_main() -> None:
         driver.drain()
         start_committed = eng.committed_total()
         dispatches = 0
+        cur_k = superstep_k
+        steps = 0
         t0 = time.perf_counter()
         while time.perf_counter() - t0 < measure_s:
+            if tuner is not None and \
+                    tuner.knobs["superstep_k"] != cur_k:
+                # apply the controller's decision BETWEEN dispatches:
+                # restage the block at the new fusion depth (broadcast
+                # views — no payload copy)
+                cur_k = tuner.knobs["superstep_k"]
+                n_new_blk = np.broadcast_to(
+                    n_new_host, (cur_k,) + n_new_host.shape)
+                pay_blk = np.broadcast_to(
+                    pay_host, (cur_k,) + pay_host.shape)
             driver.submit(n_new_blk, pay_blk)
             dispatches += 1
+            steps += cur_k
+            maybe_observe()
         driver.drain()  # run-end window boundary
         elapsed = time.perf_counter() - t0
-        steps = dispatches * superstep_k
     else:
         start_committed = eng.committed_total()
         steps, elapsed = run_single_step(measure_s)
@@ -427,6 +478,7 @@ def _child_main() -> None:
             truncated += 1
         else:
             lats.append(elapsed_sample * obs_step / steps_done)
+        maybe_observe()  # sample boundary: feed the SLO ring a window
     lats.sort()
     p50 = lats[len(lats) // 2] if lats else -1.0
     p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] if lats else -1.0
@@ -473,9 +525,14 @@ def _child_main() -> None:
             "wal_shards": wal_shards,
             "wal": overview["wal"]} if durable else {}),
         # the unified snapshot (telemetry summary + sampler health +
-        # pipeline + per-shard WAL stats) — ISSUE 6's one-stop tail
+        # pipeline + per-shard WAL stats + phase attribution) —
+        # ISSUE 6's one-stop tail, ISSUE 9's phases ride inside it
         **({"observatory": observatory.snapshot()}
            if observatory is not None else {}),
+        # SLO verdicts over the run's ring windows (ISSUE 9) + the
+        # opt-in autotuner's decisions/knobs
+        **({"slo": slo.evaluate()} if slo is not None else {}),
+        **({"autotune": tuner.overview()} if tuner is not None else {}),
     }))
     sys.stdout.flush()
     # join the WAL plane's worker/supervisor threads before interpreter

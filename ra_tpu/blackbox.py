@@ -109,6 +109,13 @@ EVENT_REGISTRY = {
     "srv.crash": "a server shell crashed out of the node event loop",
     # -- nemesis -------------------------------------------------------
     "nemesis.op": "chaos schedule executed one op",
+    # -- SLO autotuner (ra_tpu/autotune.py, ISSUE 9) -------------------
+    "tune.decision": "autotuner changed a knob (knob, old->new, "
+                     "triggering phase + objective) — RA07: no silent "
+                     "knob turns",
+    "tune.freeze": "autotuner entered a freeze (active FaultPlan/"
+                   "DiskFaultPlan or a fresh incident): decisions "
+                   "suspended",
     # -- recorder meta -------------------------------------------------
     "bb.dump": "post-mortem bundle written",
     "bb.recover": "recovery stamped a join-able recovery report",

@@ -243,6 +243,11 @@ class _WalShard:
             # horizon advanced — ra_trace joins this against
             # engine.submit by step range (docs/INTERNALS.md §10)
             record("engine.confirm", shard=self.idx, step=hi)
+            # commit_e2e phase stamp: a step is end-to-end durable when
+            # EVERY shard's horizon covers it (the merged confirm rule
+            # the commit quorum gates on) — pop matured submit stamps
+            # at the moment the laggiest shard advances
+            self.bridge._note_confirmed_steps()
             arr = self._appended.get(hi)
             if arr is not None:
                 # exact durable tail as of step hi — then re-apply the
@@ -276,8 +281,13 @@ class _WalShard:
             self._maybe_resend()
             if job is None:
                 continue
+            step, aux, t_enq = job
+            # queue_wait phase stamp: how long the submitted step sat
+            # in this shard's encode queue before a worker picked it up
+            self.bridge.phases.note("queue_wait",
+                                    time.monotonic() - t_enq)
             try:
-                self._process(*job)
+                self._process(step, aux)
             except Exception as exc:  # noqa: BLE001 — surfaced to callers
                 record("engine.crash", shard=self.idx,
                        error=repr(exc)[:200])
@@ -294,6 +304,7 @@ class _WalShard:
 
     def _process(self, step: int, aux: dict) -> None:
         lo, hi_l = self.lo, self.hi
+        t_enc = time.monotonic()
         with trace.span("wal.encode", "wal", shard=self.idx, step=step):
             # documented readback point: this worker runs one step
             # behind dispatch, so the device values are ready (or the
@@ -309,6 +320,9 @@ class _WalShard:
             r1 = int(csum[-1])
             flat = np.asarray(aux["flat_rows"][r0:r1])
             blk = encode_block_flat(hi, n_app, n_acc, flat, lane_lo=lo)
+        # wal_encode phase stamp: readback pull + encode + CRC for one
+        # step's block on this shard (runs off the dispatch thread)
+        self.bridge.phases.note("wal_encode", time.monotonic() - t_enc)
         n_s = hi_l - lo
         k = aux["flat_rows"].shape[0] // max(1, self.bridge.n_lanes)
         item = flat.dtype.itemsize * (flat.shape[-1] if flat.ndim > 1
@@ -410,11 +424,21 @@ class EngineDurability:
         self._cond = threading.Condition()
         self.counters: dict = {f: 0 for f in ENGINE_WAL_FIELDS}
         self.step_seq = 0
+        # phase-resolved latency attribution (ISSUE 9): one accumulator
+        # for the whole durable plane — the engine adopts it on attach,
+        # every WAL shard feeds its fsync/confirm stamps into it, and
+        # the bridge stamps queue/encode/e2e edges itself
+        from ..telemetry import PhaseStats
+        self.phases = PhaseStats()
+        #: step -> monotonic submit stamp; popped when the MERGED
+        #: confirm horizon covers the step (the commit_e2e phase)
+        self._submit_ts: dict = {}
         wal_kwargs = dict(sync_mode=sync_mode,
                           write_strategy=write_strategy,
                           max_size=wal_max_size,
                           max_batch_bytes=wal_batch_bytes,
                           max_batch_interval_ms=wal_batch_interval_ms,
+                          phase_stats=self.phases,
                           # every shard's post-mortem bundles land at
                           # the BRIDGE's data dir, not one per shard
                           blackbox_dir=data_dir)
@@ -577,9 +601,44 @@ class EngineDurability:
         prev = prev_hi.astype(np.int32)
         with self._cond:
             self.step_seq = step_seq
+            self._submit_ts.clear()  # replay steps are not e2e samples
             for sh in self._shards:
                 sh.confirm_upto = prev[sh.lo:sh.hi].copy()
                 sh.confirmed_step = step_seq
+
+    # -- phase attribution / live tunables ---------------------------------
+
+    def _note_confirmed_steps(self) -> None:
+        """Pop submit stamps the MERGED confirm horizon now covers and
+        record their commit_e2e samples (called from a shard's WAL
+        notify path with the bridge cond held — it is an RLock)."""
+        with self._cond:
+            m = min(sh.confirmed_step for sh in self._shards)
+            if not self._submit_ts:
+                return
+            now = time.monotonic()
+            for s in [s for s in self._submit_ts if s <= m]:
+                self.phases.note("commit_e2e",
+                                 now - self._submit_ts.pop(s))
+            # a dead shard freezes the merged horizon: stamps would
+            # otherwise pile up for the rest of the process — bound the
+            # table; dropped stamps just lose samples, never accounting
+            while len(self._submit_ts) > 4096:
+                self._submit_ts.pop(min(self._submit_ts))
+
+    def batch_interval_ms(self) -> float:
+        """The live WAL group-commit wait budget (uniform across
+        shards — the engine_pipeline overview stamps this, rule RA07)."""
+        return float(self._shards[0].wal.max_batch_interval_ms)
+
+    def set_batch_interval_ms(self, ms: float) -> None:
+        """Autotuner hook: retarget every shard's group-commit wait
+        budget.  The WAL batch threads read the interval per group, so
+        the change lands at the next batch — no restart, no flush."""
+        ms = max(0.0, float(ms))
+        for sh in self._shards:
+            sh.wal.max_batch_interval_ms = ms
+        self._bb_config["wal_batch_interval_ms"] = ms
 
     # -- submit path (engine dispatch thread — must never host-sync) --------
 
@@ -587,11 +646,13 @@ class EngineDurability:
         """Queue one step's device aux for off-thread encode + WAL write
         on every shard.  No host sync happens here: the shard workers
         pull the compacted readback when the device values are ready."""
+        t_sub = time.monotonic()
         with self._cond:
             self.step_seq += 1
             step = self.step_seq
+            self._submit_ts[step] = t_sub
             for sh in self._shards:
-                sh._jobs.append((step, aux))
+                sh._jobs.append((step, aux, t_sub))
                 sh.unprocessed += 1
             self._cond.notify_all()
         # host-side boundary event only (step counters — no device
@@ -619,13 +680,15 @@ class EngineDurability:
         subs = []
         for j in range(k):
             subs.append({key: aux[key][j] for key in self._BLOCK_KEYS})
+        t_sub = time.monotonic()
         with self._cond:
             step_lo = self.step_seq + 1
             for sub in subs:
                 self.step_seq += 1
                 step = self.step_seq
+                self._submit_ts[step] = t_sub
                 for sh in self._shards:
-                    sh._jobs.append((step, sub))
+                    sh._jobs.append((step, sub, t_sub))
                     sh.unprocessed += 1
             step_hi = self.step_seq
             self._cond.notify_all()

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import time
 from typing import Any, NamedTuple, Optional
 
 import jax
@@ -759,6 +760,12 @@ class LockstepEngine:
         self._dur = None
         self._driver = None
         self._telemetry = None  # attached TelemetrySampler (or None)
+        # phase-resolved latency attribution (ISSUE 9): host-side
+        # monotonic stamps at the dispatch/staging edges land here; a
+        # durability bridge brings its own accumulator (shared with the
+        # WAL shards) and attach_durability adopts it
+        from ..telemetry import PhaseStats
+        self.phases = PhaseStats()
         #: host-side dispatch-pipeline bookkeeping (ENGINE_PIPELINE_FIELDS)
         self.pipeline_counters = {f: 0 for f in ENGINE_PIPELINE_FIELDS}
         self._superstep_k_last = 0
@@ -806,6 +813,10 @@ class LockstepEngine:
         per-lane WAL-confirm horizon before each step and receives each
         step's append outcome after dispatch."""
         self._dur = dur
+        # one attribution plane per engine: the bridge's accumulator is
+        # already wired into its WAL shards, so the engine adopts it —
+        # staging/dispatch stamps and fsync/confirm stamps merge
+        self.phases = dur.phases
         self._compile_step(durable=True)
 
     # -- driving -----------------------------------------------------------
@@ -1221,6 +1232,12 @@ class LockstepEngine:
         # host-side pipeline counters
         out["pipeline"] = {
             "superstep_k": self._superstep_k_last,
+            # the autotuner-tunable knobs ride the overview (RA07: no
+            # silent knob turns — knob value next to the rates it moves)
+            "cmds_per_step": self.max_step_cmds,
+            "wal_max_batch_interval_ms": (
+                self._dur.batch_interval_ms()
+                if self._dur is not None else -1.0),
             "dispatch_ahead": (self._driver.max_in_flight
                                if self._driver is not None else 0),
             "dispatches_in_flight": (self._driver.in_flight()
@@ -1277,9 +1294,14 @@ class DispatchAheadDriver:
 
     def _stage(self, n_new_blk, payloads_blk, elect_blk=None) -> None:
         put = jax.device_put
+        t0 = time.monotonic()
         n = put(np.asarray(n_new_blk, np.int32),
                 self.shardings.get("n_new"))
         p = put(np.asarray(payloads_blk), self.shardings.get("payloads"))
+        # host_staging phase stamp: the host-side encode + H2D submit
+        # cost of this block (device_put is async, so this is the edge
+        # the host pays, not the wire time — rule RA04: no sync here)
+        self.engine.phases.note("host_staging", time.monotonic() - t0)
         self.engine.pipeline_counters["blocks_staged"] += 1
         self._staged = (n, p, elect_blk)
 
@@ -1292,6 +1314,7 @@ class DispatchAheadDriver:
         return self._dispatch(prev) if prev is not None else None
 
     def _dispatch(self, blk):
+        t_sub = time.monotonic()
         aux = self.engine.superstep(blk[0], blk[1], elect_blk=blk[2])
         # the `+ 0` copy decouples the readback from buffer donation by
         # the next dispatch (same contract as committed_lanes_async)
@@ -1300,7 +1323,7 @@ class DispatchAheadDriver:
             h.copy_to_host_async()
         except AttributeError:  # pragma: no cover — older jax arrays
             pass
-        self._handles.append(h)
+        self._handles.append((t_sub, h))
         while len(self._handles) > self.max_in_flight:
             # window boundary: await the OLDEST dispatch's watermark.
             # Only a harvest that actually had to WAIT counts as a
@@ -1308,7 +1331,7 @@ class DispatchAheadDriver:
             # pipeline working, not blocking (the counter backs the
             # "window_syncs << dispatches" health rule, so it must
             # distinguish the two)
-            oldest = self._handles.popleft()
+            t0, oldest = self._handles.popleft()
             try:
                 waited = not oldest.is_ready()
             except AttributeError:  # pragma: no cover — older jax arrays
@@ -1316,6 +1339,12 @@ class DispatchAheadDriver:
             if waited:
                 self.engine.pipeline_counters["window_syncs"] += 1
             self.last_committed = np.asarray(oldest)
+            # device_dispatch phase stamp: submit -> the dispatch's
+            # committed watermark observed on the host, read at the
+            # pops the in-flight cap already performs (PR 5's async
+            # watermark readbacks — no NEW sync point is introduced)
+            self.engine.phases.note("device_dispatch",
+                                    time.monotonic() - t0)
         return h
 
     def drain(self) -> Optional[np.ndarray]:
@@ -1326,5 +1355,8 @@ class DispatchAheadDriver:
             blk, self._staged = self._staged, None
             self._dispatch(blk)
         while self._handles:
-            self.last_committed = np.asarray(self._handles.popleft())
+            t0, h = self._handles.popleft()
+            self.last_committed = np.asarray(h)
+            self.engine.phases.note("device_dispatch",
+                                    time.monotonic() - t0)
         return self.last_committed

@@ -181,7 +181,8 @@ class Wal:
                  max_batch_bytes: int = 0,
                  max_batch_interval_ms: float = 0.0,
                  segment_writer=None,
-                 blackbox_dir: Optional[str] = None) -> None:
+                 blackbox_dir: Optional[str] = None,
+                 phase_stats=None) -> None:
         """write_strategy (ra_log_wal.erl:66-96):
 
         * ``default`` — one write(2) for the batch, then the sync_mode
@@ -217,6 +218,11 @@ class Wal:
         self.sync_mode = sync_mode
         self.write_strategy = write_strategy
         self.max_size = max_size
+        #: optional telemetry.PhaseStats — the engine durability bridge
+        #: passes its accumulator so the WAL's fsync_wait and
+        #: confirm_publish edges join the phase attribution (ISSUE 9);
+        #: None (the classic plane default) costs nothing
+        self._phases = phase_stats
         self.max_batch_bytes = max_batch_bytes
         self.max_batch_interval_ms = max_batch_interval_ms
         #: bounded reservoir of recent durability-syscall latencies (s)
@@ -534,9 +540,16 @@ class Wal:
             notifiers = [(self._writers[uid].notify, uid, c)
                          for uid, c in confirms.items()
                          if uid in self._writers]
+        t_pub = time.monotonic() if notifiers else 0.0
         for notify, uid, (lo, hi, term) in notifiers:
             record("wal.confirm", uid=uid, lo=lo, hi=hi)
             notify(uid, lo, hi, term)
+        if notifiers and self._phases is not None:
+            # confirm_publish phase stamp: durability -> every writer's
+            # confirm callback returned (the fan-out the commit quorum
+            # waits behind)
+            self._phases.note("confirm_publish",
+                              time.monotonic() - t_pub)
         if deferred_sync:
             # sync_after_notify: durability syscall AFTER the confirms
             # (complete_batch with post-notify sync, ra_log_wal.erl:66-96)
@@ -638,6 +651,10 @@ class Wal:
         self.counters["sync_time_us"] += int(dt * 1e6)
         record("wal.fsync", ms=round(dt * 1000, 3),
                file=os.path.basename(self._file_path))
+        if self._phases is not None:
+            # fsync_wait phase stamp (the durability-syscall edge of
+            # the per-window budget attribution)
+            self._phases.note("fsync_wait", dt)
         with self._lock:
             # stats() iterates the reservoir from other threads; an
             # unguarded append would intermittently crash that read

@@ -151,6 +151,27 @@ TELEMETRY_FIELDS = (
     "leader_age", "commit_lag", "apply_lag", "stall_steps", "steps",
 )
 
+#: phase-resolved latency attribution (ISSUE 9): the host-side edges of
+#: the lane-engine path, each a monotonic-stamp latency sample fed into
+#: a ``telemetry.PhaseStats`` accumulator (bounded reservoir + log2-ms
+#: histogram + cumulative ``total_ms`` per phase).  ``total_ms`` is
+#: MONOTONE, so differentiating it over the Observatory ring yields the
+#: per-window budget share of each phase — "where did this window's
+#: latency go" — which is exactly the autotuner's triggering-phase
+#: input.  Phases: ``host_staging`` host->device block staging in the
+#: dispatch-ahead driver, ``device_dispatch`` dispatch-submit to
+#: async-watermark-readback-observed (PR 5's step stamps; no new host
+#: syncs), ``queue_wait`` a submitted step waiting for its shard encode
+#: worker, ``wal_encode`` the off-thread readback+encode+CRC of one WAL
+#: block, ``fsync_wait`` the durability syscall, ``confirm_publish``
+#: fsync-to-confirm-notify fan-out, ``commit_e2e`` the full
+#: submit->all-shards-confirmed edge (the continuous commit-latency
+#: signal the `commit_p99_ms` SLO reads).
+PHASE_FIELDS = (
+    "host_staging", "device_dispatch", "queue_wait", "wal_encode",
+    "fsync_wait", "confirm_publish", "commit_e2e",
+)
+
 #: the on-device aggregation of TELEMETRY_FIELDS (lockstep's jitted
 #: telemetry summary): scalar rollups plus the fixed-size lag histogram
 #: and the lax.top_k offender slots.  ``stalled_lanes`` lanes at or
@@ -187,6 +208,7 @@ FIELD_REGISTRY = {
     "disk_faults": DISK_FAULT_FIELDS,
     "telemetry": TELEMETRY_FIELDS,
     "telemetry_summary": TELEMETRY_SUMMARY_FIELDS,
+    "phase": PHASE_FIELDS,
 }
 
 

@@ -110,6 +110,10 @@ class RaSystem:
             if superstep_k is None else superstep_k
         self.dispatch_ahead = defaults["dispatch_ahead"] \
             if dispatch_ahead is None else dispatch_ahead
+        #: the WAL group-commit wait budget this system was configured
+        #: with — an autotuner-tunable knob, so it is stamped in the
+        #: engine_pipeline overview next to superstep_k (rule RA07)
+        self.wal_max_batch_interval_ms = wal_max_batch_interval_ms
         os.makedirs(data_dir, exist_ok=True)
         self.segment_max_count = segment_max_count
         self._logs: dict[str, DurableLog] = {}
@@ -411,6 +415,10 @@ class RaSystem:
                             for uid, log in self._logs.items()},
                 "directory": self.directory.overview(),
                 "counters": self.counters(),
-                "engine_pipeline": {"superstep_k": self.superstep_k,
-                                    "dispatch_ahead": self.dispatch_ahead},
+                "engine_pipeline": {
+                    "superstep_k": self.superstep_k,
+                    "dispatch_ahead": self.dispatch_ahead,
+                    "wal_max_batch_interval_ms":
+                        self.wal_max_batch_interval_ms,
+                },
             }

@@ -32,6 +32,7 @@ import json
 import logging
 import os
 import re
+import threading
 import time
 from typing import Any, Callable, Optional
 
@@ -50,6 +51,82 @@ DEFAULT_CADENCE_STEPS = 64
 #: a lane is flagged STALLED once it has sat this many consecutive
 #: rounds with a commit backlog and zero commit progress
 DEFAULT_STALL_THRESHOLD = 8
+
+#: log2 millisecond buckets for phase histograms: bucket 0 = <1ms,
+#: bucket b = < 2^b ms, last bucket absorbs the tail (~9 hours)
+PHASE_HIST_BUCKETS = 16
+
+
+class PhaseStats:
+    """Phase-resolved latency attribution (ISSUE 9): where did a
+    window's latency budget go — host staging, device dispatch, WAL
+    encode, queue wait, fsync wait, confirm publish?
+
+    One accumulator per engine (the durable bridge and the attached
+    engine share one; each WAL shard feeds the same object).  A sample
+    is a pair of ``time.monotonic()`` stamps taken at HOST-side edges
+    of the dispatch/durability path — never a device sync, so the
+    attribution is always-on at zero pipeline cost (lint rule RA04
+    gates the stamp path like the sampler tick).
+
+    Per phase (``metrics.PHASE_FIELDS``): a bounded latency reservoir
+    (p50/p99/max), a log2-ms histogram, a sample count, and a MONOTONE
+    cumulative ``total_ms``.  Differentiating ``total_ms`` over the
+    Observatory time-series ring yields each phase's per-window share
+    of the budget — the SLO engine's and autotuner's triggering-phase
+    input."""
+
+    def __init__(self, *, reservoir: int = 512) -> None:
+        from .metrics import PHASE_FIELDS
+        self._fields = PHASE_FIELDS
+        self._lock = threading.Lock()
+        self._res = {p: collections.deque(maxlen=reservoir)
+                     for p in PHASE_FIELDS}
+        self._hist = {p: [0] * PHASE_HIST_BUCKETS for p in PHASE_FIELDS}
+        self._count = {p: 0 for p in PHASE_FIELDS}
+        self._total_ms = {p: 0.0 for p in PHASE_FIELDS}
+        #: samples addressed to an unknown phase (registry mismatch —
+        #: the telemetry_dropped discipline applied to phases)
+        self.dropped = 0
+
+    def note(self, phase: str, dt_s: float) -> None:
+        """Record one phase sample of ``dt_s`` seconds.  Called from
+        the dispatch thread, WAL batch threads and encode workers —
+        one lock + a deque append + int/float adds, nothing that can
+        block on the device (rule RA04)."""
+        if phase not in self._count:
+            self.dropped += 1
+            return
+        ms = dt_s * 1000.0
+        b = min(PHASE_HIST_BUCKETS - 1,
+                max(0, int(ms).bit_length()))
+        with self._lock:
+            self._res[phase].append(ms)
+            self._hist[phase][b] += 1
+            self._count[phase] += 1
+            self._total_ms[phase] += ms
+
+    def overview(self) -> dict:
+        """Per-phase ``{count, total_ms, p50_ms, p99_ms, max_ms,
+        hist}`` — what the Observatory engine source embeds (the
+        scalars flatten into the exposition/ring; the hist renders as
+        a labelled Prometheus bucket family)."""
+        out: dict = {}
+        with self._lock:
+            for p in self._fields:
+                lats = sorted(self._res[p])
+                n = len(lats)
+                out[p] = {
+                    "count": self._count[p],
+                    "total_ms": round(self._total_ms[p], 3),
+                    "p50_ms": round(lats[n // 2], 3) if n else -1.0,
+                    "p99_ms": round(lats[min(n - 1, int(n * 0.99))], 3)
+                    if n else -1.0,
+                    "max_ms": round(lats[-1], 3) if n else -1.0,
+                    "hist": list(self._hist[p]),
+                }
+        out["dropped"] = self.dropped
+        return out
 
 
 def _host_scalar(x) -> Any:
@@ -275,20 +352,31 @@ class Observatory:
         def engine_src() -> dict:
             out: dict = {"lanes": engine.n_lanes,
                          "members": engine.n_members}
+            # the autotuner-tunable knobs are stamped NEXT TO the rates
+            # they move (rule RA07: no silent knob turns — every knob
+            # the controller may touch is in this overview, so a ring
+            # window always shows knob value + its effect together)
+            dur = engine._dur
             out["pipeline"] = {
                 "superstep_k": engine._superstep_k_last,
+                "cmds_per_step": engine.max_step_cmds,
+                "wal_max_batch_interval_ms": (
+                    dur.batch_interval_ms() if dur is not None else -1.0),
                 "dispatches_in_flight": (engine._driver.in_flight()
                                          if engine._driver is not None
                                          else 0),
                 **engine.pipeline_counters,
             }
+            phases = getattr(engine, "phases", None)
+            if phases is not None:
+                out["phases"] = phases.overview()
             s = sampler or getattr(engine, "_telemetry", None)
             if s is not None:
                 out["sampler"] = dict(s.counters)
                 if s.last is not None:
                     out["telemetry"] = s.last
-            if engine._dur is not None:
-                out["wal"] = engine._dur.wal_overview()
+            if dur is not None:
+                out["wal"] = dur.wal_overview()
             return out
 
         obs.add_source("engine", engine_src)
@@ -313,8 +401,12 @@ class Observatory:
         if system is not None:
             obs.add_source("system", lambda: {
                 "counters": system.counters(),
-                "engine_pipeline": {"superstep_k": system.superstep_k,
-                                    "dispatch_ahead": system.dispatch_ahead},
+                "engine_pipeline": {
+                    "superstep_k": system.superstep_k,
+                    "dispatch_ahead": system.dispatch_ahead,
+                    "wal_max_batch_interval_ms": getattr(
+                        system, "wal_max_batch_interval_ms", -1.0),
+                },
             })
         if counters is not None:
             obs.add_source("counters", lambda: {
@@ -355,35 +447,85 @@ class Observatory:
         """The (ts, flat-numeric-dict) time series, oldest first."""
         return list(self._ring)
 
-    def window_rates(self) -> dict:
-        """Per-second deltas of every numeric key between the last two
-        snapshots.  Monotone counters (committed_total, dispatches,
-        wal writes...) read as true rates; gauges read as drift —
-        callers pick their keys from the field registry
-        (docs/OBSERVABILITY.md).
+    #: flat-key patterns whose values are MONOTONE counters: a negative
+    #: window delta on one of these is a counter reset (engine restart,
+    #: a fresh bridge adopting the Observatory's source names) and must
+    #: yield an OMITTED rate, never a negative one — a burn-rate
+    #: evaluator fed a huge negative "rate" across a restart window
+    #: would mis-verdict every objective that reads it.  Suffix-
+    #: anchored where a looser match would swallow a gauge: plain
+    #: substring "dispatches" also matches the dispatches_in_flight
+    #: DEPTH gauge, whose negative drift (pipeline draining) is real
+    #: signal a consumer must keep seeing.
+    _MONOTONE_SUFFIXES = (
+        "committed_total", "dispatches", "inner_steps", "_writes",
+        "batches", "_syncs", "events", "_count", "total_ms",
+        "blocks_staged", "seq", "telemetry_steps", "wal_files",
+        "window_syncs", "leader_changes", "bytes_written",
+    )
+    _MONOTONE_INFIXES = (
+        "bytes", "samples_", "encoded_", "readback_", "rpc_",
+        "faults_", "elections_",
+    )
+
+    @classmethod
+    def _is_monotone_key(cls, key: str) -> bool:
+        return any(key.endswith(s) for s in cls._MONOTONE_SUFFIXES) \
+            or any(h in key for h in cls._MONOTONE_INFIXES)
+
+    def window_rates(self, span: int = 1, end: int = -1,
+                     keys=None) -> dict:
+        """Per-second deltas of every numeric key between ring entries
+        ``span`` windows apart (default: the last two snapshots).
+        Monotone counters (committed_total, dispatches, wal writes...)
+        read as true rates; gauges read as drift — callers pick their
+        keys from the field registry (docs/OBSERVABILITY.md).
+
+        ``span`` > 1 rates over a wider window (``ring[end-span]`` ->
+        ``ring[end]``) — the SLO engine's multi-window burn-rate input;
+        ``end`` indexes the newer entry (negative from the newest).
+
+        Counter-reset guard: a key the monotone-hint list recognises
+        whose delta went NEGATIVE (an engine restart zeroed its
+        counters mid-ring) is omitted — absent beats a bogus negative
+        rate, same contract as the stale-sample omission below.
 
         ``engine_telemetry_*`` keys rate over the SAMPLER's own sample
         window (the embedded sample's ``ts``): snapshots taken faster
         than the harvest cadence re-embed the same sample, and the
         snapshot-ts delta would read a running engine as 0 cmds/s.
         With no fresh sample between the two snapshots those keys are
-        omitted entirely — absent beats misleadingly zero."""
-        if len(self._ring) < 2:
+        omitted entirely — absent beats misleadingly zero.
+
+        ``keys`` restricts the computation to an iterable of flat keys
+        — the SLO engine's per-objective evaluation sweeps many ring
+        windows per verdict, and differentiating every key of every
+        window would put O(windows x keys) dict work on the snapshot
+        path for the handful it reads."""
+        span = max(1, int(span))
+        n = len(self._ring)
+        if end < 0:
+            end = n + end
+        lo = end - span
+        if lo < 0 or end >= n or n < 2:
             return {}
-        (t0, a), (t1, b) = self._ring[-2], self._ring[-1]
+        (t0, a), (t1, b) = self._ring[lo], self._ring[end]
         dt = max(t1 - t0, 1e-9)
         ts_key = "engine_telemetry_ts"
         tdt = (b[ts_key] - a[ts_key]
                if ts_key in a and ts_key in b else 0.0)
         out: dict = {}
-        for k in b:
-            if k not in a:
+        for k in (b if keys is None else keys):
+            if k not in a or k not in b:
                 continue
+            delta = b[k] - a[k]
+            if delta < 0 and self._is_monotone_key(k):
+                continue  # counter reset across an engine restart
             if k.startswith("engine_telemetry_"):
                 if tdt > 1e-9 and k != ts_key:
-                    out[k] = round((b[k] - a[k]) / tdt, 4)
+                    out[k] = round(delta / tdt, 4)
                 continue
-            out[k] = round((b[k] - a[k]) / dt, 4)
+            out[k] = round(delta / dt, 4)
         return out
 
     def series(self, key: str) -> list:
@@ -435,6 +577,22 @@ class Observatory:
                         lines.append(
                             'ra_tpu_engine_%s{lane="%d",rank="%d"} %s'
                             % (field, lane, rank, _fmt_num(vals[rank])))
+        phases = snap.get("engine", {}).get("phases") or {}
+        for pname in sorted(phases):
+            ph = phases[pname]
+            if not isinstance(ph, dict):
+                continue
+            hist = ph.get("hist")
+            if not hist:
+                continue
+            # log2-ms buckets: bucket 0 = <1ms, bucket b = <2^b ms
+            cum = 0
+            for b, count in enumerate(hist):
+                cum += count
+                le = "+Inf" if b == len(hist) - 1 else str(2 ** b)
+                lines.append(
+                    'ra_tpu_engine_phase_ms_bucket{phase="%s",le="%s"}'
+                    ' %d' % (pname, le, cum))
         return "\n".join(lines) + "\n"
 
     def to_jsonl(self, path: str, *, max_lines: int = 512) -> dict:

@@ -364,6 +364,15 @@ RECORDER.add_source(
     lambda: [p.overview() for p in list(_LIVE_PLANS)])
 
 
+def live_fault_plans() -> list:
+    """The transport FaultPlans still alive in this process — what the
+    post-mortem bundle source embeds, and the autotuner's freeze guard
+    reads ("hard freeze while any FaultPlan is active": a controller
+    must never chase chaos-injected latency with knob turns).  Weakly
+    tracked: a plan with no remaining strong referent drops out."""
+    return list(_LIVE_PLANS)
+
+
 class FaultPlan:
     """Seeded fault schedule consulted by the transport.
 
@@ -401,6 +410,21 @@ class FaultPlan:
         _LIVE_PLANS.add(self)  # post-mortem bundles name active plans
 
     # -- schedule control ---------------------------------------------------
+
+    def quiet(self) -> bool:
+        """True when this plan can no longer inject anything: every
+        spec carries zero probabilities and no partition is standing.
+        A healed partition-only plan, or an all-defaults plan, is
+        quiet — the autotuner's freeze guard reads this, because a
+        plan object pinned by a router after the chaos exercise ended
+        must not freeze the controller for the rest of the process
+        (liveness is not activity)."""
+        if self.partitioned:
+            return False
+        specs = [self.default, *self.by_class.values(),
+                 *self.by_peer.values(), *self.by_peer_class.values()]
+        return all(s.drop == 0 and s.delay == 0 and s.duplicate == 0
+                   and s.reorder == 0 for s in specs)
 
     def partition(self, peer: str) -> None:
         self.partitioned.add(peer)

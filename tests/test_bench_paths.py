@@ -90,13 +90,23 @@ def test_child_superstep_mode_contract():
 def test_child_superstep_durable_mode_contract():
     """Fused dispatches over the durable engine: confirms stay
     fsync-gated (the WAL stats ride along) and the mode completes with
-    a sane latency distribution."""
+    a sane latency distribution.  Autotune opt-in rides along (ISSUE
+    9): knobs the loop cannot apply are FROZEN via bounds — the tail's
+    knob stamps must describe the measured dispatches — and any K the
+    controller picked is restaged live by the fused loop."""
     doc = run_child({"RA_TPU_BENCH_SUPERSTEP": "4",
-                     "RA_TPU_BENCH_DURABLE": "1"})
+                     "RA_TPU_BENCH_DURABLE": "1",
+                     "RA_TPU_BENCH_AUTOTUNE": "1"})
     assert doc["value"] > 0
     assert doc["durable"] is True and doc["superstep_k"] == 4
     assert doc["pipeline"]["superstep_dispatches"] > 0
     assert "wal" in doc
+    tun = doc["autotune"]
+    assert tun["knobs"]["cmds_per_step"] == 8  # frozen to the env cmds
+    assert tun["knobs"]["superstep_k"] >= 1
+    # inner_steps must agree with whatever K sequence really ran (a
+    # decision the loop did not apply would break this bookkeeping)
+    assert doc["pipeline"]["inner_steps"] >= doc["steps"]
 
 
 def test_superstep_flag_sets_env():
@@ -224,6 +234,71 @@ def test_bench_tail_carries_observatory_snapshot():
     # pipeline counters ride in the snapshot too (the SLO-autotuner
     # substrate: rate fields next to the knobs that move them)
     assert eng["pipeline"]["dispatches"] > 0
+
+
+def test_bench_tail_carries_slo_and_phase_attribution():
+    """ISSUE 9: the durable tail stamps the SLO verdicts (evaluated
+    over the run's own ring windows) and the phase attribution rides
+    the Observatory snapshot — budget decomposition + objective health
+    land in the same artifact the rounds compare."""
+    doc = run_child({"RA_TPU_BENCH_DURABLE": "1",
+                     "RA_TPU_BENCH_WAL_SHARDS": "2",
+                     "RA_TPU_BENCH_SECONDS": "1.0"})
+    objs = doc["slo"]["objectives"]
+    for name in ("commit_p99_ms", "fsync_p99_ms", "cmds_per_s"):
+        assert name in objs
+        assert objs[name]["verdict"] in ("ok", "breach", "alert",
+                                         "no_data")
+        assert "burn_fast" in objs[name]
+    # the run produced real windows and real verdicts (a 1s durable
+    # run commits plenty; commit_e2e always samples on this path)
+    assert doc["slo"]["windows"] >= 2
+    assert objs["commit_p99_ms"]["value"] is not None
+    ph = doc["observatory"]["engine"]["phases"]
+    for p in ("queue_wait", "wal_encode", "fsync_wait",
+              "confirm_publish", "commit_e2e"):
+        assert ph[p]["count"] > 0, p
+    assert ph["dropped"] == 0
+    # the tunable knobs are stamped next to the rates they move (RA07)
+    pipe = doc["observatory"]["engine"]["pipeline"]
+    assert pipe["cmds_per_step"] == 8
+    assert pipe["wal_max_batch_interval_ms"] >= 0.0
+
+
+def test_bench_diff_smoke_flags_regressions(tmp_path):
+    """tools/bench_diff.py consumes the live tail format (pinned here
+    so the format cannot drift out from under it): same-doc compare is
+    clean/exit 0; a degraded doc flags value + p99 regressions and
+    exits 1."""
+    doc = run_child({})
+    a = tmp_path / "old.json"
+    b = tmp_path / "new.json"
+    a.write_text(json.dumps(doc))
+    b.write_text(json.dumps(doc))
+    diff_tool = os.path.join(REPO, "tools", "bench_diff.py")
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b),
+                        "--json"], capture_output=True, text=True,
+                       timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    res = json.loads(r.stdout)
+    assert res["rows_compared"] == 1 and res["regressions"] == 0
+    worse = dict(doc)
+    worse["value"] = doc["value"] * 0.5
+    worse["p99_commit_latency_ms"] = \
+        doc["p99_commit_latency_ms"] * 3 + 10
+    b.write_text(json.dumps(worse))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stdout
+    assert r.stdout.count("REGRESSION") == 2, r.stdout
+    # history capture records (BENCH_r*.json wrappers) unwrap too
+    wrapped = tmp_path / "hist.json"
+    wrapped.write_text(json.dumps(
+        {"n": 1, "cmd": "x", "rc": 0, "tail": "", "parsed": doc}))
+    r = subprocess.run([sys.executable, diff_tool, str(wrapped),
+                        str(a)], capture_output=True, text=True,
+                       timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
 
 
 def test_bench_telemetry_opt_out():

@@ -19,8 +19,8 @@ def _blocks():
         text = f.read()
     return re.findall(r"```python\n(.*?)```", text, re.DOTALL)
 
-def test_readme_has_four_python_blocks():
-    assert len(_blocks()) == 4
+def test_readme_has_five_python_blocks():
+    assert len(_blocks()) == 5
 
 def test_classic_quickstart_block(tmp_path):
     src = _blocks()[0]
@@ -65,6 +65,30 @@ def test_trace_quickstart_block():
         assert isinstance(ns["t"].summary(), dict)
     finally:
         trace.set_tracer(None)
+
+
+def test_slo_autotune_quickstart_block(tmp_path):
+    """The ISSUE 9 closed-loop block: SLO verdicts + phase attribution
+    + an autotuner ticking a real durable engine, as documented."""
+    src = _blocks()[4]
+    assert "SloEngine" in src and "AutoTuner" in src
+    # patch only path + size; the loop runs exactly as documented
+    src = _patch(src, '"/tmp/ra_slo_demo", 1024', "demo_dir, 64")
+    ns: dict = {"demo_dir": str(tmp_path / "slo_demo")}
+    try:
+        exec(compile(src, "README.md[slo]", "exec"), ns)  # noqa: S102
+        verdicts = ns["slo"].evaluate()["objectives"]
+        assert set(verdicts) == {"commit_p99_ms", "fsync_p99_ms",
+                                 "cmds_per_s"}
+        ns["eng"]._dur.flush_all()  # settle async confirms -> e2e samples
+        snap = ns["obs"].snapshot()
+        assert snap["engine"]["phases"]["commit_e2e"]["count"] > 0
+        assert "autotune" in snap and "slo" in snap
+    finally:
+        if "obs" in ns:
+            ns["obs"].close()
+        if "eng" in ns:
+            ns["eng"].close()
 
 
 def test_telemetry_quickstart_block(tmp_path):

@@ -419,6 +419,88 @@ def test_checker_gates_recorder_emit_path(tmp_path):
     assert "dump" not in r.stdout
 
 
+def test_checker_enforces_autotune_contract(tmp_path):
+    """RA07 (ISSUE 9): TUNABLE_KNOBS must be stamped in the
+    engine_pipeline overview (telemetry.py next to the file) and
+    documented in docs/OBSERVABILITY.md; a knob-mutating function
+    without a registered record(...) event is a silent knob turn.
+    Applies to files named autotune.py only."""
+    (tmp_path / "telemetry.py").write_text(
+        'PIPE = {"superstep_k": 1}\n')
+    d = tmp_path / "docs"
+    d.mkdir()
+    (d / "OBSERVABILITY.md").write_text("`superstep_k` is documented\n")
+    (tmp_path / "blackbox.py").write_text(
+        'EVENT_REGISTRY = {"tune.decision": "d"}\n')
+    bad = tmp_path / "autotune.py"
+    bad.write_text(textwrap.dedent("""\
+        from blackbox import record
+
+        TUNABLE_KNOBS = ("superstep_k", "zz_ghost_knob")
+
+        class T:
+            def good_set(self, v):
+                self.knobs["superstep_k"] = v
+                record("tune.decision", new=v)
+
+            def silent_set(self, v):
+                self.knobs["superstep_k"] = v      # RA07: no event
+
+            def unregistered_set(self, v):
+                self.superstep_k = v
+                record("zz.not.registered", new=v)  # RA07: bogus type
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    out = r.stdout
+    # ghost knob: not stamped in telemetry.py AND not documented
+    assert out.count("zz_ghost_knob") == 2, out
+    assert "not stamped in the" in out and "undocumented" in out
+    assert "silent_set" in out and "unregistered_set" in out
+    assert "good_set" not in out
+    assert out.count("RA07") == 4, out
+    # the same content under another module name is not gated
+    other = tmp_path / "controller.py"
+    other.write_text(bad.read_text())
+    r = run_lint(str(other))
+    assert "RA07" not in r.stdout
+
+
+def test_checker_gates_autotune_tick_path(tmp_path):
+    """RA04 extension: host syncs reachable from the controller's
+    tick() closure are flagged — the tuner runs between dispatches."""
+    (tmp_path / "telemetry.py").write_text("PIPE = {}\n")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text("nothing\n")
+    bad = tmp_path / "autotune.py"
+    bad.write_text(textwrap.dedent("""\
+        import numpy as np
+
+        class T:
+            def tick(self):
+                self._decide()
+                return self.handle.item()
+
+            def _decide(self):
+                return np.asarray(self.state.commit)
+
+            def overview(self):
+                return np.asarray(self.rings)  # not on the tick path
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA04") == 2, r.stdout
+    assert ".item()" in r.stdout and "np.asarray" in r.stdout
+    assert "overview" not in r.stdout
+
+
+def test_autotune_module_is_ra07_and_ra04_clean():
+    """The real controller passes both gates (covered by the repo-wide
+    run too; pinned separately so a regression names the rule)."""
+    r = run_lint(os.path.join(REPO, "ra_tpu", "autotune.py"))
+    assert "RA07" not in r.stdout and "RA04" not in r.stdout, r.stdout
+
+
 def test_blackbox_module_is_ra06_and_ra04_clean():
     """The real recorder and every instrumented module pass the gates
     (covered by the repo-wide run too; pinned so a regression names

@@ -1,0 +1,193 @@
+"""bench_diff — compare two bench JSON tails and flag regressions.
+
+The bench evidence (BENCH_r*.json history, bench.py child tails,
+bench_classic tails) is only useful if rounds are actually COMPARED;
+until now that comparison was a human eyeballing JSON.  This tool
+makes it mechanical: feed it two bench documents (old first) and it
+extracts every comparable row — a dict carrying ``value`` plus
+optional latency percentiles, found at the top level or nested under
+``detail`` — pairs rows by name, and flags:
+
+* **throughput regressions**: ``value`` dropped by more than the
+  noise bar (throughput is higher-is-better);
+* **latency regressions**: ``p99_commit_latency_ms`` /
+  ``p50_commit_latency_ms`` / ``p99_applied_latency_ms`` rose by more
+  than the bar (lower-is-better; -1 sentinels = not measured, skipped);
+* frontier ``points`` are compared per ``cmds_per_step``.
+
+The noise bar defaults to 10% — the builder-box numbers swing with
+host load (the BENCH_r02 vs r04 host-drift note), so a tight default
+would page on weather.  Cross-host comparisons are labelled: the tool
+prints both ``host`` stamps when they differ, since a regression
+verdict across different machines is evidence, not proof.
+
+Usage:
+    python tools/bench_diff.py OLD.json NEW.json [--noise-pct 10]
+                               [--json]
+
+Prints a human summary (or one JSON line with ``--json``) and exits
+1 when any regression was flagged, 0 otherwise — wired into
+tests/test_bench_paths.py so the tail format cannot drift out from
+under it.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+#: lower-is-better latency fields compared when present in both rows
+LATENCY_FIELDS = ("p50_commit_latency_ms", "p99_commit_latency_ms",
+                  "p50_applied_latency_ms", "p99_applied_latency_ms")
+
+
+def _is_row(d) -> bool:
+    return isinstance(d, dict) and isinstance(d.get("value"), (int, float))
+
+
+def extract_rows(doc: dict) -> dict:
+    """name -> comparable row.  A bench child tail is one row
+    (``headline``); a parent/BENCH_r*.json doc contributes its
+    top-level value plus every row-shaped entry under ``detail``;
+    frontier docs additionally expand ``points`` per cmds_per_step."""
+    rows: dict = {}
+
+    def add(name: str, row: dict) -> None:
+        if _is_row(row):
+            rows[name] = row
+        for i, p in enumerate(row.get("points") or []):
+            if _is_row(p):
+                rows[f"{name}/cmds{p.get('cmds_per_step', i)}"] = p
+
+    if _is_row(doc):
+        add("headline", doc)
+    detail = doc.get("detail")
+    if isinstance(detail, dict):
+        for key, sub in detail.items():
+            if _is_row(sub):
+                add(key, sub)
+    return rows
+
+
+def compare_rows(old: dict, new: dict, noise_pct: float) -> list:
+    """Per-metric comparison of one row pair -> finding dicts."""
+    bar = noise_pct / 100.0
+    out = []
+    ov, nv = float(old["value"]), float(new["value"])
+    if ov > 0:
+        delta = (nv - ov) / ov
+        out.append({"metric": "value", "old": ov, "new": nv,
+                    "delta_pct": round(100 * delta, 2),
+                    "regression": delta < -bar})
+    for f in LATENCY_FIELDS:
+        o, n = old.get(f), new.get(f)
+        if not isinstance(o, (int, float)) or \
+                not isinstance(n, (int, float)) or o <= 0 or n <= 0:
+            continue  # -1 = never measured; 0 = degenerate sample
+        delta = (n - o) / o
+        out.append({"metric": f, "old": o, "new": n,
+                    "delta_pct": round(100 * delta, 2),
+                    "regression": delta > bar})
+    return out
+
+
+def diff(old_doc: dict, new_doc: dict, noise_pct: float = 10.0) -> dict:
+    old_rows = extract_rows(old_doc)
+    new_rows = extract_rows(new_doc)
+    rows: dict = {}
+    regressions = 0
+    for name in sorted(set(old_rows) & set(new_rows)):
+        findings = compare_rows(old_rows[name], new_rows[name],
+                                noise_pct)
+        rows[name] = findings
+        regressions += sum(1 for f in findings if f["regression"])
+    hosts = (old_doc.get("host") or
+             (old_doc.get("detail") or {}).get("host"),
+             new_doc.get("host") or
+             (new_doc.get("detail") or {}).get("host"))
+    cross_host = (hosts[0] or {}).get("hostname") != \
+        (hosts[1] or {}).get("hostname") if all(hosts) else False
+    return {
+        "noise_pct": noise_pct,
+        "rows_compared": len(rows),
+        "rows_only_old": sorted(set(old_rows) - set(new_rows)),
+        "rows_only_new": sorted(set(new_rows) - set(old_rows)),
+        "regressions": regressions,
+        "cross_host": cross_host,
+        "rows": rows,
+    }
+
+
+def _render(result: dict) -> str:
+    lines = [f"bench_diff  rows={result['rows_compared']} "
+             f"noise_bar={result['noise_pct']:g}% "
+             f"regressions={result['regressions']}"]
+    if result["cross_host"]:
+        lines.append("NOTE    different hosts — verdicts are "
+                     "evidence, not proof")
+    for name, findings in result["rows"].items():
+        for f in findings:
+            flag = " <<< REGRESSION" if f["regression"] else ""
+            lines.append(
+                f"{name:24s} {f['metric']:24s} "
+                f"{f['old']:>12g} -> {f['new']:>12g}  "
+                f"{f['delta_pct']:+.1f}%{flag}")
+    for name in result["rows_only_old"]:
+        lines.append(f"{name:24s} only in OLD (row dropped?)")
+    for name in result["rows_only_new"]:
+        lines.append(f"{name:24s} only in NEW")
+    return "\n".join(lines)
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        # a bench stdout capture: take the last parsable JSON line
+        doc = None
+        for line in reversed(text.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                doc = json.loads(line)
+                break
+        if doc is None:
+            raise
+    # the BENCH_r*.json round history wraps the bench doc in a capture
+    # record ({"cmd", "rc", "tail", "parsed"}); unwrap it — falling
+    # back to re-parsing the raw tail when the capture's own parse was
+    # None (a truncated tail yields zero comparable rows, not a crash)
+    if isinstance(doc, dict) and "parsed" in doc and "value" not in doc:
+        if isinstance(doc["parsed"], dict):
+            doc = doc["parsed"]
+        else:
+            try:
+                doc = json.loads(doc.get("tail") or "")
+            except ValueError:
+                pass
+    return doc
+
+
+def main(argv: list) -> int:
+    as_json = "--json" in argv
+    noise = 10.0
+    paths: list = []
+    it = iter(argv)
+    for a in it:
+        if a == "--noise-pct":
+            noise = float(next(it, "10"))
+        elif a == "--json":
+            continue
+        elif not a.startswith("--"):
+            paths.append(a)
+    if len(paths) != 2:
+        print("usage: bench_diff.py OLD.json NEW.json "
+              "[--noise-pct P] [--json]", file=sys.stderr)
+        return 2
+    result = diff(_load(paths[0]), _load(paths[1]), noise)
+    print(json.dumps(result) if as_json else _render(result))
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -64,6 +64,15 @@ Checks (cheap, high-signal, zero-config):
                 (blackbox.py ``record`` closure): the recorder rides
                 dispatch loops and WAL threads, so a blocking sync
                 there is the same bug class as a sampler-tick sync
+  RA07          (autotune.py only) the closed-loop controller
+                contract (ISSUE 9): every knob in TUNABLE_KNOBS must
+                be stamped in the engine_pipeline overview
+                (telemetry.py engine source) and documented in
+                docs/OBSERVABILITY.md, and every function that
+                mutates a knob must emit a registered EVENT_REGISTRY
+                event via record(...) — no silent knob turns; the
+                tuner's tick path also rides the RA04 no-host-sync
+                closure gate (it runs between dispatches)
   RA03          (files in a `log/` directory only) no swallow-only
                 `except OSError:`/`except Exception:` (body is just
                 `pass`) around durability-bearing I/O calls (fsync/
@@ -230,12 +239,113 @@ def _check_bench_loop_sync(tree: ast.Module, err) -> None:
 #: (a ready-gated harvest, the explicit ``drain`` barrier) carry an
 #: `# ra04-ok: <why>` line comment.
 _TELEMETRY_FILES = frozenset({"telemetry.py"})
-_SAMPLER_HOT_FUNCS = frozenset({"tick", "_start_sample", "_harvest"})
+#: ``note`` is the phase-stamp entry point (PhaseStats): it rides the
+#: dispatch thread, the WAL batch threads and the encode workers, so
+#: the no-host-sync closure gate covers it too (ISSUE 9)
+_SAMPLER_HOT_FUNCS = frozenset({"tick", "_start_sample", "_harvest",
+                                "note"})
 #: the flight recorder's emit path rides the same dispatch loops the
 #: sampler tick does — same no-host-sync contract (RA04 extension,
 #: ISSUE 7)
 _BLACKBOX_FILES = frozenset({"blackbox.py"})
 _RECORDER_HOT_FUNCS = frozenset({"record"})
+
+#: RA07 — the autotuner contract (files named autotune.py, ISSUE 9):
+#: (a) every knob in the module's TUNABLE_KNOBS tuple must be stamped
+#: in the engine_pipeline overview (the telemetry.py engine source —
+#: a knob the overview does not carry turns invisibly: the ring shows
+#: its effects with no record of its value) and documented (backticked)
+#: in docs/OBSERVABILITY.md; (b) every function that MUTATES a knob
+#: (an assignment into ``knobs[...]`` or to an attribute named after a
+#: knob) must emit a registered EVENT_REGISTRY event via record(...) in
+#: the same function — no silent knob turns.  The controller tick path
+#: additionally rides the RA04 no-host-sync closure gate: the tuner
+#: runs between dispatches, so a blocking sync there stalls the very
+#: pipeline it tunes.
+_AUTOTUNE_FILES = frozenset({"autotune.py"})
+_TUNER_HOT_FUNCS = frozenset({"tick"})
+
+
+def _tunable_knobs(tree: ast.Module) -> list:
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "TUNABLE_KNOBS" and \
+                isinstance(node.value, ast.Tuple):
+            return [(node, e.value) for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+    return []
+
+
+def _check_autotune_contract(tree: ast.Module, err, path: str,
+                             doc_text, keys) -> None:
+    """RA07 (see the block comment above)."""
+    knobs = _tunable_knobs(tree)
+    knob_names = {k for _n, k in knobs}
+    # (a) knob stamping: the engine_pipeline overview lives in
+    # telemetry.py (the Observatory engine source) — prefer one next to
+    # the checked file (self-contained fixtures), else the repo's
+    tel = os.path.join(os.path.dirname(path), "telemetry.py")
+    if not os.path.exists(tel):
+        tel = os.path.join(REPO, "ra_tpu", "telemetry.py")
+    tel_text = None
+    if os.path.exists(tel):
+        with open(tel, encoding="utf-8") as f:
+            tel_text = f.read()
+    for node, knob in knobs:
+        if tel_text is not None and f'"{knob}"' not in tel_text \
+                and f"'{knob}'" not in tel_text:
+            err(node, "RA07",
+                f"tunable knob {knob!r} is not stamped in the "
+                "engine_pipeline overview (telemetry.py engine "
+                "source); a knob the overview does not carry turns "
+                "invisibly")
+        if doc_text is not None and f"`{knob}`" not in doc_text:
+            err(node, "RA07",
+                f"tunable knob {knob!r} undocumented in "
+                "docs/OBSERVABILITY.md")
+    # (b) no silent knob turns: a knob-mutating function must record a
+    # registered event
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        mutates = None
+        for sub in ast.walk(node):
+            targets = []
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets = [sub.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    base = t.value
+                    name = base.attr if isinstance(base, ast.Attribute) \
+                        else base.id if isinstance(base, ast.Name) else None
+                    if name == "knobs":
+                        mutates = sub
+                elif isinstance(t, ast.Attribute) and \
+                        t.attr in knob_names:
+                    mutates = sub
+        if mutates is None:
+            continue
+        recorded = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and sub.args and \
+                    isinstance(sub.args[0], ast.Constant) and \
+                    isinstance(sub.args[0].value, str):
+                fn = sub.func
+                name = fn.id if isinstance(fn, ast.Name) else \
+                    fn.attr if isinstance(fn, ast.Attribute) else None
+                if name == "record" and \
+                        (keys is None or sub.args[0].value in keys):
+                    recorded = True
+        if not recorded:
+            err(mutates, "RA07",
+                f"{node.name}() mutates an autotuner knob without "
+                "emitting a registered record(...) event — silent "
+                "knob turns are unreconstructable (register the "
+                "decision in EVENT_REGISTRY)")
 
 
 def _sampler_hot_closure(tree: ast.Module,
@@ -554,6 +664,27 @@ def check_file(path: str) -> list:
             with open(doc, encoding="utf-8") as fdoc:
                 doc_text = fdoc.read()
         _check_event_registry_doc(tree, err, doc_text)
+    if os.path.basename(path) in _AUTOTUNE_FILES:
+        # the controller runs between dispatches: same RA04 closure
+        # gate as the sampler tick, rooted at the tuner's tick path
+        ra04_ok = {i + 1 for i, line in enumerate(src.splitlines())
+                   if "ra04-ok" in line}
+
+        def err_ra04_at(node: ast.AST, code: str, msg: str) -> None:
+            if getattr(node, "lineno", 0) not in ra04_ok:
+                err(node, code, msg)
+
+        _check_sampler_sync(tree, err_ra04_at, roots=_TUNER_HOT_FUNCS)
+        doc = os.path.join(os.path.dirname(path), "docs",
+                           "OBSERVABILITY.md")
+        if not os.path.exists(doc):
+            doc = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+        doc_text = None
+        if os.path.exists(doc):
+            with open(doc, encoding="utf-8") as fdoc:
+                doc_text = fdoc.read()
+        _check_autotune_contract(tree, err, path, doc_text,
+                                 _event_registry_keys(path))
     parts = set(os.path.normpath(path).split(os.sep))
     in_tests = "tests" in parts or \
         os.path.basename(path).startswith("test_")

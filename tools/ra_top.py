@@ -148,6 +148,43 @@ def render(snap: dict, prev: dict | None = None) -> str:
     if df and any(df.values()):
         hot = " ".join(f"{k}={v}" for k, v in sorted(df.items()) if v)
         lines.append(f"faults  {hot}")
+    # -- SLO verdicts (ISSUE 9) --------------------------------------------
+    slo = (snap.get("slo") or {}).get("objectives") or {}
+    if slo:
+        cells = []
+        for name in sorted(slo):
+            o = slo[name]
+            verdict = o.get("verdict", "?")
+            mark = {"ok": "OK", "no_data": "--",
+                    "breach": "BREACH", "alert": "ALERT!"}.get(
+                        verdict, verdict)
+            val = o.get("value")
+            val_s = "--" if val is None else f"{val:g}"
+            cells.append(f"{name} {mark} {val_s}{o.get('op', '')}"
+                         f"{o.get('threshold', '')} "
+                         f"burn={o.get('burn_fast', 0):g}/"
+                         f"{o.get('burn_slow', 0):g}")
+        lines.append("slo     " + " | ".join(cells))
+    # -- autotuner footer (ISSUE 9): the last decision + freeze state ------
+    tun = snap.get("autotune") or {}
+    if tun:
+        knobs = tun.get("knobs") or {}
+        knob_s = " ".join(f"{k}={v:g}" if isinstance(v, float)
+                          else f"{k}={v}" for k, v in sorted(knobs.items()))
+        last = tun.get("last_decision")
+        if last:
+            age = max(0.0, ts - last.get("ts", ts))
+            dec = (f"{last.get('knob', '?')} {last.get('old', '?')}->"
+                   f"{last.get('new', '?')} via {last.get('phase', '?')}"
+                   f"/{last.get('objective', '?')} {age:.0f}s ago")
+        else:
+            dec = "no decisions"
+        frozen = f" FROZEN({tun.get('freeze_reason')})" \
+            if tun.get("frozen") else ""
+        lines.append(f"tuner   {dec}{frozen}")
+        lines.append(f"knobs   {knob_s}  decisions="
+                     f"{tun.get('decisions', 0)} "
+                     f"cooldown={tun.get('cooldown_left', 0)}")
     # -- counters self-metric ---------------------------------------------
     dropped = snap.get("counters", {}).get("self", {}) \
         .get("telemetry_dropped")
