@@ -116,6 +116,13 @@ EVENT_REGISTRY = {
     "tune.freeze": "autotuner entered a freeze (active FaultPlan/"
                    "DiskFaultPlan or a fresh incident): decisions "
                    "suspended",
+    # -- ingress plane (ra_tpu/ingress/, ISSUE 10) ---------------------
+    "ingress.connect": "session (re)connected: epoch bump under a "
+                       "stable (tenant, lane, shard) placement",
+    "ingress.level": "backpressure ladder level transition "
+                     "(SLO-verdict-driven; open/tight/fair)",
+    "ingress.shed": "coalescer ring overflow began shedding rows "
+                    "(transition into a shed episode, not per row)",
     # -- recorder meta -------------------------------------------------
     "bb.dump": "post-mortem bundle written",
     "bb.recover": "recovery stamped a join-able recovery report",

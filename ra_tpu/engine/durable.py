@@ -626,6 +626,13 @@ class EngineDurability:
             while len(self._submit_ts) > 4096:
                 self._submit_ts.pop(min(self._submit_ts))
 
+    def pending_steps(self) -> int:
+        """Dispatched-but-unconfirmed steps on the laggiest shard — the
+        durability half of the ingress plane's bounded-queue accounting
+        (ISSUE 10): ingress queue depth + this is the node's total
+        uncommitted command backlog (IngressPlane.gauges reads it)."""
+        return self.step_seq - self.confirmed_step
+
     def batch_interval_ms(self) -> float:
         """The live WAL group-commit wait budget (uniform across
         shards — the engine_pipeline overview stamps this, rule RA07)."""

@@ -760,6 +760,7 @@ class LockstepEngine:
         self._dur = None
         self._driver = None
         self._telemetry = None  # attached TelemetrySampler (or None)
+        self._ingress = None    # attached IngressPlane (ISSUE 10)
         # phase-resolved latency attribution (ISSUE 9): host-side
         # monotonic stamps at the dispatch/staging edges land here; a
         # durability bridge brings its own accumulator (shared with the
@@ -1249,6 +1250,10 @@ class LockstepEngine:
             # WAL_FIELDS/stats), the key_metrics merge of PR 2's
             # RPC_FIELDS pattern
             out["wal"] = self._dur.wal_overview()
+        if self._ingress is not None:
+            # the session tier's flow gauges ride the engine overview
+            # (queue depth next to the pipeline it feeds, ISSUE 10)
+            out["ingress"] = self._ingress.gauges()
         return out
 
 

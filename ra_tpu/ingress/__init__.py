@@ -1,0 +1,332 @@
+"""Ingress plane: a million client sessions fanning into the lane
+engine (ISSUE 10, ROADMAP item 2).
+
+``IngressPlane`` composes the three tiers this package provides —
+
+* :class:`~ra_tpu.ingress.sessions.SessionDirectory`: external id →
+  (tenant, lane, shard) deterministic placement, reconnect-stable
+  epochs, vectorized per-session seqno dedup (at-most-once end-to-end);
+* :class:`~ra_tpu.ingress.coalesce.CoalesceWindow`: per-lane staging
+  rings coalescing concurrent submissions into the dense
+  ``[K, lanes, cmds_per_step, C]`` superstep blocks the engine eats
+  (host-side pre-jit; lint rule RA08 keeps its block-build path free of
+  per-session Python work);
+* :class:`~ra_tpu.ingress.backpressure.CreditLadder`: per-session
+  credit, per-tenant fairness, and the SLO-driven shed/defer/reject
+  ladder (FifoClient's ok→slow→StopSending protocol generalized to all
+  machines)
+
+— and drives them against a ``LockstepEngine`` through the PR 5
+``DispatchAheadDriver``, releasing session credit at block granularity
+as the driver's async committed-watermark readbacks land (no
+per-command host work anywhere past admission).
+
+Quickstart::
+
+    eng = LockstepEngine(CounterMachine(), 10_000, 3)
+    plane = IngressPlane(eng, superstep_k=4)
+    handles = plane.connect_bulk(1_000_000, tenants=16, key="fleet")
+    status = plane.submit(handles[:4096], seqnos, payloads)
+    plane.pump()          # dispatch a block when the window triggers
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..blackbox import record
+from ..engine.lockstep import DispatchAheadDriver
+from ..metrics import INGRESS_FIELDS
+from .backpressure import (DEFER, DUP, LEVEL_NAMES, OK, REJECT, SHED, SLOW,
+                           STATUS_NAMES, CreditLadder)
+from .coalesce import CoalesceWindow, batch_rank
+from .sessions import SessionDirectory, default_directory
+
+__all__ = [
+    "IngressPlane", "SessionDirectory", "CoalesceWindow", "CreditLadder",
+    "OK", "SLOW", "DEFER", "REJECT", "DUP", "SHED", "STATUS_NAMES",
+    "LEVEL_NAMES", "batch_rank", "default_directory",
+]
+
+
+class IngressPlane:
+    """The session tier over one lane engine: dedup → admission →
+    coalesce → fused dispatch, with block-granularity credit release."""
+
+    def __init__(self, engine, *, directory: Optional[SessionDirectory]
+                 = None, superstep_k: int = 8,
+                 max_in_flight: int = 2, window_s: float = 0.002,
+                 fill_frac: float = 0.5, capacity: Optional[int] = None,
+                 soft_credit: int = 64, hard_credit: int = 256,
+                 tenant_quota: int = 65536, slo=None,
+                 shardings: Optional[dict] = None) -> None:
+        self.engine = engine
+        self.directory = directory or default_directory(engine)
+        if self.directory.n_lanes != engine.n_lanes:
+            raise ValueError("directory/engine lane count mismatch")
+        self.window = CoalesceWindow(
+            engine.n_lanes, engine.max_step_cmds, engine.payload_width,
+            superstep_k=superstep_k, capacity=capacity,
+            window_s=window_s, fill_frac=fill_frac,
+            payload_dtype=np.dtype(engine.payload_dtype))
+        self.ladder = CreditLadder(self.directory,
+                                   soft_credit=soft_credit,
+                                   hard_credit=hard_credit,
+                                   tenant_quota=tenant_quota)
+        self.driver = DispatchAheadDriver(engine,
+                                          max_in_flight=max_in_flight,
+                                          shardings=shardings)
+        #: optional SloEngine whose commit-latency verdicts drive the
+        #: ladder (polled at pump time — host dict work only)
+        self.slo = slo
+        self.counters = {f: 0 for f in INGRESS_FIELDS}
+        #: in-flight blocks awaiting commit: (per-lane cumulative
+        #: dispatched-row target, handle matrix [N, width], take [N])
+        self._inflight: deque = deque()
+        self._dispatched_rows = np.zeros(engine.n_lanes, np.int64)
+        # commit baseline: election noops also advance total_committed,
+        # so the release join is >=, never ==, and credit may release a
+        # hair early around an election — flow control, not correctness
+        self._base_committed = \
+            np.asarray(engine.state.total_committed).astype(np.int64)
+        self._shedding = False
+        engine._ingress = self
+
+    # -- sessions ----------------------------------------------------------
+
+    def connect(self, external_id: str) -> int:
+        """Resolve/create a named session; reconnects bump the epoch
+        (recorded — reconnects are rare control-plane events)."""
+        h, reconnected = self.directory.connect(external_id)
+        if reconnected:
+            self.counters["reconnects"] += 1
+            record("ingress.connect", id=external_id, handle=int(h),
+                   epoch=int(self.directory.epoch[h]))
+        return h
+
+    def connect_bulk(self, n: int, *, key: str = "bulk",
+                     tenants: int = 1) -> np.ndarray:
+        """Connect a synthetic fleet (one event for the whole fleet —
+        the per-session path must not emit a million records)."""
+        known = key in self.directory._bulk
+        h = self.directory.connect_bulk(n, key=key, tenants=tenants)
+        if known:
+            self.counters["reconnects"] += n
+        record("ingress.connect", bulk=key, n=int(n),
+               reconnect=bool(known))
+        return h
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, handles, seqnos, payloads) -> np.ndarray:
+        """One ingress wave: per-row status (OK/SLOW/DEFER/REJECT/DUP/
+        SHED, np.int8).  Dedup → admission → coalesce, all vectorized;
+        only PLACED rows advance the at-most-once watermark, so a
+        deferred/rejected/shed command's resend (same seqno) is fresh."""
+        handles = np.asarray(handles, np.int64)
+        seqnos = np.asarray(seqnos, np.int64)
+        payloads = np.asarray(payloads)
+        if payloads.ndim == 1:
+            payloads = payloads[:, None]
+        n = len(handles)
+        c = self.counters
+        c["submitted"] += n
+        fresh = self.directory.fresh(handles, seqnos)
+        status = np.full(n, DUP, np.int8)
+        idx_fresh = np.flatnonzero(fresh)
+        c["dup_dropped"] += n - len(idx_fresh)
+        if not len(idx_fresh):
+            return status
+        fh = handles[idx_fresh]
+        adm = self.ladder.admit(fh)
+        status[idx_fresh] = adm
+        ok = adm <= SLOW
+        idx_ok = idx_fresh[ok]
+        if len(idx_ok):
+            placed = self.window.offer(self.directory.lane[handles[idx_ok]],
+                                       payloads[idx_ok],
+                                       handles[idx_ok])
+            if not placed.all():
+                # ring overflow: shed (bounded queues drop, they never
+                # grow) — credit returned, seqno NOT marked, so the
+                # client's resend survives the episode
+                idx_shed = idx_ok[~placed]
+                status[idx_shed] = SHED
+                self.ladder.release(handles[idx_shed])
+                c["shed_rows"] += len(idx_shed)
+                if not self._shedding:
+                    self._shedding = True
+                    record("ingress.shed", rows=int(len(idx_shed)),
+                           queue_rows=self.window.queue_rows(),
+                           level=LEVEL_NAMES[self.ladder.level])
+            else:
+                self._shedding = False
+            idx_placed = idx_ok[placed]
+            self.directory.mark(handles[idx_placed], seqnos[idx_placed])
+            c["accepted"] += len(idx_placed)
+        c["slow_signals"] += int((adm == SLOW).sum())
+        c["deferred"] += int((adm == DEFER).sum())
+        c["rejected"] += int((adm == REJECT).sum())
+        if len(idx_fresh) < n:
+            # a within-wave twin of a row that was NOT placed must not
+            # read as DUP ("already accepted — stop resending"): it
+            # inherits its first occurrence's verdict instead.  One
+            # stable lexsort groups equal (handle, seqno) runs; the run
+            # head is the row fresh() kept (or a true watermark dup,
+            # whose head status is already DUP)
+            order = np.lexsort((seqnos, handles))
+            sh, ss = handles[order], seqnos[order]
+            new_run = np.empty(n, bool)
+            new_run[0] = True
+            new_run[1:] = (sh[1:] != sh[:-1]) | (ss[1:] != ss[:-1])
+            run_ids = np.cumsum(new_run) - 1
+            st_sorted = status[order]
+            head_st = st_sorted[np.flatnonzero(new_run)][run_ids]
+            # head placed -> the twin IS a duplicate of an accepted row;
+            # head refused -> the twin shares the refusal (resendable)
+            prop = np.where(head_st <= SLOW, np.int8(DUP), head_st)
+            upd = ~new_run & (st_sorted == DUP)
+            status[order[upd]] = prop[upd]
+        return status
+
+    def submit_auto(self, handles, payloads) -> np.ndarray:
+        """Demo/test convenience: mint the next per-session seqnos
+        server-side (a well-behaved resend-free client)."""
+        handles = np.asarray(handles, np.int64)
+        return self.submit(handles, self.directory.next_seqnos(handles),
+                           payloads)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def pump(self, now: Optional[float] = None,
+             force: bool = False) -> bool:
+        """Harvest committed blocks (credit release), poll the SLO
+        ladder, and dispatch one superstep block if the window
+        triggered (or ``force``).  Host dict/numpy work only — the
+        dispatch itself is the driver's async staged submit."""
+        self._harvest()
+        if self.slo is not None:
+            # memoized with evaluate(): a per-pump poll is a dict hit
+            self.ladder.on_verdict(self.slo.verdict("commit_p99_ms"))
+        if not force and not self.window.ready(now):
+            return False
+        if self.window.queue_rows() <= 0:
+            return False
+        n_new, payloads, handles, take = self.window.pop_block()
+        self.driver.submit(n_new, payloads)
+        self._dispatched_rows += take
+        self._inflight.append((self._dispatched_rows.copy(), handles,
+                               take))
+        self.counters["blocks_built"] += 1
+        self.counters["block_rows"] += int(take.sum())
+        self._harvest()
+        return True
+
+    def _committed_rows(self) -> Optional[np.ndarray]:
+        lc = self.driver.last_committed
+        if lc is None:
+            return None
+        return np.asarray(lc, np.int64) - self._base_committed
+
+    def _harvest(self) -> None:
+        """Release credit for blocks the engine's committed watermark
+        now covers (block granularity: one vectorized release per
+        retired block, driven by the driver's EXISTING async watermark
+        readbacks — no new host syncs)."""
+        done = self._committed_rows()
+        if done is None:
+            return
+        while self._inflight:
+            target, handles, take = self._inflight[0]
+            if not (done >= target).all():
+                break
+            self._inflight.popleft()
+            width = handles.shape[1]
+            valid = np.arange(width)[None, :] < take[:, None]
+            released = self.ladder.release(handles[valid])
+            self.counters["credits_released"] += released
+
+    def settle(self, timeout: float = 30.0) -> None:
+        """Flush everything: drain the window, dispatch, and drive
+        empty supersteps until the committed watermark covers every
+        dispatched row (write-delay / durable-confirm settling), then
+        release all remaining credit.  A barrier — never on the hot
+        path."""
+        while self.window.queue_rows() > 0:
+            self.pump(force=True)
+        self.driver.drain()
+        k = self.window.superstep_k
+        n, kc, c = (self.engine.n_lanes, self.engine.max_step_cmds,
+                    self.engine.payload_width)
+        zero_n = np.zeros((k, n), np.int32)
+        zero_p = np.zeros((k, n, kc, c),
+                          np.dtype(self.engine.payload_dtype))
+        deadline = time.monotonic() + timeout
+        while self._inflight:
+            # same block shapes as the pump path: reuses the compiled
+            # fused executable rather than retracing a new geometry
+            self.driver.submit(zero_n, zero_p)
+            self.driver.drain()
+            self._harvest()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"ingress settle: {len(self._inflight)} blocks "
+                    "still uncommitted")
+
+    # -- observability -----------------------------------------------------
+
+    def gauges(self, credit_in_use: Optional[int] = None) -> dict:
+        out = {
+            "sessions": int(self.directory.n_sessions),
+            "tenants": self.directory.n_tenants,
+            "queue_rows": self.window.queue_rows(),
+            "inflight_blocks": len(self._inflight),
+            "level": self.ladder.level,
+            # O(sessions) sum: overview() passes the ladder's value in
+            # so one snapshot does the full-array reduction ONCE
+            "credit_in_use": int(self.ladder.used.sum())
+            if credit_in_use is None else credit_in_use,
+        }
+        dur = getattr(self.engine, "_dur", None)
+        if dur is not None:
+            # the durability half of the backlog: ingress queue depth
+            # + unconfirmed steps = the node's uncommitted total
+            out["wal_pending_steps"] = dur.pending_steps()
+        return out
+
+    def overview(self) -> dict:
+        """The Observatory ``ingress`` source: INGRESS_FIELDS counters
+        + flow gauges, one flat numeric namespace (ring keys
+        ``ingress_<field>``)."""
+        lad = self.ladder.overview()
+        return {**self.counters,
+                **self.gauges(credit_in_use=lad["credit_in_use"]),
+                "ladder": lad,
+                "window": self.window.overview()}
+
+    def attach(self, observatory) -> "IngressPlane":
+        """Register this plane as the Observatory's ``ingress`` source
+        (``Observatory.for_engine`` wires it automatically when the
+        engine carries an attached plane)."""
+        observatory.add_source("ingress", self.overview)
+        return self
+
+    def bench_row(self, elapsed_s: float) -> dict:
+        """A bench/soak tail row carrying the ingress regression keys
+        tools/bench_diff.py compares (``ingress_cmds_per_s`` higher-is-
+        better, ``ingress_shed_rate`` lower-is-better)."""
+        c = self.counters
+        accepted = c["accepted"]
+        submitted = max(1, c["submitted"])
+        return {
+            "value": accepted / max(elapsed_s, 1e-9),
+            "ingress_cmds_per_s": accepted / max(elapsed_s, 1e-9),
+            "ingress_shed_rate": c["shed_rows"] / submitted,
+            "ingress_accepted": accepted,
+            "ingress_submitted": c["submitted"],
+            "ingress_dup_dropped": c["dup_dropped"],
+            "elapsed_s": elapsed_s,
+        }

@@ -172,6 +172,29 @@ PHASE_FIELDS = (
     "fsync_wait", "confirm_publish", "commit_e2e",
 )
 
+#: ingress-plane counter fields (ra_tpu/ingress/, ISSUE 10): one dict
+#: per IngressPlane, merged into the Observatory as the ``ingress``
+#: source (flat ring keys ``ingress_<field>``).  ``submitted`` is every
+#: row offered to submit(); ``accepted`` the subset that reached the
+#: coalescer (placed — these and only these advance the at-most-once
+#: seqno watermark); ``dup_dropped`` rows rejected by the per-session
+#: dedup (a resend of an already-placed (session, seqno) — the proof
+#: resends are at-most-once end-to-end); ``slow_signals`` admissions
+#: past the soft credit (the generalized FifoClient "slow" verdict);
+#: ``deferred`` rows parked by tenant-fairness admission at ladder
+#: level >= 2; ``rejected`` rows refused at the hard credit (the
+#: StopSending analogue); ``shed_rows`` rows dropped by coalescer ring
+#: overflow (bounded queues shed, they never grow); ``blocks_built``
+#: superstep blocks dispatched and ``block_rows`` the rows they
+#: carried (rows/blocks = realized coalescing factor);
+#: ``reconnects`` session epoch bumps; ``credits_released`` per-row
+#: credit returns at block-commit granularity.
+INGRESS_FIELDS = (
+    "submitted", "accepted", "dup_dropped", "slow_signals", "deferred",
+    "rejected", "shed_rows", "blocks_built", "block_rows", "reconnects",
+    "credits_released",
+)
+
 #: the on-device aggregation of TELEMETRY_FIELDS (lockstep's jitted
 #: telemetry summary): scalar rollups plus the fixed-size lag histogram
 #: and the lax.top_k offender slots.  ``stalled_lanes`` lanes at or
@@ -209,6 +232,7 @@ FIELD_REGISTRY = {
     "telemetry": TELEMETRY_FIELDS,
     "telemetry_summary": TELEMETRY_SUMMARY_FIELDS,
     "phase": PHASE_FIELDS,
+    "ingress": INGRESS_FIELDS,
 }
 
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 import itertools
 import time
 from collections import deque
-from typing import Any
+from typing import Any, Optional
 
 from .. import api
 from ..core.types import Priority, ServerId
@@ -88,6 +88,16 @@ class FifoClient:
         self.next_seqno = 1
         #: seqno -> raw msg, unacknowledged pipelined enqueues
         self.pending: dict[int, Any] = {}
+        #: monotonic ts of the FIRST refused enqueue of the current
+        #: StopSending episode (None when the window is open) — the
+        #: client-side shed-decision input the ingress ladder
+        #: generalizes (ISSUE 10 satellite): how LONG a session has
+        #: been blocked, not just that it is
+        self.blocked_since: Optional[float] = None
+        #: enqueues refused by the hard window across the client's
+        #: lifetime (the StopSending analogue of INGRESS_FIELDS
+        #: ``rejected``)
+        self.ingress_rejections = 0
         self._applied = Mailbox(name=f"{tag}-applied")
         self.deliveries: list = []       # [(msg_id, header, raw)]
         self._seed = servers[0]
@@ -103,7 +113,15 @@ class FifoClient:
         :meth:`pending_count` / :meth:`flush`."""
         self.poll_applied()                  # status must see fresh acks
         if len(self.pending) >= self.max_pending:
+            # observable shed input: stamp when THIS blocked episode
+            # began (first refusal only) and count every refusal, so a
+            # caller deciding to shed/defer can read "blocked for 2s,
+            # 40 refusals" instead of a bare exception
+            if self.blocked_since is None:
+                self.blocked_since = time.monotonic()
+            self.ingress_rejections += 1
             raise StopSending(f"{len(self.pending)} enqueues unapplied")
+        self.blocked_since = None            # window open again
         seqno = self.next_seqno
         self.next_seqno += 1
         self.pending[seqno] = msg

@@ -241,3 +241,11 @@ class SloEngine:
         out["ok"] = breaches == 0
         self._cache = (cache_key, out)
         return out
+
+    def verdict(self, name: str) -> str:
+        """One objective's current verdict string (``ok`` / ``breach``
+        / ``alert`` / ``no_data``), or ``no_data`` for an unknown name
+        — the accessor the ingress backpressure ladder polls (memoized
+        with evaluate(), so a per-wave poll costs one dict lookup)."""
+        obj = self.evaluate()["objectives"].get(name)
+        return obj["verdict"] if obj else "no_data"

@@ -380,6 +380,12 @@ class Observatory:
             return out
 
         obs.add_source("engine", engine_src)
+        ing = getattr(engine, "_ingress", None)
+        if ing is not None:
+            # the session tier (ISSUE 10): INGRESS_FIELDS counters +
+            # flow gauges as their own source, so ring keys read
+            # ``ingress_<field>`` (the SLO/bench_diff namespace)
+            obs.add_source("ingress", ing.overview)
         cls._wire_host_sources(obs, system, counters, router)
         return obs
 
@@ -462,6 +468,12 @@ class Observatory:
         "batches", "_syncs", "events", "_count", "total_ms",
         "blocks_staged", "seq", "telemetry_steps", "wal_files",
         "window_syncs", "leader_changes", "bytes_written",
+        # ingress plane counters (ISSUE 10) — suffix-anchored so the
+        # ingress_queue_rows / ingress_level DEPTH gauges keep their
+        # negative drift (the dispatches_in_flight lesson)
+        "submitted", "_accepted", "dup_dropped", "slow_signals",
+        "_deferred", "_rejected", "shed_rows", "blocks_built",
+        "block_rows", "reconnects", "credits_released",
     )
     _MONOTONE_INFIXES = (
         "bytes", "samples_", "encoded_", "readback_", "rpc_",

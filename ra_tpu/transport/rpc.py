@@ -426,6 +426,16 @@ class FaultPlan:
         return all(s.drop == 0 and s.delay == 0 and s.duplicate == 0
                    and s.reorder == 0 for s in specs)
 
+    def unregister(self) -> None:
+        """Drop this plan from the live-plan registry (the bundle
+        source and the autotuner freeze guard stop seeing it) without
+        disturbing transports still holding it.  Test scoping uses
+        this: the registry is process-global and weakly held, so a
+        plan pinned by a leaked router would otherwise freeze every
+        later tuner and skip the quiet-plan probes — conftest
+        unregisters plans a test created once the test ends."""
+        _LIVE_PLANS.discard(self)
+
     def partition(self, peer: str) -> None:
         self.partitioned.add(peer)
 

@@ -21,3 +21,36 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
 from ra_tpu.utils import force_platform_from_env  # noqa: E402
 
 force_platform_from_env()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _scoped_fault_plans():
+    """Scope fault-plan registration to the test that created it.
+
+    Both plan registries are process-global: transport FaultPlans land
+    in a weakly-held live set (rpc._LIVE_PLANS) and DiskFaultPlans in a
+    module slot (log.faults).  A test that leaks a plan — a lossy spec
+    pinned by a router a leaked node keeps alive — used to poison every
+    later guard probe (the tier-1 quiet-plan probe self-skipped).  This
+    finalizer unregisters plans REGISTERED during the test and restores
+    the installed disk plan, so the probes run unconditionally; the
+    leaked objects themselves stay wired wherever they are (only the
+    registry listing is scoped)."""
+    from ra_tpu.log import faults
+    from ra_tpu.transport import rpc
+    # hold STRONG refs to the pre-existing plans: an id()-only snapshot
+    # could alias a plan that dies mid-test with a test-created one
+    # allocated at the recycled address, letting the new plan escape
+    pre_net = list(rpc.live_fault_plans())
+    pre_disk = faults.current_plan()
+    yield
+    for p in rpc.live_fault_plans():
+        if p not in pre_net:
+            p.unregister()
+    if faults.current_plan() is not pre_disk:
+        if pre_disk is None:
+            faults.clear_plan()
+        else:
+            faults.install_plan(pre_disk)

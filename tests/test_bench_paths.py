@@ -307,3 +307,38 @@ def test_bench_telemetry_opt_out():
     doc = run_child({"RA_TPU_BENCH_TELEMETRY": "0"})
     assert doc["value"] > 0
     assert "observatory" not in doc
+
+
+def test_bench_diff_compares_ingress_keys(tmp_path):
+    """ISSUE 10 satellite: when both tails carry the ingress keys,
+    bench_diff flags throughput drops (higher-is-better) and shed-rate
+    rises — including a shed rate APPEARING from a healthy 0, which the
+    latency-style o>0 guard would have skipped; tails without the keys
+    keep comparing exactly as before."""
+    diff_tool = os.path.join(REPO, "tools", "bench_diff.py")
+    base = {"value": 1000.0, "ingress_cmds_per_s": 400_000.0,
+            "ingress_shed_rate": 0.0}
+    a = tmp_path / "old.json"
+    b = tmp_path / "new.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(base))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b),
+                        "--json"], capture_output=True, text=True,
+                       timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    res = json.loads(r.stdout)
+    metrics = [f["metric"] for f in res["rows"]["headline"]]
+    assert "ingress_cmds_per_s" in metrics
+    assert "ingress_shed_rate" in metrics
+    worse = {"value": 1000.0, "ingress_cmds_per_s": 300_000.0,
+             "ingress_shed_rate": 0.25}
+    b.write_text(json.dumps(worse))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stdout
+    assert r.stdout.count("REGRESSION") == 2, r.stdout
+    # a tail without the ingress keys is compared on what it has
+    b.write_text(json.dumps({"value": 1000.0}))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -372,8 +372,23 @@ def test_client_backpressure_soft_limit_and_stop_sending():
         statuses = [client.enqueue(i)[0] for i in range(8)]
         assert statuses[:3] == ["ok"] * 3
         assert statuses[3:] == ["slow"] * 5          # window >= soft_limit
+        # an open window carries no blocked stamp and no rejections yet
+        assert client.blocked_since is None
+        assert client.ingress_rejections == 0
+        import time as _time
+        t_before = _time.monotonic()
         with pytest.raises(StopSending):
             client.enqueue("overflow")
+        # the refusal is observable (ISSUE 10 satellite): the episode's
+        # start is stamped ONCE and every refusal counts, so a shed
+        # decision can read "blocked since X, N refusals"
+        assert client.blocked_since is not None
+        assert t_before <= client.blocked_since <= _time.monotonic()
+        first_stamp = client.blocked_since
+        with pytest.raises(StopSending):
+            client.enqueue("overflow-2")
+        assert client.blocked_since == first_stamp   # episode start kept
+        assert client.ingress_rejections == 2
         # now elect and let the backlog apply: the window drains, dedup
         # keeps the queue exactly-once, and enqueue is "ok" again
         ra_tpu.trigger_election(sids[0], router=router)
@@ -381,6 +396,8 @@ def test_client_backpressure_soft_limit_and_stop_sending():
         client.flush(timeout=15.0)
         assert client.pending_count() == 0
         assert client.enqueue("after")[0] == "ok"
+        assert client.blocked_since is None          # episode ended
+        assert client.ingress_rejections == 2        # lifetime counter
         client.flush(timeout=15.0)
         leader = await_leader(router, sids)
         res = ra_tpu.local_query(

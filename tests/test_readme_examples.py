@@ -19,8 +19,8 @@ def _blocks():
         text = f.read()
     return re.findall(r"```python\n(.*?)```", text, re.DOTALL)
 
-def test_readme_has_five_python_blocks():
-    assert len(_blocks()) == 5
+def test_readme_has_six_python_blocks():
+    assert len(_blocks()) == 6
 
 def test_classic_quickstart_block(tmp_path):
     src = _blocks()[0]
@@ -87,6 +87,26 @@ def test_slo_autotune_quickstart_block(tmp_path):
     finally:
         if "obs" in ns:
             ns["obs"].close()
+        if "eng" in ns:
+            ns["eng"].close()
+
+
+def test_ingress_quickstart_block():
+    """The ISSUE 10 session-tier block: connect a bulk fleet, submit
+    with auto-minted seqnos, pump, settle — exactly once."""
+    src = _blocks()[5]
+    assert "IngressPlane" in src and "connect_bulk" in src
+    # shrink lanes + fleet for suite runtime; structure runs as written
+    src = _patch(src, "10_000", "128")
+    src = _patch(src, "50_000", "2_000")
+    ns: dict = {}
+    try:
+        exec(compile(src, "README.md[ingress]", "exec"), ns)  # noqa: S102
+        plane = ns["plane"]
+        assert plane.counters["accepted"] > 0
+        assert plane.window.queue_rows() == 0   # settled
+        assert ns["eng"].committed_total() >= plane.counters["accepted"]
+    finally:
         if "eng" in ns:
             ns["eng"].close()
 

@@ -496,8 +496,12 @@ def test_quiet_or_healed_transport_plan_does_not_freeze():
     from ra_tpu.autotune import default_freeze_guard
     from ra_tpu.log import faults
     from ra_tpu.transport.rpc import FaultPlan, FaultSpec
-    if faults.current_plan() is not None:
-        pytest.skip("a DiskFaultPlan is installed by another test")
+    # plan registration is test-scoped (the conftest autouse fixture
+    # unregisters plans leaked by earlier tests and restores the disk
+    # slot), so this probe runs UNCONDITIONALLY — tier-1 carries no
+    # skips; a failure here means the scoping fixture regressed
+    assert faults.current_plan() is None, \
+        "conftest plan scoping failed to restore the disk-plan slot"
     quiet = FaultPlan(seed=1)  # all-default specs: nothing to inject
     assert quiet.quiet()
     partitioned = FaultPlan(seed=2)
@@ -509,16 +513,12 @@ def test_quiet_or_healed_transport_plan_does_not_freeze():
     assert partitioned.quiet()  # healed partition-only plan: quiet
     del lossy
     gc.collect()
-    # only quiet plans remain alive (plus any leaked from earlier
-    # tests — if the guard still fires, a NON-quiet one leaked and
-    # this environment cannot prove the negative)
-    reason = default_freeze_guard()
-    if reason == "transport_fault_plan_active":
-        from ra_tpu.transport.rpc import live_fault_plans
-        assert any(not p.quiet() for p in live_fault_plans()), \
-            "guard fired with only quiet plans alive"
-        pytest.skip("non-quiet plan leaked by an earlier test")
-    assert reason is None
+    # only quiet plans remain alive: the scoped registry holds nothing
+    # non-quiet from earlier tests, and this test's lossy plan is gone
+    from ra_tpu.transport.rpc import live_fault_plans
+    assert all(p.quiet() for p in live_fault_plans()), \
+        "conftest plan scoping failed to unregister a leaked plan"
+    assert default_freeze_guard() is None
 
 
 def test_frozen_under_live_transport_fault_plan():

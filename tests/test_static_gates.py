@@ -512,3 +512,68 @@ def test_blackbox_module_is_ra06_and_ra04_clean():
                 "ra_tpu/engine/durable.py", "ra_tpu/engine/lockstep.py"):
         r = run_lint(os.path.join(REPO, *mod.split("/")))
         assert "RA06" not in r.stdout, (mod, r.stdout)
+
+
+def test_checker_enforces_coalescer_hot_path(tmp_path):
+    """RA08 (ISSUE 10): Python loops and dict allocation inside the
+    ingress coalescer's block-build hot path (offer/pop_block + the
+    same-module helpers they reach) are flagged; `# ra08-ok:` lines
+    and non-hot functions are exempt; other filenames are not gated."""
+    import textwrap
+    bad = tmp_path / "coalesce.py"
+    bad.write_text(textwrap.dedent("""\
+        import numpy as np
+
+        class W:
+            def offer(self, lanes, payloads, handles):
+                for ln in lanes:                      # RA08: loop
+                    self.fill[ln] += 1
+                meta = {"rows": len(lanes)}           # RA08: dict
+                return self._scatter(lanes), meta
+
+            def _scatter(self, lanes):
+                return dict(enumerate(lanes))         # RA08: via helper
+
+            def pop_block(self):
+                takes = [int(t) for t in self.fill]   # RA08: comp loop
+                return takes
+
+            def ready(self):
+                # NOT hot: loops here are control-plane work
+                return any(f > 0 for f in [1, 2])
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    out = r.stdout
+    assert out.count("RA08") == 4, out
+    assert "offer()" in out and "pop_block()" in out \
+        and "_scatter()" in out
+    assert "ready()" not in out
+    # allowlisted lines pass
+    fixed = bad.read_text() \
+        .replace("for ln in lanes:", "for ln in lanes:  # ra08-ok: tiny") \
+        .replace('meta = {"rows": len(lanes)}',
+                 'meta = {"rows": len(lanes)}  # ra08-ok: once') \
+        .replace("return dict(enumerate(lanes))",
+                 "return dict(enumerate(lanes))  # ra08-ok: cold") \
+        .replace("takes = [int(t) for t in self.fill]",
+                 "takes = [int(t) for t in self.fill]  # ra08-ok: k")
+    bad.write_text(fixed)
+    r = run_lint(str(bad))
+    assert "RA08" not in r.stdout, r.stdout
+    # the same content under another module name is not gated
+    other = tmp_path / "window.py"
+    other.write_text(textwrap.dedent("""\
+        class W:
+            def offer(self, lanes):
+                return {ln: 1 for ln in lanes}
+    """))
+    r = run_lint(str(other))
+    assert "RA08" not in r.stdout
+
+
+def test_ingress_coalescer_is_ra08_clean():
+    """The real coalescer's hot path is loop- and dict-free (covered by
+    the repo-wide run too; pinned so a regression names the rule)."""
+    r = run_lint(os.path.join(REPO, "ra_tpu", "ingress", "coalesce.py"))
+    assert "RA08" not in r.stdout, r.stdout

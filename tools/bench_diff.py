@@ -39,6 +39,14 @@ import sys
 LATENCY_FIELDS = ("p50_commit_latency_ms", "p99_commit_latency_ms",
                   "p50_applied_latency_ms", "p99_applied_latency_ms")
 
+#: ingress-plane keys (ISSUE 10), compared when BOTH tails carry them:
+#: throughput is higher-is-better like ``value``; shed rate is
+#: lower-is-better AND zero is a meaningful healthy baseline, so a
+#: shed rate appearing from 0 flags against an absolute floor of 1.0
+#: in the relative formula rather than being skipped as degenerate
+INGRESS_RATE_FIELDS = ("ingress_cmds_per_s",)
+INGRESS_SHED_FIELDS = ("ingress_shed_rate",)
+
 
 def _is_row(d) -> bool:
     return isinstance(d, dict) and isinstance(d.get("value"), (int, float))
@@ -84,6 +92,25 @@ def compare_rows(old: dict, new: dict, noise_pct: float) -> list:
                 not isinstance(n, (int, float)) or o <= 0 or n <= 0:
             continue  # -1 = never measured; 0 = degenerate sample
         delta = (n - o) / o
+        out.append({"metric": f, "old": o, "new": n,
+                    "delta_pct": round(100 * delta, 2),
+                    "regression": delta > bar})
+    for f in INGRESS_RATE_FIELDS:
+        o, n = old.get(f), new.get(f)
+        if not isinstance(o, (int, float)) or \
+                not isinstance(n, (int, float)) or o <= 0:
+            continue
+        delta = (n - o) / o
+        out.append({"metric": f, "old": o, "new": n,
+                    "delta_pct": round(100 * delta, 2),
+                    "regression": delta < -bar})
+    for f in INGRESS_SHED_FIELDS:
+        o, n = old.get(f), new.get(f)
+        if not isinstance(o, (int, float)) or \
+                not isinstance(n, (int, float)) or o < 0 or n < 0:
+            continue  # negative = sentinel; 0 is a real (healthy) rate
+        base = o if o > 0 else 1.0
+        delta = (n - o) / base
         out.append({"metric": f, "old": o, "new": n,
                     "delta_pct": round(100 * delta, 2),
                     "regression": delta > bar})

@@ -73,6 +73,17 @@ Checks (cheap, high-signal, zero-config):
                 event via record(...) — no silent knob turns; the
                 tuner's tick path also rides the RA04 no-host-sync
                 closure gate (it runs between dispatches)
+  RA08          (ingress coalesce.py only) the block-build hot path
+                (`offer`/`pop_block` + every same-module helper they
+                reach) must stay vectorized: no per-session Python
+                loops (for/while/comprehensions) and no dict
+                allocation (literals, comprehensions, dict() calls) —
+                a per-row Python loop there turns the million-session
+                fan-in back into per-command host work, the cost class
+                the coalescer exists to remove; a deliberate exception
+                carries an `# ra08-ok: <why>` line comment.  The
+                INGRESS_FIELDS registry/doc half rides RA05 (the tuple
+                lives in metrics.py like every other group)
   RA03          (files in a `log/` directory only) no swallow-only
                 `except OSError:`/`except Exception:` (body is just
                 `pass`) around durability-bearing I/O calls (fsync/
@@ -404,6 +415,44 @@ def _check_sampler_sync(tree: ast.Module, err,
                     "on is_ready() or mark the line '# ra04-ok: why'")
 
 
+#: RA08 — the ingress coalescer's block-build hot path (files named
+#: coalesce.py, ISSUE 10): offer/pop_block run for every ingress wave
+#: at up-to-millions-of-rows rates, so they and every same-module
+#: helper they reach must stay vectorized — a per-session Python loop
+#: or a per-row dict allocation there reintroduces exactly the
+#: per-command host work the dense-block design removes.
+_INGRESS_HOT_FILES = frozenset({"coalesce.py"})
+_COALESCE_HOT_FUNCS = frozenset({"offer", "pop_block"})
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+               ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _check_coalesce_hot_path(tree: ast.Module, err) -> None:
+    """RA08: forbid Python loops and dict allocation in the coalescer
+    hot path (allowlist via `# ra08-ok:` line comment)."""
+    for node in _sampler_hot_closure(tree, _COALESCE_HOT_FUNCS).values():
+        for sub in ast.walk(node):
+            if isinstance(sub, _LOOP_NODES):
+                err(sub, "RA08",
+                    f"Python loop in coalescer hot path {node.name}() "
+                    "— per-session iteration turns the vectorized "
+                    "block build back into per-command host work; "
+                    "vectorize (argsort/fancy indexing) or mark the "
+                    "line '# ra08-ok: why'")
+            elif isinstance(sub, ast.Dict):
+                err(sub, "RA08",
+                    f"dict allocation in coalescer hot path "
+                    f"{node.name}(); preallocate outside the hot path "
+                    "or mark the line '# ra08-ok: why'")
+            elif isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Name) and \
+                    sub.func.id == "dict":
+                err(sub, "RA08",
+                    f"dict() allocation in coalescer hot path "
+                    f"{node.name}(); preallocate outside the hot path "
+                    "or mark the line '# ra08-ok: why'")
+
+
 #: RA05 — the field-group registry contract (metrics.py): a counter
 #: field that FIELD_REGISTRY does not list escapes the registry parity
 #: test, and one docs/OBSERVABILITY.md does not name is a number nobody
@@ -631,6 +680,15 @@ def check_file(path: str) -> list:
                 err(node, code, msg)
 
         _check_engine_hot_sync(tree, err_ra02)
+    if os.path.basename(path) in _INGRESS_HOT_FILES:
+        ra08_ok = {i + 1 for i, line in enumerate(src.splitlines())
+                   if "ra08-ok" in line}
+
+        def err_ra08(node: ast.AST, code: str, msg: str) -> None:
+            if getattr(node, "lineno", 0) not in ra08_ok:
+                err(node, code, msg)
+
+        _check_coalesce_hot_path(tree, err_ra08)
     if os.path.basename(path) in (_BENCH_FILES | _TELEMETRY_FILES):
         ra04_ok = {i + 1 for i, line in enumerate(src.splitlines())
                    if "ra04-ok" in line}

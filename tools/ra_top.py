@@ -128,6 +128,25 @@ def render(snap: dict, prev: dict | None = None) -> str:
             f"fusion={fusion} "
             f"in_flight={pipe.get('dispatches_in_flight', 0)} "
             f"window_syncs={pipe.get('window_syncs', 0)}")
+    # -- ingress plane (ISSUE 10) ------------------------------------------
+    ing = snap.get("ingress") or {}
+    if ing:
+        if prev is not None:
+            p_ing = prev.get("ingress") or {}
+            dt = max(ts - prev.get("ts", ts), 1e-9)
+            da = ing.get("accepted", 0) - p_ing.get("accepted", 0)
+            rate = _fmt_rate(da / dt)
+        else:
+            rate = "--"
+        shed = ing.get("shed_rows", 0)
+        flag = " <<< SHEDDING" if shed and prev is not None and \
+            shed > (prev.get("ingress") or {}).get("shed_rows", 0) else ""
+        lines.append(
+            f"ingress {rate} acc/s  sessions={ing.get('sessions', 0)} "
+            f"q={ing.get('queue_rows', 0)} "
+            f"level={ing.get('ladder', {}).get('level_name', '?')} "
+            f"dup={ing.get('dup_dropped', 0)} shed={shed}"
+            f" rej={ing.get('rejected', 0)}{flag}")
     # -- WAL shards --------------------------------------------------------
     wal = eng.get("wal") or {}
     shards = wal.get("shards") or []

@@ -7,6 +7,17 @@ Usage:  python tools/soak.py [seeds_per_family] [offset]
         python tools/soak.py --superstep SEED [n]
         python tools/soak.py --obs SEED [n] [jsonl_path]
         python tools/soak.py --blackbox SEED [n]
+        python tools/soak.py --ingress SEED [n]
+
+``--ingress`` runs the ISSUE 10 acceptance scenario at FULL scale
+(tests/test_ingress.run_ingress_soak): ~1M simulated sessions fanning
+into 10k lanes through the session-directory → coalescer →
+backpressure-ladder path, with duplicate resends, member-failure/
+election chaos and a seeded DiskFaultPlan injecting real WAL faults on
+the durable variant — then an exactly-once oracle check (final machine
+state == the dedup'd placed set, so no resend applied twice) plus
+monotone consistent-read probes.  Prints a one-line JSON tail carrying
+``ingress_cmds_per_s``/``ingress_shed_rate`` for tools/bench_diff.py.
 
 ``--disk-faults`` runs the storage-plane chaos family instead
 (tests/test_disk_faults.run_disk_chaos): ``n`` seeded episodes starting
@@ -169,7 +180,40 @@ def _blackbox_main(argv: list) -> int:
     return 1 if failed else 0
 
 
+def _ingress_main(argv: list) -> int:
+    """--ingress SEED [n]: the million-session fan-in soak (ISSUE 10)."""
+    import json
+
+    import test_ingress as ti
+
+    seed = int(argv[0]) if argv else 0
+    n = int(argv[1]) if len(argv) > 1 else 1
+    t0 = time.time()
+    failed = []
+    last = {}
+    for s in range(seed, seed + n):
+        with tempfile.TemporaryDirectory(prefix="soak_ing_") as d:
+            try:
+                last = ti.run_ingress_soak(
+                    s, sessions=1_000_000, lanes=10_000, waves=24,
+                    wave_rows=200_000, durable_dir=d, disk_faults=True)
+            except Exception:  # noqa: BLE001 — report seed + continue
+                failed.append(s)
+                if len(failed) == 1:
+                    traceback.print_exc()
+    print(f"ingress: {n - len(failed)}/{n} ok in "
+          f"{time.time() - t0:.1f}s"
+          + (f"  FAILED seeds: {failed[:10]}" if failed else ""),
+          flush=True)
+    if last:
+        # the bench_diff-comparable tail (ingress throughput/shed keys)
+        print(json.dumps(last), flush=True)
+    return 1 if failed else 0
+
+
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--ingress":
+        return _ingress_main(sys.argv[2:])
     if len(sys.argv) > 1 and sys.argv[1] == "--blackbox":
         return _blackbox_main(sys.argv[2:])
     if len(sys.argv) > 1 and sys.argv[1] == "--disk-faults":
