@@ -88,6 +88,17 @@ _BLK = struct.Struct("<4sII8sI")
 _BLK2 = struct.Struct("<4sII8sII")
 
 
+def _is_multidevice(arr) -> bool:
+    """True when a step-aux leaf lives sharded across >1 device (the
+    engine state was placed on a mesh).  Host numpy (recovery replay)
+    and single-device jax arrays read False.  Pure metadata — no
+    device sync."""
+    try:
+        return len(arr.sharding.device_set) > 1
+    except AttributeError:
+        return False
+
+
 def encode_block_flat(hi: np.ndarray, n_app: np.ndarray, n_acc: np.ndarray,
                       flat: np.ndarray, lane_lo: int = 0) -> bytes:
     """Encode one step's append outcome for a lane slice from the
@@ -306,19 +317,45 @@ class _WalShard:
         lo, hi_l = self.lo, self.hi
         t_enc = time.monotonic()
         with trace.span("wal.encode", "wal", shard=self.idx, step=step):
-            # documented readback point: this worker runs one step
-            # behind dispatch, so the device values are ready (or the
-            # pull overlaps the next dispatch) — RA02's allowlisted home
-            hi = np.asarray(aux["appended_hi"][lo:hi_l]).astype(np.int32)
-            n_app = np.asarray(aux["n_app"][lo:hi_l]).astype(np.int32)
-            n_acc = np.asarray(aux["n_acc"][lo:hi_l]).astype(np.int32)
-            # only this slice's row-offset boundary values are needed —
-            # pulling the full-N cumsum on every shard would duplicate
-            # the transfer S times
-            csum = np.asarray(aux["row_csum"][max(0, lo - 1):hi_l])
-            r0 = int(csum[0]) if lo else 0
-            r1 = int(csum[-1])
-            flat = np.asarray(aux["flat_rows"][r0:r1])
+            if aux.get("__mesh__"):
+                # sharded-engine path (ISSUE 11): a worker thread must
+                # NOT launch device computations — slicing a sharded
+                # array compiles+enqueues a multi-device gather, and
+                # concurrent enqueues from encode workers deadlock
+                # against the dispatch thread's pjit.  The bridge
+                # materializes the step's aux to host ONCE (pure d2h
+                # transfers, safe off-thread); slicing happens in
+                # numpy.
+                host = self.bridge._host_aux(aux)
+                hi = host["appended_hi"][lo:hi_l]
+                n_app = host["n_app"][lo:hi_l]
+                n_acc = host["n_acc"][lo:hi_l]
+                full_csum = host["row_csum"]
+                # csum: this shard's logical slice, kept for the
+                # readback_bytes accounting below (the wire moved the
+                # FULL cumsum once per step via _host_aux)
+                csum = full_csum[max(0, lo - 1):hi_l]
+                r0 = int(full_csum[lo - 1]) if lo else 0
+                r1 = int(full_csum[hi_l - 1])
+                flat = host["flat_rows"][r0:r1]
+            else:
+                # documented readback point: this worker runs one step
+                # behind dispatch, so the device values are ready (or
+                # the pull overlaps the next dispatch) — RA02's
+                # allowlisted home
+                hi = np.asarray(
+                    aux["appended_hi"][lo:hi_l]).astype(np.int32)
+                n_app = np.asarray(
+                    aux["n_app"][lo:hi_l]).astype(np.int32)
+                n_acc = np.asarray(
+                    aux["n_acc"][lo:hi_l]).astype(np.int32)
+                # only this slice's row-offset boundary values are
+                # needed — pulling the full-N cumsum on every shard
+                # would duplicate the transfer S times
+                csum = np.asarray(aux["row_csum"][max(0, lo - 1):hi_l])
+                r0 = int(csum[0]) if lo else 0
+                r1 = int(csum[-1])
+                flat = np.asarray(aux["flat_rows"][r0:r1])
             blk = encode_block_flat(hi, n_app, n_acc, flat, lane_lo=lo)
         # wal_encode phase stamp: readback pull + encode + CRC for one
         # step's block on this shard (runs off the dispatch thread)
@@ -422,6 +459,10 @@ class EngineDurability:
             # wait just adds a per-step confirm-latency tax.
             wal_batch_interval_ms = 0.0
         self._cond = threading.Condition()
+        #: serializes the once-per-step host materialization of mesh
+        #: aux (see _host_aux) — NOT self._cond: a d2h transfer can
+        #: take milliseconds and must never block the confirm path
+        self._readback_lock = threading.Lock()
         self.counters: dict = {f: 0 for f in ENGINE_WAL_FIELDS}
         self.step_seq = 0
         # phase-resolved latency attribution (ISSUE 9): one accumulator
@@ -633,6 +674,15 @@ class EngineDurability:
         uncommitted command backlog (IngressPlane.gauges reads it)."""
         return self.step_seq - self.confirmed_step
 
+    def shard_layout(self) -> list:
+        """``[[lo, hi], ...]`` lane slice per WAL shard — the bench
+        tail's ``wal_shard_layout`` stamp (ISSUE 11 satellite): a
+        multichip row must record whether its fsync parallelism was
+        per-device (slices matching the mesh's lane sharding) or
+        host-defaulted, or cross-round durable comparisons are
+        apples-to-oranges."""
+        return [[sh.lo, sh.hi] for sh in self._shards]
+
     def batch_interval_ms(self) -> float:
         """The live WAL group-commit wait budget (uniform across
         shards — the engine_pipeline overview stamps this, rule RA07)."""
@@ -649,17 +699,40 @@ class EngineDurability:
 
     # -- submit path (engine dispatch thread — must never host-sync) --------
 
+    def _host_aux(self, aux: dict) -> dict:
+        """Host materialization of one step's aux, ONCE per step across
+        all shards (first worker converts, the rest reuse the memo).
+        Under a mesh the conversion is pure device->host transfers —
+        safe from a worker thread, unlike slicing, which would enqueue
+        a multi-device computation concurrently with the dispatch
+        thread (a runtime deadlock, observed on the forced-host CPU
+        client).  The full compacted buffer therefore moves once per
+        step instead of S sliced gathers."""
+        host = aux.get("__host__")
+        if host is not None:
+            return host
+        with self._readback_lock:
+            host = aux.get("__host__")
+            if host is None:
+                host = {k: np.asarray(aux[k])
+                        for k in self._BLOCK_KEYS}
+                aux["__host__"] = host
+        return host
+
     def submit(self, aux: dict) -> None:
         """Queue one step's device aux for off-thread encode + WAL write
         on every shard.  No host sync happens here: the shard workers
         pull the compacted readback when the device values are ready."""
+        job = {key: aux[key] for key in self._BLOCK_KEYS}
+        if _is_multidevice(job["appended_hi"]):
+            job["__mesh__"] = True
         t_sub = time.monotonic()
         with self._cond:
             self.step_seq += 1
             step = self.step_seq
             self._submit_ts[step] = t_sub
             for sh in self._shards:
-                sh._jobs.append((step, aux, t_sub))
+                sh._jobs.append((step, job, t_sub))
                 sh.unprocessed += 1
             self._cond.notify_all()
         # host-side boundary event only (step counters — no device
@@ -684,9 +757,16 @@ class EngineDurability:
         shard workers, WAL record format and confirm protocol are
         unchanged: one RTB block per inner step per shard, confirms
         advance per inner step as each block fsyncs."""
+        mesh = _is_multidevice(aux["appended_hi"])
         subs = []
         for j in range(k):
-            subs.append({key: aux[key][j] for key in self._BLOCK_KEYS})
+            # leading-axis slices taken HERE, on the dispatch thread:
+            # under a mesh these enqueue multi-device gathers, which
+            # only the dispatch thread may do (see _host_aux)
+            sub = {key: aux[key][j] for key in self._BLOCK_KEYS}
+            if mesh:
+                sub["__mesh__"] = True
+            subs.append(sub)
         t_sub = time.monotonic()
         with self._cond:
             step_lo = self.step_seq + 1

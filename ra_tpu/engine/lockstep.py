@@ -761,6 +761,9 @@ class LockstepEngine:
         self._driver = None
         self._telemetry = None  # attached TelemetrySampler (or None)
         self._ingress = None    # attached IngressPlane (ISSUE 10)
+        self._mesh = None       # device mesh, set by shard_engine_state
+                                # (ISSUE 11: drivers/ingress read it to
+                                # stage blocks pre-partitioned)
         # phase-resolved latency attribution (ISSUE 9): host-side
         # monotonic stamps at the dispatch/staging edges land here; a
         # durability bridge brings its own accumulator (shared with the
@@ -1003,6 +1006,51 @@ class LockstepEngine:
         self._fail_host[lane, slot] = False
         self.state = self._snapshot_install(lane, slot)
 
+    def recover_members(self, lanes, slots) -> None:
+        """Vectorized :meth:`recover_member`: revive MANY (lane, slot)
+        pairs in one state update (one masked snapshot-install over the
+        whole fleet instead of ~6 device ops per member).  The
+        multichip chaos phase heals thousands of members per round at
+        the 64k-lane ladder rung — per-member eager updates there cost
+        seconds of dispatch latency per heal (ISSUE 11).  Same contract
+        as the scalar form: recovering a lane's CURRENT leader slot is
+        refused, install seeds from the leader's APPLIED frontier."""
+        lanes = np.atleast_1d(np.asarray(lanes)).astype(np.int64)
+        slots = np.atleast_1d(np.asarray(slots)).astype(np.int64)
+        if not len(lanes):
+            return
+        leads = np.asarray(self.state.leader_slot)[lanes]
+        if (leads == slots).any():
+            bad = lanes[leads == slots]
+            raise ValueError(
+                f"lanes {bad[:8].tolist()}: slot is the lane's leader; "
+                "recover the other members, trigger_election, then "
+                "recover this slot")
+        record("engine.recover", lanes=lanes[:64].tolist(),
+               n=int(len(lanes)))
+        self._fail_host[lanes, slots] = False
+        rv_host = np.zeros((self.n_lanes, self.n_members), bool)
+        rv_host[lanes, slots] = True
+        rv = jnp.asarray(rv_host)
+        st = self.state
+        lead = st.leader_slot[:, None]                        # [N,1]
+        snap = jnp.take_along_axis(st.applied, lead, axis=1)  # [N,1]
+
+        def from_leader(x):
+            idx = lead.reshape((self.n_lanes, 1) + (1,) * (x.ndim - 2))
+            idx = jnp.broadcast_to(idx, (self.n_lanes, 1) + x.shape[2:])
+            lx = jnp.take_along_axis(x, idx, axis=1)
+            m = rv.reshape(rv.shape + (1,) * (x.ndim - 2))
+            return jnp.where(m, lx, x)
+
+        self.state = st._replace(
+            mac=jax.tree.map(from_leader, st.mac),
+            applied=jnp.where(rv, snap, st.applied),
+            commit=jnp.where(rv, snap, st.commit),
+            last_index=jnp.where(rv, snap, st.last_index),
+            last_written=jnp.where(rv, snap, st.last_written),
+            active=st.active | rv)
+
     def _snapshot_install(self, lane: int, slot: int) -> LaneState:
         """Seed a (re)joining member from the lane leader at the leader's
         APPLIED index — the snapshot covers exactly the state the copied
@@ -1184,6 +1232,16 @@ class LockstepEngine:
 
     # -- readback ----------------------------------------------------------
 
+    def mesh_shape(self) -> str:
+        """``"<members>x<lanes>"`` device-mesh stamp (``""`` when
+        unsharded) — rides the engine_pipeline overview so multichip
+        bench tails/ring windows always carry the mesh the rates were
+        measured on (ISSUE 11 satellite)."""
+        if self._mesh is None:
+            return ""
+        shape = dict(self._mesh.shape)
+        return f"{shape.get('members', 1)}x{shape.get('lanes', 1)}"
+
     def committed_total(self) -> int:
         # per-lane counters are int32 (wrap needs 2^31 commits in ONE lane —
         # unreachable in practice); the node-wide sum can exceed 2^31, so
@@ -1236,6 +1294,7 @@ class LockstepEngine:
             # the autotuner-tunable knobs ride the overview (RA07: no
             # silent knob turns — knob value next to the rates it moves)
             "cmds_per_step": self.max_step_cmds,
+            "mesh_shape": self.mesh_shape(),
             "wal_max_batch_interval_ms": (
                 self._dur.batch_interval_ms()
                 if self._dur is not None else -1.0),

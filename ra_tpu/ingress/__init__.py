@@ -76,6 +76,13 @@ class IngressPlane:
                                    soft_credit=soft_credit,
                                    hard_credit=hard_credit,
                                    tenant_quota=tenant_quota)
+        if shardings is None and getattr(engine, "_mesh", None) is not None:
+            # mesh-native composition (ISSUE 11): a sharded engine's
+            # plane stages its coalesced blocks pre-partitioned against
+            # the mesh, so the fused dispatch consumes them with zero
+            # resharding copies (shard_engine_state stamped the mesh)
+            from ..parallel.mesh import superstep_block_shardings
+            shardings = superstep_block_shardings(engine._mesh)
         self.driver = DispatchAheadDriver(engine,
                                           max_in_flight=max_in_flight,
                                           shardings=shardings)

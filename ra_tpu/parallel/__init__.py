@@ -1,1 +1,3 @@
-from .mesh import lane_mesh, shard_engine_state, state_shardings
+from .mesh import (lane_mesh, mesh_superstep_driver, per_device_wal_shards,
+                   shard_engine_state, state_shardings,
+                   superstep_block_shardings)

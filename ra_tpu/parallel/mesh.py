@@ -20,13 +20,14 @@ chips stand in for hosts.
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..engine.lockstep import LaneState
+from ..engine.lockstep import DispatchAheadDriver, LaneState
 
 
 def lane_mesh(devices=None, member_axis: int = 1) -> Mesh:
@@ -80,11 +81,32 @@ def state_shardings(mesh: Mesh, state: LaneState) -> LaneState:
 
 def shard_engine_state(engine, mesh: Optional[Mesh] = None):
     """Place an engine's state on a mesh; subsequent jitted steps run
-    SPMD with XLA-inserted collectives."""
+    SPMD with XLA-inserted collectives.
+
+    Beyond the state pytree itself (ISSUE 11, the mesh-native pipeline):
+
+    * the engine's cached zero masks (``_zero_fail``/``_zero_elect``/
+      ``_zero_confirm``) are re-placed with matching shardings — every
+      dispatch consumes them, and leaving them single-device would
+      either recompile the step for a mixed-sharding signature or pay a
+      broadcast copy per dispatch;
+    * ``engine._mesh`` records the mesh so downstream wiring
+      (:class:`~ra_tpu.engine.lockstep.DispatchAheadDriver` via
+      :func:`mesh_superstep_driver`, ``IngressPlane``) picks up the
+      matching :func:`superstep_block_shardings` automatically — the
+      SNIPPETS.md pjit rule that out/in axis resources of chained
+      jitted calls must MATCH so staged blocks never repartition.
+    """
     if mesh is None:
         mesh = lane_mesh()
     shardings = state_shardings(mesh, engine.state)
     engine.state = jax.device_put(engine.state, shardings)
+    lane_sh = NamedSharding(mesh, P("lanes"))
+    engine._zero_elect = jax.device_put(engine._zero_elect, lane_sh)
+    engine._zero_confirm = jax.device_put(engine._zero_confirm, lane_sh)
+    engine._zero_fail = jax.device_put(
+        engine._zero_fail, NamedSharding(mesh, P("lanes", "members")))
+    engine._mesh = mesh
     return mesh
 
 
@@ -110,3 +132,123 @@ def superstep_block_shardings(mesh: Mesh) -> dict:
         "payloads": NamedSharding(mesh, P(None, "lanes", None, None)),
         "query": vec,
     }
+
+
+#: the multichip lane ladder shared by ``bench.py --multichip`` and
+#: the dryrun throughput/chaos phases (ISSUE 11): low rungs are
+#: dispatch-bound (fusion wins), the top rung shows where the mesh
+#: goes compute-bound.  ONE definition so tools/bench_diff.py's
+#: per-rung row keys (``multichip/<mesh>/lanes<N>``) pair across the
+#: two capture formats.
+DEFAULT_LANE_LADDER = (1024, 8192, 65536)
+
+
+def lane_ladder(env: Optional[str] = None) -> list:
+    """Resolve the multichip lane ladder: an explicit ``env`` string >
+    the shared ``RA_TPU_MULTICHIP_LANES`` env > the default.  Spaces
+    tolerated; an empty or unparsable spec degrades to the default
+    ladder — a sweep must fall back to the standard rungs, never crash
+    on a malformed override."""
+    import os
+    raw = env if env is not None else \
+        os.environ.get("RA_TPU_MULTICHIP_LANES", "")
+    try:
+        rungs = [int(x.strip()) for x in raw.split(",") if x.strip()]
+    except ValueError:
+        rungs = []
+    return rungs or list(DEFAULT_LANE_LADDER)
+
+
+def mesh_shapes(n_devices: int) -> list:
+    """``[(member_axis, lane_axis, members), ...]`` the multichip
+    sweeps enumerate: pure lane-parallel ``1xD`` (3 members), plus the
+    ``2x(D/2)`` member-replicated deployment (4 members) when the
+    device count allows — the MULTICHIP_r05 shapes.  Shared by
+    ``bench.py --multichip`` and ``dryrun_multichip`` so per-shape
+    capture keys pair across formats."""
+    shapes = [(1, n_devices, 3)]
+    if n_devices % 2 == 0 and n_devices >= 4:
+        shapes.append((2, n_devices // 2, 4))
+    return shapes
+
+
+def ladder_rungs(ladder, lane_devices: int) -> list:
+    """Clamp each ladder rung to the mesh's minimum useful width
+    (>= 16 lanes per lane-axis device) and DEDUPE: on a wide mesh the
+    clamp can collapse adjacent rungs, and both capture formats must
+    emit identical ``multichip/<mesh>/lanes<N>`` keys for the same
+    config or tools/bench_diff.py silently skips the pairing."""
+    return sorted({max(int(r), 16 * lane_devices) for r in ladder})
+
+
+def per_device_wal_shards(mesh: Mesh) -> int:
+    """WAL shard count for a per-device durable layout: one shard per
+    LANE-axis device.  ``EngineDurability`` slices lanes into S equal
+    contiguous ranges (``bounds[i] = round(i*N/S)``) — exactly the lane
+    slices an even ``P('lanes')`` sharding places per device — so each
+    device's committed rows are encoded+fsynced by its own shard and
+    fsync parallelism scales with the mesh instead of serializing on
+    one writer.  RTB2 recovery merges ANY shard layout, so reopening
+    the same dir under a different mesh shape needs no migration."""
+    return int(mesh.shape["lanes"])
+
+
+def mesh_superstep_driver(engine, mesh: Optional[Mesh] = None,
+                          max_in_flight: int = 2) -> DispatchAheadDriver:
+    """A :class:`DispatchAheadDriver` whose staged blocks are placed
+    with :func:`superstep_block_shardings` — the mesh-native form of
+    the PR 5 host pipeline: device_put partitions block i+1 across the
+    mesh while dispatch i executes, and because the staging shardings
+    match the fused step's input shardings the dispatch consumes the
+    staged block with zero resharding copies."""
+    mesh = mesh or getattr(engine, "_mesh", None)
+    if mesh is None:
+        mesh = shard_engine_state(engine)
+    return DispatchAheadDriver(engine, max_in_flight=max_in_flight,
+                               shardings=superstep_block_shardings(mesh))
+
+
+def drive_uniform_window(driver: DispatchAheadDriver, n_new_blk,
+                         payloads_blk, seconds: float, *,
+                         observe=None):
+    """The mesh driver's measured dispatch loop: staged superstep
+    submits back to back for ``seconds``, with NO device->host sync
+    anywhere in the loop — the in-flight cap's async committed-
+    watermark readbacks (inside ``driver.submit``) are the only
+    synchronization, exactly the PR 5 window discipline.  Lint rule
+    RA04's same-module call closure covers this function (see
+    tools/lint.py): a blocking sync moved into a helper here cannot
+    escape the gate, the same way the bench loops are policed.
+
+    ``observe()`` runs between dispatches (host-side dict work only —
+    an Observatory snapshot, an autotuner tick); it may return a new
+    ``(n_new_blk, payloads_blk)`` pair to restage the schedule at a
+    different fusion depth (how the autotuner-driven frontier sweep
+    applies K decisions between dispatches).  Returns
+    ``(dispatches, inner_steps, elapsed_s)``; the caller drains."""
+    dispatches = 0
+    inner = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        driver.submit(n_new_blk, payloads_blk)
+        dispatches += 1
+        inner += int(n_new_blk.shape[0])
+        if observe is not None:
+            nxt = observe()
+            if nxt is not None:
+                n_new_blk, payloads_blk = nxt
+    return dispatches, inner, time.perf_counter() - t0
+
+
+def ingress_submit_wave(plane, handles, seqnos, payloads):
+    """Mesh-side ingress pump: one vectorized submission wave into a
+    SHARDED engine's plane — dedup -> admission -> coalesce -> staged
+    fused dispatch, returning the per-row status.  All per-session
+    work stays inside the plane's vectorized sweeps; lint rule RA08's
+    no-per-session-Python gate covers this function and every
+    same-module helper it reaches (a mesh-side loop or per-row dict
+    here would reintroduce the per-command host work the dense-block
+    path removed)."""
+    status = plane.submit(handles, seqnos, payloads)
+    plane.pump(force=True)
+    return status

@@ -360,6 +360,7 @@ class Observatory:
             out["pipeline"] = {
                 "superstep_k": engine._superstep_k_last,
                 "cmds_per_step": engine.max_step_cmds,
+                "mesh_shape": engine.mesh_shape(),
                 "wal_max_batch_interval_ms": (
                     dur.batch_interval_ms() if dur is not None else -1.0),
                 "dispatches_in_flight": (engine._driver.in_flight()

@@ -109,6 +109,78 @@ def test_child_superstep_durable_mode_contract():
     assert doc["pipeline"]["inner_steps"] >= doc["steps"]
 
 
+def test_child_multichip_mode_contract():
+    """The sharded-mesh frontier sweep (ISSUE 11): per mesh shape x
+    lane rung, the superstep+dispatch-ahead pipeline over sharded
+    state vs the single-step reference, with the autotuner's chosen
+    knobs and the engine_pipeline config stamped per row.  Exercised
+    off-hardware at a tiny ladder on the 8 forced-host devices so the
+    sweep cannot rot while only single-device modes are benchmarked."""
+    doc = run_child({"RA_TPU_BENCH_MODE": "multichip",
+                     "RA_TPU_BENCH_MESH_LANES": "64",
+                     "RA_TPU_BENCH_SECONDS": "0.4",
+                     "XLA_FLAGS":
+                     "--xla_force_host_platform_device_count=8"},
+                    timeout=420)
+    assert doc["value"] > 0 and doc["n_devices"] == 8
+    rows = doc["multichip"]
+    assert {r["mesh"] for r in rows} == {"1x8", "2x4"}
+    # the shared rung clamp (ladder_rungs): >= 16 lanes per lane-axis
+    # device, so the 64-lane override clamps to 128 on the 1x8 shape
+    expect_lanes = {"1x8": 128, "2x4": 64}
+    for r in rows:
+        assert r["value"] > 0 and r["lanes"] == expect_lanes[r["mesh"]]
+        assert r["single_step_ref"]["value"] > 0
+        assert r["speedup_vs_single_step"] > 0
+        assert r["latency_mode"] == "step_stamped"
+        assert r["p50_commit_latency_ms"] > 0
+        # the cross-round attribution stamp (ISSUE 11 satellite)
+        ep = r["engine_pipeline"]
+        assert ep["mesh_shape"] == r["mesh"]
+        assert ep["superstep_k"] >= 1 and ep["dispatch_ahead"] >= 1
+        assert "donation" in ep and "wal_shard_layout" in ep
+        # pipeline counters rode the sweep (fused dispatches happened)
+        assert r["pipeline"]["superstep_dispatches"] > 0
+        assert r["pipeline"]["mesh_shape"] == r["mesh"]
+        # the autotuner drove the walk and its knobs are stamped
+        assert r["autotune"]["knobs"]["superstep_k"] == \
+            ep["superstep_k"]
+        assert r["tune_k_rates"]
+    assert doc["best_point"]["mesh"] in ("1x8", "2x4")
+
+
+def test_bench_diff_compares_multichip_tails(tmp_path):
+    """ISSUE 11 satellite: bench_diff pairs multichip rows per mesh
+    shape x lane rung (cmds_per_s higher-is-better) alongside the
+    existing keys, and the dryrun-format rows (cmds_per_s, no value)
+    compare too."""
+    import tools.bench_diff as bd
+    old = {"value": 2e6, "multichip": [
+        {"mesh": "1x8", "lanes": 1024, "value": 1.5e6,
+         "p99_commit_latency_ms": 20.0},
+        {"mesh": "2x4", "lanes": 1024, "cmds_per_s": 1.6e6},
+        {"mesh": "2x4", "lanes": 8192, "value": 2.0e6}]}
+    new = {"value": 2e6, "multichip": [
+        {"mesh": "1x8", "lanes": 1024, "value": 1.6e6,
+         "p99_commit_latency_ms": 90.0},
+        {"mesh": "2x4", "lanes": 1024, "cmds_per_s": 0.5e6},
+        {"mesh": "2x4", "lanes": 8192, "value": 2.1e6}]}
+    res = bd.diff(old, new, noise_pct=10.0)
+    rows = res["rows"]
+    assert "multichip/1x8/lanes1024" in rows
+    assert "multichip/2x4/lanes1024" in rows
+    assert "multichip/2x4/lanes8192" in rows
+    by = {(n, f["metric"]): f for n, fs in rows.items() for f in fs}
+    # per-shape throughput regression flagged (higher-is-better)...
+    assert by[("multichip/2x4/lanes1024", "value")]["regression"]
+    # ...latency rise flagged, healthy rows clean
+    assert by[("multichip/1x8/lanes1024",
+               "p99_commit_latency_ms")]["regression"]
+    assert not by[("multichip/2x4/lanes8192", "value")]["regression"]
+    assert res["regressions"] >= 2
+    assert bd.diff(old, old, noise_pct=10.0)["regressions"] == 0
+
+
 def test_superstep_flag_sets_env():
     """`bench.py --superstep [K]` resolves to the child env contract
     ("auto" = the system-level superstep_k tunable)."""

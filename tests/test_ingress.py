@@ -449,17 +449,46 @@ def test_ingress_throughput_beats_classic_tcp_100x():
 def run_ingress_soak(seed, *, sessions=50_000, lanes=512, waves=12,
                      wave_rows=20_000, durable_dir=None,
                      disk_faults=False, superstep_k=4, cmds=16,
-                     wal_shards=2, throughput_bar=None) -> dict:
+                     wal_shards=2, mesh=False,
+                     throughput_bar=None) -> dict:
     """ROADMAP item 2 acceptance: ``sessions`` simulated sessions fan
     into ``lanes`` lanes through the full ingress path with duplicate
     resends, member-failure/election chaos (the lane plane's transport
     events), a live lossy transport FaultPlan standing in the process
     registry, and — on the durable variant — a seeded DiskFaultPlan
     injecting real WAL faults.  Exactly-once oracle + monotone
-    consistent-read probes; returns a bench_diff-comparable row."""
+    consistent-read probes; returns a bench_diff-comparable row.
+
+    ``mesh=True`` (ISSUE 11) runs the SAME scenario end-to-end on
+    lane state sharded over every available device: per-device WAL
+    shards on the durable variant (fsync parallelism follows the lane
+    sharding), blocks staged pre-partitioned via the plane's auto
+    shardings, and submission waves pumped through the mesh-side
+    ``ingress_submit_wave`` path."""
     from ra_tpu.transport.rpc import FaultPlan, FaultSpec
     rng = np.random.default_rng(seed)
     ring = max(512, superstep_k * cmds * 4)
+    device_mesh = None
+    _mesh_wave = None
+    if mesh:
+        import jax
+
+        from ra_tpu.parallel.mesh import (
+            ingress_submit_wave as _mesh_wave, lane_mesh,
+            per_device_wal_shards)
+        if len(jax.devices()) < 2:
+            # a plain error, NOT pytest.skip: this is a library entry
+            # (tools/soak.py --mesh) and Skipped derives from
+            # BaseException, which would blow through soak's per-seed
+            # except Exception reporting
+            raise RuntimeError(
+                "mesh soak needs >=2 devices; run with JAX_PLATFORMS="
+                "cpu XLA_FLAGS=--xla_force_host_platform_device_count=8")
+        device_mesh = lane_mesh(jax.devices(), member_axis=1)
+        if durable_dir is not None:
+            # per-device WAL shard layout: one shard per lane-axis
+            # device, slice boundaries matching the lane sharding
+            wal_shards = per_device_wal_shards(device_mesh)
     if durable_dir is not None:
         from ra_tpu.engine.durable import open_engine
         eng = open_engine(CounterMachine(), durable_dir, lanes,
@@ -467,6 +496,9 @@ def run_ingress_soak(seed, *, sessions=50_000, lanes=512, waves=12,
                           max_step_cmds=cmds, donate=False)
     else:
         eng = mk_engine(lanes=lanes, cmds=cmds, ring=ring)
+    if device_mesh is not None:
+        from ra_tpu.parallel.mesh import shard_engine_state
+        shard_engine_state(eng, device_mesh)
     disk_plan = None
     net_plan = FaultPlan(seed=seed, default=FaultSpec(drop=0.1))
     if disk_faults:
@@ -504,13 +536,19 @@ def run_ingress_soak(seed, *, sessions=50_000, lanes=512, waves=12,
             sess = h[rng.integers(0, sessions, wave_rows)]
             seq = plane.directory.next_seqnos(sess)
             pay = rng.integers(1, 8, (wave_rows, 1)).astype(np.int32)
-            st = plane.submit(sess, seq, pay)
+            if device_mesh is not None:
+                # the mesh-side pump path (vectorized end to end;
+                # lint RA08 gates its module closure)
+                st = _mesh_wave(plane, sess, seq, pay)
+            else:
+                st = plane.submit(sess, seq, pay)
             ok = st <= SLOW
             np.add.at(expected, plane.directory.lane[sess[ok]],
                       pay[ok, 0].astype(np.int64))
             placed_total += int(ok.sum())
             placed_waves.append((sess[ok], seq[ok], pay[ok]))
-            plane.pump(force=True)
+            if device_mesh is None:
+                plane.pump(force=True)
             work_s += time.perf_counter() - tw
             # duplicate resends of an earlier placed wave: the dedup
             # gate must answer DUP for every row (at-most-once)
@@ -582,6 +620,13 @@ def run_ingress_soak(seed, *, sessions=50_000, lanes=512, waves=12,
             "blocks_built": c["blocks_built"], "elapsed_s": elapsed,
             "work_s": work_s,
             "durable": durable_dir is not None,
+            # mesh stamps (ISSUE 11): the sharding + WAL layout the
+            # oracle ran against, bench_diff-attributable like the
+            # engine_pipeline stamps in the multichip tail
+            "mesh": eng.mesh_shape(),
+            "wal_shards": wal_shards if durable_dir is not None else 0,
+            "wal_shard_layout": eng._dur.shard_layout()
+            if durable_dir is not None else [],
             "disk_faults_injected":
                 dict(disk_plan.counters) if disk_plan else {},
         }
@@ -610,6 +655,48 @@ def test_ingress_soak_cpu_scaled_durable_with_disk_faults(tmp_path):
                            durable_dir=str(tmp_path / "ing"),
                            disk_faults=True, wal_shards=2)
     assert res["durable"] and res["placed"] > 10_000
+
+
+def _require_multidevice():
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("single-device backend")
+
+
+def test_ingress_soak_cpu_scaled_mesh_durable(tmp_path):
+    """Tier-1 mesh variant (ISSUE 11): the same exactly-once scenario
+    end-to-end on lane state SHARDED over the 8 forced-host devices —
+    per-device WAL shards (one per lane-axis device, fsync parallelism
+    following the lane sharding), blocks staged pre-partitioned via
+    the plane's auto shardings, disk-fault + election chaos."""
+    _require_multidevice()
+    res = run_ingress_soak(3, sessions=4_000, lanes=64, waves=6,
+                           wave_rows=2_500, superstep_k=2, cmds=8,
+                           durable_dir=str(tmp_path / "ing"),
+                           disk_faults=True, mesh=True)
+    assert res["durable"] and res["mesh"] == "1x8"
+    assert res["wal_shards"] == 8
+    # per-device layout: 8 equal contiguous lane slices
+    assert res["wal_shard_layout"] == [[i * 8, (i + 1) * 8]
+                                       for i in range(8)]
+    assert res["placed"] > 5_000
+    assert res["dup_dropped"] > 0
+
+
+@pytest.mark.slow
+def test_ingress_soak_full_scale_mesh(tmp_path):
+    """The ISSUE 11 acceptance scenario at full scale: 1M sessions
+    into >= 100k lanes sharded across the 8 forced-host devices,
+    durable with per-device WAL shards, under disk-fault + election
+    chaos, exactly-once oracle exact (tools/soak.py --ingress --mesh
+    runs the same entry)."""
+    _require_multidevice()
+    res = run_ingress_soak(0, sessions=1_000_000, lanes=102_400,
+                           waves=24, wave_rows=200_000,
+                           durable_dir=str(tmp_path / "ing"),
+                           disk_faults=True, mesh=True)
+    assert res["sessions"] == 1_000_000 and res["lanes"] >= 100_000
+    assert res["mesh"] == "1x8" and res["wal_shards"] == 8
 
 
 @pytest.mark.slow
@@ -655,7 +742,7 @@ def test_ra_top_renders_ingress_panel(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     base_ing = {"sessions": 1_000_000, "queue_rows": 512,
                 "accepted": 10_000, "dup_dropped": 37, "shed_rows": 0,
-                "rejected": 5,
+                "rejected": 5, "wal_pending_steps": 3,
                 "ladder": {"level_name": "tight", "level": 1}}
     t0 = time.time()
     snap0 = {"seq": 1, "ts": t0 - 1.0,
@@ -677,5 +764,8 @@ def test_ra_top_renders_ingress_panel(tmp_path):
     assert "ingress" in out and "sessions=1000000" in out
     assert "q=512" in out and "level=tight" in out
     assert "dup=37" in out and "shed=40" in out
+    # the durability half of the backlog renders under durable/mesh
+    # runs (ISSUE 11 satellite)
+    assert "wal_pending=3" in out
     assert "SHEDDING" in out
     assert "50.0K acc/s" in out or "acc/s" in out

@@ -371,6 +371,99 @@ def test_closed_loop_deepens_batching_on_throughput_floor():
                  windows=6) == []
 
 
+def mk_mesh_obs(state):
+    """An Observatory whose engine source mirrors a SHARDED-MESH
+    durable engine: mesh_shape stamped in the pipeline overview and
+    PER-DEVICE WAL shards (8, one per lane-axis device) feeding the
+    wildcard fsync objective — the layout the multichip sweep's tuner
+    reads (ISSUE 11)."""
+    obs = Observatory(ring_capacity=64)
+
+    def engine_src():
+        fp = state["fsync_p99"]
+        return {
+            "pipeline": {"mesh_shape": "1x8"},
+            "phases": {
+                "device_dispatch": {"total_ms": state["disp_total"]},
+                "fsync_wait": {"total_ms": state["fsync_total"]},
+                "commit_e2e": {"total_ms": state["e2e_total"],
+                               "p99_ms": state["commit_p99"]},
+            },
+            # 8 per-device shards; the objective's max-aggregation
+            # must read the laggiest device's fsync tail
+            "wal": {"shards": [
+                {"shard": i, "lanes": [i * 8, (i + 1) * 8],
+                 "fsync_p99_ms": fp if fp < 0 else fp + 0.01 * i}
+                for i in range(8)]},
+            "telemetry": {"ts": time.time(),
+                          "committed_total": state["committed"]},
+            "gauge_cmds_per_s": state["gauge_rate"],
+        }
+
+    obs.add_source("engine", engine_src)
+    return obs
+
+
+def mesh_plant(knobs, state):
+    """Synthetic sharded-mesh plant: dispatch-bound while the fixed
+    per-dispatch cost dominates (fusion amortizes it across the mesh);
+    once the per-device WAL shards saturate (``regime`` flips), the
+    fsync tail grows with the group wait AND the per-dispatch burst K
+    — fusing deeper into the saturated shards makes it worse."""
+    k = knobs["superstep_k"]
+    interval = knobs["wal_max_batch_interval_ms"]
+    if state["regime"] == "dispatch":
+        state["disp_total"] += 100.0 / k
+        state["fsync_total"] += 4.0
+        state["e2e_total"] += 110.0 / k
+        state["commit_p99"] = 100.0 / k + 5.0
+        state["fsync_p99"] = 5.0
+    else:
+        state["fsync_total"] += 100.0
+        state["disp_total"] += 5.0
+        state["e2e_total"] += 120.0
+        state["fsync_p99"] = 30.0 + 2.0 * interval + 4.0 * k
+        state["commit_p99"] = state["fsync_p99"] / 2.0
+    state["committed"] += 10000.0
+
+
+def test_closed_loop_converges_on_mesh_plant():
+    """ISSUE 11 acceptance: pointing the PR 8 controller at a mesh
+    plant is the cheapest frontier search we own — on the
+    dispatch-bound mesh K walks up (1->2->4->8, attributed to
+    device_dispatch) and converges; when the per-device WAL shards
+    go fsync-bound it backs the group wait off 2->1->0 and then
+    halves K, never fusing deeper into saturated shards."""
+    state = {**base_state(), "regime": "dispatch"}
+    obs = mk_mesh_obs(state)
+    slo = SloEngine(obs, default_objectives(min_cmds_per_s=1.0),
+                    fast_windows=3, slow_windows=6,
+                    burn_fast=0.5, burn_slow=0.25)
+    tuner = mk_tuner(slo, obs,
+                     knobs={"superstep_k": 1,
+                            "wal_max_batch_interval_ms": 2.0})
+    up = drive(obs, tuner, state, mesh_plant, windows=16)
+    assert [(d["knob"], d["new"]) for d in up] == [
+        ("superstep_k", 2), ("superstep_k", 4), ("superstep_k", 8)]
+    assert all(d["phase"] == "device_dispatch" for d in up)
+    # converged on the dispatch-bound mesh: green windows stay quiet
+    assert drive(obs, tuner, state, mesh_plant, windows=4) == []
+    # the per-device shards saturate: fsync owns the budget
+    state["regime"] = "fsync"
+    down = drive(obs, tuner, state, mesh_plant, windows=18)
+    assert [(d["knob"], d["new"]) for d in down] == [
+        ("wal_max_batch_interval_ms", 1.0),
+        ("wal_max_batch_interval_ms", 0.0),
+        ("superstep_k", 4)]
+    assert all(d["objective"] == "fsync_p99_ms" for d in down)
+    assert all(d["phase"] == "fsync_wait" for d in down)
+    assert drive(obs, tuner, state, mesh_plant, windows=6) == []
+    # the chosen knobs ride the snapshot the multichip tail stamps
+    snap = obs.snapshot()
+    assert snap["autotune"]["knobs"]["superstep_k"] == 4
+    assert snap["engine"]["pipeline"]["mesh_shape"] == "1x8"
+
+
 def test_hysteresis_one_noisy_window_never_turns_a_knob():
     state = base_state()
     obs = mk_obs(state)

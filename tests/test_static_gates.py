@@ -577,3 +577,77 @@ def test_ingress_coalescer_is_ra08_clean():
     the repo-wide run too; pinned so a regression names the rule)."""
     r = run_lint(os.path.join(REPO, "ra_tpu", "ingress", "coalesce.py"))
     assert "RA08" not in r.stdout, r.stdout
+
+
+def test_checker_gates_mesh_driver_dispatch_loop(tmp_path):
+    """RA04 (mesh extension, ISSUE 11): host syncs reachable from the
+    mesh driver's dispatch loop (drive_uniform_window + same-module
+    closure) are flagged — the sharded frontier's measured loop obeys
+    the same no-sync contract as the bench loops.  Applies to files
+    named mesh.py only."""
+    bad = tmp_path / "mesh.py"
+    bad.write_text(textwrap.dedent("""\
+        import numpy as np
+
+        def drive_uniform_window(driver, nb, pb, seconds):
+            n = 0
+            while n < 100:
+                driver.submit(nb, pb)
+                _peek(driver)
+                n += 1
+            return n
+
+        def _peek(driver):
+            driver.engine.block_until_ready()
+            return np.asarray(driver.last_committed)
+
+        def shard_engine_state(engine):
+            # not on the dispatch loop: conversions here are fine
+            return np.asarray(engine.state.commit)
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA04") == 2, r.stdout
+    assert "_peek" in r.stdout
+    assert "shard_engine_state" not in r.stdout
+    # the same content under another module name is not gated
+    other = tmp_path / "driver.py"
+    other.write_text(bad.read_text())
+    r = run_lint(str(other))
+    assert "RA04" not in r.stdout
+
+
+def test_checker_gates_mesh_ingress_pump_path(tmp_path):
+    """RA08 (mesh extension, ISSUE 11): per-session Python loops/dict
+    allocation in the mesh-side ingress pump path (ingress_submit_wave
+    + closure) are flagged; non-pump functions are exempt."""
+    bad = tmp_path / "mesh.py"
+    bad.write_text(textwrap.dedent("""\
+        def ingress_submit_wave(plane, handles, seqnos, payloads):
+            for h in handles:                     # RA08: per-session
+                plane.touch(h)
+            return _meta(handles)
+
+        def _meta(handles):
+            return {"rows": len(handles)}         # RA08: via helper
+
+        def lane_mesh(devices):
+            # control-plane setup: loops here are fine
+            return [d for d in devices]
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA08") == 2, r.stdout
+    assert "ingress_submit_wave" in r.stdout and "_meta" in r.stdout
+    assert "lane_mesh" not in r.stdout
+    other = tmp_path / "pump.py"
+    other.write_text(bad.read_text())
+    r = run_lint(str(other))
+    assert "RA08" not in r.stdout
+
+
+def test_mesh_module_is_ra04_and_ra08_clean():
+    """The real mesh driver passes both gates (covered by the repo-wide
+    run too; pinned separately so a regression names the rule)."""
+    r = run_lint(os.path.join(REPO, "ra_tpu", "parallel", "mesh.py"))
+    assert "RA04" not in r.stdout and "RA08" not in r.stdout, r.stdout

@@ -13,7 +13,12 @@ optional latency percentiles, found at the top level or nested under
 * **latency regressions**: ``p99_commit_latency_ms`` /
   ``p50_commit_latency_ms`` / ``p99_applied_latency_ms`` rose by more
   than the bar (lower-is-better; -1 sentinels = not measured, skipped);
-* frontier ``points`` are compared per ``cmds_per_step``.
+* frontier ``points`` are compared per ``cmds_per_step``;
+* multichip sweep tails (ISSUE 11) are compared per mesh shape x lane
+  rung (``multichip/<mesh>/lanes<N>``, cmds_per_s higher-is-better) —
+  a cross-round mesh delta is attributable via each row's stamped
+  ``engine_pipeline`` config (superstep_k/dispatch_ahead/donation/
+  wal shard layout/mesh shape).
 
 The noise bar defaults to 10% — the builder-box numbers swing with
 host load (the BENCH_r02 vs r04 host-drift note), so a tight default
@@ -68,6 +73,18 @@ def extract_rows(doc: dict) -> dict:
 
     if _is_row(doc):
         add("headline", doc)
+    for i, m in enumerate(doc.get("multichip") or []):
+        # multichip sweep rows, one per mesh shape x lane rung; the
+        # dryrun-format rows carry ``cmds_per_s`` instead of ``value``
+        if not isinstance(m, dict):
+            continue
+        row = dict(m)
+        if "value" not in row and \
+                isinstance(row.get("cmds_per_s"), (int, float)):
+            row["value"] = row["cmds_per_s"]
+        if _is_row(row):
+            rows[f"multichip/{row.get('mesh', i)}/"
+                 f"lanes{row.get('lanes', '?')}"] = row
     detail = doc.get("detail")
     if isinstance(detail, dict):
         for key, sub in detail.items():

@@ -42,7 +42,10 @@ Checks (cheap, high-signal, zero-config):
                 telemetry sampler path (telemetry.py tick/
                 _start_sample/_harvest): the sampler rides the
                 dispatch loop, so its tick path obeys the same
-                no-blocking-sync contract
+                no-blocking-sync contract; and the MESH driver's
+                dispatch loop (mesh.py drive_uniform_window + its
+                same-module call closure, ISSUE 11) — the sharded
+                frontier's measured loop obeys the same contract
   RA05          (metrics.py only) every module-level counter-field
                 tuple (`*_FIELDS`) must be listed in FIELD_REGISTRY
                 (the registry parity test iterates it) and every field
@@ -83,7 +86,10 @@ Checks (cheap, high-signal, zero-config):
                 the coalescer exists to remove; a deliberate exception
                 carries an `# ra08-ok: <why>` line comment.  The
                 INGRESS_FIELDS registry/doc half rides RA05 (the tuple
-                lives in metrics.py like every other group)
+                lives in metrics.py like every other group).  ALSO
+                gates the mesh-side ingress pump path (mesh.py
+                ingress_submit_wave + closure, ISSUE 11): per-session
+                Python on the sharded fan-in is the same cost class
   RA03          (files in a `log/` directory only) no swallow-only
                 `except OSError:`/`except Exception:` (body is just
                 `pass`) around durability-bearing I/O calls (fsync/
@@ -426,11 +432,23 @@ _COALESCE_HOT_FUNCS = frozenset({"offer", "pop_block"})
 _LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
                ast.SetComp, ast.DictComp, ast.GeneratorExp)
 
+#: RA04/RA08 (mesh extension, ISSUE 11) — the mesh driver module
+#: (files named mesh.py): ``drive_uniform_window`` is the sharded
+#: frontier's measured dispatch loop, so its same-module call closure
+#: rides the RA04 no-host-sync gate exactly like the bench loops; the
+#: mesh-side ingress pump path (``ingress_submit_wave`` + closure)
+#: rides RA08's no-per-session-Python gate — a per-session loop there
+#: would put per-command host work back on the 100k-lane fan-in.
+_MESH_FILES = frozenset({"mesh.py"})
+_MESH_DISPATCH_FUNCS = frozenset({"drive_uniform_window"})
+_MESH_INGRESS_FUNCS = frozenset({"ingress_submit_wave"})
 
-def _check_coalesce_hot_path(tree: ast.Module, err) -> None:
+
+def _check_coalesce_hot_path(tree: ast.Module, err,
+                             roots=_COALESCE_HOT_FUNCS) -> None:
     """RA08: forbid Python loops and dict allocation in the coalescer
     hot path (allowlist via `# ra08-ok:` line comment)."""
-    for node in _sampler_hot_closure(tree, _COALESCE_HOT_FUNCS).values():
+    for node in _sampler_hot_closure(tree, roots).values():
         for sub in ast.walk(node):
             if isinstance(sub, _LOOP_NODES):
                 err(sub, "RA08",
@@ -689,6 +707,29 @@ def check_file(path: str) -> list:
                 err(node, code, msg)
 
         _check_coalesce_hot_path(tree, err_ra08)
+    if os.path.basename(path) in _MESH_FILES:
+        # the mesh driver's dispatch loop rides the RA04 no-host-sync
+        # closure gate (a sync there serializes the sharded frontier's
+        # measured pipeline) and the mesh-side ingress pump path rides
+        # RA08's no-per-session-Python gate (ISSUE 11 satellite)
+        mesh_lines = src.splitlines()
+        ra04_ok_m = {i + 1 for i, line in enumerate(mesh_lines)
+                     if "ra04-ok" in line}
+        ra08_ok_m = {i + 1 for i, line in enumerate(mesh_lines)
+                     if "ra08-ok" in line}
+
+        def err_ra04_mesh(node: ast.AST, code: str, msg: str) -> None:
+            if getattr(node, "lineno", 0) not in ra04_ok_m:
+                err(node, code, msg)
+
+        def err_ra08_mesh(node: ast.AST, code: str, msg: str) -> None:
+            if getattr(node, "lineno", 0) not in ra08_ok_m:
+                err(node, code, msg)
+
+        _check_sampler_sync(tree, err_ra04_mesh,
+                            roots=_MESH_DISPATCH_FUNCS)
+        _check_coalesce_hot_path(tree, err_ra08_mesh,
+                                 roots=_MESH_INGRESS_FUNCS)
     if os.path.basename(path) in (_BENCH_FILES | _TELEMETRY_FILES):
         ra04_ok = {i + 1 for i, line in enumerate(src.splitlines())
                    if "ra04-ok" in line}

@@ -141,12 +141,16 @@ def render(snap: dict, prev: dict | None = None) -> str:
         shed = ing.get("shed_rows", 0)
         flag = " <<< SHEDDING" if shed and prev is not None and \
             shed > (prev.get("ingress") or {}).get("shed_rows", 0) else ""
+        # the durability half of the backlog under durable/mesh runs
+        # (ingress queue + unconfirmed WAL steps = uncommitted total)
+        wp = ing.get("wal_pending_steps")
+        wp_s = f" wal_pending={wp}" if wp is not None else ""
         lines.append(
             f"ingress {rate} acc/s  sessions={ing.get('sessions', 0)} "
             f"q={ing.get('queue_rows', 0)} "
             f"level={ing.get('ladder', {}).get('level_name', '?')} "
             f"dup={ing.get('dup_dropped', 0)} shed={shed}"
-            f" rej={ing.get('rejected', 0)}{flag}")
+            f" rej={ing.get('rejected', 0)}{wp_s}{flag}")
     # -- WAL shards --------------------------------------------------------
     wal = eng.get("wal") or {}
     shards = wal.get("shards") or []
@@ -155,13 +159,27 @@ def render(snap: dict, prev: dict | None = None) -> str:
         shards = [sys_wal]
     for sh in shards[:8]:
         sid = sh.get("shard", "-")
+        lanes_sl = sh.get("lanes")
+        lane_s = f" lanes={lanes_sl[0]}..{lanes_sl[1]}" \
+            if isinstance(lanes_sl, list) and len(lanes_sl) == 2 else ""
         lines.append(
             f"wal[{sid}] fsync p50={sh.get('fsync_p50_ms', -1)}ms "
             f"p99={sh.get('fsync_p99_ms', -1)}ms "
             f"rec/fsync={sh.get('records_per_fsync', -1)} "
             f"queue={sh.get('queue_depth', 0)} "
             f"jobs={sh.get('jobs_pending', 0)} "
-            f"lag={sh.get('confirm_lag_steps', 0)}")
+            f"lag={sh.get('confirm_lag_steps', 0)}{lane_s}")
+    if len(shards) > 8:
+        # a wide per-device mesh layout (one shard per lane device):
+        # summarize the tail rather than silently truncating it
+        rest = shards[8:]
+        worst = max((s.get("fsync_p99_ms", -1) for s in rest),
+                    default=-1)
+        lag = max((s.get("confirm_lag_steps", 0) for s in rest),
+                  default=0)
+        jobs = sum(s.get("jobs_pending", 0) for s in rest)
+        lines.append(f"wal[+{len(rest)}] worst fsync p99={worst}ms "
+                     f"jobs={jobs} lag_max={lag}")
     df = (wal.get("disk_faults")
           or snap.get("system", {}).get("counters", {}).get("disk_faults"))
     if df and any(df.values()):

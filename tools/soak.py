@@ -7,7 +7,7 @@ Usage:  python tools/soak.py [seeds_per_family] [offset]
         python tools/soak.py --superstep SEED [n]
         python tools/soak.py --obs SEED [n] [jsonl_path]
         python tools/soak.py --blackbox SEED [n]
-        python tools/soak.py --ingress SEED [n]
+        python tools/soak.py --ingress SEED [n] [--mesh]
 
 ``--ingress`` runs the ISSUE 10 acceptance scenario at FULL scale
 (tests/test_ingress.run_ingress_soak): ~1M simulated sessions fanning
@@ -181,11 +181,18 @@ def _blackbox_main(argv: list) -> int:
 
 
 def _ingress_main(argv: list) -> int:
-    """--ingress SEED [n]: the million-session fan-in soak (ISSUE 10)."""
+    """--ingress SEED [n] [--mesh]: the million-session fan-in soak
+    (ISSUE 10).  ``--mesh`` (ISSUE 11) runs it end-to-end on lane
+    state sharded across every forced-host device — 1M sessions into
+    >= 100k lanes over >= 8 devices, durable with PER-DEVICE WAL
+    shards, under the same disk-fault + election chaos and
+    exactly-once oracle."""
     import json
 
     import test_ingress as ti
 
+    mesh = "--mesh" in argv
+    argv = [a for a in argv if a != "--mesh"]
     seed = int(argv[0]) if argv else 0
     n = int(argv[1]) if len(argv) > 1 else 1
     t0 = time.time()
@@ -195,14 +202,16 @@ def _ingress_main(argv: list) -> int:
         with tempfile.TemporaryDirectory(prefix="soak_ing_") as d:
             try:
                 last = ti.run_ingress_soak(
-                    s, sessions=1_000_000, lanes=10_000, waves=24,
-                    wave_rows=200_000, durable_dir=d, disk_faults=True)
+                    s, sessions=1_000_000,
+                    lanes=102_400 if mesh else 10_000, waves=24,
+                    wave_rows=200_000, durable_dir=d, disk_faults=True,
+                    mesh=mesh)
             except Exception:  # noqa: BLE001 — report seed + continue
                 failed.append(s)
                 if len(failed) == 1:
                     traceback.print_exc()
-    print(f"ingress: {n - len(failed)}/{n} ok in "
-          f"{time.time() - t0:.1f}s"
+    print(f"ingress{'-mesh' if mesh else ''}: "
+          f"{n - len(failed)}/{n} ok in {time.time() - t0:.1f}s"
           + (f"  FAILED seeds: {failed[:10]}" if failed else ""),
           flush=True)
     if last:

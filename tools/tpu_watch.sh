@@ -34,6 +34,10 @@ for attempt in $(seq 1 "$MAX_ATTEMPTS"); do
     capture kv_2k          600 RA_TPU_BENCH_MACHINE=kv RA_TPU_BENCH_LANES=2000 \
                                RA_TPU_BENCH_SECONDS=3.0
     capture headline_pallas 600 RA_TPU_QUORUM_IMPL=pallas RA_TPU_BENCH_SECONDS=3.0
+    # the sharded-mesh frontier sweep (ISSUE 11): only meaningful when
+    # the backend exposes >1 real device; the child no-ops the 2x4
+    # shape on a single chip but the 1xD ladder still captures
+    capture multichip      900 RA_TPU_BENCH_MODE=multichip RA_TPU_BENCH_SECONDS=3.0
     echo "$(date +%H:%M:%S) matrix done" >> "$OUT/log"
     exit 0
   fi
