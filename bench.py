@@ -898,6 +898,42 @@ def _multichip_main() -> None:
 
 
 # ---------------------------------------------------------------------------
+# wire mode: the socket-path frontier (ISSUE 12, ROADMAP item 2)
+# ---------------------------------------------------------------------------
+
+def _wire_bench_main() -> None:
+    """One rung of the wire connection ladder as a bench phase: the
+    full wire path (fixed-stride frames → per-connection rings →
+    vectorized sweep → ingress → fused dispatch) with a reconnect
+    storm mid-run, measured end to end through a durable engine by
+    default.  The tail carries ``wire_cmds_per_s`` /
+    ``wire_shed_rate`` / ``wire_reconnect_recovery_s`` so
+    tools/bench_diff.py tracks the wire frontier like any other."""
+    import tempfile
+
+    from ra_tpu.wire.soak import run_wire_soak
+
+    conns = int(os.environ.get("RA_TPU_BENCH_WIRE_CONNS", "100000"))
+    lanes = int(os.environ.get("RA_TPU_BENCH_WIRE_LANES", "1024"))
+    waves = int(os.environ.get("RA_TPU_BENCH_WIRE_WAVES", "12"))
+    durable = os.environ.get("RA_TPU_BENCH_WIRE_DURABLE", "1") == "1"
+    seed = int(os.environ.get("RA_TPU_BENCH_WIRE_SEED", "0"))
+    kw = dict(conns=conns, lanes=lanes, waves=waves,
+              wave_ops=max(20_000, conns // 2),
+              ring_records=16 if conns >= 1 << 19 else 32,
+              socket_conns=32, socket_ops=16)
+    if durable:
+        with tempfile.TemporaryDirectory(prefix="bench_wire_") as d:
+            row = run_wire_soak(seed, durable_dir=d, **kw)
+    else:
+        row = run_wire_soak(seed, **kw)
+    row["metric"] = "wire_committed_cmds_per_sec"
+    row["unit"] = "cmds/s"
+    row["host"] = _host_meta()
+    print(json.dumps(row))
+
+
+# ---------------------------------------------------------------------------
 # frontier mode: the latency/throughput frontier (one child, four points)
 # ---------------------------------------------------------------------------
 
@@ -1170,6 +1206,8 @@ def _parse_flags(argv) -> None:
         os.environ["RA_TPU_BENCH_SUPERSTEP"] = k
     if "--multichip" in argv:
         os.environ["RA_TPU_BENCH_MODE"] = "multichip"
+    if "--wire" in argv:
+        os.environ["RA_TPU_BENCH_MODE"] = "wire"
 
 
 MULTICHIP_TIMEOUT_S = 1200
@@ -1183,8 +1221,26 @@ def main() -> None:
             _frontier_main()
         elif mode == "multichip":
             _multichip_main()
+        elif mode == "wire":
+            _wire_bench_main()
         else:
             _child_main()
+        return
+
+    if os.environ.get("RA_TPU_BENCH_MODE") == "wire":
+        # the wire ladder is host-side + engine: CPU-safe everywhere,
+        # one child (retry once), always a JSON tail
+        env = {"RA_TPU_BENCH_MODE": "wire"}
+        if _probe_platform() in (None, "cpu"):
+            env.update({"PYTHONPATH": "", "JAX_PLATFORMS": "cpu"})
+        res = _run_child(env, CHILD_TIMEOUT_S) or \
+            _run_child(env, CHILD_TIMEOUT_S)
+        if res is not None:
+            print(json.dumps(res))
+        else:
+            print(json.dumps({
+                "value": 0.0, "error": "wire_children_failed",
+                "detail": {"child_errors": _CHILD_ERRORS[-2:]}}))
         return
 
     if os.environ.get("RA_TPU_BENCH_MODE") == "multichip":

@@ -123,6 +123,16 @@ EVENT_REGISTRY = {
                      "(SLO-verdict-driven; open/tight/fair)",
     "ingress.shed": "coalescer ring overflow began shedding rows "
                     "(transition into a shed episode, not per row)",
+    # -- wire plane (ra_tpu/wire/, ISSUE 12) ---------------------------
+    "wire.conn": "connection lifecycle: accept/close/bulk-connect/"
+                 "reconnect-storm (loopback fleets emit ONE event, "
+                 "never one per connection)",
+    "wire.credit": "the credit-frame ladder level changed between "
+                   "sweeps (transition only, never per row)",
+    "wire.shed": "a sweep began answering shed verdicts (transition "
+                 "into a wire shed episode)",
+    "wire.error": "protocol error (bad hello/version/record) closed "
+                  "a connection",
     # -- recorder meta -------------------------------------------------
     "bb.dump": "post-mortem bundle written",
     "bb.recover": "recovery stamped a join-able recovery report",

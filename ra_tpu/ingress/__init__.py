@@ -89,6 +89,11 @@ class IngressPlane:
         #: optional SloEngine whose commit-latency verdicts drive the
         #: ladder (polled at pump time — host dict work only)
         self.slo = slo
+        #: optional block-retire hook (the wire plane's ack fan-out,
+        #: ISSUE 12): called with the released handle array whenever a
+        #: block's committed watermark lands — i.e. off the driver's
+        #: EXISTING async readbacks, never a new host sync
+        self.on_block_committed = None
         self.counters = {f: 0 for f in INGRESS_FIELDS}
         #: in-flight blocks awaiting commit: (per-lane cumulative
         #: dispatched-row target, handle matrix [N, width], take [N])
@@ -255,6 +260,8 @@ class IngressPlane:
             valid = np.arange(width)[None, :] < take[:, None]
             released = self.ladder.release(handles[valid])
             self.counters["credits_released"] += released
+            if self.on_block_committed is not None:
+                self.on_block_committed(handles[valid])
 
     def settle(self, timeout: float = 30.0) -> None:
         """Flush everything: drain the window, dispatch, and drive

@@ -195,6 +195,28 @@ INGRESS_FIELDS = (
     "credits_released",
 )
 
+#: wire-plane counter fields (ra_tpu/wire/, ISSUE 12): one dict per
+#: WireListener, the Observatory ``wire`` source (flat ring keys
+#: ``wire_<field>``).  Pool lifecycle: ``conns_opened``/
+#: ``conns_closed`` connection slots bound/released (socket accepts
+#: AND loopback bulk connects), ``hello_reconnects`` re-binds of a
+#: known connection key (the epoch-bump trigger).  Data plane:
+#: ``bytes_recv`` raw bytes landed in the rings, ``sweeps`` vectorized
+#: sweep passes, ``swept_rows`` DATA records decoded and submitted
+#: (the wire twin of ingress ``submitted``), ``protocol_errors``
+#: malformed frames/records (each closes its connection).  Feedback
+#: plane: ``credit_rows``/``ack_rows`` verdict and watermark records
+#: serialized back; ``credit_ok``/``credit_slow``/``credit_defer``/
+#: ``credit_reject``/``credit_dup``/``credit_shed`` the credit-level
+#: histogram — per-status verdict counts (ra_top renders these as the
+#: wire panel's credit histogram).
+WIRE_FIELDS = (
+    "conns_opened", "conns_closed", "hello_reconnects", "bytes_recv",
+    "sweeps", "swept_rows", "protocol_errors", "credit_rows",
+    "ack_rows", "credit_ok", "credit_slow", "credit_defer",
+    "credit_reject", "credit_dup", "credit_shed",
+)
+
 #: the on-device aggregation of TELEMETRY_FIELDS (lockstep's jitted
 #: telemetry summary): scalar rollups plus the fixed-size lag histogram
 #: and the lax.top_k offender slots.  ``stalled_lanes`` lanes at or
@@ -233,6 +255,7 @@ FIELD_REGISTRY = {
     "telemetry_summary": TELEMETRY_SUMMARY_FIELDS,
     "phase": PHASE_FIELDS,
     "ingress": INGRESS_FIELDS,
+    "wire": WIRE_FIELDS,
 }
 
 

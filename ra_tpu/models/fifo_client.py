@@ -19,6 +19,11 @@ from typing import Any, Optional
 
 from .. import api
 from ..core.types import Priority, ServerId
+# the ONE verdict enum (ISSUE 12): FifoClient's ok → slow →
+# StopSending ladder speaks the same values the ingress CreditLadder
+# and the wire credit frame serialize (imported from the enum's home
+# module to keep this import cycle-free; ra_tpu.wire re-exports it)
+from ..ingress.backpressure import OK, REJECT, SLOW, STATUS_NAMES
 
 _mailbox_ids = itertools.count()
 
@@ -60,7 +65,16 @@ class Mailbox:
 class StopSending(RuntimeError):
     """enqueue() refused: unapplied commands reached max_pending (the
     reference's `{error, stop_sending}`, ra_fifo_client.erl:106-110) —
-    drain with flush()/poll_applied() before sending more."""
+    drain with flush()/poll_applied() before sending more.
+
+    On the unified verdict surface (ISSUE 12) this IS the ``reject``
+    tier: :attr:`VERDICT` carries the shared enum value the wire
+    plane's credit frames serialize for the same condition."""
+
+    #: the shared-admission-enum value this exception represents —
+    #: one verdict enum for FifoClient, the ingress ladder and the
+    #: wire credit frame
+    VERDICT: int = REJECT
 
 
 class FifoClient:
@@ -126,8 +140,32 @@ class FifoClient:
         self.next_seqno += 1
         self.pending[seqno] = msg
         self._pipeline(seqno, msg)
-        status = "slow" if len(self.pending) >= self.soft_limit else "ok"
+        # status strings derive from the ONE shared verdict enum
+        # (ra_tpu.wire.framing / ingress.backpressure): "ok"/"slow"
+        # exactly as before, now spelled by the wire plane's names
+        status = STATUS_NAMES[SLOW] \
+            if len(self.pending) >= self.soft_limit else STATUS_NAMES[OK]
         return status, seqno
+
+    def current_verdict(self) -> int:
+        """The session's admission verdict on the shared enum: OK
+        below soft_limit, SLOW past it, REJECT (= StopSending) at
+        max_pending — what a credit frame would say about this
+        session right now."""
+        self.poll_applied()
+        n = len(self.pending)
+        if n >= self.max_pending:
+            return REJECT
+        return SLOW if n >= self.soft_limit else OK
+
+    def credit_frame(self) -> bytes:
+        """Serialize the session's current verdict with the wire
+        plane's ONE credit-frame encoder (the ISSUE 12 unification):
+        a FifoClient backpressure episode and a wire credit frame are
+        the same protocol, byte for byte."""
+        from ..wire.framing import encode_credit
+        return encode_credit(0, [0], [max(0, self.next_seqno - 1)],
+                             [self.current_verdict()])
 
     def _trace_ctx(self, seqno: int) -> str:
         """Deterministic ingress trace id for one enqueue (ISSUE 7):

@@ -1,0 +1,116 @@
+"""Machine-level dedup: the exactly-once-observable half of the wire
+contract (ISSUE 12).
+
+The ingress gate is at-most-once (docs/INGRESS.md "Delivery
+guarantees"): a placed-but-unacked command can be lost to a Raft-legal
+truncation, so an at-least-once client re-enqueues unacked payloads
+under FRESH seqnos after an epoch bump — and that re-enqueue may
+duplicate a command whose first copy did commit.  The reference splits
+the problem exactly this way: ``ra.erl pipeline_command`` resends
+freely and the fifo machine dedups per-enqueuer seqnos machine-side
+(PAPER.md §1).  :class:`DedupCounterMachine` is that machine-side half
+for the wire plane's counter workload: every command carries a
+``(slot, op_id)`` client identity and the machine applies each op at
+most once, so end-to-end semantics upgrade to exactly-once-observable.
+
+Command encoding (``command_spec`` int32[3]): ``[slot, op_id, delta]``
+
+* ``slot`` — the session's per-lane rank (assigned at connect; unique
+  within a lane, < ``slots``).  An out-of-range slot is a no-op.
+* ``op_id`` — the client's monotone per-session operation id,
+  **starting at 1** (0 = the noop padding the engine's election path
+  feeds through empty command slots).
+* ``delta`` — the increment.
+
+State per lane: ``{"value": int32, "seq": int32[slots]}`` where
+``seq[slot]`` is the highest op applied for that client.  The batch
+fold is vectorized AND exactly order-equivalent to the sequential
+masked apply: a row applies iff its op exceeds both the slot's
+watermark at window entry and the max op of every earlier same-slot
+row in the window (the running-watermark prefix max — duplicates and
+stale re-sends inside one fused window are skipped just as a
+sequential scan would skip them).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.machine import JitMachine
+
+_I32 = jnp.int32
+
+
+def _scatter_max(seq, slot, val):
+    """Batched per-row scatter-max into the slot axis: flattens the
+    leading dims and vmaps one ``at[].max`` (duplicate slots resolve by
+    max, which is exactly the watermark semantics)."""
+    s = seq.shape[-1]
+    lead = seq.shape[:-1]
+    seqf = seq.reshape((-1, s))
+    slotf = slot.reshape((-1,) + slot.shape[len(lead):])
+    valf = val.reshape(slotf.shape)
+    out = jax.vmap(lambda q, i, v: q.at[i].max(v))(seqf, slotf, valf)
+    return out.reshape(seq.shape)
+
+
+class DedupCounterMachine(JitMachine):
+    command_spec = ("int32", (3,))
+    reply_spec = ("int32", ())
+    version = 0
+    supports_batch_apply = True
+
+    def __init__(self, slots: int = 64) -> None:
+        if slots < 1:
+            raise ValueError("slots must be >= 1")
+        self.slots = int(slots)
+
+    def jit_init(self, n_lanes: int):
+        return {"value": jnp.zeros((n_lanes,), _I32),
+                "seq": jnp.zeros((n_lanes, self.slots), _I32)}
+
+    def jit_apply(self, meta, command, state):
+        s = self.slots
+        raw = command[..., 0]
+        ok = (raw >= 0) & (raw < s)
+        slot = jnp.clip(raw, 0, s - 1)
+        op = command[..., 1]
+        delta = command[..., 2]
+        cur = jnp.take_along_axis(state["seq"], slot[..., None],
+                                  axis=-1)[..., 0]
+        fresh = ok & (op > cur)
+        value = state["value"] + jnp.where(fresh, delta, 0)
+        seq = _scatter_max(state["seq"], slot[..., None],
+                           jnp.where(fresh, op, 0)[..., None])
+        return {"value": value, "seq": seq}, value
+
+    def jit_apply_batch(self, meta, commands, mask, state):
+        # commands [..., A, 3], mask bool[..., A]; exact sequential
+        # equivalence via the running-watermark prefix max (see module
+        # docstring) — one [A, A] pairwise block, A = apply window
+        s = self.slots
+        raw = commands[..., 0]
+        ok = mask & (raw >= 0) & (raw < s)
+        slot = jnp.clip(raw, 0, s - 1)
+        op = commands[..., 1]
+        delta = commands[..., 2]
+        cur = jnp.take_along_axis(state["seq"], slot, axis=-1)
+        a = op.shape[-1]
+        same_slot = slot[..., :, None] == slot[..., None, :]
+        earlier = jnp.tril(jnp.ones((a, a), bool), k=-1)
+        prior_op = jnp.max(
+            jnp.where(same_slot & earlier & ok[..., None, :],
+                      op[..., None, :], 0), axis=-1)
+        fresh = ok & (op > jnp.maximum(cur, prior_op))
+        value = state["value"] + \
+            jnp.sum(jnp.where(fresh, delta, 0), axis=-1)
+        seq = _scatter_max(state["seq"], slot,
+                           jnp.where(fresh, op, 0))
+        return {"value": value, "seq": seq}
+
+    def encode_command(self, command):
+        slot, op, delta = command
+        return jnp.asarray([int(slot), int(op), int(delta)], _I32)
+
+    def decode_reply(self, reply):
+        return int(reply)

@@ -1,0 +1,222 @@
+"""Wire framing: the byte protocol between real clients and the
+ingress plane (ISSUE 12).
+
+The design constraint is the RA08/RA09 discipline extended to the
+socket path: the server's reader loop does ZERO per-command Python
+work, so the steady-state client→server stream must parse as one
+vectorized numpy sweep.  That forces a **fixed-stride** data stream:
+after the HELLO handshake, a connection's ingress bytes are a pure
+sequence of equal-size length-prefixed DATA records —
+
+    <u32 len> <u8 type=DATA> <u8 flags> <u16 sess> <u64 seqno> <i32 payload x C>
+
+— so a ring buffer holding N records is decoded by ONE ``frombuffer``
+view plus column slices (``decode_data``), never a per-frame walk.
+``len`` counts the bytes after the length prefix (the classic
+length-prefix contract); ``sess`` is the session's offset within the
+connection's session block (one TCP connection may multiplex up to
+65,536 wire sessions — the unit of flow control is the SESSION, the
+connection is just its transport).
+
+Control frames are variable-length and rare (connect-time / credit
+return), so they may be built and parsed per frame:
+
+* ``HELLO``      client→server  ``<ver u8> <tenants u8> <keylen u16>
+  <n_sessions u32> <key bytes>`` — resolves/creates the connection's
+  session block (same key ⇒ same sessions, epoch bumped: a reconnect).
+* ``HELLO_ACK``  server→client  ``<ver u8> <flags u8> <pad u16>
+  <epoch u32> <handle_base u64> <nslots u32> <i32 slot x nslots>`` —
+  the epoch is the at-least-once client's re-enqueue trigger
+  (docs/INGRESS.md "Delivery guarantees"); the per-session dedup
+  SLOTS are the machine-level identity a client embeds in payloads
+  for exactly-once-observable workloads (wire/dedup.py).
+* ``CREDIT``     server→client  ``<level u8> <pad u8> <count u16>`` +
+  ``count`` records ``<sess u16> <seqno u64> <status u8>`` — the
+  CreditLadder verdict for every swept row, serialized back per
+  connection.  This frame IS the generalized ``StopSending``: the
+  status enum is the ingress plane's (ok/slow/defer/reject/dup/shed),
+  one enum, one encoder (:func:`encode_credit`), shared with
+  :class:`~ra_tpu.models.fifo_client.FifoClient`.
+* ``ACK``        server→client  ``<pad u16> <count u16>`` + ``count``
+  records ``<sess u16> <acked u64>`` — per-session cumulative
+  committed placed-row watermarks (flow-control grade: duplicate row
+  commits can run a watermark ahead; exactness is machine-level — see
+  docs/INGRESS.md).
+
+The version byte rides HELLO/HELLO_ACK; a mismatch refuses the
+connection before any data record is interpreted.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# one verdict enum for the whole admission surface: the wire credit
+# frame, the ingress ladder and the fifo client's ok→slow→StopSending
+# protocol all speak these values (the ISSUE 12 unification satellite)
+from ..ingress.backpressure import (DEFER, DUP, OK, REJECT, SHED, SLOW,
+                                    STATUS_NAMES)
+
+__all__ = [
+    "WIRE_VERSION", "T_HELLO", "T_HELLO_ACK", "T_DATA", "T_CREDIT",
+    "T_ACK", "data_dtype", "credit_dtype", "ack_dtype", "data_stride",
+    "encode_hello", "decode_hello", "encode_hello_ack",
+    "decode_hello_ack", "encode_data", "decode_data", "encode_credit",
+    "decode_credit", "encode_ack", "decode_ack", "read_frame",
+    "OK", "SLOW", "DEFER", "REJECT", "DUP", "SHED", "STATUS_NAMES",
+]
+
+#: protocol version (HELLO/HELLO_ACK version byte)
+WIRE_VERSION = 1
+
+T_HELLO = 1
+T_HELLO_ACK = 2
+T_DATA = 3
+T_CREDIT = 4
+T_ACK = 5
+
+_LEN = struct.Struct("<I")
+_HELLO = struct.Struct("<BBBHI")       # type, ver, tenants, keylen, n_sessions
+_HELLO_ACK = struct.Struct("<BBBHIQ")  # type, ver, flags, pad, epoch, base
+_CREDIT_HDR = struct.Struct("<BBBH")   # type, level, pad, count
+_ACK_HDR = struct.Struct("<BBHH")      # type, pad, pad, count
+
+
+def data_dtype(payload_width: int) -> np.dtype:
+    """Packed little-endian record dtype of one DATA frame (stride =
+    16 + 4*C bytes)."""
+    return np.dtype([("len", "<u4"), ("type", "u1"), ("flags", "u1"),
+                     ("sess", "<u2"), ("seqno", "<u8"),
+                     ("pay", "<i4", (int(payload_width),))])
+
+
+def data_stride(payload_width: int) -> int:
+    return data_dtype(payload_width).itemsize
+
+
+#: CREDIT record: one verdict per swept row (11 bytes packed)
+credit_dtype = np.dtype([("sess", "<u2"), ("seqno", "<u8"),
+                         ("status", "u1")])
+
+#: ACK record: per-session cumulative committed-row watermark
+ack_dtype = np.dtype([("sess", "<u2"), ("acked", "<u8")])
+
+
+# -- control frames (rare; per-frame Python is fine here) -------------------
+
+def encode_hello(key: str, n_sessions: int, *, tenants: int = 1) -> bytes:
+    kb = key.encode()
+    body = _HELLO.pack(T_HELLO, WIRE_VERSION, tenants, len(kb),
+                       n_sessions) + kb
+    return _LEN.pack(len(body)) + body
+
+
+def decode_hello(body: bytes) -> dict:
+    t, ver, tenants, keylen, n_sessions = _HELLO.unpack_from(body)
+    if t != T_HELLO:
+        raise ValueError(f"not a HELLO frame (type {t})")
+    key = body[_HELLO.size:_HELLO.size + keylen].decode()
+    return {"version": ver, "tenants": tenants, "key": key,
+            "n_sessions": n_sessions}
+
+
+def encode_hello_ack(epoch: int, handle_base: int,
+                     slots=None) -> bytes:
+    slots = np.zeros(0, np.int32) if slots is None else \
+        np.asarray(slots, np.int32)
+    body = _HELLO_ACK.pack(T_HELLO_ACK, WIRE_VERSION, 0, 0,
+                           epoch, handle_base) \
+        + struct.pack("<I", len(slots)) + slots.tobytes()
+    return _LEN.pack(len(body)) + body
+
+
+def decode_hello_ack(body: bytes) -> dict:
+    t, ver, _fl, _p, epoch, base = _HELLO_ACK.unpack_from(body)
+    if t != T_HELLO_ACK:
+        raise ValueError(f"not a HELLO_ACK frame (type {t})")
+    (n,) = struct.unpack_from("<I", body, _HELLO_ACK.size)
+    slots = np.frombuffer(body, "<i4", n, _HELLO_ACK.size + 4) \
+        if n else None
+    return {"version": ver, "epoch": epoch, "handle_base": base,
+            "slots": slots}
+
+
+# -- the data stream (vectorized both ways) ---------------------------------
+
+def encode_data(sess, seqnos, payloads) -> bytes:
+    """Encode a batch of commands as the fixed-stride DATA stream (one
+    structured-array fill + ``tobytes`` — no per-record Python)."""
+    payloads = np.asarray(payloads)
+    if payloads.ndim == 1:
+        payloads = payloads[:, None]
+    n, c = payloads.shape
+    rec = np.zeros(n, data_dtype(c))
+    rec["len"] = rec.dtype.itemsize - 4
+    rec["type"] = T_DATA
+    rec["sess"] = np.asarray(sess)
+    rec["seqno"] = np.asarray(seqnos)
+    rec["pay"] = payloads
+    return rec.tobytes()
+
+
+def decode_data(buf, payload_width: int) -> np.ndarray:
+    """View a byte block as DATA records (the sweep-side decode: one
+    ``frombuffer``, zero copies).  ``buf`` length must be a whole
+    number of strides."""
+    return np.frombuffer(buf, data_dtype(payload_width))
+
+
+# -- credit / ack (vectorized records, small per-frame headers) -------------
+
+def encode_credit(level: int, sess, seqnos, statuses) -> bytes:
+    """THE credit-frame encoder (one encoder for the whole verdict
+    surface): per-row CreditLadder verdicts + the current ladder level,
+    serialized as one frame."""
+    rec = np.zeros(len(np.atleast_1d(np.asarray(sess))), credit_dtype)
+    rec["sess"] = np.asarray(sess)
+    rec["seqno"] = np.asarray(seqnos)
+    rec["status"] = np.asarray(statuses)
+    body = _CREDIT_HDR.pack(T_CREDIT, int(level), 0, len(rec)) \
+        + rec.tobytes()
+    return _LEN.pack(len(body)) + body
+
+
+def decode_credit(body: bytes) -> tuple:
+    """Returns ``(level, records)`` with ``records`` a credit_dtype
+    array (vectorized client-side decode)."""
+    t, level, _p, count = _CREDIT_HDR.unpack_from(body)
+    if t != T_CREDIT:
+        raise ValueError(f"not a CREDIT frame (type {t})")
+    rec = np.frombuffer(body, credit_dtype, count, _CREDIT_HDR.size)
+    return level, rec
+
+
+def encode_ack(sess, acked) -> bytes:
+    rec = np.zeros(len(np.atleast_1d(np.asarray(sess))), ack_dtype)
+    rec["sess"] = np.asarray(sess)
+    rec["acked"] = np.asarray(acked)
+    body = _ACK_HDR.pack(T_ACK, 0, 0, len(rec)) + rec.tobytes()
+    return _LEN.pack(len(body)) + body
+
+
+def decode_ack(body: bytes) -> np.ndarray:
+    t, _a, _b, count = _ACK_HDR.unpack_from(body)
+    if t != T_ACK:
+        raise ValueError(f"not an ACK frame (type {t})")
+    return np.frombuffer(body, ack_dtype, count, _ACK_HDR.size)
+
+
+def read_frame(buf: bytes, offset: int = 0):
+    """Client-side frame walk over a received byte buffer: returns
+    ``(type, body, next_offset)`` or ``None`` when the buffer holds no
+    complete frame at ``offset`` (control-plane parsing — the server
+    side never walks frames, it sweeps)."""
+    if len(buf) - offset < _LEN.size:
+        return None
+    (length,) = _LEN.unpack_from(buf, offset)
+    start = offset + _LEN.size
+    if len(buf) - start < length or length < 1:
+        return None
+    body = buf[start:start + length]
+    return body[0], body, start + length

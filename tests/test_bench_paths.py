@@ -381,6 +381,70 @@ def test_bench_telemetry_opt_out():
     assert "observatory" not in doc
 
 
+def test_child_wire_mode_contract(tmp_path):
+    """ISSUE 12: the ``bench.py --wire`` child prints one JSON tail
+    carrying the wire frontier keys (format pinned — bench_diff and
+    the round captures parse this shape).  Tiny CPU-scaled config."""
+    doc = run_child({
+        "RA_TPU_BENCH_MODE": "wire",
+        "RA_TPU_BENCH_WIRE_CONNS": "512",
+        "RA_TPU_BENCH_WIRE_LANES": "64",
+        "RA_TPU_BENCH_WIRE_WAVES": "4",
+        "RA_TPU_BENCH_WIRE_DURABLE": "0",
+    })
+    assert doc["value"] > 0
+    assert doc["wire_cmds_per_s"] == doc["value"]
+    assert 0 <= doc["wire_shed_rate"] <= 1
+    assert "wire_reconnect_recovery_s" in doc
+    assert doc["conns"] == 512 and doc["metric"] == \
+        "wire_committed_cmds_per_sec"
+    assert doc["storm_requeued"] > 0       # the storm actually ran
+    assert "host" in doc
+
+
+def test_wire_flag_sets_env():
+    """--wire routes the parent into the wire-mode child (the flag
+    twin of --multichip)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("bench_flags", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    old = os.environ.pop("RA_TPU_BENCH_MODE", None)
+    try:
+        mod._parse_flags(["--wire"])
+        assert os.environ["RA_TPU_BENCH_MODE"] == "wire"
+    finally:
+        if old is None:
+            os.environ.pop("RA_TPU_BENCH_MODE", None)
+        else:
+            os.environ["RA_TPU_BENCH_MODE"] = old
+
+
+def test_bench_diff_compares_wire_keys(tmp_path):
+    """ISSUE 12 satellite: when both tails carry the wire keys,
+    bench_diff flags throughput drops, shed-rate rises AND reconnect-
+    recovery regressions (0 is a healthy baseline for both; a -1
+    recovery sentinel = no storm ran, skipped)."""
+    diff_tool = os.path.join(REPO, "tools", "bench_diff.py")
+    base = {"value": 90_000.0, "wire_cmds_per_s": 90_000.0,
+            "wire_shed_rate": 0.0, "wire_reconnect_recovery_s": 0.1}
+    a = tmp_path / "old.json"
+    b = tmp_path / "new.json"
+    a.write_text(json.dumps(base))
+    worse = {"value": 40_000.0, "wire_cmds_per_s": 40_000.0,
+             "wire_shed_rate": 0.3, "wire_reconnect_recovery_s": 3.0}
+    b.write_text(json.dumps(worse))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stdout
+    # value + wire_cmds_per_s + shed rate + recovery
+    assert r.stdout.count("REGRESSION") == 4, r.stdout
+    b.write_text(json.dumps(base))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 def test_bench_diff_compares_ingress_keys(tmp_path):
     """ISSUE 10 satellite: when both tails carry the ingress keys,
     bench_diff flags throughput drops (higher-is-better) and shed-rate

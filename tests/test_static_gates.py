@@ -646,6 +646,73 @@ def test_checker_gates_mesh_ingress_pump_path(tmp_path):
     assert "RA08" not in r.stdout
 
 
+def test_checker_enforces_wire_sweep_path(tmp_path):
+    """RA09 (ISSUE 12): Python loops and dict allocation inside the
+    wire reader sweep path (sweep + the same-module helpers it
+    reaches) are flagged — per-frame Python there is the RA08 bug
+    class extended to the socket path.  `# ra09-ok:` allowlists
+    per-CONNECTION work; non-sweep functions and other directories
+    are not gated."""
+    wdir = tmp_path / "wire"
+    wdir.mkdir()
+    bad = wdir / "server.py"
+    bad.write_text(textwrap.dedent("""\
+        import numpy as np
+
+        class L:
+            def sweep(self):
+                rows = [r for r in self.rbuf]         # RA09: loop
+                meta = {"rows": len(rows)}            # RA09: dict
+                return self._fanout(rows), meta
+
+            def _fanout(self, rows):
+                for r in rows:                        # RA09: via helper
+                    self.send(r)
+
+            def overview(self):
+                # NOT on the sweep path: control-plane loops are fine
+                return {k: v for k, v in self.counters.items()}
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    out = r.stdout
+    assert out.count("RA09") == 3, out
+    assert "sweep()" in out and "_fanout()" in out
+    assert "overview" not in out
+    # allowlisted per-connection lines pass
+    fixed = bad.read_text() \
+        .replace("rows = [r for r in self.rbuf]",
+                 "rows = [r for r in self.rbuf]  # ra09-ok: test") \
+        .replace('meta = {"rows": len(rows)}',
+                 'meta = {"rows": len(rows)}  # ra09-ok: once') \
+        .replace("for r in rows:",
+                 "for r in rows:  # ra09-ok: per-connection write")
+    bad.write_text(fixed)
+    r = run_lint(str(bad))
+    assert "RA09" not in r.stdout, r.stdout
+    # the same content OUTSIDE a wire/ directory is not gated
+    other = tmp_path / "server.py"
+    other.write_text(textwrap.dedent("""\
+        class L:
+            def sweep(self):
+                return [r for r in self.rbuf]
+    """))
+    r = run_lint(str(other))
+    assert "RA09" not in r.stdout
+
+
+def test_wire_package_is_ra09_clean():
+    """The real wire sweep path is loop- and dict-free outside its
+    allowlisted per-connection sites (covered by the repo-wide run
+    too; pinned so a regression names the rule)."""
+    import os as _os
+    wdir = os.path.join(REPO, "ra_tpu", "wire")
+    for name in sorted(_os.listdir(wdir)):
+        if name.endswith(".py"):
+            r = run_lint(os.path.join(wdir, name))
+            assert "RA09" not in r.stdout, (name, r.stdout)
+
+
 def test_mesh_module_is_ra04_and_ra08_clean():
     """The real mesh driver passes both gates (covered by the repo-wide
     run too; pinned separately so a regression names the rule)."""

@@ -49,8 +49,14 @@ LATENCY_FIELDS = ("p50_commit_latency_ms", "p99_commit_latency_ms",
 #: lower-is-better AND zero is a meaningful healthy baseline, so a
 #: shed rate appearing from 0 flags against an absolute floor of 1.0
 #: in the relative formula rather than being skipped as degenerate
-INGRESS_RATE_FIELDS = ("ingress_cmds_per_s",)
-INGRESS_SHED_FIELDS = ("ingress_shed_rate",)
+#: wire-plane keys (ISSUE 12) ride the same two shapes: throughput
+#: higher-is-better; shed rate AND reconnect-storm recovery time
+#: lower-is-better with 0 a meaningful healthy baseline (recovery
+#: carries a -1 "no storm ran" sentinel, skipped like the latency
+#: sentinels)
+INGRESS_RATE_FIELDS = ("ingress_cmds_per_s", "wire_cmds_per_s")
+INGRESS_SHED_FIELDS = ("ingress_shed_rate", "wire_shed_rate",
+                       "wire_reconnect_recovery_s")
 
 
 def _is_row(d) -> bool:

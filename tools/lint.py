@@ -90,6 +90,18 @@ Checks (cheap, high-signal, zero-config):
                 gates the mesh-side ingress pump path (mesh.py
                 ingress_submit_wave + closure, ISSUE 11): per-session
                 Python on the sharded fan-in is the same cost class
+  RA09          (files in a `wire/` directory only, ISSUE 12) the
+                wire reader SWEEP path (`sweep` + every same-module
+                helper it reaches) must do zero per-frame/per-command
+                Python work: no Python loops (for/while/
+                comprehensions) and no dict allocation — the sweep
+                runs for every ingress pass at up-to-millions-of-
+                frames rates, and a per-frame Python object there
+                reintroduces exactly the per-command cost the
+                preallocated-ring design removes (RA08 extended to
+                the socket path).  Per-CONNECTION work (a socket
+                write per conn, a protocol-error close) carries an
+                `# ra09-ok: <why>` line comment
   RA03          (files in a `log/` directory only) no swallow-only
                 `except OSError:`/`except Exception:` (body is just
                 `pass`) around durability-bearing I/O calls (fsync/
@@ -443,32 +455,46 @@ _MESH_FILES = frozenset({"mesh.py"})
 _MESH_DISPATCH_FUNCS = frozenset({"drive_uniform_window"})
 _MESH_INGRESS_FUNCS = frozenset({"ingress_submit_wave"})
 
+#: RA09 — the wire reader sweep path (files in a `wire/` directory,
+#: ISSUE 12): `sweep` + its same-module call closure is the zero-per-
+#: command contract the whole wire plane is built on — length-prefixed
+#: frames land in preallocated rings and are decoded by ONE vectorized
+#: pass, so a per-frame Python loop or allocation there is the RA08
+#: bug class extended to the socket path.  Per-CONNECTION work (one
+#: socket write per conn, a protocol-error close) is allowlisted via
+#: `# ra09-ok: <why>` line comments.
+_WIRE_SWEEP_FUNCS = frozenset({"sweep"})
+
 
 def _check_coalesce_hot_path(tree: ast.Module, err,
-                             roots=_COALESCE_HOT_FUNCS) -> None:
-    """RA08: forbid Python loops and dict allocation in the coalescer
-    hot path (allowlist via `# ra08-ok:` line comment)."""
+                             roots=_COALESCE_HOT_FUNCS,
+                             code: str = "RA08",
+                             what: str = "coalescer") -> None:
+    """RA08/RA09: forbid Python loops and dict allocation in a
+    vectorized hot path (allowlist via `# ra08-ok:`/`# ra09-ok:` line
+    comment — resolved by the caller's err wrapper)."""
+    mark = f"# {code.lower()}-ok: why"
     for node in _sampler_hot_closure(tree, roots).values():
         for sub in ast.walk(node):
             if isinstance(sub, _LOOP_NODES):
-                err(sub, "RA08",
-                    f"Python loop in coalescer hot path {node.name}() "
-                    "— per-session iteration turns the vectorized "
-                    "block build back into per-command host work; "
+                err(sub, code,
+                    f"Python loop in {what} hot path {node.name}() "
+                    "— per-row iteration turns the vectorized "
+                    "path back into per-command host work; "
                     "vectorize (argsort/fancy indexing) or mark the "
-                    "line '# ra08-ok: why'")
+                    f"line '{mark}'")
             elif isinstance(sub, ast.Dict):
-                err(sub, "RA08",
-                    f"dict allocation in coalescer hot path "
+                err(sub, code,
+                    f"dict allocation in {what} hot path "
                     f"{node.name}(); preallocate outside the hot path "
-                    "or mark the line '# ra08-ok: why'")
+                    f"or mark the line '{mark}'")
             elif isinstance(sub, ast.Call) and \
                     isinstance(sub.func, ast.Name) and \
                     sub.func.id == "dict":
-                err(sub, "RA08",
-                    f"dict() allocation in coalescer hot path "
+                err(sub, code,
+                    f"dict() allocation in {what} hot path "
                     f"{node.name}(); preallocate outside the hot path "
-                    "or mark the line '# ra08-ok: why'")
+                    f"or mark the line '{mark}'")
 
 
 #: RA05 — the field-group registry contract (metrics.py): a counter
@@ -707,6 +733,17 @@ def check_file(path: str) -> list:
                 err(node, code, msg)
 
         _check_coalesce_hot_path(tree, err_ra08)
+    if os.path.basename(os.path.dirname(path)) == "wire":
+        ra09_ok = {i + 1 for i, line in enumerate(src.splitlines())
+                   if "ra09-ok" in line}
+
+        def err_ra09(node: ast.AST, code: str, msg: str) -> None:
+            if getattr(node, "lineno", 0) not in ra09_ok:
+                err(node, code, msg)
+
+        _check_coalesce_hot_path(tree, err_ra09,
+                                 roots=_WIRE_SWEEP_FUNCS,
+                                 code="RA09", what="wire sweep")
     if os.path.basename(path) in _MESH_FILES:
         # the mesh driver's dispatch loop rides the RA04 no-host-sync
         # closure gate (a sync there serializes the sharded frontier's

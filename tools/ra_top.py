@@ -151,6 +151,31 @@ def render(snap: dict, prev: dict | None = None) -> str:
             f"level={ing.get('ladder', {}).get('level_name', '?')} "
             f"dup={ing.get('dup_dropped', 0)} shed={shed}"
             f" rej={ing.get('rejected', 0)}{wp_s}{flag}")
+    # -- wire plane (ISSUE 12) ---------------------------------------------
+    wire = snap.get("wire") or {}
+    if wire:
+        p_wire = (prev.get("wire") or {}) if prev is not None else {}
+        if prev is not None:
+            dt = max(ts - prev.get("ts", ts), 1e-9)
+            dr = wire.get("swept_rows", 0) - p_wire.get("swept_rows", 0)
+            rate = _fmt_rate(dr / dt)
+        else:
+            rate = "--"
+        # credit-level histogram over the window (falls back to the
+        # lifetime totals on the first frame)
+        levels = ("credit_ok", "credit_slow", "credit_defer",
+                  "credit_reject", "credit_dup", "credit_shed")
+        hist = [max(0, wire.get(k, 0) - p_wire.get(k, 0)) for k in levels] \
+            if prev is not None else [wire.get(k, 0) for k in levels]
+        names = ("ok", "slow", "defer", "rej", "dup", "shed")
+        hist_s = " ".join(f"{n}={v}" for n, v in zip(names, hist) if v)
+        errs = wire.get("protocol_errors", 0)
+        lines.append(
+            f"wire    {rate} rec/s  conns={wire.get('conns', 0)} "
+            f"(sock={wire.get('socket_conns', 0)} "
+            f"paused={wire.get('paused_conns', 0)})  "
+            f"credit[{_spark(hist)}] {hist_s or 'idle'}"
+            + (f"  errs={errs}" if errs else ""))
     # -- WAL shards --------------------------------------------------------
     wal = eng.get("wal") or {}
     shards = wal.get("shards") or []

@@ -8,6 +8,18 @@ Usage:  python tools/soak.py [seeds_per_family] [offset]
         python tools/soak.py --obs SEED [n] [jsonl_path]
         python tools/soak.py --blackbox SEED [n]
         python tools/soak.py --ingress SEED [n] [--mesh]
+        python tools/soak.py --wire SEED [--durable] [--c1m]
+
+``--wire`` climbs the ISSUE 12 connection ladder (ra_tpu/wire/soak.py
+run_wire_soak): C10k (with a real-socket side-car) → C100k loopback
+connections — add ``--c1m`` for the full C1M rung — each rung the
+whole wire path (fixed-stride frames → per-connection rings →
+vectorized sweep → ingress → fused dispatch) under a reconnect storm,
+election chaos, and a standing transport FaultPlan, closed by the
+exactly-once-observable oracle (machine-level dedup).  ``--durable``
+adds fsync-gated commits with a seeded DiskFaultPlan.  Prints one
+JSON tail per rung carrying ``wire_cmds_per_s``/``wire_shed_rate``/
+``wire_reconnect_recovery_s`` for tools/bench_diff.py.
 
 ``--ingress`` runs the ISSUE 10 acceptance scenario at FULL scale
 (tests/test_ingress.run_ingress_soak): ~1M simulated sessions fanning
@@ -220,7 +232,32 @@ def _ingress_main(argv: list) -> int:
     return 1 if failed else 0
 
 
+def _wire_main(argv: list) -> int:
+    """--wire SEED [--durable] [--c1m]: the C10k→C1M ladder."""
+    from ra_tpu.wire.soak import ladder_main
+
+    durable = "--durable" in argv
+    c1m = "--c1m" in argv
+    argv = [a for a in argv if not a.startswith("--")]
+    seed = int(argv[0]) if argv else 0
+    rungs = [10_000, 100_000] + ([1_000_000] if c1m else [])
+    t0 = time.time()
+    try:
+        ladder_main(seed, rungs, lanes=1024, waves=12,
+                    durable=durable, disk_faults=durable)
+    except Exception:  # noqa: BLE001 — report + nonzero exit
+        traceback.print_exc()
+        print(f"wire ladder: FAILED in {time.time() - t0:.1f}s",
+              flush=True)
+        return 1
+    print(f"wire ladder: {len(rungs)}/{len(rungs)} rungs ok in "
+          f"{time.time() - t0:.1f}s", flush=True)
+    return 0
+
+
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--wire":
+        return _wire_main(sys.argv[2:])
     if len(sys.argv) > 1 and sys.argv[1] == "--ingress":
         return _ingress_main(sys.argv[2:])
     if len(sys.argv) > 1 and sys.argv[1] == "--blackbox":
