@@ -114,6 +114,13 @@ def _host_meta() -> dict:
             "cpu_count": os.cpu_count(),
             "loadavg_1m": round(os.getloadavg()[0], 2),
         }
+        try:
+            # host envelope (ISSUE 13 satellite): fd cap + core count,
+            # the cross-host drift dimensions — one shared impl
+            from ra_tpu.utils import host_envelope
+            meta.update(host_envelope())
+        except Exception:  # noqa: BLE001 — optional on exotic platforms
+            pass
     except Exception:  # noqa: BLE001 — metadata must never kill a bench
         pass
     return meta

@@ -10,15 +10,23 @@ vectorized path; THIS file benches the full-featured classic path — the
 one that carries every feature (durable WAL + segments, membership,
 snapshots) — in two phases:
 
-  A. "local": 1 cluster x 3 members on three in-process RaNodes over a
-     LocalRouter, durable RaSystem logs.
+  A. "local": 1 cluster x 3 members CO-HOSTED on one RaNode over one
+     RaSystem — the shared-WAL deployment the group-commit fan-in is
+     built for (ISSUE 13): every member's batch-appends land in ONE
+     Wal, so one fdatasync covers all three members' bursts.  (Through
+     r05 this phase ran 3 RaNodes with 3 private WALs; the co-hosted
+     protocol measures the deployment the classic plane actually
+     ships, see docs/BENCHMARKS.md.)
   B. "tcp": 1 cluster x 3 members, each member its own OS process
      behind a TcpRouter (the erlang-dist role), the client in the
-     parent process pipelining over real sockets.
+     parent process pipelining over real sockets via the remote
+     pipeline fan-in (multi-command frames, batch-encoded wire).
 
 Machine: ra_bench's noop counter with a release_cursor every 100k
 applies (ra_bench.erl:43-49); payloads are 256-byte blobs
-(?DATA_SIZE, ra_bench.erl:34).
+(?DATA_SIZE, ra_bench.erl:34).  The machine implements the batched
+apply fold (Machine.apply_batch) — order-equivalent to the per-entry
+fold, exercised continuously by the oracle tests.
 
 Prints ONE JSON line:
   {"metric": "classic_node_committed_cmds_per_sec", "value": <tcp phase>,
@@ -45,10 +53,16 @@ DATA_SIZE = int(os.environ.get("RA_TPU_CLASSIC_DATA_SIZE", "256"))
 RELEASE_EVERY = 100_000
 TARGET = 20_000.0
 
+#: commands a client sends per credit draw — amortizes the credit
+#: lock/wake over a burst while keeping at most ``pipe`` in flight
+BURST = 64
+
 
 def _noop_machine():
     """ra_bench's machine: state counts applies, cursor released every
-    100k so the log truncates (ra_bench.erl:43-49)."""
+    100k so the log truncates (ra_bench.erl:43-49).  Implements the
+    batched fold (ISSUE 13): replies are the running count, exactly
+    what folding apply() over the run yields."""
     from ra_tpu.core.machine import Machine
     from ra_tpu.core.types import ReleaseCursor
 
@@ -62,52 +76,98 @@ def _noop_machine():
                 return new, new, [ReleaseCursor(meta.index, new)]
             return new, new
 
+        def apply_batch(self, meta, commands, state):
+            n = len(commands)
+            new = state + n
+            replies = list(range(state + 1, new + 1))
+            # the run crosses at most one release point (runs are
+            # bounded by the flush size << 100k): emit the same cursor
+            # the per-entry fold would have
+            base = meta.index
+            k = ((base + n - 1) // RELEASE_EVERY) * RELEASE_EVERY
+            if k >= base:
+                return new, replies, [ReleaseCursor(k, state + k - base + 1)]
+            return new, replies
+
     return NoopBench()
 
 
 class _Client:
-    """One pipelining client: keeps ``pipe`` commands in flight, counts
-    applied notifications, records enqueue->applied latency
+    """One pipelining client: keeps up to ``pipe`` commands in flight,
+    counts applied notifications, samples enqueue->applied latency 1/16
     (ra_bench.erl:153-190 measures the same edge via ra_event applied
-    batches)."""
+    batches).  Credit is drawn in bursts so the per-command cost of the
+    measuring client itself stays off the measured plane's budget."""
 
     def __init__(self, cid: int, pipe: int):
         self.cid = cid
-        self.credit = threading.Semaphore(pipe)
+        self.pipe = pipe
+        self.credit = pipe
         self.applied = 0
         self.lats: list = []
-        self.inflight: dict = {}
+        self.inflight: dict = {}   # sampled corr -> t0 (1/16 of sends)
+        #: credit-starvation resets: pipelined casts are fire-and-forget,
+        #: so a dropped frame (full peer queue, broken conn) loses its
+        #: acks and leaks credit — after 2s of zero credit with no acks
+        #: the window refills and the reset is COUNTED, so a lossy run
+        #: is visible in the row instead of silently idling a client
+        self.credit_resets = 0
         self._lock = threading.Lock()
+        self._have = threading.Event()
 
     def on_notify(self, batch) -> None:
         now = time.perf_counter()
-        n = 0
+        n = len(batch)
         with self._lock:
-            for corr, _reply in batch:
-                t0 = self.inflight.pop(corr, None)
-                if t0 is not None:
-                    n += 1
-                    if self.applied % 16 == 0:  # sample 1/16
+            self.applied += n
+            inflight = self.inflight
+            if inflight:
+                for corr, _reply in batch:
+                    t0 = inflight.pop(corr, None)
+                    if t0 is not None:
                         self.lats.append(now - t0)
-                self.applied += 1
-        for _ in range(n):
-            self.credit.release()
+            self.credit += n
+        self._have.set()
 
     def run(self, send, stop_evt, payload) -> None:
         seq = 0
+        starved_since = None
         while not stop_evt.is_set():
-            if not self.credit.acquire(timeout=0.25):
-                continue
-            corr = (self.cid, seq)
-            seq += 1
             with self._lock:
-                self.inflight[corr] = time.perf_counter()
+                take = self.credit if self.credit < BURST else BURST
+                self.credit -= take
+            if take <= 0:
+                self._have.clear()
+                if self._have.wait(0.02):
+                    starved_since = None
+                    continue
+                now = time.perf_counter()
+                if starved_since is None:
+                    starved_since = now
+                elif now - starved_since > 2.0:
+                    # leaked credits (dropped fire-and-forget frames):
+                    # refill the window and count the reset
+                    with self._lock:
+                        self.credit = self.pipe
+                        self.credit_resets += 1
+                    starved_since = None
+                continue
+            starved_since = None
+            sent = 0
             try:
-                send(payload, corr, self.on_notify)
+                for _ in range(take):
+                    corr = (self.cid, seq)
+                    if not (seq & 15):  # sample 1/16
+                        t0 = time.perf_counter()
+                        with self._lock:
+                            self.inflight[corr] = t0
+                    seq += 1
+                    send(payload, corr, self.on_notify)
+                    sent += 1
             except Exception:  # noqa: BLE001 — leader moved; retry path
                 with self._lock:
-                    self.inflight.pop(corr, None)
-                self.credit.release()
+                    self.credit += take - sent
+                    self.inflight.pop((self.cid, seq - 1), None)
                 time.sleep(0.05)
 
 
@@ -144,12 +204,14 @@ def _drive(send, warm_send) -> dict:
         "latency_samples": n,
         "degree": DEGREE, "pipe": PIPE, "data_size": DATA_SIZE,
         "seconds": SECONDS,
+        # nonzero = the run lost fire-and-forget frames (see _Client)
+        "credit_resets": sum(c.credit_resets for c in clients),
         "meets_reference_target": applied / elapsed >= TARGET,
     }
 
 
 # ---------------------------------------------------------------------------
-# phase A: in-process (1 RaNode per member name, LocalRouter)
+# phase A: in-process, co-hosted members over one shared-WAL RaSystem
 # ---------------------------------------------------------------------------
 
 def _phase_local() -> dict:
@@ -160,11 +222,12 @@ def _phase_local() -> dict:
 
     tmp = tempfile.mkdtemp(prefix="ra_classic_local_")
     router = LocalRouter()
-    sids = [ServerId(f"b{i}", f"bn{i}") for i in (1, 2, 3)]
-    systems = {s.node: RaSystem(os.path.join(tmp, s.node)) for s in sids}
-    nodes = {s.node: RaNode(s.node, router=router,
-                            log_factory=systems[s.node].log_factory)
-             for s in sids}
+    # ONE node + ONE system: the three members share the node's event
+    # loop and — the group-commit fan-in (ISSUE 13) — one Wal, so every
+    # member's batch-append rides the same fsync group
+    system = RaSystem(tmp)
+    node = RaNode("bn", router=router, log_factory=system.log_factory)
+    sids = [ServerId(f"b{i}", "bn") for i in (1, 2, 3)]
     try:
         ra_tpu.start_cluster("classic", _noop_machine, sids, router=router,
                              election_timeout_ms=500, tick_interval_ms=100)
@@ -181,28 +244,39 @@ def _phase_local() -> dict:
         leader = res.leader
 
         def send(payload, corr, cb):
+            # untraced bulk pipelining (the reference's cast carries no
+            # tracing either) — the measured path is the data plane,
+            # not the per-command observability plane
             ra_tpu.pipeline_command(leader, payload, correlation=corr,
-                                    notify_to=cb, router=router)
+                                    notify_to=cb, router=router,
+                                    trace_ctx=False)
 
         def warm(payload):
             ra_tpu.process_command(leader, payload, router=router)
 
         row = _drive(send, warm)
         row["members"] = 3
-        row["transport"] = "in-process"
+        row["transport"] = "in-process (co-hosted, shared WAL)"
         row["durable"] = True
-        # unified Observatory snapshot of the leader's system (WAL
-        # fsync p50/p99 + queue depth, segment writer, disk faults) —
-        # the classic-plane half of ISSUE 6's one-stop JSON tail
-        obs = systems[leader.node].observatory()
+        # replication-batching health (CLASSIC_FIELDS, ISSUE 13): AER
+        # batch sizes from the cores + the shared WAL's group-commit
+        # fan-in factor, stamped next to each other
+        wal_stats = system.wal.stats()
+        row["classic_batch"] = {
+            **node.classic_stats(),
+            "records_per_fsync": wal_stats["records_per_fsync"],
+        }
+        # unified Observatory snapshot of the shared system (WAL fsync
+        # p50/p99 + queue depth, segment writer, disk faults) with the
+        # classic batching stats wired in as their own source
+        obs = system.observatory()
+        obs.add_source("classic", node.classic_stats)
         row["observatory"] = obs.snapshot()
         obs.close()
         return row
     finally:
-        for n in nodes.values():
-            n.stop()
-        for s in systems.values():
-            s.close()
+        node.stop()
+        system.close()
         shutil.rmtree(tmp, ignore_errors=True)
 
 
@@ -244,8 +318,7 @@ def _phase_tcp() -> dict:
     import multiprocessing as mp
 
     import ra_tpu
-    from ra_tpu.core.types import (CommandEvent, ForceElectionEvent,
-                                   ReplyMode, ServerId, UserCommand)
+    from ra_tpu.core.types import ForceElectionEvent, ServerId
     from ra_tpu.transport.tcp import TcpRouter
 
     ctx = mp.get_context("spawn")
@@ -293,11 +366,13 @@ def _phase_tcp() -> dict:
         leader = res.leader
 
         def send(payload, corr, cb):
-            ok = client.send("?", leader, CommandEvent(
-                UserCommand(payload, reply_mode=ReplyMode.NOTIFY,
-                            correlation=corr, notify_to=cb)))
-            if not ok:
-                raise RuntimeError("send failed")
+            # the remote pipeline fan-in (ISSUE 13): commands buffer
+            # client-side and ship as multi-command frames; followers
+            # relay a stale-leader batch, so a mid-run election costs
+            # one hop, not an exception storm
+            ra_tpu.pipeline_command(leader, payload, correlation=corr,
+                                    notify_to=cb, router=client,
+                                    trace_ctx=False)
 
         def warm(payload):
             ra_tpu.process_command(leader, payload, router=client)
@@ -306,6 +381,18 @@ def _phase_tcp() -> dict:
         row["members"] = 3
         row["transport"] = "tcp (3 OS processes)"
         row["durable"] = True
+        # frame-loss visibility for the fire-and-forget client path
+        # (pairs with the row's credit_resets counter)
+        row["client_dropped_sends"] = client.dropped_sends
+        # the leader worker's replication-batching health over the
+        # control plane (ISSUE 13 — the tail carries the same
+        # CLASSIC_FIELDS shape as the local phase)
+        try:
+            row["classic_batch"] = ra_tpu.node_call(
+                leader.node, "classic_stats", {}, router=client,
+                timeout=30)
+        except (RuntimeError, TimeoutError) as exc:
+            row["classic_batch"] = {"error": repr(exc)[:200]}
         # client-side Observatory: the reliable-RPC counters (retries,
         # dedup hits, unreachable) ride the classic JSON tail like the
         # WAL stats do on the local phase (ISSUE 7 satellite — the

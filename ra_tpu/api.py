@@ -392,19 +392,38 @@ def pipeline_command(server_id: ServerId, data: Any, correlation: Any = None,
                      notify_to: Any = None,
                      priority: Priority = Priority.LOW,
                      router: Optional[LocalRouter] = None,
-                     trace_ctx: Optional[str] = None) -> None:
+                     trace_ctx: Any = None) -> None:
     """Fire-and-forget with applied-notification (ra:pipeline_command/4
     :886-896).  notify_to receives [(correlation, reply)] batches.
     Like process_command, the ingress mints (or adopts) a trace context
-    that rides the command through the flight-recorder hop events."""
+    that rides the command through the flight-recorder hop events —
+    pass ``trace_ctx=False`` to pipeline UNTRACED (the reference's cast
+    carries no tracing either): at 100k cmds/s the per-command mint +
+    ingress/append/apply hop records are real budget, and a bulk
+    pipeliner can opt out without touching anyone else's traces.
+
+    ``server_id`` on a node this process hosts submits through the
+    node's low-priority flush; a REMOTE member (TcpRouter reach, ISSUE
+    13) buffers through the router's pipeline fan-in and ships as
+    multi-command {commands, Batch} frames — the cross-host twin of
+    the node-side flush."""
     router = router or DEFAULT_ROUTER
-    node = _node_of(server_id, router)
-    ctx = trace_ctx or trace.new_trace_ctx()
-    record("cmd.ingress", trace=ctx, op="pipeline_command",
-           target=str(server_id))
+    node = router.nodes.get(server_id.node)
+    if trace_ctx is False:
+        ctx = None
+    else:
+        ctx = trace_ctx or trace.new_trace_ctx()
+        record("cmd.ingress", trace=ctx, op="pipeline_command",
+               target=str(server_id))
     cmd = UserCommand(data, reply_mode=ReplyMode.NOTIFY,
                       correlation=correlation, notify_to=notify_to,
                       trace=ctx)
+    if node is None:
+        cast = getattr(router, "pipeline_cast", None)
+        if cast is None:
+            raise RuntimeError(f"node {server_id.node} is not running")
+        cast(server_id, cmd)
+        return
     node.submit_command(server_id.name, cmd, None, priority=priority)
 
 

@@ -61,6 +61,10 @@ EVENT_REGISTRY = {
     "cmd.append": "leader appended the command at (uid, idx, term)",
     "cmd.commit": "a server's commit index advanced to idx (uid-keyed)",
     "cmd.apply": "a traced command was applied on a member",
+    # -- classic replication batching (ISSUE 13) -----------------------
+    "rpc.batch": "leader built one multi-entry AppendEntries batch "
+                 "(entry count + payload bytes; ONE event per batch, "
+                 "never per entry)",
     # -- reliable control-plane RPC (transport/rpc.py) -----------------
     "rpc.send": "reliable-RPC attempt left the sender (rid stable "
                 "across retries)",

@@ -50,9 +50,11 @@ def cond_concrete(pred, true_fn, false_fn, operands):
     return true_fn(operands) if concrete else false_fn(operands)
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class ApplyMeta:
-    """Metadata passed to apply/3 (ra_machine:command_meta_data())."""
+    """Metadata passed to apply/3 (ra_machine:command_meta_data()).
+    Slotted: one instance per applied command on every member — the
+    apply fold is the classic plane's hottest loop (ISSUE 13)."""
 
     index: int
     term: int
@@ -83,6 +85,20 @@ class Machine:
         Returns ``(new_state, reply)`` or ``(new_state, reply, effects)``.
         """
         raise NotImplementedError
+
+    #: OPTIONAL batched apply (ISSUE 13): when a machine sets this to a
+    #: callable ``apply_batch(meta, commands, state) -> (state, replies)``
+    #: (or ``(state, replies, effects)``), the core's apply fold hands it
+    #: RUNS of contiguous same-term plain user commands in one call
+    #: instead of one :meth:`apply` per entry.  ``meta`` describes the
+    #: FIRST entry of the run; command ``i`` applied at ``meta.index + i``
+    #: (machines that key on the index compute it that way).  ``replies``
+    #: must be one reply per command, in order — they feed the same
+    #: notify/await-consensus plumbing the per-entry path feeds.  The
+    #: contract is exact order equivalence with folding :meth:`apply`
+    #: over the run; machines whose apply has per-command effects should
+    #: leave this None (the default) and take the per-entry path.
+    apply_batch = None
 
     # -- optional callbacks -------------------------------------------------
 

@@ -84,6 +84,7 @@ from .types import (
     StartElectionTimeout,
     TickEvent,
     TransferLeadershipEvent,
+    UpEvent,
     UserCommand,
     WalUpEvent,
     WrittenEvent,
@@ -177,8 +178,20 @@ class RaServer:
         self.aux_state: Any = self.machine.init_aux(config.uid)
         self.commit_latency: float = 0.0
         #: core-owned counters (merged into key_metrics by the shell);
-        #: plain dict so the core stays free of registry dependencies
-        self.stats: dict = {"term_and_voted_for_updates": 0}
+        #: plain dict so the core stays free of registry dependencies.
+        #: aer_batches_sent / aer_batch_entries are the leader-side
+        #: replication-batching health pair (ISSUE 13): entries/batches
+        #: is the realized AER batching factor
+        self.stats: dict = {"term_and_voted_for_updates": 0,
+                            "aer_batches_sent": 0, "aer_batch_entries": 0}
+        #: bounded reservoir of recent AER batch sizes — the p50/p99
+        #: substrate of RaNode.classic_stats() (CLASSIC_FIELDS)
+        self._aer_batch_sizes: deque = deque(maxlen=512)
+        #: batch-append fast paths when the log implements them (the
+        #: durable + memory logs do; bare mocks fall back per-entry)
+        self._log_append_batch = getattr(log, "append_batch", None)
+        self._log_read_payloads = getattr(log, "read_range_with_payloads",
+                                          None)
         self._transfer_target: Optional[ServerId] = None
         #: SnapshotMeta of an in-flight chunked install (the log owns the
         #: streamed bytes; the core only tracks which snapshot it is)
@@ -761,7 +774,13 @@ class RaServer:
         check = self._has_log_entry_or_snapshot(rpc.prev_log_index,
                                                 rpc.prev_log_term)
         if check == "ok":
-            entries = self._drop_existing(list(rpc.entries))
+            entries = list(rpc.entries)
+            payloads = rpc.payloads
+            dropped = self._count_existing(entries)
+            if dropped:
+                entries = entries[dropped:]
+                if payloads is not None:
+                    payloads = payloads[dropped:]
             if not entries:
                 last_idx = self.log.last_index_term().index
                 if not rpc.entries and last_idx > rpc.prev_log_index:
@@ -793,7 +812,13 @@ class RaServer:
                                        self._aer_reply(rpc.term, True)))
                 return effects
             self._adopt_cluster_changes(entries)
-            self.log.write(entries)
+            # the frame's pre-encoded durable images (when shipped)
+            # ride into the log so the batch write skips re-encoding
+            # (one WAL fan-in submit either way, ISSUE 13)
+            if payloads is not None:
+                self.log.write(entries, payloads)
+            else:
+                self.log.write(entries)
             effects.extend(self._evaluate_commit_index_follower())
             # success reply is sent when the WrittenEvent arrives
             return effects
@@ -837,14 +862,15 @@ class RaServer:
             return "missing"
         return "ok" if t == term else "term_mismatch"
 
-    def _drop_existing(self, entries: list) -> list:
-        """Skip entries already present with the same idx+term
-        (ra_server.erl drop_existing)."""
+    def _count_existing(self, entries: list) -> int:
+        """How many leading entries are already present with the same
+        idx+term (the drop_existing prefix length — returned as a count
+        so the AER path can slice the shipped payloads in step)."""
         i = 0
         while i < len(entries) and self.log.exists(entries[i].index,
                                                    entries[i].term):
             i += 1
-        return entries[i:]
+        return i
 
     def _adopt_cluster_changes(self, entries: list) -> None:
         """Followers adopt cluster changes when the entry is ADDED to
@@ -1251,9 +1277,7 @@ class RaServer:
         if isinstance(event, CommandEvent):
             return self._leader_command(event.command, event.from_)
         if isinstance(event, CommandsEvent):
-            effects: list = []
-            for cmd in event.commands:
-                effects.extend(self._leader_append(cmd, None))
+            effects = self._leader_append_batch(event.commands)
             effects.extend(self._make_pipelined_rpcs())
             return effects
         if isinstance(event, WrittenEvent):
@@ -1378,6 +1402,18 @@ class RaServer:
             if peer is not None and peer.status == PeerStatus.NORMAL:
                 peer.status = PeerStatus.DISCONNECTED
             return []
+        if isinstance(event, UpEvent):
+            # a co-hosted sibling restarted: resume replication NOW —
+            # without this edge a restarted follower behind the tail
+            # can never catch up (it loses pre-votes and the leader
+            # skips DISCONNECTED peers forever)
+            peer = self.cluster.get(event.target)
+            if peer is not None and \
+                    peer.status == PeerStatus.DISCONNECTED:
+                peer.status = PeerStatus.NORMAL
+                eff = self._make_rpc_for_peer(event.target, peer, 1)
+                return [eff] if eff is not None else []
+            return []
         if isinstance(event, ElectionTimeout):
             return []
         if isinstance(event, TickEvent):
@@ -1490,6 +1526,54 @@ class RaServer:
     def _leader_command(self, cmd: Any, from_: Any) -> list:
         effects = self._leader_append(cmd, from_)
         effects.extend(self._make_pipelined_rpcs())
+        return effects
+
+    def _leader_append_batch(self, commands: tuple) -> list:
+        """Drain one {commands, Batch} flush into the log as RUNS of
+        plain user commands (ISSUE 13): one contiguous-index Entry run,
+        ONE log batch-append (= one memtable lock cycle + one WAL
+        fan-in submit) per run, with per-command bookkeeping reduced to
+        the reply-mode/trace checks.  Anything that is not a plain
+        UserCommand (membership ops, machine-internal commands) closes
+        the run and takes the per-command append path — those are rare
+        and carry their own effect logic."""
+        effects: list = []
+        run: list = []
+        append_batch = self._log_append_batch
+        log = self.log
+
+        def _flush_run() -> None:
+            if not run:
+                return
+            idx0 = log.next_index()
+            term = self.current_term
+            entries = [Entry(idx0 + i, term, cmd)
+                       for i, cmd in enumerate(run)]
+            if append_batch is not None:
+                append_batch(entries)
+            else:
+                for e in entries:
+                    log.append(e)
+            uid = self.cfg.uid
+            for i, cmd in enumerate(run):
+                if cmd.trace is not None:
+                    # the trace ctx -> (uid, idx) join point (ISSUE 7)
+                    record("cmd.append", trace=cmd.trace, uid=uid,
+                           idx=idx0 + i, term=term, server=str(self.id))
+                if cmd.reply_mode is ReplyMode.AFTER_LOG_APPEND and \
+                        cmd.from_ is not None:
+                    effects.append(Reply(cmd.from_,
+                                         CommandResult(idx0 + i, term,
+                                                       None, self.id)))
+            run.clear()
+
+        for cmd in commands:
+            if type(cmd) is UserCommand:
+                run.append(cmd)
+            else:
+                _flush_run()
+                effects.extend(self._leader_append(cmd, None))
+        _flush_run()
         return effects
 
     def _leader_append(self, cmd: Any, from_: Any) -> list:
@@ -1674,12 +1758,82 @@ class RaServer:
         to = min(last_idx, apply_to)
         notifys: dict = {}
         t0 = time.monotonic()
-        for entry in self.log.read_range(self.last_applied + 1, to):
-            self._apply_one(entry, effects, notifys, suppress)
+        entries = self.log.read_range(self.last_applied + 1, to)
+        batch_fn = self.effective_machine.apply_batch
+        # applied-notification routing is leader-only (followers drop
+        # Notify effects in _filter_follower_effects) — skip collecting
+        # what would be thrown away (ISSUE 13); from_-carrying replies
+        # (member-replier await_consensus) are preserved regardless
+        collect_notify = self.raft_state == RaftState.LEADER or \
+            (self.raft_state == RaftState.AWAIT_CONDITION and
+             self.condition is not None and
+             self.condition.transition_to == RaftState.LEADER)
+        i = 0
+        n = len(entries)
+        while i < n:
+            if self.machine_version < self.effective_machine_version:
+                break  # version gate: cannot apply further (same stop
+                # condition _apply_one enforces per entry)
+            entry = entries[i]
+            if batch_fn is None or type(entry.command) is not UserCommand:
+                self._apply_one(entry, effects, notifys, suppress)
+                i += 1
+                # the apply may have bumped the effective machine (a
+                # noop version bump mid-range): re-resolve the batch fn
+                batch_fn = self.effective_machine.apply_batch
+                continue
+            # batched fold (ISSUE 13): hand the machine the contiguous
+            # same-term run of plain user commands in ONE call; replies
+            # come back in order and feed the same notify plumbing
+            j = i + 1
+            term = entry.term
+            while j < n and entries[j].term == term and \
+                    type(entries[j].command) is UserCommand:
+                j += 1
+            run = entries[i:j]
+            self._apply_user_run(run, batch_fn, effects, notifys,
+                                 suppress, collect_notify)
+            i = j
         self.commit_latency = time.monotonic() - t0
         if notifys and not suppress:
             for to_pid, corrs in notifys.items():
                 effects.append(Notify(to_pid, tuple(corrs)))
+
+    def _apply_user_run(self, run: list, batch_fn, effects: list,
+                        notifys: dict, suppress: bool,
+                        collect_notify: bool = True) -> None:
+        """Apply one contiguous run of plain user commands through the
+        machine's batched fold.  Exactly order-equivalent to folding
+        apply() over the run (the apply_batch contract); the per-command
+        tail work (trace hops, reply/notify routing) is reduced to the
+        cheapest possible checks — and reply routing is skipped
+        entirely for commands that cannot owe one (no from_, no
+        notify_to), which on followers is every pipelined command."""
+        first = run[0]
+        meta = ApplyMeta(index=first.index, term=first.term,
+                         machine_version=self.effective_machine_version)
+        result = batch_fn(meta, [e.command.data for e in run],
+                          self.machine_state)
+        if len(result) == 3:
+            self.machine_state, replies, app_effs = result
+        else:
+            self.machine_state, replies = result
+            app_effs = []
+        self.last_applied = run[-1].index
+        if suppress:
+            return  # recovery replay: not a live apply hop
+        if app_effs:
+            effects.extend(app_effs)
+        uid = self.cfg.uid
+        for e, reply in zip(run, replies):
+            cmd = e.command
+            if cmd.trace is not None:
+                record("cmd.apply", trace=cmd.trace, uid=uid,
+                       idx=e.index, server=str(self.id))
+            if cmd.from_ is not None or \
+                    (collect_notify and cmd.notify_to is not None):
+                self._add_reply(cmd, e.index, e.term, reply, effects,
+                                notifys)
 
     def _apply_one(self, entry: Entry, effects: list, notifys: dict,
                    suppress: bool) -> None:
@@ -1783,6 +1937,10 @@ class RaServer:
         max_pipeline_count, batches by max_append_entries_batch."""
         effects: list = []
         next_log_idx = self.log.next_index()
+        # one read memo per send wave: caught-up peers want the SAME
+        # range, so the second peer's AER reuses the first's entries +
+        # payloads instead of re-reading the log (ISSUE 13)
+        memo: dict = {}
         for pid, peer in self.cluster.items():
             if pid == self.id or peer.status != PeerStatus.NORMAL:
                 continue
@@ -1794,7 +1952,7 @@ class RaServer:
                 continue
             batch = min(self.cfg.max_append_entries_batch,
                         self.cfg.max_pipeline_count - in_flight)
-            eff = self._make_rpc_for_peer(pid, peer, batch)
+            eff = self._make_rpc_for_peer(pid, peer, batch, memo)
             if eff is not None:
                 peer.commit_index_sent = self.commit_index
                 effects.append(eff)
@@ -1813,7 +1971,8 @@ class RaServer:
         return effects
 
     def _make_rpc_for_peer(self, pid: ServerId, peer: Peer,
-                           batch: int) -> Optional[Any]:
+                           batch: int,
+                           memo: Optional[dict] = None) -> Optional[Any]:
         prev_idx = peer.next_index - 1
         if prev_idx == 0 and self.log.snapshot_index_term().index > 0:
             # peer wants the log from the very start but our prefix is
@@ -1840,14 +1999,48 @@ class RaServer:
                                     token=peer.snapshot_sender)
         last_idx = self.log.last_index_term().index
         to = min(last_idx, prev_idx + batch)
-        entries = tuple(self.log.read_range(prev_idx + 1, to)) \
-            if to > prev_idx else ()
+        entries: tuple = ()
+        payloads = None
         if to > prev_idx:
-            peer.next_index = to + 1
+            # one-lock batched read WITH the already-encoded durable
+            # images when the range is memtable-resident (the common
+            # steady-state case) — bounded by the frame byte budget;
+            # catch-up ranges that left the memtable fall back to the
+            # plain read and followers re-encode (ISSUE 13)
+            cached = memo.get((prev_idx + 1, to)) \
+                if memo is not None else None
+            if cached is not None:
+                entries, payloads = cached
+            else:
+                got = self._log_read_payloads(
+                    prev_idx + 1, to,
+                    self.cfg.max_append_entries_bytes) \
+                    if self._log_read_payloads is not None else None
+                if got is not None:
+                    entries = tuple(got[0])
+                    payloads = tuple(got[1])
+                else:
+                    entries = tuple(self.log.read_range(prev_idx + 1,
+                                                        to))
+                    payloads = None
+                if memo is not None:
+                    memo[(prev_idx + 1, to)] = (entries, payloads)
+            if entries:
+                peer.next_index = entries[-1].index + 1
+                n = len(entries)
+                self.stats["aer_batches_sent"] += 1
+                self.stats["aer_batch_entries"] += n
+                self._aer_batch_sizes.append(n)
+                # ONE event per replication batch (never per entry):
+                # the wire-batching health signal (ISSUE 13 / RA06)
+                record("rpc.batch", to=str(pid), n=n,
+                       bytes=sum(map(len, payloads))
+                       if payloads is not None else -1)
         return SendRpc(pid, AppendEntriesRpc(
             term=self.current_term, leader_id=self.id,
             prev_log_index=prev_idx, prev_log_term=prev_term or 0,
-            leader_commit=self.commit_index, entries=entries))
+            leader_commit=self.commit_index, entries=entries,
+            payloads=payloads))
 
     # -- consistent queries (ra_server.erl:3032-3190) ----------------------
 

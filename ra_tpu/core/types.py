@@ -97,9 +97,14 @@ class Priority(str, enum.Enum):
     LOW = "low"
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class UserCommand:
-    """'$usr' — a command for the user state machine."""
+    """'$usr' — a command for the user state machine.
+
+    ``slots=True`` because this is the highest-volume object on the
+    classic plane: one instance per client command, created on the
+    ingress path at up-to-100k/s rates (ISSUE 13) — the slotted form
+    drops per-instance dict allocation from the hot path."""
 
     data: Any
     reply_mode: ReplyMode = ReplyMode.AWAIT_CONSENSUS
@@ -202,6 +207,13 @@ class AppendEntriesRpc:
     prev_log_term: int
     leader_commit: int
     entries: tuple = ()  # tuple[Entry, ...]
+    #: OPTIONAL encoded durable images parallel to ``entries`` (ISSUE
+    #: 13): the leader already holds each entry's WAL payload bytes in
+    #: its memtable, and shipping them lets followers feed their WAL
+    #: without re-encoding (the batch-append path skips one pickle per
+    #: entry per follower).  None when the leader's bytes are gone
+    #: (segment-flushed catch-up) — followers then encode themselves.
+    payloads: Optional[tuple] = None
 
 
 @dataclass(frozen=True)
@@ -364,6 +376,21 @@ class DownEvent:
 
     target: Any
     reason: Any = None
+
+
+@dataclass(frozen=True)
+class UpEvent:
+    """Process-up notification: a co-hosted member (re)started.  The
+    DownEvent twin for the in-process deployment (ISSUE 13): a kill
+    broadcast DownEvent to co-hosted siblings — the leader marked the
+    peer DISCONNECTED and stopped replicating to it — but a restart
+    had no up edge, so a restarted follower with a shorter log wedged
+    forever (it cannot win pre-votes, and the leader never resumes its
+    catch-up).  Cross-node deployments heal through the transport
+    failure detector's NodeEvent("up"); this is the same verdict at
+    member granularity for siblings that share a node."""
+
+    target: Any
 
 
 @dataclass(frozen=True)
@@ -688,7 +715,8 @@ def strip_msg_handles(msg: Any) -> Any:
 #: tunable cannot silently stop round-tripping through recovery
 SNAPSHOT_TUNABLE_KEYS = (
     "await_condition_timeout_ms", "max_pipeline_count",
-    "max_append_entries_batch", "snapshot_chunk_size",
+    "max_append_entries_batch", "max_append_entries_bytes",
+    "command_flush_size", "snapshot_chunk_size",
     "install_snap_rpc_timeout_ms", "friendly_name",
 )
 
@@ -707,7 +735,26 @@ class ServerConfig:
     tick_interval_ms: int = 1000
     await_condition_timeout_ms: int = 3000
     max_pipeline_count: int = 4096   # ra_server.hrl:7
-    max_append_entries_batch: int = 128  # ra_server.hrl:8
+    #: entries per AppendEntries frame.  The reference ships 128
+    #: (ra_server.hrl:8); with the batch-native follower path (ONE
+    #: append + ONE WAL fan-in submit + ONE cumulative reply per
+    #: frame, ISSUE 13) deeper frames amortize strictly further, so
+    #: the default rides the byte bound below instead
+    max_append_entries_batch: int = 1024
+    #: byte bound on one AppendEntries frame (ISSUE 13): a batch closes
+    #: when EITHER the entry cap or this payload-byte budget is reached
+    #: (evaluated against the encoded durable images when the leader
+    #: holds them), so a burst of large commands cannot build a frame
+    #: that stalls the socket behind one send
+    max_append_entries_bytes: int = 1 << 20
+    #: how many buffered low-priority commands flush into one
+    #: {commands, Batch} event (ISSUE 13).  The reference's
+    #: ?FLUSH_COMMANDS_SIZE is 16 (ra_server.hrl:11); the batch-native
+    #: append path amortizes its one-lock/one-WAL-submit cost over the
+    #: whole event, so a deeper default flush is strictly cheaper until
+    #: frames hit max_append_entries_* bounds (512 measured best on the
+    #: classic bench; 1024 starts trading latency for nothing)
+    command_flush_size: int = 512
     snapshot_chunk_size: int = 1024 * 1024  # ra_server.hrl:9
     install_snap_rpc_timeout_ms: int = 30_000
     membership: Membership = Membership.VOTER

@@ -480,7 +480,7 @@ class DurableLog:
         try:
             for idx, term, raw in items:
                 self.counters["write_resends"] += 1
-                self.wal.write(self.uid, idx, term, raw)
+                self.wal.write(self.uid, idx, term, raw)  # ra10-ok: crash-recovery resend, not the steady-state path
         except WalDown:
             self._wal_generation = -1  # resend incomplete: retry later
             return False
@@ -521,7 +521,29 @@ class DurableLog:
                 f"append gap: {entry.index} != {self._last_index + 1}")
         self._put(entry)
 
-    def write(self, entries: list) -> None:
+    def append_batch(self, entries: list,
+                     payloads: Optional[list] = None) -> None:
+        """Leader-path batch append (ISSUE 13): strictly-appending
+        contiguous entries, one lock acquisition and ONE WAL fan-in
+        submit for the whole run — the per-entry ``append`` path costs
+        a lock cycle plus a WAL queue hand-off per entry, which at
+        group-commit rates dominates the event-loop thread."""
+        if not entries:
+            return
+        if entries[0].index != self._last_index + 1:
+            from .memory import IntegrityError
+            raise IntegrityError(
+                f"append gap: {entries[0].index} != "
+                f"{self._last_index + 1}")
+        self._put_batch(entries, payloads)
+
+    def write(self, entries: list,
+              payloads: Optional[list] = None) -> None:
+        """Follower-path batch write (may overwrite).  ``payloads`` —
+        encoded durable images parallel to ``entries`` shipped inside
+        the AppendEntries frame (the leader already paid the encode for
+        its own WAL) — lets the whole batch reach the WAL without one
+        pickle per entry (rule RA10)."""
         if not entries:
             return
         first = entries[0].index
@@ -529,14 +551,69 @@ class DurableLog:
             from .memory import IntegrityError
             raise IntegrityError(
                 f"write gap: {first} > {self._last_index + 1}")
-        for e in entries:
-            self._put(e)
+        self._put_batch(entries, payloads)
+
+    def _put_batch(self, entries: list,
+                   payloads: Optional[list] = None) -> None:
+        """Shared batch insert: encode what wasn't shipped, handle the
+        overwrite rewind ONCE for the run, bulk-load the memtable, and
+        hand the WAL one contiguous fan-in submit."""
+        if payloads is None or len(payloads) != len(entries):
+            # local/fallback encode — the leader's own append, or a
+            # catch-up resend whose source bytes were segment-flushed
+            payloads = [encode_command(e.command)  # ra10-ok: fallback when no shipped payloads ride the frame
+                        for e in entries]
+        self.counters["write_ops"] += len(entries)
+        first = entries[0].index
+        last_e = entries[-1]
+        # resolve the overwrite-rewind predecessor term BEFORE taking
+        # the log lock: fetch_term can fall through to a segment read
+        # (_io_lock), and _io_lock-inside-_lock inverts the documented
+        # lock order against flush_mem_to_segments (ABBA).  Safe to
+        # pre-read: entry terms are immutable and only this (event
+        # loop) thread writes/truncates the log.
+        rewind_term = 0
+        if first <= self._last_index and first > 1:
+            rewind_term = self.fetch_term(first - 1) or 0
+        with self._lock:
+            if first <= self._last_index:
+                # overwrite: invalidate the stale tail above the batch
+                # head; rewind last_written so AER replies stay truthful
+                # (the same discipline as _put, once per run)
+                for k in range(last_e.index + 1, self._last_index + 1):
+                    self._memtable.pop(k, None)
+                    self._mem_bytes.pop(k, None)
+                if self._last_written.index >= first:
+                    self._last_written = IdxTerm(first - 1, rewind_term)
+            memtable = self._memtable
+            mem_bytes = self._mem_bytes
+            items = []
+            truncate = self._truncate_next
+            self._truncate_next = False
+            for e, payload in zip(entries, payloads):
+                memtable[e.index] = (e.term, e.command)
+                mem_bytes[e.index] = payload
+                items.append((e.index, e.term, payload, truncate))
+                truncate = False
+            self._last_index = last_e.index
+            self._last_term = last_e.term
+            # submit under the log lock (queue.put only — no blocking);
+            # same resend-before-submit generation discipline as _put
+            if getattr(self, "_wal_generation", None) != \
+                    self.wal.generation:
+                self._resend_unconfirmed_locked()
+            self.wal.write_many(self.uid, items)
 
     def _put(self, entry: Entry) -> None:
         # live reply handles are process-local: stripped from the durable
         # image (the memtable keeps the full command for leader replies)
         payload = encode_command(entry.command)
         self.counters["write_ops"] += 1
+        # pre-read like _put_batch: a fetch_term miss under _lock would
+        # take _io_lock and invert the lock order (ABBA vs segment flush)
+        rewind_term = 0
+        if entry.index <= self._last_index and entry.index > 1:
+            rewind_term = self.fetch_term(entry.index - 1) or 0
         with self._lock:
             if entry.index <= self._last_index:
                 # overwrite: invalidate the stale tail; rewind last_written
@@ -545,9 +622,8 @@ class DurableLog:
                     self._memtable.pop(k, None)
                     self._mem_bytes.pop(k, None)
                 if self._last_written.index >= entry.index:
-                    prev = entry.index - 1
-                    self._last_written = IdxTerm(
-                        prev, self.fetch_term(prev) or 0)
+                    self._last_written = IdxTerm(entry.index - 1,
+                                                 rewind_term)
             self._memtable[entry.index] = (entry.term, entry.command)
             self._mem_bytes[entry.index] = payload
             self._last_index = entry.index
@@ -697,13 +773,73 @@ class DurableLog:
         return acc
 
     def read_range(self, from_idx: int, to_idx: int) -> list:
-        out = []
-        for i in range(max(from_idx, self._first_index),
-                       min(to_idx, self._last_index) + 1):
-            e = self.fetch(i)
-            if e is not None:
-                out.append(e)
+        """Batched range read: ONE lock cycle for the memtable pass
+        (the hot case — AER building and the apply fold read recent
+        entries), with per-index segment fallback for anything older
+        (ISSUE 13; the per-index ``fetch`` path paid a lock per
+        entry)."""
+        out: list = []
+        misses = 0
+        with self._lock:
+            lo = max(from_idx, self._first_index)
+            hi = min(to_idx, self._last_index)
+            if hi < lo:
+                return out
+            n = hi - lo + 1
+            self.counters["read_ops"] += n
+            mt = self._memtable
+            for i in range(lo, hi + 1):
+                ent = mt.get(i)
+                if ent is not None:
+                    out.append(Entry(i, ent[0], ent[1]))
+                else:
+                    out.append(i)  # placeholder: resolve via segments
+                    misses += 1
+            self.counters["read_cache"] += n - misses
+        if misses:
+            for k, v in enumerate(out):
+                if type(v) is int:
+                    got = self._segment_read(v)
+                    out[k] = Entry(v, got[0], decode_command(got[1])) \
+                        if got is not None else None
+            out = [e for e in out if e is not None]
         return out
+
+    def read_range_with_payloads(self, from_idx: int, to_idx: int,
+                                 max_bytes: int = 0) -> Optional[tuple]:
+        """(entries, payloads) for the memtable-resident contiguous
+        prefix of [from_idx, to_idx] — the leader's AER build reads
+        entries AND their already-encoded durable images in one lock
+        cycle, so followers can feed their WAL without re-encoding
+        (AppendEntriesRpc.payloads, ISSUE 13).  ``max_bytes`` > 0 caps
+        the prefix at the frame byte budget.  None when the range head
+        has left the memtable (segment-flushed catch-up) — the caller
+        falls back to ``read_range`` with no payloads."""
+        entries: list = []
+        payloads: list = []
+        total = 0
+        with self._lock:
+            if from_idx < self._first_index or \
+                    to_idx > self._last_index or to_idx < from_idx:
+                return None
+            mt = self._memtable
+            mb = self._mem_bytes
+            for i in range(from_idx, to_idx + 1):
+                ent = mt.get(i)
+                raw = mb.get(i)
+                if ent is None or raw is None:
+                    break
+                entries.append(Entry(i, ent[0], ent[1]))
+                payloads.append(raw)
+                total += len(raw)
+                if max_bytes and total >= max_bytes:
+                    break
+            n = len(entries)
+            self.counters["read_ops"] += n
+            self.counters["read_cache"] += n
+        if not entries:
+            return None
+        return entries, payloads
 
     def sparse_read(self, indexes: Iterable[int]) -> list:
         out = []

@@ -94,10 +94,34 @@ class MemoryLog:
         self._last_term = entry.term
         self._queue_written(entry.index, entry.index, entry.term)
 
-    def write(self, entries: list) -> None:
+    def append_batch(self, entries: list, payloads=None) -> None:
+        """Leader-path batch append (ISSUE 13): contiguous strictly-new
+        entries, ONE queued written event for the whole run (the batch
+        twin of :meth:`append`; ``payloads`` — pre-encoded durable
+        images — is accepted for interface parity and ignored, this
+        backend keeps no bytes)."""
+        if not entries:
+            return
+        if entries[0].index != self._last_index + 1:
+            raise IntegrityError(
+                f"append gap: {entries[0].index} != "
+                f"{self._last_index + 1}")
+        self.counters["write_ops"] += len(entries)
+        for e in entries:
+            self._entries[e.index] = e
+        last = entries[-1]
+        self._last_index = last.index
+        self._last_term = last.term
+        # one confirm for the run: terms are uniform by construction
+        # (a leader appends in its own term), so the range event is
+        # exactly what the per-entry events would have coalesced into
+        self._queue_written(entries[0].index, last.index, last.term)
+
+    def write(self, entries: list, payloads=None) -> None:
         """Follower-path write; may overwrite.  First index must be within
         [first_index, last_index+1]; everything after the batch is
-        truncated."""
+        truncated.  ``payloads`` (pre-encoded durable images shipped in
+        the AER, ISSUE 13) is ignored — this backend keeps no bytes."""
         if not entries:
             return
         first = entries[0].index

@@ -234,6 +234,25 @@ TELEMETRY_SUMMARY_FIELDS = (
     "top_stall_steps", "committed_total",
 )
 
+#: classic replication-batching health (ISSUE 13): the shape of
+#: ``RaNode.classic_stats()`` — stamped into bench_classic's JSON tail
+#: (both phases) and wired into the leader system's Observatory as the
+#: ``classic`` source.  ``aer_batches_sent`` counts multi-entry
+#: AppendEntries frames built by leaders hosted on the node and
+#: ``aer_batch_entries`` the entries they carried (their ratio is the
+#: realized AER batching factor); ``entries_per_batch_p50``/
+#: ``entries_per_batch_p99``/``entries_per_batch_mean`` come from the
+#: cores' bounded batch-size reservoirs; ``records_per_fsync`` — the
+#: group-commit fan-in half of the pair — is Wal.stats()'s
+#: amortization factor, stamped next to the AER numbers by the
+#: embedding bench so one doc answers "how batched was replication,
+#: end to end".
+CLASSIC_FIELDS = (
+    "aer_batches_sent", "aer_batch_entries", "entries_per_batch_p50",
+    "entries_per_batch_p99", "entries_per_batch_mean",
+    "records_per_fsync",
+)
+
 #: the complete field-group registry (rule RA05): every counter-field
 #: tuple in this module MUST be listed here, covered by the registry
 #: parity test (tests/test_telemetry.py) and documented in
@@ -256,6 +275,7 @@ FIELD_REGISTRY = {
     "phase": PHASE_FIELDS,
     "ingress": INGRESS_FIELDS,
     "wire": WIRE_FIELDS,
+    "classic": CLASSIC_FIELDS,
 }
 
 

@@ -84,8 +84,12 @@ _TIMEOUT_KINDS = {
     "long": (2.0, 3.2),
 }
 
-#: low-priority commands buffered before a {commands, ...} flush
-#: (?FLUSH_COMMANDS_SIZE, ra_server.hrl:11)
+#: low-priority commands buffered before a {commands, ...} flush — the
+#: reference's ?FLUSH_COMMANDS_SIZE (ra_server.hrl:11) default; the
+#: per-server ``ServerConfig.command_flush_size`` knob overrides it
+#: (ISSUE 13: the batch-native append path amortizes one lock + one
+#: WAL fan-in submit over the whole flush, so deeper flushes are
+#: strictly cheaper until the AER frame bounds bite)
 FLUSH_COMMANDS_SIZE = 16
 
 
@@ -180,6 +184,11 @@ class ServerShell:
         self.election_deadline: Optional[float] = None
         self.tick_deadline: float = time.monotonic() + \
             server.cfg.tick_interval_ms / 1000.0
+        #: per-shell flush depth (ServerConfig.command_flush_size,
+        #: falling back to the reference's 16) — cached here so the
+        #: poll loop pays one attribute read, not a config chain
+        self.flush_size = getattr(server.cfg, "command_flush_size", 0) \
+            or FLUSH_COMMANDS_SIZE
         self.stopped = False
 
     @property
@@ -231,6 +240,11 @@ class RaNode:
             self.directory[config.uid] = config
         # new servers get an election timeout so a fresh cluster elects
         self._arm_election(shell, "medium")
+        # co-hosted siblings learn the member is back: a leader that saw
+        # the kill's DownEvent resumes replication (the up edge the
+        # transport detector provides for cross-node peers — without it
+        # a restarted behind-the-tail follower wedges, ISSUE 13)
+        self._notify_up(config.server_id)
         self._wake.set()
         return config.server_id
 
@@ -388,6 +402,15 @@ class RaNode:
                 other.inbox.append(DownEvent(dead))
         self._wake.set()
 
+    def _notify_up(self, sid: ServerId) -> None:
+        """The restart twin of _notify_down: co-hosted siblings (most
+        importantly a leader that marked this peer DISCONNECTED at the
+        kill's DownEvent) resume treating it as reachable."""
+        from .core.types import UpEvent
+        for other in list(self.shells.values()):
+            if not other.stopped and other.sid != sid:
+                other.inbox.append(UpEvent(sid))
+
     def process_down(self, pid: Any, reason: Any = "normal") -> None:
         """Report death of a machine-monitored external process.  Members
         monitoring ``pid`` get a ``("down", pid, reason)`` builtin command
@@ -442,7 +465,8 @@ class RaNode:
         if shell is None or shell.stopped:
             return False
         shell.inbox.append(msg)
-        self._wake.set()
+        if not self._wake.is_set():  # see submit_command
+            self._wake.set()
         return True
 
     # -- control plane (cross-node lifecycle, ra_server_sup_sup.erl:42-130)
@@ -462,6 +486,11 @@ class RaNode:
                 result = "ok"
             elif op == "force_delete_server":
                 result = self._control_force_delete(args)
+            elif op == "classic_stats":
+                # read-only batching-health probe (ISSUE 13): lets a
+                # bench/ops client collect the leader's CLASSIC_FIELDS
+                # from a remote worker process over the control plane
+                result = self.classic_stats()
             else:
                 result = ErrorResult(f"unknown_control_op:{op}", None)
         except Exception as exc:  # noqa: BLE001 — errors travel to caller
@@ -590,7 +619,11 @@ class RaNode:
             shell.low_queue.append(command)
         else:
             shell.inbox.append(CommandEvent(command, from_=from_))
-        self._wake.set()
+        # set-when-clear guard: at pipelined rates the flag is almost
+        # always already set (the loop only clears it when idle), and
+        # Event.set() takes a lock this path should not pay per command
+        if not self._wake.is_set():
+            self._wake.set()
         return True
 
     # -- event loop ---------------------------------------------------------
@@ -686,7 +719,7 @@ class RaNode:
         # throughput moving 1 -> 16 batches per poll)
         batches = 0
         while shell.low_queue and batches < 16:
-            n = min(len(shell.low_queue), FLUSH_COMMANDS_SIZE)
+            n = min(len(shell.low_queue), shell.flush_size)
             batch = tuple(shell.low_queue.popleft() for _ in range(n))
             shell.inbox.append(CommandsEvent(batch))
             batches += 1
@@ -917,6 +950,44 @@ class RaNode:
                                                 token=eff.token))
 
     # -- introspection -------------------------------------------------------
+
+    def classic_stats(self) -> dict:
+        """Replication-batching health across this node's members — the
+        CLASSIC_FIELDS snapshot (ISSUE 13): AER batches sent, total
+        entries they carried, entries/batch p50/p99/mean from the
+        cores' bounded reservoirs.  ``records_per_fsync`` (the
+        group-commit fan-in half of the pair) lives in ``Wal.stats()``
+        — the embedding bench/Observatory stamps both side by side."""
+        batches = 0
+        entries = 0
+        sizes: list = []
+        for shell in list(self.shells.values()):
+            srv = shell.server
+            batches += srv.stats.get("aer_batches_sent", 0)
+            entries += srv.stats.get("aer_batch_entries", 0)
+            # the event-loop thread appends concurrently (maxlen'd, so
+            # a full deque mutates on every append): copy into a FRESH
+            # list with retries rather than crash a stats probe
+            # mid-traffic (a partial extend must not duplicate)
+            got: list = []
+            for _ in range(4):
+                try:
+                    got = list(srv._aer_batch_sizes)
+                    break
+                except RuntimeError:
+                    got = []
+            sizes.extend(got)
+        sizes.sort()
+        n = len(sizes)
+        return {
+            "aer_batches_sent": batches,
+            "aer_batch_entries": entries,
+            "entries_per_batch_mean":
+                round(entries / batches, 2) if batches else -1.0,
+            "entries_per_batch_p50": sizes[n // 2] if n else -1,
+            "entries_per_batch_p99":
+                sizes[min(n - 1, int(n * 0.99))] if n else -1,
+        }
 
     def overview(self) -> dict:
         return {

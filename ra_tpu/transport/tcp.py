@@ -47,14 +47,19 @@ from dataclasses import replace as _dc_replace
 from typing import Optional
 
 from ..core.types import (
+    AppendEntriesRpc,
     CommandEvent,
     CommandsEvent,
+    Entry,
     NODE_SCOPE,
     NodeControlEvent,
     NodeEvent,
+    ReplyMode,
     ServerId,
+    UserCommand,
     strip_msg_handles,
 )
+from ..log.durable import decode_command
 from ..metrics import RPC_FIELDS
 from ..node import LocalRouter
 from .rpc import RpcReceiver, stamp_origin
@@ -69,6 +74,11 @@ FRAME_REPLY = 3
 FRAME_NOTIFY = 4
 FRAME_RPC_REQ = 5
 FRAME_RPC_RESP = 6
+#: batch-encoded data frame (ISSUE 13): ONE pickle + ONE length prefix
+#: for every plain routed message the sender loop coalesced — the
+#: per-item _encode_item path paid a pickle and a frame header per
+#: message, which at batched-AER rates dominated the sender thread
+FRAME_MSG_BATCH = 7
 
 #: fault kinds the recv/ping paths can honor (they cannot delay,
 #: duplicate or reorder — see FaultPlan.decide's honor contract)
@@ -80,7 +90,7 @@ _DROP_ONLY = frozenset({"drop"})
 _FRAME_CLASS = {FRAME_MSG: "msg", FRAME_PING: "ping",
                 FRAME_HELLO: "hello", FRAME_REPLY: "reply",
                 FRAME_NOTIFY: "notify", FRAME_RPC_REQ: "rpc_req",
-                FRAME_RPC_RESP: "rpc_resp"}
+                FRAME_RPC_RESP: "rpc_resp", FRAME_MSG_BATCH: "msg"}
 
 SEND_QUEUE_MAX = 10_000
 MAX_FRAME = 64 * 1024 * 1024  # snapshot chunks are 1MB; generous headroom
@@ -147,6 +157,15 @@ class TcpRouter(LocalRouter):
         self._calls: dict = {}
         self._call_seq = 0
         self._call_lock = threading.Lock()
+        # remote pipeline fan-in (ISSUE 13): per-target buffers of
+        # pipelined commands flushed as {commands, Batch} events — the
+        # cross-host twin of RaNode's low-priority flush, so a wire
+        # client's casts ride multi-command frames instead of one
+        # CommandEvent frame per command
+        self._pipe_bufs: dict = {}
+        self._pipe_lock = threading.Lock()
+        self._pipe_evt = threading.Event()
+        self._pipe_thread: Optional[threading.Thread] = None
         # durable applied-notification sinks for pipelined commands that
         # cross hosts: nid -> callable, id(callable) -> nid.  Unlike
         # _calls these are multi-shot (one client receives many Notify
@@ -229,10 +248,12 @@ class TcpRouter(LocalRouter):
         """Relayed command events carry local ack sinks (notify_to
         callables); swap them for ('rnotify', addr, id) handles so
         applied-notifications route back across hosts instead of landing
-        on an orphan unpickled copy."""
+        on an orphan unpickled copy.  CommandsEvent batches are left to
+        the SENDER thread's compact wire form (ISSUE 13), which does
+        the same handle swap per batch instead of one dataclass-replace
+        per command here on the caller's thread."""
         if isinstance(msg, CommandsEvent):
-            return CommandsEvent(tuple(self._rewrite_cmd(c)
-                                       for c in msg.commands))
+            return msg
         if isinstance(msg, CommandEvent):
             return _dc_replace(msg, command=self._rewrite_cmd(msg.command))
         return msg
@@ -281,8 +302,11 @@ class TcpRouter(LocalRouter):
 
     #: frames coalesced into one sendall by the sender loop — the
     #: gen_batch_server shape on the wire: whatever accumulated while
-    #: the previous syscall ran goes out as one write
-    SEND_COALESCE = 64
+    #: the previous syscall ran goes out as one write; plain routed
+    #: messages additionally share ONE batch frame + ONE pickle
+    #: (FRAME_MSG_BATCH, ISSUE 13), so deeper coalescing amortizes
+    #: encode setup as well as the syscall
+    SEND_COALESCE = 256
 
     def _sender_loop(self, peer: _Peer) -> None:
         while not self._stop:
@@ -316,6 +340,84 @@ class TcpRouter(LocalRouter):
                 # catch-up will resend what matters)
                 self.dropped_sends += len(items)
 
+    def _wire_form(self, to, msg, src):
+        """Routed-message wire image, built on the SENDER thread.  Two
+        compact forms (ISSUE 13):
+
+        * an AppendEntries batch carrying its encoded durable payloads
+          ships as index base + per-entry terms + payload bytes instead
+          of pickled command objects — pickling bytes is a memcpy while
+          pickling a dataclass per entry dominated the sender loop, and
+          the payload IS the handle-stripped durable image so no strip
+          pass is needed;
+        * a CommandsEvent of plain pipelined notify-mode commands ships
+          as per-command (data, correlation, notify-handle, trace)
+          tuples — the handle swap (_notify_id) happens here, memoized
+          per batch, instead of one dataclass replace + lock per
+          command on the caller's thread.
+
+        The receiver thread rebuilds the objects (decode off BOTH
+        nodes' event-loop threads)."""
+        tm = type(msg)
+        if tm is AppendEntriesRpc and msg.payloads is not None \
+                and msg.entries:
+            ents = msg.entries
+            return (to, src, ("__aer__", msg.term, msg.leader_id,
+                              msg.prev_log_index, msg.prev_log_term,
+                              msg.leader_commit, ents[0].index,
+                              tuple(e.term for e in ents),
+                              msg.payloads))
+        if tm is CommandsEvent:
+            cmds = msg.commands
+            handles: dict = {}  # per-batch memo: id(fn) -> handle
+            rows = []
+            for c in cmds:
+                if type(c) is not UserCommand or \
+                        c.reply_mode is not ReplyMode.NOTIFY or \
+                        c.from_ is not None or c.reply_from is not None:
+                    rows = None
+                    break
+                nt = c.notify_to
+                if nt is not None and callable(nt):
+                    h = handles.get(id(nt))
+                    if h is None:
+                        h = handles[id(nt)] = (
+                            "rnotify", tuple(self.listen_addr),
+                            self._router_id, self._notify_id(nt))
+                    nt = h
+                rows.append((c.data, c.correlation, nt, c.trace))
+            if rows is not None:
+                return (to, src, ("__cmds__", tuple(rows)))
+            # mixed batch (rare): the legacy per-command rewrite + strip
+            msg = CommandsEvent(tuple(self._rewrite_cmd(c)
+                                      for c in cmds))
+            return (to, src, msg)
+        return (to, src, strip_msg_handles(msg))
+
+    @staticmethod
+    def _from_wire(msg):
+        """Inverse of _wire_form, run on the receiver thread."""
+        if type(msg) is tuple and msg:
+            tag = msg[0]
+            if tag == "__aer__":
+                (_tag, term, leader_id, pli, plt, commit, first, terms,
+                 payloads) = msg
+                entries = tuple(
+                    Entry(first + i, terms[i],
+                          decode_command(payloads[i]))
+                    for i in range(len(payloads)))
+                return AppendEntriesRpc(
+                    term=term, leader_id=leader_id, prev_log_index=pli,
+                    prev_log_term=plt, leader_commit=commit,
+                    entries=entries, payloads=payloads)
+            if tag == "__cmds__":
+                return CommandsEvent(tuple(
+                    UserCommand(data, reply_mode=ReplyMode.NOTIFY,
+                                correlation=corr, notify_to=nt,
+                                trace=tr)
+                    for data, corr, nt, tr in msg[1]))
+        return msg
+
     def _encode_item(self, item) -> Optional[bytes]:
         if isinstance(item, _FaultHeld):  # plan cleared mid-delay
             item = item.item
@@ -334,11 +436,28 @@ class TcpRouter(LocalRouter):
                 frame = bytes([FRAME_RPC_RESP]) + pickle.dumps(
                     msg, protocol=pickle.HIGHEST_PROTOCOL)
             else:
-                payload = pickle.dumps((to, src, strip_msg_handles(msg)),
+                payload = pickle.dumps(self._wire_form(to, msg, src),
                                        protocol=pickle.HIGHEST_PROTOCOL)
                 frame = bytes([FRAME_MSG]) + payload
         except (pickle.PicklingError, TypeError, AttributeError):
             # per-message failure: drop it, the connection is healthy
+            return None
+        return _LEN.pack(len(frame)) + frame
+
+    def _encode_msg_batch(self, items: list) -> Optional[bytes]:
+        """ONE frame for a run of plain routed messages: the batch is
+        pickled in a single dumps call with a shared length prefix, so
+        the pickle setup and the per-frame header amortize across
+        everything the sender loop coalesced (ISSUE 13 / rule RA10).
+        Falls back to per-item encoding when any message in the batch
+        refuses to pickle (the per-item path then drops just that
+        message)."""
+        try:
+            triples = [self._wire_form(to, msg, src)
+                       for to, msg, src in items]
+            frame = bytes([FRAME_MSG_BATCH]) + pickle.dumps(
+                triples, protocol=pickle.HIGHEST_PROTOCOL)
+        except (pickle.PicklingError, TypeError, AttributeError):
             return None
         return _LEN.pack(len(frame)) + frame
 
@@ -350,9 +469,30 @@ class TcpRouter(LocalRouter):
         if sock is None:
             return False
         buf = bytearray()
-        for item in items:
-            encoded = self._encode_item(item)
+        # routed messages batch into one frame; control-plane singles
+        # (reply/notify/rpc frames — rare) keep their per-item frames
+        plain: list = []
+        for item in items:  # ra10-ok: per-ITEM partition/encode of control-plane singles; data frames batch below
+            if isinstance(item, _FaultHeld):  # plan cleared mid-delay
+                item = item.item
+            if isinstance(item[0], str) and item[0].startswith("__"):
+                encoded = self._encode_item(item)  # ra10-ok: control-plane singles (reply/notify/rpc) are rare
+                if encoded is not None:
+                    buf += encoded
+            else:
+                plain.append(item if len(item) == 3 else (*item, None))
+        if len(plain) == 1:
+            encoded = self._encode_item(plain[0])
             if encoded is not None:
+                buf += encoded
+        elif plain:
+            encoded = self._encode_msg_batch(plain)
+            if encoded is None:
+                for item in plain:
+                    encoded = self._encode_item(item)  # ra10-ok: fallback after a batch pickling failure
+                    if encoded is not None:
+                        buf += encoded
+            else:
                 buf += encoded
         if not buf:
             return True  # every item unpicklable: dropped individually
@@ -426,6 +566,68 @@ class TcpRouter(LocalRouter):
             for cid, f in list(self._calls.items()):
                 if f is fut:
                     del self._calls[cid]
+
+    # ------------------------------------------------------------------
+    # remote pipeline fan-in (api.pipeline_command's cross-host half)
+    # ------------------------------------------------------------------
+
+    #: commands per flushed {commands, Batch} frame and the straggler
+    #: flush cadence — PIPELINE_FLUSH_SIZE matches the server-side
+    #: command_flush_size default so one wire frame fills one leader
+    #: batch append (ISSUE 13)
+    PIPELINE_FLUSH_SIZE = 512
+    PIPELINE_FLUSH_INTERVAL_S = 0.002
+
+    def pipeline_cast(self, target: ServerId, cmd) -> bool:
+        """Buffer one fire-and-forget command toward ``target``; full
+        buffers flush inline as a CommandsEvent, stragglers are flushed
+        by a small cadence thread within ~PIPELINE_FLUSH_INTERVAL_S.
+        Same at-most-once posture as every data-plane cast: a dropped
+        frame is the client's timeout/retry problem.  The steady-state
+        cast is one lock cycle + one list append: the flusher wake and
+        the thread-liveness check run only on a buffer's FIRST fill.
+        Full-buffer flushes send INSIDE the buffer lock — the cadence
+        flusher sends under the same lock, so one caller's casts reach
+        the peer queue in submission order (an inline flush racing a
+        swapped-but-unsent cadence batch would otherwise overtake it);
+        send() is nonblocking (put_nowait), so the hold is short."""
+        with self._pipe_lock:
+            buf = self._pipe_bufs.get(target)
+            if buf is None:
+                buf = self._pipe_bufs[target] = []
+            buf.append(cmd)
+            n = len(buf)
+            if n >= self.PIPELINE_FLUSH_SIZE:
+                del self._pipe_bufs[target]
+                return self.send("?", target, CommandsEvent(tuple(buf)))
+        if n == 1:
+            if self._pipe_thread is None or \
+                    not self._pipe_thread.is_alive():
+                with self._pipe_lock:
+                    if self._pipe_thread is None or \
+                            not self._pipe_thread.is_alive():
+                        self._pipe_thread = threading.Thread(
+                            target=self._pipe_flusher, daemon=True,
+                            name="ra-tcp-pipe-flush")
+                        self._pipe_thread.start()
+            self._pipe_evt.set()
+        return True
+
+    def _pipe_flusher(self) -> None:
+        while not self._stop:
+            time.sleep(self.PIPELINE_FLUSH_INTERVAL_S)
+            with self._pipe_lock:
+                # swap AND send under the buffer lock: see pipeline_cast
+                # — an inline full-buffer flush must not overtake a
+                # swapped-but-unsent cadence batch
+                bufs, self._pipe_bufs = self._pipe_bufs, {}
+                for target, buf in bufs.items():
+                    if buf:
+                        self.send("?", target, CommandsEvent(tuple(buf)))
+            if not bufs:
+                # idle: park until the next cast instead of spinning
+                self._pipe_evt.wait(0.25)
+                self._pipe_evt.clear()
 
     # ------------------------------------------------------------------
     # reliable control-plane RPC (transport/rpc.py rides these)
@@ -710,7 +912,18 @@ class TcpRouter(LocalRouter):
                         continue  # per-source drop (co-hosted routers)
                     node = self.nodes.get(to.node)
                     if node is not None:
-                        node.deliver(to, msg)
+                        node.deliver(to, self._from_wire(msg))
+                elif kind == FRAME_MSG_BATCH:
+                    # one frame, many routed messages (ISSUE 13): the
+                    # recv-side fault decision above covered the frame
+                    # as one "msg"-class delivery, matching the one
+                    # syscall it rode in on
+                    for to, src, msg in pickle.loads(frame[1:]):
+                        if src in self.blocked_nodes:
+                            continue
+                        node = self.nodes.get(to.node)
+                        if node is not None:
+                            node.deliver(to, self._from_wire(msg))
                 elif kind == FRAME_REPLY:
                     call_id, reply = pickle.loads(frame[1:])
                     with self._call_lock:

@@ -1,1 +1,19 @@
 from .jaxenv import force_platform_from_env
+
+
+def host_envelope() -> dict:
+    """Host resource envelope for bench/soak JSON tails (ISSUE 13):
+    the fd cap (the wire ladder's 20k-rlimit ceiling) and the core
+    count (the 1-core partition tax) both surfaced as unexplained
+    cross-host drift in round captures — every capture carries them
+    so drift is attributable.  ONE implementation: bench._host_meta
+    and the soak tails all merge this dict."""
+    import os
+    env: dict = {"cpu_count": os.cpu_count()}
+    try:
+        import resource
+        env["rlimit_nofile"] = \
+            resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    except Exception:  # noqa: BLE001 — optional on exotic platforms
+        pass
+    return env

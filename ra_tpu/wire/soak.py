@@ -37,6 +37,13 @@ from .framing import data_stride
 from .server import WireListener
 
 
+def _host_envelope() -> dict:
+    """Host stamp for the soak tails (ISSUE 13 satellite) — the shared
+    ra_tpu.utils.host_envelope implementation."""
+    from ..utils import host_envelope
+    return host_envelope()
+
+
 def run_wire_soak(seed: int, *, conns: int = 10_000,
                   sessions_per_conn: int = 1, lanes: int = 512,
                   waves: int = 12, wave_ops: int = 50_000,
@@ -296,6 +303,7 @@ def ladder_main(seed: int, rungs, *, durable: bool = False,
                 durable_dir=d if durable else None,
                 disk_faults=disk_faults, **kw)
         res["rung"] = f"C{conns}"
+        res["host"] = _host_envelope()
         print(f"wire C{conns}: {res['wire_cmds_per_s']:.0f} cmds/s  "
               f"shed={res['wire_shed_rate']:.4f}  "
               f"recovery={res['wire_reconnect_recovery_s']:.2f}s  "

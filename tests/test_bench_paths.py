@@ -282,6 +282,56 @@ def test_classic_bench_contract():
     # snapshot/exposition like the WAL stats do)
     rpc = detail["tcp"]["observatory"]["rpc"]
     assert "rpc_calls" in rpc and "rpc_dedup_hits" in rpc
+    # ISSUE 13 satellites: the host envelope carries the fd cap next
+    # to cpu_count (cross-host drift attribution) and both phases
+    # stamp the CLASSIC_FIELDS batching-health shape — AER batches
+    # actually multi-entry, and the local (shared-WAL) phase shows the
+    # group-commit fan-in factor
+    assert detail["host"]["rlimit_nofile"] > 0
+    from ra_tpu.metrics import CLASSIC_FIELDS
+    for phase in ("local", "tcp"):
+        cb = detail[phase]["classic_batch"]
+        assert cb["aer_batches_sent"] > 0, (phase, cb)
+        assert cb["aer_batch_entries"] > cb["aer_batches_sent"], \
+            (phase, cb)  # batching really happened (entries/batch > 1)
+    local_cb = detail["local"]["classic_batch"]
+    assert set(CLASSIC_FIELDS) <= set(local_cb)
+    assert local_cb["records_per_fsync"] != 0
+    # ...and the classic stats ride the local Observatory snapshot
+    assert detail["local"]["observatory"]["classic"][
+        "aer_batches_sent"] > 0
+
+
+def test_bench_diff_compares_classic_captures(tmp_path):
+    """ISSUE 13 satellite: bench_diff pairs classic captures per phase
+    (classic/local + classic/tcp): throughput drops (higher-better,
+    the classic_node_committed_cmds_per_sec sub-values) and
+    p99_applied_latency_ms rises (lower-better) are flagged; the r05
+    on-disk capture shape itself produces the rows."""
+    import tools.bench_diff as bd
+    r05 = bd._load(os.path.join(REPO, "BENCH_CLASSIC_r05.json"))
+    rows = bd.extract_rows(r05)
+    assert "classic/local" in rows and "classic/tcp" in rows
+    new = {"metric": "classic_node_committed_cmds_per_sec",
+           "value": 1000.0,
+           "detail": {
+               "local": {"value": 8000.0,
+                         "p99_applied_latency_ms": 500.0},
+               "tcp": {"value": 1000.0,
+                       "p99_applied_latency_ms": 1100.0}}}
+    res = bd.diff(r05, new, noise_pct=10.0)
+    by = {(n, f["metric"]): f for n, fs in res["rows"].items()
+          for f in fs}
+    # local throughput halved + latency doubled: both flagged
+    assert by[("classic/local", "value")]["regression"]
+    assert by[("classic/local",
+               "p99_applied_latency_ms")]["regression"]
+    # tcp p99 improved: clean
+    assert not by[("classic/tcp",
+                   "p99_applied_latency_ms")]["regression"]
+    assert res["regressions"] >= 3  # local value+p99, tcp value
+    # self-compare is clean
+    assert bd.diff(r05, r05, noise_pct=10.0)["regressions"] == 0
 
 
 def test_bench_tail_carries_observatory_snapshot():

@@ -747,3 +747,114 @@ def run_disk_chaos(seed: int, data_dir: str) -> None:
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_disk_chaos_pinned_seeds(tmp_path, seed):
     run_disk_chaos(seed, str(tmp_path / f"s{seed}"))
+
+
+def test_batched_replication_kill9_leader_mid_batch_oracle(tmp_path):
+    """ISSUE 13 acceptance: the batch-native replication path (deep
+    {commands, Batch} flushes -> multi-entry AERs -> write_many group
+    commits through ONE shared Wal) under an ACTIVE DiskFaultPlan
+    (fsync-EIO + torn write), with the leader kill-9'd MID-BATCH.
+    Contract: the survivors elect and keep committing, the killed
+    member recovers over its durable state and reconverges, every
+    APPLIED-NOTIFIED command survives, and no command ever applies
+    twice (every member's counter == the same exactly-once total)."""
+    router = LocalRouter()
+    # co-hosted members over ONE system: all three feed one group-
+    # commit Wal — the shared-WAL fan-in deployment the batching
+    # tentpole targets
+    system = RaSystem(str(tmp_path))
+    node = RaNode("kb", router=router, log_factory=system.log_factory)
+    sids = [ServerId(f"kb{i}", "kb") for i in (1, 2, 3)]
+    notified: list = []
+    nlock = threading.Lock()
+
+    def on_notify(batch):
+        with nlock:
+            notified.extend(corr for corr, _r in batch)
+
+    try:
+        for sid in sids:
+            node.start_server(ServerConfig(
+                server_id=sid, uid=f"uid_{sid.name}",
+                cluster_name="kill9batch", initial_members=tuple(sids),
+                machine=SimpleMachine(lambda c, s: s + c, 0),
+                election_timeout_ms=120, tick_interval_ms=50))
+        ra_tpu.trigger_election(sids[0], router)
+        leader = await_leader(router, sids)
+
+        # storm faults while the batched burst is in flight
+        faults.install_plan(DiskFaultPlan(seed=29, by_class={
+            "wal": DiskFaultSpec(fsync_eio=0.6, short_write=0.4,
+                                 limit=6)}))
+        sent = 0
+        for i in range(1200):
+            ra_tpu.pipeline_command(leader, 1, correlation=("k", i),
+                                    notify_to=on_notify, router=router,
+                                    trace_ctx=False)
+            sent += 1
+        # kill-9 the leader mid-burst: batches are in every stage —
+        # low-queue, in-flight AERs, WAL group, unsent confirms
+        time.sleep(0.15)
+        node.kill_server(leader.name)
+        survivors = [s for s in sids if s != leader]
+        new_leader = await_leader(router, survivors, timeout=15.0)
+        # progress under the active plan proves the ladder holds with
+        # batching on.  Probe writes carry value 0 so a timed-out
+        # attempt retried after an election cannot perturb the exact
+        # at-most-once accounting below even if both attempts commit.
+        for _ in (1, 2):
+            deadline = time.monotonic() + 30
+            r = None
+            while r is None and time.monotonic() < deadline:
+                try:
+                    r = ra_tpu.process_command(new_leader, 0,
+                                               router=router,
+                                               timeout=10.0)
+                except TimeoutError:
+                    continue
+            assert r is not None
+        faults.clear_plan()
+        # the killed member restarts over its surviving durable state
+        node.start_server(ServerConfig(
+            server_id=leader, uid=f"uid_{leader.name}",
+            cluster_name="kill9batch", initial_members=tuple(sids),
+            machine=SimpleMachine(lambda c, s: s + c, 0),
+            election_timeout_ms=120, tick_interval_ms=50))
+        # settle: a final fully-acked write, then all members converge
+        r = ra_tpu.process_command(new_leader, 1000, router=router,
+                                   timeout=30.0)
+        final = r.reply
+        deadline = time.monotonic() + 20
+        states = {}
+        while time.monotonic() < deadline:
+            states = {str(s): ra_tpu.local_query(
+                s, lambda st: st, router=router).reply for s in sids}
+            if len(set(states.values())) == 1 and \
+                    list(states.values())[0] == final:
+                break
+            time.sleep(0.05)
+        assert len(set(states.values())) == 1, states
+        total = list(states.values())[0]
+        assert total == final
+        with nlock:
+            acked = len(set(notified))
+            dup_acks = len(notified) - acked
+        # at-most-once apply with cumulative-ack batches: the burst's
+        # contribution to the converged counter (value 1 per command)
+        # must cover every ACKED command and never exceed what was
+        # SENT — nothing acked was lost, nothing applied twice.  acked
+        # may trail applied: a leader kill loses the leader-local
+        # applied-notifications for entries the successor commits
+        # (Raft-legal, the documented at-most-once gate), but never
+        # duplicates one.
+        assert dup_acks == 0, dup_acks
+        burst_applied = total - 1000
+        assert acked <= burst_applied <= sent, \
+            (acked, burst_applied, sent)
+        ctr = faults.disk_fault_counters()
+        assert ctr["faults_injected"] >= 1, ctr
+        assert ctr["fsync_retries_after_failure"] == 0, ctr
+    finally:
+        faults.clear_plan()
+        node.stop()
+        system.close()

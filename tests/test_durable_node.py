@@ -212,3 +212,52 @@ def test_config_modification_at_restart(tmp_path):
     assert sh.server.machine_state == 5    # durable state preserved
     node2.stop()
     system2.close()
+
+
+def test_cohosted_follower_restart_resumes_replication(tmp_path):
+    """ISSUE 13 (found by the verify probe): co-hosted members share a
+    node, so a kill broadcasts DownEvent and the leader marks the peer
+    DISCONNECTED — but a RESTART had no up edge, so a restarted
+    follower whose log was behind the tail wedged forever: it cannot
+    win pre-votes (shorter log) and the leader skips DISCONNECTED
+    peers.  start_server now broadcasts the UpEvent twin and the
+    leader resumes catch-up immediately."""
+    router = LocalRouter()
+    system = RaSystem(str(tmp_path))
+    node = RaNode("ch", router=router, log_factory=system.log_factory)
+    sids = [ServerId(f"ch{i}", "ch") for i in (1, 2, 3)]
+
+    def cfg(sid):
+        return ServerConfig(server_id=sid, uid=f"uid_{sid.name}",
+                            cluster_name="cohosted",
+                            initial_members=tuple(sids),
+                            machine=counter(),
+                            election_timeout_ms=120,
+                            tick_interval_ms=50)
+
+    try:
+        for sid in sids:
+            node.start_server(cfg(sid))
+        ra_tpu.trigger_election(sids[0], router)
+        leader = await_leader(router, sids)
+        for v in range(1, 11):
+            ra_tpu.process_command(leader, v, router=router)
+        follower = next(s for s in sids if s != leader)
+        node.kill_server(follower.name)
+        # the log moves PAST the killed member: on restart it is
+        # behind the tail, so only leader-driven catch-up can save it
+        r = ra_tpu.process_command(leader, 100, router=router)
+        final = r.reply
+        node.start_server(cfg(follower))
+        deadline = time.monotonic() + 10
+        got = None
+        while time.monotonic() < deadline:
+            got = ra_tpu.local_query(follower, lambda s: s,
+                                     router=router).reply
+            if got == final:
+                break
+            time.sleep(0.02)
+        assert got == final, (got, final)
+    finally:
+        node.stop()
+        system.close()

@@ -713,6 +713,92 @@ def test_wire_package_is_ra09_clean():
             assert "RA09" not in r.stdout, (name, r.stdout)
 
 
+def test_checker_enforces_classic_hot_path(tmp_path):
+    """RA10 (ISSUE 13): per-entry pickle.dumps/encode_command and
+    per-entry WAL submits inside loops in the classic replication hot
+    paths are flagged — including a pickle moved into a same-module
+    helper called from the loop; `# ra10-ok:` allowlists deliberate
+    per-item sites; unscoped filenames are not gated."""
+    bad = tmp_path / "tcp.py"
+    bad.write_text(textwrap.dedent("""\
+        import pickle
+
+        class R:
+            def _send_items(self, peer, items):
+                buf = bytearray()
+                for item in items:
+                    buf += pickle.dumps(item)       # RA10: per-item
+                    buf += self._encode_item(item)  # RA10: via helper
+                return bytes(buf)
+
+            def _encode_item(self, item):
+                return pickle.dumps(item)
+
+            def overview(self):
+                # not on the sender path: per-item work is fine here
+                return [pickle.dumps(x) for x in (1, 2)]
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA10") == 2, r.stdout
+    assert "_send_items" in r.stdout
+    assert "overview" not in r.stdout
+    # allowlisted lines pass
+    fixed = bad.read_text() \
+        .replace("buf += pickle.dumps(item)       # RA10: per-item",
+                 "buf += pickle.dumps(item)  # ra10-ok: singles") \
+        .replace("buf += self._encode_item(item)  # RA10: via helper",
+                 "buf += self._encode_item(item)  # ra10-ok: fallback")
+    bad.write_text(fixed)
+    r = run_lint(str(bad))
+    assert "RA10" not in r.stdout, r.stdout
+    # log/durable.py: per-entry WAL submits in the batch-append path
+    logdir = tmp_path / "log"
+    logdir.mkdir()
+    dlog = logdir / "durable.py"
+    dlog.write_text(textwrap.dedent("""\
+        def encode_command(cmd):
+            import pickle
+            return pickle.dumps(cmd)
+
+        class D:
+            def write(self, entries):
+                for e in entries:
+                    payload = encode_command(e)     # RA10: per-entry
+                    self.wal.write(self.uid, e, payload)  # RA10: WAL
+    """))
+    r = run_lint(str(dlog))
+    assert r.returncode == 1
+    assert r.stdout.count("RA10") == 2, r.stdout
+    assert "per-entry WAL submit" in r.stdout
+    # the same content under another parent dir is not gated
+    other = tmp_path / "durable.py"
+    other.write_text(dlog.read_text())
+    r = run_lint(str(other))
+    assert "RA10" not in r.stdout
+    # an unscoped filename with the same sender content is not gated
+    free = tmp_path / "sender.py"
+    free.write_text(textwrap.dedent("""\
+        import pickle
+
+        class R:
+            def _send_items(self, peer, items):
+                return [pickle.dumps(i) for i in items]
+    """))
+    r = run_lint(str(free))
+    assert "RA10" not in r.stdout
+
+
+def test_classic_hot_paths_are_ra10_clean():
+    """The real sender loop, batch-append, and commit-advance closures
+    pass the per-entry gate (covered by the repo-wide run too; pinned
+    separately so a regression names the rule)."""
+    for mod in ("ra_tpu/transport/tcp.py", "ra_tpu/log/durable.py",
+                "ra_tpu/core/server.py"):
+        r = run_lint(os.path.join(REPO, *mod.split("/")))
+        assert "RA10" not in r.stdout, (mod, r.stdout)
+
+
 def test_mesh_module_is_ra04_and_ra08_clean():
     """The real mesh driver passes both gates (covered by the repo-wide
     run too; pinned separately so a regression names the rule)."""

@@ -14,6 +14,11 @@ optional latency percentiles, found at the top level or nested under
   ``p50_commit_latency_ms`` / ``p99_applied_latency_ms`` rose by more
   than the bar (lower-is-better; -1 sentinels = not measured, skipped);
 * frontier ``points`` are compared per ``cmds_per_step``;
+* classic captures (BENCH_CLASSIC_r*, ISSUE 13) are compared per
+  phase: ``classic/local`` and ``classic/tcp`` rows pair the
+  ``classic_node_committed_cmds_per_sec`` sub-values (higher-better)
+  and their ``p99_applied_latency_ms`` (lower-better), so the classic
+  frontier is regression-tracked like every other;
 * multichip sweep tails (ISSUE 11) are compared per mesh shape x lane
   rung (``multichip/<mesh>/lanes<N>``, cmds_per_s higher-is-better) —
   a cross-round mesh delta is attributable via each row's stamped
@@ -92,10 +97,14 @@ def extract_rows(doc: dict) -> dict:
             rows[f"multichip/{row.get('mesh', i)}/"
                  f"lanes{row.get('lanes', '?')}"] = row
     detail = doc.get("detail")
+    classic = doc.get("metric") == "classic_node_committed_cmds_per_sec"
     if isinstance(detail, dict):
         for key, sub in detail.items():
             if _is_row(sub):
-                add(key, sub)
+                # classic phase rows get a stable namespaced name so
+                # r05-era and r06-era captures pair up (ISSUE 13)
+                add(f"classic/{key}" if classic and
+                    key in ("local", "tcp") else key, sub)
     return rows
 
 

@@ -102,6 +102,21 @@ Checks (cheap, high-signal, zero-config):
                 the socket path).  Per-CONNECTION work (a socket
                 write per conn, a protocol-error close) carries an
                 `# ra09-ok: <why>` line comment
+  RA10          (classic replication hot path, ISSUE 13) no per-entry
+                `pickle.dumps`/`encode_command` and no per-entry WAL
+                append/fsync INSIDE A LOOP within the batch-native hot
+                paths: the transport sender loop (`tcp.py::_send_items`
+                + same-module closure), the follower/leader batch
+                append (`log/durable.py::write`/`append_batch`/
+                `_put_batch` + closure), and the leader commit-advance
+                closure (`core/server.py::_leader_aer_reply`/
+                `_evaluate_quorum`).  Calls to same-module helpers that
+                themselves encode (contain a dumps/encode_command) are
+                flagged at the loop call site too — moving the pickle
+                into a helper must not escape the gate.  Deliberate
+                per-item sites (control-plane singles, the
+                no-shipped-payloads fallback, crash-recovery resends)
+                carry an `# ra10-ok: <why>` line comment
   RA03          (files in a `log/` directory only) no swallow-only
                 `except OSError:`/`except Exception:` (body is just
                 `pass`) around durability-bearing I/O calls (fsync/
@@ -497,6 +512,72 @@ def _check_coalesce_hot_path(tree: ast.Module, err,
                     f"or mark the line '{mark}'")
 
 
+#: RA10 — the classic replication hot path (ISSUE 13): per scoped file,
+#: the root functions whose same-module call closure must not pickle or
+#: touch the WAL per entry inside a loop.  Scope key: (basename,
+#: required parent dir or None).
+_RA10_SCOPES = {
+    ("tcp.py", None): frozenset({"_send_items"}),
+    ("durable.py", "log"): frozenset({"write", "append_batch",
+                                      "_put_batch"}),
+    ("server.py", "core"): frozenset({"_leader_aer_reply",
+                                      "_evaluate_quorum"}),
+}
+_RA10_ENCODE_NAMES = frozenset({"dumps", "encode_command"})
+_RA10_SYNC_NAMES = frozenset({"fsync", "fdatasync"})
+
+
+def _check_classic_hot_path(tree: ast.Module, err, roots) -> None:
+    """RA10: inside the hot-path closure, flag per-entry encode/WAL
+    calls that sit INSIDE a loop (allowlist via `# ra10-ok:` line
+    comment, resolved by the caller's err wrapper)."""
+    funcs: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+    # same-module helpers that themselves encode: calling one inside a
+    # loop is the same per-entry pickle, one hop removed
+    encoders = set()
+    for name, fn in funcs.items():
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                cname = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else None
+                if cname in _RA10_ENCODE_NAMES:
+                    encoders.add(name)
+                    break
+    seen: set = set()
+    for node in _sampler_hot_closure(tree, roots).values():
+        for loop in ast.walk(node):
+            if not isinstance(loop, _LOOP_NODES):
+                continue
+            for sub in ast.walk(loop):
+                if not isinstance(sub, ast.Call) or id(sub) in seen:
+                    continue
+                f = sub.func
+                cname = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else None
+                if cname in _RA10_SYNC_NAMES or (
+                        cname in ("write", "write_many") and
+                        isinstance(f, ast.Attribute) and
+                        isinstance(f.value, ast.Attribute) and
+                        f.value.attr == "wal"):
+                    seen.add(id(sub))
+                    err(sub, "RA10",
+                        f"per-entry WAL submit/sync ({cname}) inside a "
+                        f"loop in classic hot path {node.name}() — use "
+                        "the group-commit fan-in (write_many) outside "
+                        "the loop or mark the line '# ra10-ok: why'")
+                elif cname in _RA10_ENCODE_NAMES or cname in encoders:
+                    seen.add(id(sub))
+                    err(sub, "RA10",
+                        f"per-entry encode ({cname}) inside a loop in "
+                        f"classic hot path {node.name}() — batch-encode "
+                        "outside the loop (one pickle per frame/run) or "
+                        "mark the line '# ra10-ok: why'")
+
+
 #: RA05 — the field-group registry contract (metrics.py): a counter
 #: field that FIELD_REGISTRY does not list escapes the registry parity
 #: test, and one docs/OBSERVABILITY.md does not name is a number nobody
@@ -724,6 +805,19 @@ def check_file(path: str) -> list:
                 err(node, code, msg)
 
         _check_engine_hot_sync(tree, err_ra02)
+    base = os.path.basename(path)
+    parent = os.path.basename(os.path.dirname(path))
+    for (b, pdir), roots in _RA10_SCOPES.items():
+        if base == b and (pdir is None or parent == pdir):
+            ra10_ok = {i + 1 for i, line in enumerate(src.splitlines())
+                       if "ra10-ok" in line}
+
+            def err_ra10(node: ast.AST, code: str, msg: str,
+                         _ok=ra10_ok) -> None:
+                if getattr(node, "lineno", 0) not in _ok:
+                    err(node, code, msg)
+
+            _check_classic_hot_path(tree, err_ra10, roots)
     if os.path.basename(path) in _INGRESS_HOT_FILES:
         ra08_ok = {i + 1 for i, line in enumerate(src.splitlines())
                    if "ra08-ok" in line}

@@ -228,6 +228,10 @@ def _ingress_main(argv: list) -> int:
           flush=True)
     if last:
         # the bench_diff-comparable tail (ingress throughput/shed keys)
+        # with the host envelope (fd cap + core count, ISSUE 13 — the
+        # drift dimensions the cross-host comparisons kept missing)
+        from ra_tpu.wire.soak import _host_envelope
+        last["host"] = _host_envelope()
         print(json.dumps(last), flush=True)
     return 1 if failed else 0
 
