@@ -214,7 +214,7 @@ class _WalShard:
         self.idx = idx
         self.lo = lo
         self.hi = hi
-        self.bridge = bridge
+        self.bridge = bridge  # ra-type: EngineDurability
         self.error: Optional[BaseException] = None
         self.retirer = _WalFileRetirer()
         self.wal = Wal(shard_dir, segment_writer=self.retirer,

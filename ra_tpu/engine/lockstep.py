@@ -757,9 +757,9 @@ class LockstepEngine:
         self._donate = donate
         self._superstep_donate = superstep_donate \
             if superstep_donate is not None else True
-        self._dur = None
+        self._dur = None  # ra-type: ra_tpu.engine.durable.EngineDurability
         self._driver = None
-        self._telemetry = None  # attached TelemetrySampler (or None)
+        self._telemetry = None  # ra-type: ra_tpu.telemetry.TelemetrySampler
         self._ingress = None    # attached IngressPlane (ISSUE 10)
         self._mesh = None       # device mesh, set by shard_engine_state
                                 # (ISSUE 11: drivers/ingress read it to
@@ -833,7 +833,7 @@ class LockstepEngine:
         old code paid on every masked step (ISSUE 5 satellite).  Callers
         must pass host data (numpy/list); a device array here would
         reintroduce the sync it exists to remove."""
-        arr = np.asarray(mask)
+        arr = np.asarray(mask)  # ra02-ok: host data by contract (docstring) — a device array here would reintroduce the sync this helper removes
         return jnp.asarray(arr), bool(arr.any())
 
     def step(self, n_new, payloads, elect_mask=None,
@@ -1359,9 +1359,9 @@ class DispatchAheadDriver:
     def _stage(self, n_new_blk, payloads_blk, elect_blk=None) -> None:
         put = jax.device_put
         t0 = time.monotonic()
-        n = put(np.asarray(n_new_blk, np.int32),
+        n = put(np.asarray(n_new_blk, np.int32),  # ra02-ok: host block -> staging encode (async H2D; no device readback)
                 self.shardings.get("n_new"))
-        p = put(np.asarray(payloads_blk), self.shardings.get("payloads"))
+        p = put(np.asarray(payloads_blk), self.shardings.get("payloads"))  # ra02-ok: host block -> staging encode (async H2D; no device readback)
         # host_staging phase stamp: the host-side encode + H2D submit
         # cost of this block (device_put is async, so this is the edge
         # the host pays, not the wire time — rule RA04: no sync here)
@@ -1402,7 +1402,7 @@ class DispatchAheadDriver:
                 waited = True
             if waited:
                 self.engine.pipeline_counters["window_syncs"] += 1
-            self.last_committed = np.asarray(oldest)
+            self.last_committed = np.asarray(oldest)  # ra02-ok: the in-flight cap's window-boundary readback — the driver's single documented sync point (window_syncs)
             # device_dispatch phase stamp: submit -> the dispatch's
             # committed watermark observed on the host, read at the
             # pops the in-flight cap already performs (PR 5's async

@@ -48,6 +48,11 @@ MAX_OPEN_SEGMENTS = 5
 SNAP_MAGIC = b"RTSN"
 _SNAP_HDR = struct.Struct("<4sII")  # magic, version, crc(meta+state)
 
+#: sentinel for "not answerable from memtable/snapshot alone" — the
+#: under-_lock half of a term lookup returns it instead of falling
+#: through to a segment read (_io_lock), see _mem_term_locked (RA11)
+_MISS = object()
+
 MAX_CHECKPOINTS = 10  # ra.hrl:234
 
 #: fast-path frame marker for the durable command image.  Pickle streams
@@ -416,6 +421,25 @@ class DurableLog:
 
     def _wal_notify(self, uid: str, lo: Optional[int], hi: int,
                     term: int) -> None:
+        rewind_term = 0
+        if lo is None and term == -2:
+            # pre-read OUTSIDE the log lock (rule RA11, the
+            # _put/_put_batch overwrite-rewind idiom): fetch_term can
+            # fall through to a segment read (_io_lock), and
+            # _io_lock-inside-_lock inverts the documented io-then-log
+            # order against flush_mem_to_segments — the ABBA class the
+            # PR 13 review fixed on the append path survived here until
+            # the RA11 analyzer flagged it.  Safe unlocked: the guard
+            # below re-checks last_written under _lock before applying,
+            # and an overwrite racing this read either starts <= hi
+            # (rewinding last_written below hi, so the guard fails) or
+            # starts above hi (leaving the term at hi untouched).  A
+            # concurrent snapshot INSTALL is the remaining race (it
+            # prunes <= meta.index and would leave this pre-read
+            # stale), so the rewind branch re-resolves via
+            # _mem_term_locked and only falls back to this value for a
+            # segment-resident hi — segment terms are immutable.
+            rewind_term = self.fetch_term(hi) or 0
         with self._lock:
             if lo is None:
                 # resend_from: re-submit memtable entries above hi
@@ -437,8 +461,26 @@ class DurableLog:
                     # of trusting the poisoned one (the entries are
                     # still memtable-resident: pruning only happens at
                     # segment flush, which is gated on last_written)
-                    self._last_written = IdxTerm(
-                        hi, self.fetch_term(hi) or 0)
+                    got = self._mem_term_locked(hi)
+                    if got is None and self._snapshot is not None and \
+                            self._snapshot[0].index >= hi:
+                        # a snapshot install landed between the
+                        # pre-read and this lock and pruned <= hi.
+                        # Entries up to the snapshot are durable via
+                        # the snapshot — but the poisoned confirms may
+                        # cover memtable entries ABOVE it, so clamp
+                        # last_written to the snapshot (never below:
+                        # that would stamp a stale term under durable
+                        # state) and let the floor clamp below resend
+                        # exactly the (snapshot, last_index] suffix
+                        snap = self._snapshot[0]
+                        if self._last_written.index > snap.index:
+                            self._last_written = IdxTerm(snap.index,
+                                                         snap.term)
+                    else:
+                        if got is not None and got is not _MISS:
+                            rewind_term = got  # fresher than pre-read
+                        self._last_written = IdxTerm(hi, rewind_term)
                 start = max(hi, self._last_written.index) + 1
                 for idx in range(start, self._last_index + 1):
                     ent = self._memtable.get(idx)
@@ -649,13 +691,20 @@ class DurableLog:
                            truncate=truncate)
 
     def set_last_index(self, idx: int) -> None:
+        if idx >= self._last_index:
+            return
+        # pre-read OUTSIDE the log lock (rule RA11, the _put/_put_batch
+        # idiom): a fetch_term miss under _lock would take _io_lock and
+        # invert the documented io-then-log order.  Race-free: terms
+        # are immutable at a given index until overwritten, and only
+        # the event-loop thread that calls this truncates/overwrites.
+        term = self.fetch_term(idx) or 0
         with self._lock:
             if idx >= self._last_index:
                 return
             for i in range(idx + 1, self._last_index + 1):
                 self._memtable.pop(i, None)
                 self._mem_bytes.pop(i, None)
-            term = self.fetch_term(idx) or 0
             self._last_index, self._last_term = idx, term
             if self._last_written.index > idx:
                 self._last_written = IdxTerm(idx, term)
@@ -670,7 +719,8 @@ class DurableLog:
             evts, self._events = self._events, []
         return evts
 
-    def handle_written(self, evt: WrittenEvent) -> None:
+    def handle_written(self, evt: WrittenEvent,
+                       _seg: tuple = (None, None)) -> None:
         with self._lock:
             if evt.from_index > self._last_written.index + 1 and \
                     evt.from_index <= self._last_index:
@@ -708,15 +758,38 @@ class DurableLog:
             # coalesced batch confirm can cover an overwritten suffix
             # while its surviving prefix is genuinely durable
             to = min(evt.to_index, self._last_index)
-            term = self.fetch_term(to)
-            if term == evt.term:
-                if to > self._last_written.index:
+            if to <= self._last_written.index:
+                # duplicate/stale confirm: every branch below is a
+                # no-op for an index already at/under last_written
+                return
+            term = self._mem_term_locked(to)
+            if term is _MISS and _seg[0] == to:
+                # resolved by the out-of-lock segment read below
+                term = _seg[1]
+            if term is not _MISS:
+                if term == evt.term:
+                    # to > last_written is guaranteed by the early
+                    # return above (the lock is held throughout)
                     self._last_written = IdxTerm(to, term)
-            elif term is None and self._snapshot is not None and \
-                    self._snapshot[0].index >= to:
-                pass  # truncated by snapshot: subsumed
-            # else: stale confirm for an overwritten term — ignored; the
-            # rewrite is already queued to the WAL
+                elif term is None and self._snapshot is not None and \
+                        self._snapshot[0].index >= to:
+                    pass  # truncated by snapshot: subsumed
+                # else: stale confirm for an overwritten term — ignored;
+                # the rewrite is already queued to the WAL
+                return
+        # Memtable miss ABOVE last_written: the entry was flushed +
+        # pruned to a segment before this confirm was processed — the
+        # segment writer flushes up to the WAL FILE's range, which can
+        # run ahead of the log's processed confirm watermark, so this
+        # is a valid confirm for an already-segment-durable entry and
+        # must still advance last_written.  Resolve the term WITHOUT
+        # holding _lock (a segment read takes _io_lock; io-then-log is
+        # the documented order, rule RA11) and re-enter: ``to`` is
+        # stable across the round trip — evt is ours and _last_index
+        # only moves on this event-loop thread — so the second pass
+        # hits the ``_seg[0] == to`` branch and terminates.
+        got = self._segment_read(to)
+        self.handle_written(evt, _seg=(to, got[0] if got else None))
 
     # -- reads --------------------------------------------------------------
 
@@ -749,17 +822,28 @@ class DurableLog:
                         return got
         return None
 
+    def _mem_term_locked(self, idx: int):
+        """Memtable/snapshot half of a term lookup; MUST run under
+        self._lock.  Returns ``_MISS`` when only a segment read can
+        answer — callers holding _lock must NOT fall through to
+        ``_segment_read`` (it takes _io_lock; io-then-log is the
+        documented order, rule RA11)."""
+        if self._snapshot is not None and \
+                idx == self._snapshot[0].index:
+            return self._snapshot[0].term
+        if idx < self._first_index or idx > self._last_index:
+            return None
+        ent = self._memtable.get(idx)
+        if ent is not None:
+            return ent[0]
+        return _MISS
+
     def fetch_term(self, idx: int) -> Optional[int]:
         self.counters["fetch_term"] += 1
         with self._lock:
-            if self._snapshot is not None and \
-                    idx == self._snapshot[0].index:
-                return self._snapshot[0].term
-            if idx < self._first_index or idx > self._last_index:
-                return None
-            ent = self._memtable.get(idx)
-            if ent is not None:
-                return ent[0]
+            got = self._mem_term_locked(idx)
+        if got is not _MISS:
+            return got
         got = self._segment_read(idx)
         return got[0] if got else None
 

@@ -232,7 +232,7 @@ class TelemetrySampler:
                     return
                 self.counters["blocking_waits"] += 1
             self._pending.popleft()
-            snap = {k: _host_scalar(v) for k, v in out.items()}  # ra04-ok: is_ready-gated (or an explicit drain barrier)
+            snap = {k: _host_scalar(v) for k, v in out.items()}  # is_ready-gated (or an explicit drain barrier); the syncs live in _host_scalar
             snap["ts"] = ts
             snap["inner_steps_at_sample"] = steps
             snap["stall_threshold"] = self.stall_threshold

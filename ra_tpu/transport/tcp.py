@@ -472,7 +472,7 @@ class TcpRouter(LocalRouter):
         # routed messages batch into one frame; control-plane singles
         # (reply/notify/rpc frames — rare) keep their per-item frames
         plain: list = []
-        for item in items:  # ra10-ok: per-ITEM partition/encode of control-plane singles; data frames batch below
+        for item in items:  # per-ITEM partition of control-plane singles (the encodes inside carry the ra10 tags); data frames batch below
             if isinstance(item, _FaultHeld):  # plan cleared mid-delay
                 item = item.item
             if isinstance(item[0], str) and item[0].startswith("__"):

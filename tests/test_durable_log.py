@@ -409,3 +409,127 @@ def test_multi_server_rollover_parallel_flush(tmp_path):
     # wall time at scale (disk-bound flushes overlap)
     assert elapsed < 30
     sys_.close()
+
+
+def test_lock_order_fix_paths_keep_semantics(tmp_path):
+    """ISSUE 14 / RA11 regression: three sites used to resolve terms via
+    fetch_term while HOLDING the log lock — a segment-read fallthrough
+    there takes _io_lock and inverts the documented io-then-log order
+    (ABBA vs flush_mem_to_segments).  The fix pre-reads outside the
+    lock (set_last_index, _wal_notify) and short-circuits stale
+    confirms to a memtable-only lookup (handle_written).  Pin the
+    observable semantics on the exact shape that exercised the old
+    fallthrough: entries flushed to segments and pruned from the
+    memtable."""
+    from ra_tpu.core.types import WrittenEvent
+
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 201):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    # move everything to segments: term lookups below last_written now
+    # REQUIRE the segment path (the memtable is empty)
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    assert log.overview()["num_mem_entries"] == 0
+    # (1) handle_written: a duplicate/stale confirm at/below
+    # last_written is a no-op — and never touches the segment path
+    # under the log lock
+    before = log.last_written()
+    log.handle_written(WrittenEvent(1, 150, 1))
+    assert log.last_written() == before
+    # a stale confirm with a WRONG term is equally a no-op
+    log.handle_written(WrittenEvent(100, 180, 7))
+    assert log.last_written() == before
+    # (2) set_last_index: truncation whose boundary term lives in a
+    # segment resolves through the pre-read and still rewinds both
+    # last_index and last_written
+    log.set_last_index(150)
+    assert log.last_index_term().index == 150
+    assert log.last_index_term().term == 1
+    assert log.last_written().index == 150
+    assert log.last_written().term == 1
+    # reads above the truncation are gone; below still served
+    assert log.fetch(151) is None
+    assert log.fetch(150).command.data == 150
+    sys_.close()
+
+
+def test_confirm_for_flushed_ahead_entries_still_advances(tmp_path):
+    """Review regression pin (ISSUE 14): the segment writer flushes up
+    to the WAL FILE's range, which can run AHEAD of the log's processed
+    confirm watermark — a confirm arriving AFTER its entries were
+    flushed+pruned must still advance last_written (resolved via an
+    out-of-lock segment read, never _io_lock-under-_lock)."""
+    from ra_tpu.core.types import WrittenEvent
+
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 101):
+        log.append(Entry(i, 1, UserCommand(i)))
+    # make everything WAL-durable, then STEAL the queued confirms so
+    # the log never processes them
+    sys_.wal.flush()
+    held = [e for e in log.take_events()
+            if isinstance(e, WrittenEvent)]
+    assert held, "expected queued WAL confirms"
+    assert log.last_written().index == 0
+    # roll + flush: the segment writer prunes the whole memtable even
+    # though the log's confirm watermark still sits at 0
+    sys_.wal.rollover()
+    sys_.wal.flush()
+    sys_.segment_writer.await_idle()
+    held += [e for e in log.take_events()
+             if isinstance(e, WrittenEvent)]
+    assert log.overview()["num_mem_entries"] == 0
+    # the late confirms now resolve their terms from segments and the
+    # watermark catches up
+    for e in held:
+        log.handle_written(e)
+    assert log.last_written().index == 100, log.last_written()
+    assert log.last_written().term == 1
+    sys_.close()
+
+
+def test_poison_rewind_skips_snapshot_subsumed_range(tmp_path):
+    """Review regression pin (ISSUE 14, round 3): the poison-rewind
+    pre-read in _wal_notify races a concurrent snapshot install — if
+    the install prunes <= meta.index between the out-of-lock
+    fetch_term and the locked rewind, the pre-read term is stale and
+    the rewind would drag last_written BELOW the installed snapshot.
+    The rewind branch now re-resolves under the lock and, for a
+    snapshot-subsumed range, CLAMPS last_written to the snapshot —
+    never below it (stale term under durable state), never leaving it
+    above (memtable entries between the snapshot and the old watermark
+    rode the failed syscall and MUST be resent; a first-cut skip left
+    them only in the poisoned file)."""
+    sys_ = mk_system(tmp_path)
+    log = mk_log(sys_)
+    for i in range(1, 101):
+        log.append(Entry(i, 1, UserCommand(i)))
+    drain(log)
+    # snapshot at 80 WITHOUT a segment flush: entries 81..100 stay
+    # memtable-resident — the shape where a skipped rewind loses data
+    log.update_release_cursor(80, (), 0, {"acc": 1})
+    assert log.first_index() == 81
+    assert log.overview()["num_mem_entries"] == 20
+    assert log.last_written().index == 100
+    resends_before = log.counters["write_resends"]
+    # a late poison notify for a range the snapshot subsumed: the exact
+    # interleaving is pre-read -> install -> locked rewind; calling
+    # after the install drives the same locked branch (the under-lock
+    # re-resolve returns None for a pruned index either way)
+    log._wal_notify(log.uid, None, 50, -2)
+    # clamped to the snapshot, not rewound to hi=50
+    assert log.last_written() == (80, 1), log.last_written()
+    # and the memtable suffix above the snapshot was re-submitted
+    assert log.counters["write_resends"] - resends_before == 20
+    drain(log)
+    assert log.last_written().index == 100
+    # the log still confirms fresh appends normally afterwards
+    log.append(Entry(101, 1, UserCommand(101)))
+    drain(log)
+    assert log.last_written().index == 101
+    sys_.close()

@@ -804,3 +804,749 @@ def test_mesh_module_is_ra04_and_ra08_clean():
     run too; pinned separately so a regression names the rule)."""
     r = run_lint(os.path.join(REPO, "ra_tpu", "parallel", "mesh.py"))
     assert "RA04" not in r.stdout and "RA08" not in r.stdout, r.stdout
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14 — the whole-program analyzer (tools/analyzer/): cross-module
+# closures, RA11 lock-order cycles, RA12 thread roles, the suppression
+# audit, and the CLI additions (--changed/--json/--report).
+# ---------------------------------------------------------------------------
+
+def test_checker_catches_cross_module_escape(tmp_path):
+    """The tentpole regression: a host sync moved into a helper ONE
+    MODULE AWAY is flagged.  The pre-ISSUE-14 gate walked only the
+    same-module call closure, so this exact shape escaped every rule —
+    the finding below lands in helpers.py, a file the old checker
+    could never attribute a sampler-path finding to."""
+    pkg = tmp_path / "plane"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helpers.py").write_text(textwrap.dedent("""\
+        import numpy as np
+
+        def pull(handle):
+            return np.asarray(handle)
+    """))
+    (pkg / "telemetry.py").write_text(textwrap.dedent("""\
+        from .helpers import pull
+
+        class S:
+            def tick(self):
+                return pull(self.handle)
+    """))
+    r = run_lint(str(pkg / "telemetry.py"))
+    assert r.returncode == 1
+    assert "RA04" in r.stdout, r.stdout
+    assert "helpers.py" in r.stdout and "pull" in r.stdout, r.stdout
+
+
+def test_checker_resolves_ra_type_annotation_seams(tmp_path):
+    """`# ra-type: Class` on an attribute assignment types the seam, so
+    the closure walks through dynamically passed collaborators (the
+    light-annotation half of ISSUE 14 — lockstep's `_dur` bridge and
+    the WAL shard's `bridge` use exactly this)."""
+    bad = tmp_path / "lockstep.py"
+    bad.write_text(textwrap.dedent("""\
+        class Bridge:
+            def work(self):
+                return self.h.item()
+
+        class Eng:
+            def __init__(self, bridge):
+                self.bridge = bridge  # ra-type: Bridge
+
+            def step(self):
+                self.bridge.work()
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert "RA02" in r.stdout and "work" in r.stdout, r.stdout
+    # without the annotation the seam is opaque: no finding (the
+    # analyzer only follows provable edges)
+    bad.write_text(bad.read_text().replace("  # ra-type: Bridge", ""))
+    r = run_lint(str(bad))
+    assert "RA02" not in r.stdout, r.stdout
+
+
+def test_checker_detects_lock_order_cycle(tmp_path):
+    """RA11: an ABBA pair — a-then-b on one path, b-then-a (through a
+    helper call) on another — is a lock-order cycle; both directions
+    are named.  A consistent hierarchy passes clean."""
+    pkg = tmp_path / "store"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    mod = pkg / "store.py"
+    mod.write_text(textwrap.dedent("""\
+        import threading
+
+
+        class Store:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def put(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def flush(self):
+                with self._b:
+                    self._refresh()
+
+            def _refresh(self):
+                with self._a:
+                    pass
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("RA11") == 2, r.stdout
+    assert "Store._a" in r.stdout and "Store._b" in r.stdout
+    # consistent a-then-b everywhere: clean
+    mod.write_text(textwrap.dedent("""\
+        import threading
+
+
+        class Store:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def put(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def flush(self):
+                with self._a:
+                    self._refresh()
+
+            def _refresh(self):
+                with self._b:
+                    pass
+    """))
+    r = run_lint(str(pkg))
+    assert "RA11" not in r.stdout, r.stdout
+
+
+def test_checker_pins_the_fetch_term_abba_shape(tmp_path):
+    """The exact shape RA11 caught LIVE in log/durable.py (ISSUE 14):
+    a term lookup whose tail falls through to the io lock, called while
+    the log lock is held, against a flush path that holds io-then-log.
+    The PR 13 review fixed this class on the append path by hand; the
+    analyzer found three surviving sites (_wal_notify/set_last_index/
+    handle_written) — fixed in this PR and pinned clean below."""
+    pkg = tmp_path / "logpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    mod = pkg / "durlog.py"
+    mod.write_text(textwrap.dedent("""\
+        import threading
+
+
+        class Log:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._io_lock = threading.Lock()
+
+            def fetch_term(self, idx):
+                with self._lock:
+                    got = idx
+                return self._segment_read(got)
+
+            def _segment_read(self, idx):
+                with self._io_lock:
+                    return idx
+
+            def handle_written(self, evt):
+                with self._lock:
+                    return self.fetch_term(evt)
+
+            def flush(self):
+                with self._io_lock:
+                    with self._lock:
+                        pass
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert "RA11" in r.stdout, r.stdout
+    assert "Log._io_lock" in r.stdout and "Log._lock" in r.stdout
+    # `# ra11-ok:` allowlists reviewed edges (both directions tagged)
+    fixed = mod.read_text() \
+        .replace("return self.fetch_term(evt)",
+                 "return self.fetch_term(evt)  # ra11-ok: reviewed") \
+        .replace("with self._lock:\n                pass",
+                 "with self._lock:  # ra11-ok: reviewed\n"
+                 "                pass")
+    mod.write_text(fixed)
+    r = run_lint(str(pkg))
+    assert "RA11" not in r.stdout, r.stdout
+
+
+def test_log_layer_is_ra11_clean():
+    """The real log layer holds the documented io-then-log order with
+    no cycle — the PR 13 ABBA class cannot reland (ISSUE 14
+    acceptance pin; the three fixed sites live in durable.py)."""
+    r = run_lint(os.path.join(REPO, "ra_tpu", "log"))
+    assert "RA11" not in r.stdout, r.stdout
+    r = run_lint(os.path.join(REPO, "ra_tpu", "log", "durable.py"))
+    assert "RA11" not in r.stdout, r.stdout
+
+
+def test_checker_ra11_lock_annotation_names_dynamic_locks(tmp_path):
+    """`# ra11-lock: Name` names a dynamically passed lock so its
+    acquisitions join the order graph (the small annotation ISSUE 14
+    specifies for locks the resolver cannot type)."""
+    pkg = tmp_path / "w"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "worker.py").write_text(textwrap.dedent("""\
+        import threading
+
+
+        class W:
+            def __init__(self, shared):
+                self._own = threading.Lock()
+                self._shared = shared
+
+            def a(self):
+                with self._own:
+                    with self._shared:  # ra11-lock: Pool.biglock
+                        pass
+
+            def b(self):
+                with self._shared:  # ra11-lock: Pool.biglock
+                    with self._own:
+                        pass
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("RA11") == 2, r.stdout
+    assert "Pool.biglock" in r.stdout and "W._own" in r.stdout
+
+
+def test_checker_detects_worker_thread_device_ops(tmp_path):
+    """RA12: jax.*/jnp.* calls, device_put and block_until_ready in the
+    transitive closure of a threading.Thread target are flagged — the
+    PR 11 mesh deadlock (an encode worker enqueuing device work against
+    an in-flight pjit), as a lint.  Non-worker functions and
+    non-package files are exempt."""
+    pkg = tmp_path / "eng"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    mod = pkg / "shard.py"
+    mod.write_text(textwrap.dedent("""\
+        import threading
+
+        import jax
+        import jax.numpy as jnp
+
+
+        class Shard:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                self._work()
+
+            def _work(self):
+                a = jnp.ones(3)
+                jax.device_put(a)
+                a.block_until_ready()
+
+            def overview(self):
+                return jnp.zeros(1)
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("RA12") == 3, r.stdout
+    assert "_work" in r.stdout and "overview" not in r.stdout
+    assert "jnp.ones" in r.stdout and "jax.device_put" in r.stdout
+    assert ".block_until_ready()" in r.stdout
+    # tagged host-materialization sites pass (and stay audit-live)
+    fixed = mod.read_text() \
+        .replace("a = jnp.ones(3)",
+                 "a = jnp.ones(3)  # ra12-ok: pre-spawn smoke") \
+        .replace("jax.device_put(a)",
+                 "jax.device_put(a)  # ra12-ok: staged pre-spawn") \
+        .replace("a.block_until_ready()",
+                 "a.block_until_ready()  # ra12-ok: joined after stop")
+    mod.write_text(fixed)
+    r = run_lint(str(pkg))
+    assert "RA12" not in r.stdout and "AUDIT" not in r.stdout, r.stdout
+    # the same content OUTSIDE a package (no __init__.py) is not gated:
+    # test harnesses and CLI tools own their whole process
+    loose = tmp_path / "shard.py"
+    loose.write_text(textwrap.dedent("""\
+        import threading
+
+        import jax.numpy as jnp
+
+
+        class Shard:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                return jnp.ones(3)
+    """))
+    r = run_lint(str(loose))
+    assert "RA12" not in r.stdout, r.stdout
+
+
+def test_engine_and_parallel_are_ra12_clean():
+    """ISSUE 14 acceptance pin: the real worker closures (WAL shard
+    encode workers, supervisors, TCP/wire reader loops) are free of
+    device ops — the sharded path materializes host-side ONCE via the
+    annotated `bridge` seam (`EngineDurability._host_aux`, pure d2h),
+    so the PR 11 deadlock class cannot reland."""
+    for mod in ("ra_tpu/engine", "ra_tpu/parallel", "ra_tpu/log",
+                "ra_tpu/wire", "ra_tpu/transport"):
+        r = run_lint(os.path.join(REPO, *mod.split("/")))
+        assert "RA12" not in r.stdout, (mod, r.stdout)
+
+
+def test_engine_pipeline_closure_is_ra02_ra04_clean():
+    """ISSUE 14: the cross-module closure walks step/superstep through
+    the annotated seams (DispatchAheadDriver staging, the durability
+    bridge, the sampler).  The syncs it surfaced — _host_mask's host
+    coercion, _stage's staging encodes, _dispatch's window-boundary
+    readback — are documented ra02-ok points; an UNtagged sync reached
+    through any of these seams now fails the gate."""
+    for mod in ("ra_tpu/engine/lockstep.py", "ra_tpu/engine/durable.py",
+                "ra_tpu/parallel/mesh.py"):
+        r = run_lint(os.path.join(REPO, *mod.split("/")))
+        assert "RA02" not in r.stdout and "RA04" not in r.stdout, \
+            (mod, r.stdout)
+
+
+def test_checker_flags_drain_inside_bench_dispatch_loop(tmp_path):
+    """`.drain()` is a full pipeline barrier — the strongest sync of
+    all — and the pre-ISSUE-14 gate missed it inside measured loops."""
+    bad = tmp_path / "bench.py"
+    bad.write_text(textwrap.dedent("""\
+        def run(driver, n, p):
+            for _ in range(8):
+                driver.submit(n, p)
+                driver.drain()
+            driver.drain()
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA04") == 1, r.stdout
+    assert ".drain()" in r.stdout
+
+
+def test_audit_flags_stale_suppressions(tmp_path):
+    """The allowlist-rot gate: a raNN-ok tag on a line its rule family
+    no longer flags is itself an error; live tags, tags inside string
+    literals, and tests-dir files are exempt."""
+    bad = tmp_path / "lockstep.py"
+    bad.write_text(textwrap.dedent("""\
+        import numpy as np
+
+
+        def step(x):
+            host = np.asarray(x)  # ra02-ok: documented readback
+            y = 1 + 1  # ra02-ok: stale - nothing flagged here
+            return host, y
+    """))
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("AUDIT") == 1, r.stdout
+    assert "stale suppression" in r.stdout and ":6:" in r.stdout
+    # a tag inside a string literal is NOT a suppression comment
+    strings = tmp_path / "strings.py"
+    strings.write_text(
+        'S = "np.asarray(x)  # ra02-ok: not a comment"\n')
+    r = run_lint(str(strings))
+    assert "AUDIT" not in r.stdout, r.stdout
+    # tests-dir files are exempt (their tags live inside fixtures)
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    (tdir / "helper.py").write_text("y = 1  # ra02-ok: fixture text\n")
+    r = run_lint(str(tdir / "helper.py"))
+    assert "AUDIT" not in r.stdout, r.stdout
+
+
+def test_suppression_tag_families_cover_shared_closures(tmp_path):
+    """RA02/RA04 police the same host-sync class from different roots;
+    one line reached by both carries ONE documented tag and either
+    code's tag suppresses both (and stays audit-live)."""
+    bad = tmp_path / "lockstep.py"
+    bad.write_text(textwrap.dedent("""\
+        import numpy as np
+
+
+        def step(x):
+            return tick(x)
+
+
+        def tick(x):
+            return np.asarray(x)  # ra02-ok: one tag for both closures
+    """))
+    r = run_lint(str(bad))
+    # tick is reached from step's RA02 closure; under telemetry.py's
+    # name it would ALSO be an RA04 root — the single ra02-ok tag
+    # suppresses the family either way
+    assert "RA02" not in r.stdout and "RA04" not in r.stdout, r.stdout
+    assert "AUDIT" not in r.stdout, r.stdout
+
+
+def test_analyzer_runtime_budget():
+    """Satellite (ISSUE 14): the whole-repo pass stays well inside a
+    tier-1 budget — the gate must never become the slow step.  The
+    measured full pass is ~4s on the builder box; 60s absorbs shared-CI
+    noise with a wide margin."""
+    import time as _time
+    t0 = _time.monotonic()
+    r = run_lint()
+    elapsed = _time.monotonic() - t0
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert elapsed < 60.0, f"analyzer too slow for tier-1: {elapsed:.1f}s"
+
+
+def test_lint_changed_mode_runs():
+    """`--changed` lints only files differing from HEAD (fast local
+    loop).  Content depends on the working tree, so pin the contract:
+    it runs, keeps the output format, and never scans MORE files than
+    the default target set."""
+    r = run_lint("--changed")
+    assert r.returncode in (0, 1), r.stderr
+    tail = r.stdout.strip().splitlines()[-1]
+    assert tail.startswith("lint: ") and "files" in tail, r.stdout
+    full = run_lint()
+    n_changed = int(tail.split()[1])
+    n_full = int(full.stdout.strip().splitlines()[-1].split()[1])
+    assert n_changed <= n_full
+
+
+def test_lint_json_output():
+    """`--json` emits the machine-readable finding pool (findings +
+    suppressed + file count) for CI tooling."""
+    import json as _json
+    r = run_lint("--json", os.path.join(REPO, "ra_tpu", "telemetry.py"))
+    data = _json.loads(r.stdout)
+    assert data["files"] == 1
+    assert data["findings"] == []
+    assert any(s["code"] in ("RA02", "RA04") for s in data["suppressed"])
+
+
+def test_lint_report_output():
+    """`--report` renders the grouped human view over the same pool."""
+    r = run_lint("--report", os.path.join(REPO, "ra_tpu", "telemetry.py"))
+    assert "static analysis report" in r.stdout
+    assert "suppressed" in r.stdout
+
+
+def test_ra11_mutual_recursion_is_order_independent(tmp_path):
+    """Review regression pin: mutually recursive lock-takers must
+    contribute their FULL transitive lock sets regardless of traversal
+    order.  The first cut memoized a cycle-truncated DFS result, so an
+    early caller could poison the memo and a genuine ABBA pair went
+    unreported; the analyzer now SCC-collapses the call graph."""
+    pkg = tmp_path / "rec"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "m.py").write_text(textwrap.dedent("""\
+        import threading
+
+
+        class R:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._c = threading.Lock()
+
+            def early(self):
+                # traversal bait: computes f's set before h needs g's
+                self.f(3)
+
+            def f(self, n):
+                with self._a:
+                    pass
+                if n:
+                    self.g(n - 1)
+
+            def g(self, n):
+                with self._b:
+                    pass
+                if n:
+                    self.f(n - 1)
+
+            def h(self):
+                with self._c:
+                    self.g(1)
+
+            def inv(self):
+                with self._a:
+                    with self._c:
+                        pass
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1, r.stdout
+    assert "RA11" in r.stdout, r.stdout
+    assert "R._c" in r.stdout and "R._a" in r.stdout, r.stdout
+
+
+def test_lint_missing_target_fails_loudly():
+    """Review regression pin: a typo'd explicit target must not report
+    green having linted nothing."""
+    r = run_lint("ra_tpu/enigne_typo.py")
+    assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+    assert "no such target" in r.stderr, r.stderr
+
+
+def test_ra12_gates_positional_thread_spawns(tmp_path):
+    """Review regression pin: threading.Thread's FIRST positional
+    parameter is `group` — `Thread(None, self._run)` must still harvest
+    `_run` as a worker root."""
+    pkg = tmp_path / "pos"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "w.py").write_text(textwrap.dedent("""\
+        import threading
+
+        import jax.numpy as jnp
+
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(None, self._run)
+                self._t.start()
+
+            def _run(self):
+                return jnp.ones(3)
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert "RA12" in r.stdout and "_run" in r.stdout, r.stdout
+
+
+def test_ra11_ignores_locks_in_deferred_callbacks(tmp_path):
+    """Review regression pin: a `with self._a:` body that merely
+    DEFINES a callback taking `self._b` does not hold a while taking b
+    — deferred execution must not create acquisition-order edges (the
+    first cut walked nested defs and reported a bogus ABBA)."""
+    pkg = tmp_path / "cb"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "m.py").write_text(textwrap.dedent("""\
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._cbs = []
+
+            def register(self):
+                with self._a:
+                    def cb():
+                        with self._b:
+                            pass
+                    self._cbs.append(cb)
+
+            def other(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """))
+    r = run_lint(str(pkg))
+    assert "RA11" not in r.stdout, r.stdout
+
+
+def test_ra11_flags_plain_lock_self_deadlock(tmp_path):
+    """Review regression pin: re-acquiring a held plain threading.Lock
+    is a GUARANTEED self-deadlock, not a benign reentry — the first cut
+    dropped every same-lock edge, so `outer()` holding `_lock` and
+    calling `inner()` (which takes `_lock` again) linted clean while
+    hanging the process unconditionally.  RLock (and the RLock-backed
+    default Condition) stay edge-free."""
+    pkg = tmp_path / "sd"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    src = textwrap.dedent("""\
+        import threading
+
+
+        class Eng:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    return 1
+    """)
+    (pkg / "eng.py").write_text(src)
+    r = run_lint(str(pkg))
+    assert r.returncode == 1, r.stdout
+    assert "RA11" in r.stdout and "self-deadlock" in r.stdout, r.stdout
+    assert "Eng._lock" in r.stdout, r.stdout
+    # reentrant ctors are exempt: the same shape over an RLock is fine
+    (pkg / "eng.py").write_text(src.replace("threading.Lock()",
+                                            "threading.RLock()"))
+    r = run_lint(str(pkg))
+    assert "RA11" not in r.stdout, r.stdout
+    assert r.returncode == 0, r.stdout
+
+
+def test_scoped_lint_keeps_cross_module_tags_live(tmp_path):
+    """Review regression pin: rule roots are harvested from every
+    indexed source module, not just the lint TARGETS — the first cut
+    seeded roots from targets only, so linting a tagged helper alone
+    (exactly what --changed does after editing it) lost the root one
+    file away, read the tag as stale, and the fast loop false-failed
+    on code the full run passes."""
+    pkg = tmp_path / "scoped"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helpers.py").write_text(textwrap.dedent("""\
+        import numpy as np
+
+
+        def pull(handle):
+            return np.asarray(handle)  # ra04-ok: window boundary
+    """))
+    (pkg / "telemetry.py").write_text(textwrap.dedent("""\
+        from .helpers import pull
+
+
+        class S:
+            def tick(self):
+                return pull(self.handle)
+    """))
+    full = run_lint(str(pkg))
+    assert full.returncode == 0, full.stdout
+    scoped = run_lint(str(pkg / "helpers.py"))
+    assert scoped.returncode == 0, scoped.stdout
+    assert "AUDIT" not in scoped.stdout, scoped.stdout
+    # and the gate itself still bites in the scoped run: untag the
+    # helper and linting it ALONE must flag the cross-module sync
+    (pkg / "helpers.py").write_text(textwrap.dedent("""\
+        import numpy as np
+
+
+        def pull(handle):
+            return np.asarray(handle)
+    """))
+    scoped = run_lint(str(pkg / "helpers.py"))
+    assert scoped.returncode == 1, scoped.stdout
+    assert "RA04" in scoped.stdout, scoped.stdout
+
+
+def test_lint_changed_rejects_explicit_paths():
+    """Review regression pin: `--changed` with explicit targets used to
+    silently lint the git-changed set and ignore the paths — now a loud
+    usage error, like unknown flags."""
+    r = run_lint("--changed", "ra_tpu")
+    assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+    assert "no explicit targets" in r.stderr, r.stderr
+
+
+def test_lint_syntax_prefix_contract(tmp_path):
+    """Review regression pin: syntax findings keep the historical
+    'path:N: syntax: msg' rendering (the colon after `syntax`) that CI
+    greps key on."""
+    bad = tmp_path / "syn.py"
+    bad.write_text("def broken(:\n")
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert ": syntax: " in r.stdout, r.stdout
+
+
+def test_scoped_lint_attributes_findings_to_reaching_roots(tmp_path):
+    """Review regression pin (round 3): a finding carries exactly the
+    root modules whose closure REACHES it — stamping the whole rule's
+    root set made linting one root file report escapes only reachable
+    from a different root (editing telemetry.py then `--changed` would
+    false-fail on a pre-existing mesh-only escape)."""
+    pkg = tmp_path / "attr"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helper.py").write_text(textwrap.dedent("""\
+        import numpy as np
+
+
+        def pull(handle):
+            return np.asarray(handle)
+    """))
+    (pkg / "mesh.py").write_text(textwrap.dedent("""\
+        from .helper import pull
+
+
+        def drive_uniform_window(h):
+            return pull(h)
+    """))
+    (pkg / "telemetry.py").write_text(textwrap.dedent("""\
+        class S:
+            def tick(self):
+                return 1
+    """))
+    r = run_lint(str(pkg / "telemetry.py"))
+    assert r.returncode == 0, r.stdout
+    assert "helper.py" not in r.stdout, r.stdout
+    r = run_lint(str(pkg / "mesh.py"))
+    assert r.returncode == 1, r.stdout
+    assert "RA04" in r.stdout and "helper.py" in r.stdout, r.stdout
+
+
+def test_ra11_annotated_locks_never_claim_unproven_self_deadlock(
+        tmp_path):
+    """Review regression pin (round 3): `# ra11-lock:` is the escape
+    hatch for locks the resolver cannot type — forcing ctor 'Lock' on
+    it false-positived a self-deadlock on annotated RLocks/Conditions.
+    Unknown ctor orders ABBA edges but never claims self-deadlock; an
+    explicit `# ra11-lock: Name Ctor` token or the named class's
+    indexed lock attr proves one."""
+    pkg = tmp_path / "ann"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    body = textwrap.dedent("""\
+        class W:
+            def outer(self):
+                with self._shared:  # ra11-lock: Pool.biglock{tok}
+                    self.inner()
+
+            def inner(self):
+                with self._shared:  # ra11-lock: Pool.biglock{tok}
+                    return 1
+    """)
+    (pkg / "m.py").write_text(body.format(tok=""))
+    r = run_lint(str(pkg))
+    assert "self-deadlock" not in r.stdout, r.stdout
+    assert r.returncode == 0, r.stdout
+    # pinning the ctor in the annotation proves the deadlock
+    (pkg / "m.py").write_text(body.format(tok=" Lock"))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1, r.stdout
+    assert "self-deadlock" in r.stdout, r.stdout
+    # the named class's indexed lock attr resolves the ctor too: an
+    # RLock-typed Pool.biglock stays clean without any extra token
+    (pkg / "m.py").write_text(
+        "import threading\n\n\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self.biglock = threading.RLock()\n\n\n"
+        + body.format(tok=""))
+    r = run_lint(str(pkg))
+    assert "self-deadlock" not in r.stdout, r.stdout
+
+
+def test_lint_changed_fails_loudly_when_git_unavailable():
+    """Review regression pin (round 3): `--changed` must not silently
+    widen to the full default target set when git fails — that hands
+    the user findings for files they never touched."""
+    env = dict(os.environ, PATH="/nonexistent")
+    r = subprocess.run([sys.executable, LINT, "--changed"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+    assert "could not read the git diff" in r.stderr, r.stderr

@@ -1,0 +1,42 @@
+"""Whole-program static analyzer for the ra_tpu tree (ISSUE 14).
+
+The package behind ``tools/lint.py``'s closure-gated rules: an AST
+index + cross-module call graph (``index``), declarative closure rule
+specs evaluated by one shared walker (``rules`` — RA02/RA04/RA08/
+RA09/RA10), the RA11 lock-order cycle analyzer (``locks``), the RA12
+thread-role/device-sync checker (``threads``), and the suppression
+audit (``audit``).  ``run_analysis`` is the one-call entry point
+lint.py delegates to; ``report`` renders ``--report``/``--json``.
+
+Design contract: the engine only follows PROVABLE edges (imports,
+``self`` methods + MRO, annotated parameters/returns, constructor
+assignments, ``# ra-type:`` hints) — see index.py's docstring and
+docs/INTERNALS.md §15 for the resolution rules and their documented
+limitations.
+"""
+from __future__ import annotations
+
+from .audit import apply_suppressions, audit_suppressions
+from .index import PackageIndex, build_index
+from .locks import evaluate_lock_order
+from .rules import CLOSURE_RULES, Finding, evaluate_closure_rules
+from .threads import evaluate_thread_roles
+
+__all__ = ["Finding", "PackageIndex", "build_index", "run_analysis",
+           "CLOSURE_RULES", "apply_suppressions", "audit_suppressions",
+           "evaluate_closure_rules", "evaluate_lock_order",
+           "evaluate_thread_roles"]
+
+
+def run_analysis(targets, repo=None, default_sources=None):
+    """Index the targets (plus what they resolve into) and evaluate
+    every engine rule.  Returns ``(raw_findings, index)`` — RAW means
+    unsuppressed; the caller merges with its local per-file findings
+    and applies :func:`apply_suppressions` / :func:`audit_suppressions`
+    over the combined pool so one tag system covers both layers."""
+    idx = build_index(targets, repo=repo, default_sources=default_sources)
+    raw = []
+    raw.extend(evaluate_closure_rules(idx))
+    raw.extend(evaluate_lock_order(idx))
+    raw.extend(evaluate_thread_roles(idx))
+    return raw, idx

@@ -1,0 +1,108 @@
+"""Suppression handling + the allowlist-rot audit (ISSUE 14).
+
+Two halves:
+
+* :func:`apply_suppressions` — partitions RAW findings into
+  (active, suppressed) using the per-rule ``# raNN-ok: <why>`` line
+  tags (family-aware: an RA02 tag also covers an RA04 finding on the
+  same line, see rules.TAG_FAMILIES) and the generic ``noqa`` marker.
+  Matching is by line CONTENT (substring), preserving the historical
+  lint behaviour.
+
+* :func:`audit_suppressions` — the rot check: every ``raNN-ok`` tag
+  that appears as an ACTUAL COMMENT (tokenize, so tags inside string
+  literals/docstrings — e.g. fixture sources embedded in tests — are
+  ignored) on a line its rule (family) no longer flags is ITSELF an
+  error.  Allowlists can't rot: delete the construct and the stale tag
+  fails the gate until the comment goes too.  Tests are exempt (their
+  tags live inside fixture strings by construction).
+
+Audit findings use the code ``AUDIT`` and name the tag in lowercase
+only, so per-rule cleanliness pins (``"RA04" not in output``) never
+trip on a stale-tag report.
+"""
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+
+from .rules import Finding, family_codes
+
+__all__ = ["apply_suppressions", "audit_suppressions"]
+
+_TAG_RE = re.compile(r"\bra(\d{2})-ok\b")
+
+
+def _line_cache(paths):
+    cache = {}
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as f:
+                cache[p] = f.read()
+        except OSError:
+            cache[p] = ""
+    return cache
+
+
+def apply_suppressions(findings, src_by_path=None):
+    """(active, suppressed) split of RAW findings."""
+    if src_by_path is None:
+        src_by_path = _line_cache({f.path for f in findings})
+    lines_by_path = {p: s.splitlines() for p, s in src_by_path.items()}
+    active, suppressed = [], []
+    for f in findings:
+        lines = lines_by_path.get(f.path, [])
+        line = lines[f.line - 1] if 1 <= f.line <= len(lines) else ""
+        tags = {f"ra{m}-ok" for m in _TAG_RE.findall(line)}
+        fam_tags = {c.lower() + "-ok" for c in family_codes(f.code)}
+        if "noqa" in line or (tags & fam_tags):
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+def _comment_tags(src):
+    """{(lineno, tag)} for raNN-ok tags in REAL comment tokens."""
+    out = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                for m in _TAG_RE.findall(tok.string):
+                    out.add((tok.start[0], f"ra{m}-ok"))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        pass
+    return out
+
+
+def audit_suppressions(target_paths, raw_findings, src_by_path=None,
+                       skip_tests=True):
+    """AUDIT findings for stale ``raNN-ok`` tags in the target files:
+    a tag whose rule family produced NO raw finding on its line no
+    longer suppresses anything and must be removed (or the construct
+    it documented restored)."""
+    if src_by_path is None:
+        src_by_path = _line_cache(set(target_paths))
+    flagged = {}
+    for f in raw_findings:
+        flagged.setdefault((f.path, f.line), set()).add(f.code)
+    out = []
+    for path in target_paths:
+        norm = path.replace("\\", "/")
+        base = norm.rsplit("/", 1)[-1]
+        if skip_tests and ("/tests/" in norm or
+                           base.startswith("test_")):
+            continue
+        src = src_by_path.get(path, "")
+        for lineno, tag in sorted(_comment_tags(src)):
+            code = "RA" + tag[2:4]
+            fam = set(family_codes(code))
+            if not (flagged.get((path, lineno), set()) & fam):
+                out.append(Finding(
+                    path, lineno, "AUDIT",
+                    f"stale suppression: '{tag}' tag but its rule no "
+                    "longer flags this line — remove the comment (the "
+                    "allowlist-rot gate, ISSUE 14) or restore the "
+                    "construct it documents"))
+    return out

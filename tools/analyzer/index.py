@@ -1,0 +1,709 @@
+"""AST index + cross-module call graph (ISSUE 14 tentpole core).
+
+Parses a set of Python files into per-module tables (functions,
+classes, imports, lock attributes) and resolves call sites to concrete
+function definitions ACROSS module boundaries — the resolution layer
+every closure-gated lint rule (RA02/RA04/RA08/RA09/RA10), the RA11
+lock-order analyzer and the RA12 thread-role checker walk on.
+
+Resolution strategies, in the order a call site tries them:
+
+* ``name(...)``            — same-module function, or an imported name
+                             (``from x import f`` / package re-export
+                             chains), or a class constructor
+                             (resolves to ``Class.__init__``)
+* ``self.m(...)``          — method in the enclosing class's MRO
+                             (bases resolve cross-module); falls back
+                             to any same-module def named ``m`` (the
+                             pre-ISSUE-14 same-module behaviour, kept
+                             so the old gates never lose coverage)
+* ``mod.f(...)``           — function in an imported module;
+                             ``Class.m(...)`` for imported classes
+* ``var.m(...)``           — local variable typed by a parameter
+                             annotation (``def f(d: Driver)``), an
+                             assignment from a resolvable constructor
+                             (``d = Driver(...)``), or a called
+                             function's return annotation
+* ``self.attr.m(...)``     — instance attribute typed by
+                             ``self.attr = Class(...)``, an annotated
+                             ``__init__`` parameter assigned to it, an
+                             ``attr: Class`` AnnAssign, or an explicit
+                             ``# ra-type: Class`` line comment (the
+                             small annotation ISSUE 14 adds for
+                             dynamically passed collaborators)
+
+Anything deeper (callbacks stored in dicts, ``x[i].m()``, duck-typed
+parameters without annotations) is deliberately unresolved: the
+analyzer only follows edges it can prove, and the docs record the
+limitation (docs/INTERNALS.md §15).
+
+Stdlib-only (``ast``): the image ships no ruff/mypy and installing
+tools is off the table.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+#: constructors whose ``self.x = threading.X()`` assignment marks
+#: ``x`` as a lock attribute (RA11 harvests acquisitions of these)
+LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                        "BoundedSemaphore"})
+#: lock ctors a thread may re-acquire while already holding without a
+#: GUARANTEED deadlock — RLock is reentrant, the default Condition
+#: wraps an RLock, and semaphores admit multiple holders.  A plain
+#: Lock is absent: re-entering one blocks its own thread forever, and
+#: RA11 reports that self-edge as a one-lock cycle (locks.edges()).
+REENTRANT_CTORS = frozenset({"RLock", "Condition", "Semaphore",
+                             "BoundedSemaphore"})
+
+
+class FuncInfo:
+    __slots__ = ("name", "qualname", "module", "node", "cls")
+
+    def __init__(self, name, qualname, module, node, cls=None):
+        self.name = name
+        self.qualname = qualname
+        self.module = module
+        self.node = node
+        self.cls = cls
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"<Func {self.module.name}:{self.qualname}>"
+
+
+class ClassInfo:
+    __slots__ = ("name", "module", "node", "methods", "base_exprs",
+                 "attr_refs", "lock_attrs", "_mro")
+
+    def __init__(self, name, module, node):
+        self.name = name
+        self.module = module
+        self.node = node
+        self.methods = {}      # name -> FuncInfo (direct only)
+        self.base_exprs = []   # ast exprs of bases
+        self.attr_refs = {}    # attr -> type ref (ast node or str)
+        self.lock_attrs = {}   # attr -> ctor name ("Lock"/"RLock"/...)
+        self._mro = None
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"<Class {self.module.name}:{self.name}>"
+
+
+class ModuleInfo:
+    __slots__ = ("path", "name", "stem", "tree", "lines", "functions",
+                 "classes", "import_mod", "import_name", "func_defs",
+                 "module_locks", "is_target", "in_tests", "in_package")
+
+    def __init__(self, path, name, stem, tree, lines):
+        self.path = path
+        self.name = name            # dotted name when under a package
+        self.stem = stem
+        self.tree = tree
+        self.lines = lines
+        self.functions = {}         # module-level funcs: name -> FuncInfo
+        self.classes = {}           # name -> ClassInfo
+        self.import_mod = {}        # alias -> (dotted, level)
+        self.import_name = {}       # alias -> (dotted, orig, level)
+        self.func_defs = {}         # bare name -> [FuncInfo] (ALL defs)
+        self.module_locks = {}      # name -> ctor name
+        self.is_target = False
+        parts = set(os.path.normpath(path).split(os.sep))
+        self.in_tests = "tests" in parts or \
+            os.path.basename(path).startswith("test_")
+        self.in_package = os.path.exists(
+            os.path.join(os.path.dirname(path), "__init__.py"))
+
+    def line(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+def _module_name(path):
+    """Dotted module name + search root: walk up while the directory is
+    a package (__init__.py)."""
+    stem = os.path.splitext(os.path.basename(path))[0]
+    d = os.path.dirname(os.path.abspath(path))
+    parts = [] if stem == "__init__" else [stem]
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        d = os.path.dirname(d)
+    return ".".join(parts) or stem, d
+
+
+def _annotation_expr(node):
+    """Unwrap Optional[X]/X | None style annotations to the inner
+    type expression."""
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        name = base.id if isinstance(base, ast.Name) else \
+            base.attr if isinstance(base, ast.Attribute) else None
+        if name == "Optional":
+            return node.slice
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        # X | None
+        for side in (node.left, node.right):
+            if not (isinstance(side, ast.Constant) and side.value is None):
+                return side
+        return None
+    return node
+
+
+def _lock_ctor_name(call):
+    """'Lock'/'RLock'/... when ``call`` constructs a threading lock."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and \
+            isinstance(fn.value, ast.Name) and \
+            fn.value.id == "threading" and fn.attr in LOCK_CTORS:
+        return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in LOCK_CTORS:
+        return fn.id
+    return None
+
+
+def iter_scope(node):
+    """``ast.walk`` that does not descend into NESTED function/lambda
+    definitions: the enclosing function's own executable scope.  A
+    ``with self._lock:`` body that merely DEFINES a callback does not
+    run it while the lock is held — lock/edge harvesting must not
+    attribute the callback's acquisitions to the outer scope."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _param_annotations(fn_node):
+    args = fn_node.args
+    out = {}
+    for a in list(args.posonlyargs) + list(args.args) + \
+            list(args.kwonlyargs):
+        if a.annotation is not None:
+            out[a.arg] = a.annotation
+    return out
+
+
+class PackageIndex:
+    """Index over a set of files; the resolution + closure engine."""
+
+    def __init__(self):
+        self.by_path = {}        # abspath -> ModuleInfo
+        self.search_dirs = []    # roots for absolute-import resolution
+        self._callee_memo = {}   # id(FuncInfo) -> [FuncInfo]
+        self._scoped_callee_memo = {}
+        self._local_type_memo = {}
+
+    # -- construction ------------------------------------------------------
+
+    def add_file(self, path, is_target=False):
+        path = os.path.abspath(path)
+        mod = self.by_path.get(path)
+        if mod is None:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+                tree = ast.parse(src, path)
+            except (OSError, SyntaxError):
+                return None
+            name, root = _module_name(path)
+            stem = os.path.splitext(os.path.basename(path))[0]
+            mod = ModuleInfo(path, name, stem, tree, src.splitlines())
+            self.by_path[path] = mod
+            if root not in self.search_dirs:
+                self.search_dirs.append(root)
+            self._build_module(mod)
+        if is_target:
+            mod.is_target = True
+        return mod
+
+    def _build_module(self, mod):
+        # imports harvested from the WHOLE tree: this codebase defers
+        # imports into functions to break cycles, and resolution must
+        # see those edges too (shadowing by scope is ignored — a wrong
+        # edge only ever ADDS a function to a closure)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    dotted = alias.name if alias.asname else \
+                        alias.name.split(".")[0]
+                    mod.import_mod.setdefault(bound, (dotted, 0))
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    mod.import_name.setdefault(
+                        bound, (base, alias.name, node.level))
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(node.name, node.name, mod, node)
+                mod.functions.setdefault(node.name, fi)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(node.name, mod, node)
+                ci.base_exprs = list(node.bases)
+                mod.classes[node.name] = ci
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fi = FuncInfo(sub.name,
+                                      f"{node.name}.{sub.name}",
+                                      mod, sub, ci)
+                        ci.methods.setdefault(sub.name, fi)
+                self._scan_class_attrs(mod, ci)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                ctor = _lock_ctor_name(node.value)
+                if ctor:
+                    mod.module_locks[node.targets[0].id] = ctor
+        # bare-name fallback table: EVERY def in the file (incl. nested),
+        # preserving the pre-ISSUE-14 same-module resolution superset
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls = None
+                qual = node.name
+                for ci in mod.classes.values():
+                    if node in ci.node.body:
+                        cls = ci
+                        qual = f"{ci.name}.{node.name}"
+                        break
+                known = (cls.methods.get(node.name) if cls
+                         else mod.functions.get(node.name))
+                fi = known if known is not None and known.node is node \
+                    else FuncInfo(node.name, qual, mod, node, cls)
+                mod.func_defs.setdefault(node.name, []).append(fi)
+
+    def _scan_class_attrs(self, mod, ci):
+        """Type + lock harvesting for ``self.attr`` assignments across
+        every method of the class."""
+        for m in ci.methods.values():
+            anns = _param_annotations(m.node)
+            for sub in ast.walk(m.node):
+                target = None
+                value = None
+                ann = None
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    target, value = sub.targets[0], sub.value
+                elif isinstance(sub, ast.AnnAssign):
+                    target, value, ann = sub.target, sub.value, \
+                        sub.annotation
+                if not (isinstance(target, ast.Attribute) and
+                        isinstance(target.value, ast.Name) and
+                        target.value.id == "self"):
+                    continue
+                attr = target.attr
+                ctor = _lock_ctor_name(value)
+                if ctor:
+                    ci.lock_attrs.setdefault(attr, ctor)
+                    continue
+                # explicit hint wins: `self.x = y  # ra-type: Class`
+                line = mod.line(getattr(sub, "lineno", 0))
+                if "# ra-type:" in line:
+                    hint = line.split("# ra-type:", 1)[1].strip()
+                    hint = hint.split()[0] if hint else ""
+                    if hint:
+                        ci.attr_refs[attr] = hint
+                        continue
+                if ann is not None:
+                    ci.attr_refs.setdefault(attr, _annotation_expr(ann))
+                elif isinstance(value, ast.Call):
+                    ci.attr_refs.setdefault(attr, value)
+                elif isinstance(value, ast.Name) and value.id in anns:
+                    ci.attr_refs.setdefault(
+                        attr, _annotation_expr(anns[value.id]))
+
+    # -- module / name resolution -----------------------------------------
+
+    def _module_by_parts(self, base_dir, parts):
+        cand = os.path.join(base_dir, *parts) + ".py"
+        m = self.by_path.get(os.path.abspath(cand))
+        if m is not None:
+            return m
+        cand = os.path.join(base_dir, *parts, "__init__.py")
+        return self.by_path.get(os.path.abspath(cand))
+
+    def resolve_module(self, from_mod, dotted, level=0):
+        parts = [p for p in dotted.split(".") if p] if dotted else []
+        if level:
+            d = os.path.dirname(from_mod.path)
+            for _ in range(level - 1):
+                d = os.path.dirname(d)
+            return self._module_by_parts(d, parts) if parts else \
+                self.by_path.get(os.path.abspath(
+                    os.path.join(d, "__init__.py")))
+        if not parts:
+            return None
+        # sibling-first (the fixture idiom: `from blackbox import x`
+        # next to the checked file), then each package search root
+        sib = self._module_by_parts(os.path.dirname(from_mod.path), parts)
+        if sib is not None:
+            return sib
+        for root in self.search_dirs:
+            m = self._module_by_parts(root, parts)
+            if m is not None:
+                return m
+        return None
+
+    def resolve_name(self, mod, name, _depth=0):
+        """('func'|'class'|'module', info) for a bare name in ``mod``,
+        following import chains up to a small depth."""
+        if _depth > 6:
+            return None
+        if name in mod.functions:
+            return ("func", mod.functions[name])
+        if name in mod.classes:
+            return ("class", mod.classes[name])
+        if name in mod.import_name:
+            base, orig, level = mod.import_name[name]
+            target = self.resolve_module(mod, base, level)
+            if target is not None:
+                got = self.resolve_name(target, orig, _depth + 1)
+                if got is not None:
+                    return got
+                # `from pkg.x import y` where y is itself a module
+                sub = self.resolve_module(
+                    target, orig, 1) if target.stem == "__init__" or \
+                    os.path.basename(target.path) == "__init__.py" \
+                    else None
+                if sub is not None:
+                    return ("module", sub)
+            # unresolved import target: maybe `from a.b import c` with
+            # a.b.c being a module file
+            dotted = f"{base}.{orig}" if base else orig
+            sub = self.resolve_module(mod, dotted, level)
+            if sub is not None:
+                return ("module", sub)
+            return None
+        if name in mod.import_mod:
+            dotted, level = mod.import_mod[name]
+            target = self.resolve_module(mod, dotted, level)
+            if target is not None:
+                return ("module", target)
+        return None
+
+    def resolve_type(self, mod, ref, _depth=0):
+        """ClassInfo for a type reference: ast Name/Attribute/Constant
+        string annotation, or a plain string hint."""
+        if ref is None or _depth > 6:
+            return None
+        if isinstance(ref, str):
+            parts = ref.split(".")
+            if len(parts) == 1:
+                got = self.resolve_name(mod, parts[0])
+                return got[1] if got and got[0] == "class" else None
+            got = self.resolve_name(mod, parts[0])
+            if got and got[0] == "module":
+                return self.resolve_type(got[1], ".".join(parts[1:]),
+                                         _depth + 1)
+            # fully-qualified hint (`# ra-type: pkg.mod.Class`): try
+            # every module/class split against the search roots, so a
+            # hint works even where the module is not imported
+            for i in range(len(parts) - 1, 0, -1):
+                target = self.resolve_module(mod, ".".join(parts[:i]))
+                if target is not None:
+                    if i == len(parts) - 1:
+                        ci = target.classes.get(parts[i])
+                        if ci is not None:
+                            return ci
+                    else:
+                        got2 = self.resolve_type(
+                            target, ".".join(parts[i:]), _depth + 1)
+                        if got2 is not None:
+                            return got2
+            return None
+        if isinstance(ref, ast.Constant) and isinstance(ref.value, str):
+            return self.resolve_type(mod, ref.value, _depth + 1)
+        if isinstance(ref, ast.Call):
+            # `self.x = ClassName(...)` — the constructor IS the type
+            return self.resolve_type(mod, ref.func, _depth + 1)
+        if isinstance(ref, ast.Name):
+            got = self.resolve_name(mod, ref.id)
+            return got[1] if got and got[0] == "class" else None
+        if isinstance(ref, ast.Attribute) and \
+                isinstance(ref.value, ast.Name):
+            got = self.resolve_name(mod, ref.value.id)
+            if got and got[0] == "module":
+                inner = got[1].classes.get(ref.attr)
+                if inner is not None:
+                    return inner
+                got2 = self.resolve_name(got[1], ref.attr)
+                return got2[1] if got2 and got2[0] == "class" else None
+        sub = _annotation_expr(ref) if isinstance(ref, ast.AST) else None
+        if sub is not None and sub is not ref:
+            return self.resolve_type(mod, sub, _depth + 1)
+        return None
+
+    def mro(self, ci):
+        if ci._mro is not None:
+            return ci._mro
+        ci._mro = [ci]  # cycle guard: partial result visible to reentry
+        out = [ci]
+        for b in ci.base_exprs:
+            base = self.resolve_type(ci.module, b)
+            if base is not None and base is not ci:
+                for anc in self.mro(base):
+                    if anc not in out:
+                        out.append(anc)
+        ci._mro = out
+        return out
+
+    def find_method(self, ci, name):
+        for anc in self.mro(ci):
+            m = anc.methods.get(name)
+            if m is not None:
+                return m
+        return None
+
+    def lock_attr_ctor(self, ci, attr):
+        """Lock ctor name for ``attr`` through the class MRO, with the
+        DEFINING class — locks are named by where they are created."""
+        for anc in self.mro(ci):
+            if attr in anc.lock_attrs:
+                return anc.lock_attrs[attr], anc
+        return None, None
+
+    def attr_type(self, ci, attr):
+        for anc in self.mro(ci):
+            ref = anc.attr_refs.get(attr)
+            if ref is not None:
+                return self.resolve_type(anc.module, ref)
+        return None
+
+    # -- local variable typing --------------------------------------------
+
+    def local_types(self, fi):
+        memo = self._local_type_memo.get(id(fi))
+        if memo is not None:
+            return memo
+        types = {}
+        # install the (still partial) dict up front: _attr_chain_type
+        # resolves Name bases through it while the scan below runs
+        self._local_type_memo[id(fi)] = types
+        anns = _param_annotations(fi.node)
+        for name, ann in anns.items():
+            t = self.resolve_type(fi.module, _annotation_expr(ann))
+            if t is not None:
+                types[name] = t
+        for sub in ast.walk(fi.node):
+            if not (isinstance(sub, ast.Assign) and
+                    len(sub.targets) == 1 and
+                    isinstance(sub.targets[0], ast.Name)):
+                continue
+            name = sub.targets[0].id
+            v = sub.value
+            if isinstance(v, ast.Call):
+                callee = self._callable_target(fi, v)
+                if isinstance(callee, ClassInfo):
+                    types[name] = callee
+                elif isinstance(callee, FuncInfo) and \
+                        callee.node.returns is not None:
+                    t = self.resolve_type(
+                        callee.module,
+                        _annotation_expr(callee.node.returns))
+                    if t is not None:
+                        types[name] = t
+            elif isinstance(v, ast.Attribute):
+                t = self._attr_chain_type(fi, v)
+                if t is not None:
+                    types[name] = t
+        return types
+
+    def _attr_chain_type(self, fi, node):
+        """Type of `self.a`, `self.a.b`, `var.a` attribute chains."""
+        if isinstance(node, ast.Name):
+            if node.id == "self":
+                return fi.cls
+            return self._local_type_memo.get(id(fi), {}).get(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self._attr_chain_type(fi, node.value)
+            if isinstance(base, ClassInfo):
+                return self.attr_type(base, node.attr)
+        return None
+
+    def _callable_target(self, fi, call):
+        """ClassInfo (constructor) / FuncInfo the call invokes, pre-
+        method-resolution — used for local type inference."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            got = self.resolve_name(fi.module, fn.id)
+            if got is not None:
+                return got[1]
+        elif isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name):
+            got = self.resolve_name(fi.module, fn.value.id)
+            if got and got[0] == "module":
+                got2 = self.resolve_name(got[1], fn.attr)
+                if got2 is not None:
+                    return got2[1]
+        return None
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_call(self, fi, call):
+        """FuncInfos a call site may invoke (best-effort, proof-only)."""
+        fn = call.func
+        out = []
+        if isinstance(fn, ast.Name):
+            got = self.resolve_name(fi.module, fn.id)
+            if got is not None:
+                kind, info = got
+                if kind == "func":
+                    out.append(info)
+                elif kind == "class":
+                    init = self.find_method(info, "__init__")
+                    if init is not None:
+                        out.append(init)
+            elif fn.id in fi.module.func_defs and \
+                    fn.id not in fi.module.functions:
+                # nested def referenced by bare name (legacy superset)
+                out.extend(fi.module.func_defs[fn.id])
+        elif isinstance(fn, ast.Attribute):
+            attr = fn.attr
+            base = fn.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and fi.cls is not None:
+                m = self.find_method(fi.cls, attr)
+                if m is not None:
+                    out.append(m)
+                else:
+                    # pre-ISSUE-14 fallback: any same-module def by name
+                    out.extend(fi.module.func_defs.get(attr, []))
+            elif isinstance(base, ast.Name) and base.id == "self":
+                out.extend(fi.module.func_defs.get(attr, []))
+            elif isinstance(base, ast.Name):
+                got = self.resolve_name(fi.module, base.id)
+                if got is not None:
+                    kind, info = got
+                    if kind == "module":
+                        got2 = self.resolve_name(info, attr)
+                        if got2 and got2[0] == "func":
+                            out.append(got2[1])
+                        elif got2 and got2[0] == "class":
+                            init = self.find_method(got2[1], "__init__")
+                            if init is not None:
+                                out.append(init)
+                    elif kind == "class":
+                        m = self.find_method(info, attr)
+                        if m is not None:
+                            out.append(m)
+                else:
+                    t = self.local_types(fi).get(base.id)
+                    if isinstance(t, ClassInfo):
+                        m = self.find_method(t, attr)
+                        if m is not None:
+                            out.append(m)
+            elif isinstance(base, ast.Attribute):
+                t = self._attr_chain_type(fi, base)
+                if isinstance(t, ClassInfo):
+                    m = self.find_method(t, attr)
+                    if m is not None:
+                        out.append(m)
+        return out
+
+    def callees(self, fi):
+        memo = self._callee_memo.get(id(fi))
+        if memo is not None:
+            return memo
+        self.local_types(fi)  # prime the memo for _attr_chain_type
+        out = []
+        seen = set()
+        for sub in ast.walk(fi.node):
+            if isinstance(sub, ast.Call):
+                for callee in self.resolve_call(fi, sub):
+                    if id(callee) not in seen and callee.node is not \
+                            fi.node:
+                        seen.add(id(callee))
+                        out.append(callee)
+        self._callee_memo[id(fi)] = out
+        return out
+
+    def callees_scoped(self, fi):
+        """Like :meth:`callees` but only for call sites in ``fi``'s own
+        executable scope (nested defs excluded) — the lock analyzer's
+        edge semantics: a callback defined under a lock is not CALLED
+        under it."""
+        memo = self._scoped_callee_memo.get(id(fi))
+        if memo is not None:
+            return memo
+        self.local_types(fi)
+        out = []
+        seen = set()
+        for sub in iter_scope(fi.node):
+            if isinstance(sub, ast.Call):
+                for callee in self.resolve_call(fi, sub):
+                    if id(callee) not in seen and \
+                            callee.node is not fi.node:
+                        seen.add(id(callee))
+                        out.append(callee)
+        self._scoped_callee_memo[id(fi)] = out
+        return out
+
+    def closure(self, roots):
+        """Transitive cross-module call closure from the given
+        FuncInfos; returns {id: FuncInfo} in BFS order."""
+        out = {}
+        queue = list(roots)
+        while queue:
+            fi = queue.pop(0)
+            if id(fi) in out:
+                continue
+            out[id(fi)] = fi
+            queue.extend(self.callees(fi))
+        return out
+
+
+def build_index(targets, repo=None, default_sources=None):
+    """Index the target files plus everything they may resolve into:
+    same-directory siblings (the fixture idiom), the enclosing package
+    tree, and — for files inside the repo — the repo's default source
+    roots, so single-file invocations resolve cross-module edges the
+    same way the full run does."""
+    idx = PackageIndex()
+    extra = set()
+    repo_abs = os.path.abspath(repo) if repo else None
+    listed_dirs = set()
+    walked_pkgs = set()
+    for t in targets:
+        t = os.path.abspath(t)
+        d = os.path.dirname(t)
+        if d not in listed_dirs:
+            listed_dirs.add(d)
+            try:
+                for n in os.listdir(d):
+                    if n.endswith(".py"):
+                        extra.add(os.path.join(d, n))
+            except OSError:
+                pass
+        # enclosing package tree — every ra_tpu/* target resolves the
+        # same root, so walk each root ONCE (the default 131-file run
+        # used to do 70 full-tree os.walk passes, ~1.3s of the gate's
+        # ~4s; review finding)
+        pkg = d
+        while os.path.exists(os.path.join(pkg, "__init__.py")):
+            pkg = os.path.dirname(pkg)
+        if pkg != d and pkg not in walked_pkgs:
+            walked_pkgs.add(pkg)
+            for root, dirs, names in os.walk(pkg):
+                dirs[:] = [x for x in dirs
+                           if x not in ("__pycache__", ".git",
+                                        ".pytest_cache")]
+                extra.update(os.path.join(root, n) for n in names
+                             if n.endswith(".py"))
+        if repo_abs and t.startswith(repo_abs + os.sep) and \
+                default_sources:
+            extra.update(default_sources)
+    for t in targets:
+        idx.add_file(t, is_target=True)
+    for e in extra:
+        idx.add_file(e, is_target=False)
+    return idx

@@ -1,0 +1,400 @@
+"""RA11 — whole-program lock-order analyzer (ISSUE 14 tentpole part 2).
+
+Harvests lock acquisitions (``with self._lock:``-style contexts over
+attributes assigned ``threading.Lock()/RLock()/Condition()``, plus an
+explicit ``# ra11-lock: Class.attr [Ctor]`` line annotation for
+dynamically passed locks — the optional second token pins the
+constructor, else the named class's indexed lock attr decides, else
+the ctor stays unknown), builds the global acquisition-order graph — an edge
+``A -> B`` means "B is acquired (directly or through any resolvable
+call chain, cross-module) while A is held" — and reports every cycle:
+two code paths that take the same pair of locks in opposite orders are
+the ABBA deadlock class the PR 13 review caught by hand in
+``log/durable.py`` (``_lock`` vs ``_io_lock``).
+
+Lock identity is ``DefiningClass.attr`` (or ``module.name`` for
+module-level locks): every instance of a class shares the node, which
+is exactly the granularity a lock HIERARCHY is documented at
+(docs/INTERNALS.md §15).  Reentrant re-acquisition of the SAME lock
+(``RLock``, or a ``Condition`` used by its owner) is not an edge —
+but re-entering a plain ``Lock`` while holding it IS reported, as a
+one-lock cycle: a non-reentrant self-acquisition blocks its own
+thread forever (index.REENTRANT_CTORS draws the line).
+
+Known limitations (documented, deliberate): callbacks stored in
+containers (``self._subs[uid](...)``, ``w.notify(...)``) and locks
+reached through unannotated parameters are invisible — the analyzer
+follows only provable edges.  ``# ra11-ok: <why>`` on an edge's
+acquisition line allowlists a reviewed false positive.
+"""
+from __future__ import annotations
+
+import ast
+
+from .index import REENTRANT_CTORS, iter_scope
+from .rules import Finding
+
+__all__ = ["evaluate_lock_order"]
+
+
+class _LockNode:
+    __slots__ = ("key", "ctor")
+
+    def __init__(self, key, ctor):
+        self.key = key
+        self.ctor = ctor
+
+
+def _with_lock_items(idx, fi, node):
+    """(lock_key, ctor) for each known-lock context manager of a With
+    statement; unknown context managers resolve to nothing."""
+    out = []
+    mod = fi.module
+    line = mod.line(node.lineno)
+    hint = None
+    if "# ra11-lock:" in line:
+        hint = line.split("# ra11-lock:", 1)[1].strip() or None
+    for item in node.items:
+        expr = item.context_expr
+        got = _resolve_lock_expr(idx, fi, expr)
+        if got is None and hint:
+            got = _hint_lock(idx, hint)
+            hint = None  # one annotation names one lock
+        if got is not None:
+            out.append(got)
+    return out
+
+
+def _hint_lock(idx, hint):
+    """Lock node for a ``# ra11-lock: Class.attr [Ctor]`` annotation.
+    The optional second token pins the constructor; otherwise the
+    named class's indexed lock attr decides; otherwise the ctor is
+    None — UNKNOWN, which still orders ABBA edges but is never claimed
+    to be a guaranteed self-deadlock (the annotation is the escape
+    hatch for locks the resolver cannot type, so a forced 'Lock' here
+    false-positived on annotated RLocks/Conditions — review finding)."""
+    toks = hint.split()
+    key = toks[0]
+    ctor = toks[1] if len(toks) > 1 else None
+    if ctor is None and "." in key:
+        cls_name, attr = key.rsplit(".", 1)
+        for mod in idx.by_path.values():
+            ci = mod.classes.get(cls_name)
+            if ci is not None:
+                got, _defining = idx.lock_attr_ctor(ci, attr)
+                if got is not None:
+                    ctor = got
+                    break
+    return (key, ctor)
+
+
+def _resolve_lock_expr(idx, fi, expr):
+    mod = fi.module
+    if isinstance(expr, ast.Name):
+        ctor = mod.module_locks.get(expr.id)
+        if ctor:
+            return (f"{mod.stem}.{expr.id}", ctor)
+        # local variable aliased from an attribute chain:
+        # ``cond = self.bridge._cond; with cond:``
+        tgt = _local_lock_binding(idx, fi, expr.id)
+        if tgt is not None:
+            return tgt
+        return None
+    if isinstance(expr, ast.Attribute):
+        return _attr_lock(idx, fi, expr)
+    return None
+
+
+def _attr_lock(idx, fi, expr):
+    """Lock node for ``self.X`` / ``self.obj.X`` / ``var.X``."""
+    base = expr.value
+    attr = expr.attr
+    owner = None
+    if isinstance(base, ast.Name):
+        if base.id == "self":
+            owner = fi.cls
+        else:
+            owner = idx.local_types(fi).get(base.id)
+    elif isinstance(base, ast.Attribute):
+        owner = idx._attr_chain_type(fi, base)
+    if owner is None:
+        return None
+    ctor, defining = idx.lock_attr_ctor(owner, attr)
+    if ctor is None:
+        return None
+    return (f"{defining.name}.{attr}", ctor)
+
+
+def _local_lock_binding(idx, fi, name):
+    """Resolve ``name`` when a function body binds it to a lock
+    attribute chain (one level of aliasing, assignment-order blind —
+    good enough for the ``cond = self.bridge._cond`` idiom)."""
+    for sub in ast.walk(fi.node):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                isinstance(sub.targets[0], ast.Name) and \
+                sub.targets[0].id == name and \
+                isinstance(sub.value, ast.Attribute):
+            got = _attr_lock(idx, fi, sub.value)
+            if got is not None:
+                return got
+    return None
+
+
+class _LockWorld:
+    """Per-index lock database: per-function acquired-lock sets
+    (transitive) and the global acquisition-order edge list."""
+
+    def __init__(self, idx):
+        self.idx = idx
+        self._acquired = {}
+        self._built = set()
+        self.ctors = {}   # lock key -> ctor name (first sighting wins)
+
+    def _direct_locks(self, fi):
+        out = set()
+        # same-scope only: a nested def's acquisitions belong to the
+        # nested function (it has its own FuncInfo), not to the scope
+        # that merely defines it
+        for sub in iter_scope(fi.node):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for key, ctor in _with_lock_items(self.idx, fi, sub):
+                    out.add(key)
+                    # None = unknown (unresolved annotation): never
+                    # recorded, so a later PROVEN sighting of the same
+                    # key still lands regardless of traversal order
+                    if ctor is not None:
+                        self.ctors.setdefault(key, ctor)
+        return out
+
+    def _build(self, seeds):
+        """Order-independent transitive acquired-lock sets for every
+        function reachable from ``seeds``: collapse the call graph's
+        SCCs (Tarjan emits them callees-first) and propagate each
+        SCC's union downstream->up.  A plain DFS memo truncates at
+        cycles, so mutually recursive lock-takers would memoize
+        PARTIAL sets depending on traversal order — a missed-ABBA
+        false negative (caught in review)."""
+        funcs = {}
+        stack = list(seeds)
+        while stack:
+            fi = stack.pop()
+            if id(fi) in funcs or id(fi) in self._built:
+                continue
+            funcs[id(fi)] = fi
+            stack.extend(self.idx.callees_scoped(fi))
+        if not funcs:
+            return
+        succ = {nid: [id(c) for c in self.idx.callees_scoped(fi)
+                      if id(c) in funcs or id(c) in self._built]
+                for nid, fi in funcs.items()}
+        # traversal stays inside this pass; edges into already-built
+        # nodes survive in ``succ`` for the union step below
+        trav = {nid: [c for c in cs if c in funcs]
+                for nid, cs in succ.items()}
+        for scc in _tarjan_sccs(funcs, trav):
+            # _tarjan_sccs emits an SCC only after every SCC it can
+            # reach — callee unions below are already final
+            locks = set()
+            for nid in scc:
+                locks |= self._direct_locks(funcs[nid])
+                for cid in succ[nid]:
+                    if cid not in scc:
+                        locks |= self._acquired.get(cid, set())
+            for nid in scc:
+                self._acquired[nid] = locks
+                self._built.add(nid)
+
+    def acquired(self, fi):
+        """Set of lock keys ``fi`` may acquire, transitively through
+        every resolvable callee (order-independent; see _build)."""
+        if id(fi) not in self._built:
+            self._build([fi])
+        return self._acquired.get(id(fi), set())
+
+    def edges(self, functions):
+        """{(A, B): [(path, line, via)]} acquisition-order edges over
+        the given functions."""
+        out = {}
+
+        def add(a, b, path, line, via, ctor_b=None):
+            if a == b:
+                # re-acquiring the lock you already hold: an RLock (or
+                # the RLock-backed default Condition; semaphores admit
+                # multiple holders) is fine — a plain Lock is a
+                # guaranteed self-deadlock and keeps the edge, which
+                # _cycles reports as a one-lock cycle.  An UNKNOWN
+                # ctor (unresolved ra11-lock annotation) is dropped
+                # too: self-deadlock is only ever claimed when the
+                # non-reentrant constructor is proven.
+                ctor = ctor_b or self.ctors.get(a)
+                if ctor is None or ctor in REENTRANT_CTORS:
+                    return
+            out.setdefault((a, b), []).append((path, line, via))
+
+        for fi in functions:
+            for sub in iter_scope(fi.node):
+                if not isinstance(sub, (ast.With, ast.AsyncWith)):
+                    continue
+                held = _with_lock_items(self.idx, fi, sub)
+                if not held:
+                    continue
+                # multiple context managers in one `with a, b:` acquire
+                # left-to-right: that order is itself a set of edges
+                for i in range(len(held) - 1):
+                    for j in range(i + 1, len(held)):
+                        add(held[i][0], held[j][0], fi.module.path,
+                            sub.lineno, f"{fi.qualname} (with a, b)",
+                            ctor_b=held[j][1])
+                held_keys = [k for k, _c in held]
+                for stmt in sub.body:
+                    # same-scope: a callback DEFINED under the lock is
+                    # not CALLED under it (deferred execution) — skip
+                    # def statements outright (iter_scope only prunes
+                    # defs BELOW its root)
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        continue
+                    for inner in iter_scope(stmt):
+                        if isinstance(inner, (ast.With, ast.AsyncWith)):
+                            for key, ctor in _with_lock_items(
+                                    self.idx, fi, inner):
+                                for a in held_keys:
+                                    add(a, key, fi.module.path,
+                                        inner.lineno,
+                                        f"{fi.qualname} (nested with)",
+                                        ctor_b=ctor)
+                        elif isinstance(inner, ast.Call):
+                            for callee in self.idx.resolve_call(fi,
+                                                                inner):
+                                for key in self.acquired(callee):
+                                    for a in held_keys:
+                                        add(a, key, fi.module.path,
+                                            inner.lineno,
+                                            f"{fi.qualname} -> "
+                                            f"{callee.qualname}()")
+        return out
+
+
+def _tarjan_sccs(nodes, succ):
+    """Strongly connected components of a directed graph (iterative
+    Tarjan), emitted callees-first: an SCC is appended only after every
+    SCC it can reach.  Both consumers (_LockWorld._build's union
+    propagation and _cycles) depend on that order — ONE implementation,
+    because the lowlink/stack bookkeeping already bit us once (the
+    cycle-truncated DFS memo, review round 1)."""
+    index = {}
+    low = {}
+    on_stack = set()
+    tstack = []
+    sccs = []
+    counter = [0]
+    for start in nodes:
+        if start in index:
+            continue
+        work = [(start, iter(succ.get(start, ())))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        tstack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    tstack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(succ.get(nxt, ()))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = set()
+                while True:
+                    w = tstack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+def _cycles(edge_map):
+    """Node sets on a lock-order cycle: multi-node SCCs, plus a single
+    node with a (non-reentrant, see edges()) self-edge — re-acquiring a
+    held plain Lock is a one-lock deadlock."""
+    graph = {}
+    for (a, b) in edge_map:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    out = []
+    for scc in _tarjan_sccs(graph, graph):
+        node = next(iter(scc))
+        if len(scc) > 1 or node in graph[node]:
+            out.append(scc)
+    return out
+
+
+def evaluate_lock_order(idx):
+    """RAW RA11 findings: one per acquisition-order edge that sits on a
+    lock-order cycle, located at the inner acquisition / call site so
+    both directions of an ABBA pair are named."""
+    functions = []
+    for mod in idx.by_path.values():
+        # every indexed package module, not just lint targets — scoped
+        # runs evaluate the whole program (see rules._rule_roots)
+        if mod.in_tests or not mod.in_package:
+            continue
+        for defs in mod.func_defs.values():
+            functions.extend(defs)
+    if not functions:
+        return []
+    world = _LockWorld(idx)
+    world._build(functions)
+    edge_map = world.edges(functions)
+    out = []
+    for scc in _cycles(edge_map):
+        # provenance: every edge site on the cycle — linting any ONE
+        # of those files must surface both directions of the pair
+        site_paths = tuple({path
+                            for (a, b), sites in edge_map.items()
+                            if a in scc and b in scc
+                            for (path, _line, _via) in sites})
+        if len(scc) == 1:
+            (lone,) = scc
+            for path, line, via in edge_map.get((lone, lone), ()):
+                out.append(Finding(
+                    path, line, "RA11",
+                    f"self-deadlock: {lone} re-acquired while already "
+                    f"held (via {via}) — a plain threading.Lock is not "
+                    "reentrant, so this acquisition blocks its own "
+                    "thread forever; use RLock, move the inner work "
+                    "outside the lock, or mark the line "
+                    "'# ra11-ok: why'", roots=site_paths))
+            continue
+        cyc = " -> ".join(sorted(scc)) + " -> ..."
+        for (a, b), sites in edge_map.items():
+            if a in scc and b in scc:
+                for path, line, via in sites:
+                    out.append(Finding(
+                        path, line, "RA11",
+                        f"lock-order cycle: {b} acquired while holding "
+                        f"{a} (via {via}), but the reverse order also "
+                        f"exists on this cycle [{cyc}] — the ABBA "
+                        "deadlock class; fix one direction (pre-read "
+                        "outside the lock, the _put/_put_batch idiom) "
+                        "or mark the line '# ra11-ok: why'",
+                        roots=site_paths))
+    uniq = {}
+    for f in out:
+        uniq.setdefault(f.key(), f)
+    return list(uniq.values())

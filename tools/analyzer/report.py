@@ -1,0 +1,58 @@
+"""Output rendering for the analyzer: ``--report`` and ``--json``.
+
+The default lint output contract is unchanged (one
+``path:line: CODE msg`` line per finding + the ``lint: N files, M
+findings`` summary); these renderers are additive views over the same
+finding pool.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+
+
+def render_json(files, active, suppressed, elapsed_s):
+    def row(f):
+        return {"path": f.path, "line": f.line, "code": f.code,
+                "msg": f.msg}
+    return json.dumps({
+        "files": len(files),
+        "findings": [row(f) for f in active],
+        "suppressed": [row(f) for f in suppressed],
+        "elapsed_s": round(elapsed_s, 4),
+    }, indent=2, sort_keys=True)
+
+
+def render_report(files, active, suppressed, elapsed_s, repo=None):
+    """Human-grouped report: per-rule counts, then findings grouped by
+    file, then the suppression inventory."""
+    lines = []
+    lines.append("static analysis report")
+    lines.append(f"  files scanned: {len(files)}")
+    lines.append(f"  findings: {len(active)} active, "
+                 f"{len(suppressed)} suppressed (tagged)")
+    lines.append(f"  elapsed: {elapsed_s:.2f}s")
+    by_code = collections.Counter(f.code for f in active)
+    if by_code:
+        lines.append("")
+        lines.append("by rule:")
+        for code in sorted(by_code):
+            lines.append(f"  {code:<6} {by_code[code]}")
+    by_file = collections.defaultdict(list)
+    for f in active:
+        by_file[f.path].append(f)
+    if by_file:
+        lines.append("")
+        lines.append("by file:")
+        for path in sorted(by_file):
+            rel = os.path.relpath(path, repo) if repo else path
+            lines.append(f"  {rel}:")
+            for f in sorted(by_file[path], key=lambda x: x.line):
+                lines.append(f"    :{f.line} {f.code} {f.msg}")
+    if suppressed:
+        sup_by_code = collections.Counter(f.code for f in suppressed)
+        lines.append("")
+        lines.append("suppressed (allowlisted) by rule: " + ", ".join(
+            f"{c}={n}" for c, n in sorted(sup_by_code.items())))
+    return "\n".join(lines)

@@ -1,0 +1,435 @@
+"""Declarative closure-gated rule specs + the shared walker (ISSUE 14).
+
+Every closure-gated rule is a :class:`ClosureRule`: scopes (which files
+seed roots), root function names, a forbidden-construct kind, and an
+allowlist tag.  One engine resolves the roots' CROSS-MODULE transitive
+call closure (tools/analyzer/index.py) and walks each reached function
+for the rule's forbidden constructs — so a host sync or per-entry
+pickle moved into a helper one file away can no longer escape the gate
+(the pre-ISSUE-14 checkers only followed same-module calls).
+
+Rules here (the doc-of-record for codes is tools/lint.py's docstring):
+
+  RA02  engine step hot loop: no np.asarray/.item() host syncs
+  RA04  bench/soak dispatch loops + sampler/recorder/tuner/mesh tick
+        paths: no blocking device->host syncs
+  RA08  ingress coalescer + mesh ingress pump: no per-session Python
+        loops / dict allocation
+  RA09  wire reader sweep path: same, extended to the socket path
+  RA10  classic replication hot paths: no per-entry encode/WAL submit
+        inside loops
+
+Findings are RAW (unsuppressed): tools/analyzer/audit.py applies the
+``# raNN-ok`` line allowlists and audits them for staleness.  Tag
+FAMILIES: RA02/RA04 are one host-sync family and RA08/RA09 one
+per-row-Python family — a line a cross-module closure reaches from two
+gates carries ONE documented tag, and the audit accepts either.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+__all__ = ["Finding", "CLOSURE_RULES", "evaluate_closure_rules",
+           "TAG_FAMILIES", "family_codes"]
+
+
+class Finding:
+    __slots__ = ("path", "line", "code", "msg", "roots")
+
+    def __init__(self, path, line, code, msg, roots=()):
+        self.path = path
+        self.line = line
+        self.code = code
+        self.msg = msg
+        # provenance: module paths of the rule roots (spawn sites, lock
+        # sites, closure seeds) this finding was reached from.  The
+        # engine evaluates the WHOLE program so scoped runs match the
+        # full run's raw pool (the audit depends on that); the caller
+        # then reports a finding only when its path OR one of its roots
+        # is a lint target — linting fixture A must not surface sibling
+        # B's findings, while a cross-module escape rooted in A still
+        # lands wherever the construct lives.
+        self.roots = tuple(roots)
+
+    def key(self):
+        return (self.path, self.line, self.code, self.msg)
+
+    def render(self):
+        return f"{self.path}:{self.line}: {self.code} {self.msg}"
+
+
+#: allowlist-tag families: a tag from any rule in the family suppresses
+#: (and keeps live, for the audit) a finding from any other member —
+#: RA02/RA04 police the same host-sync bug class from different roots,
+#: RA08/RA09 the same per-row-Python class.
+TAG_FAMILIES = (
+    ("RA02", "RA04"),
+    ("RA08", "RA09"),
+    ("RA03",),
+    ("RA10",),
+    ("RA11",),
+    ("RA12",),
+)
+
+
+def family_codes(code):
+    for fam in TAG_FAMILIES:
+        if code in fam:
+            return fam
+    return (code,)
+
+
+# -- scopes ---------------------------------------------------------------
+
+class Scope:
+    """Selects root functions inside matching target files."""
+
+    def __init__(self, roots, basenames=None, parent=None, dirname=None):
+        self.roots = frozenset(roots)
+        self.basenames = frozenset(basenames) if basenames else None
+        self.parent = parent      # required parent directory name
+        self.dirname = dirname    # any path component (e.g. "wire")
+
+    def matches(self, path):
+        base = os.path.basename(path)
+        if self.basenames is not None and base not in self.basenames:
+            return False
+        if self.parent is not None and \
+                os.path.basename(os.path.dirname(path)) != self.parent:
+            return False
+        if self.dirname is not None:
+            parts = os.path.normpath(path).split(os.sep)
+            if self.dirname not in parts[:-1]:
+                return False
+        return True
+
+
+class ClosureRule:
+    def __init__(self, code, kind, scopes, msg_ctx):
+        self.code = code
+        self.kind = kind          # "sync" | "loops" | "per_entry"
+        self.scopes = scopes
+        self.msg_ctx = msg_ctx    # human name of the gated path
+
+
+_HOT_STEP_FUNCS = frozenset({"step", "_step", "submit", "uniform_step",
+                             "superstep", "_superstep", "submit_block",
+                             "uniform_superstep"})
+_SAMPLER_HOT_FUNCS = frozenset({"tick", "_start_sample", "_harvest",
+                                "note"})
+
+CLOSURE_RULES = [
+    ClosureRule("RA02", "sync_ra02",
+                [Scope(_HOT_STEP_FUNCS,
+                       basenames={"lockstep.py", "durable.py"})],
+                "hot-loop"),
+    ClosureRule("RA04", "sync",
+                [Scope(_SAMPLER_HOT_FUNCS, basenames={"telemetry.py"}),
+                 Scope({"record"}, basenames={"blackbox.py"}),
+                 Scope({"tick"}, basenames={"autotune.py"}),
+                 Scope({"drive_uniform_window"}, basenames={"mesh.py"})],
+                "sampler tick-path"),
+    ClosureRule("RA08", "loops",
+                [Scope({"offer", "pop_block"},
+                       basenames={"coalesce.py"}),
+                 Scope({"ingress_submit_wave"}, basenames={"mesh.py"})],
+                "coalescer"),
+    ClosureRule("RA09", "loops",
+                [Scope({"sweep"}, dirname="wire")],
+                "wire sweep"),
+    ClosureRule("RA10", "per_entry",
+                [Scope({"_send_items"}, basenames={"tcp.py"}),
+                 Scope({"write", "append_batch", "_put_batch"},
+                       basenames={"durable.py"}, parent="log"),
+                 Scope({"_leader_aer_reply", "_evaluate_quorum"},
+                       basenames={"server.py"}, parent="core")],
+                "classic hot path"),
+]
+
+#: bench/soak dispatch-loop scope (RA04's loop-shaped half): any loop
+#: in these files that dispatches engine work is a measured region
+_BENCH_FILES = frozenset({"bench.py", "bench_classic.py", "soak.py"})
+_DISPATCH_ATTRS = frozenset({"step", "superstep", "uniform_step",
+                             "uniform_superstep", "submit"})
+#: ``drain`` is new with ISSUE 14: a driver/sampler drain is a full
+#: pipeline barrier, the strongest sync of all — the pre-engine gate
+#: missed it (bench.py's probe loop carried a prophylactic tag for it)
+_SYNC_ATTRS = frozenset({"block_until_ready", "committed_total", "item",
+                         "drain"})
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+               ast.SetComp, ast.DictComp, ast.GeneratorExp)
+_RA10_ENCODE_NAMES = frozenset({"dumps", "encode_command"})
+_RA10_SYNC_NAMES = frozenset({"fsync", "fdatasync"})
+
+
+# -- forbidden-construct walkers -----------------------------------------
+
+def _walk_sync(fi, code, ctx, out, attrs=_SYNC_ATTRS,
+               msg_tail="blocks the dispatch loop the path rides; "
+                        "gate on is_ready() or mark the line "
+                        "'# ra04-ok: why'"):
+    path = fi.module.path
+    for sub in ast.walk(fi.node):
+        if not isinstance(sub, ast.Call):
+            continue
+        fn = sub.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        if fn.attr in attrs and not sub.args:
+            out.append(Finding(path, sub.lineno, code,
+                               f".{fn.attr}() in {ctx} {fi.name}() "
+                               + msg_tail))
+        elif fn.attr == "asarray" and \
+                isinstance(fn.value, ast.Name) and fn.value.id == "np":
+            out.append(Finding(path, sub.lineno, code,
+                               f"np.asarray() in {ctx} {fi.name}() "
+                               + msg_tail))
+
+
+def _walk_sync_ra02(fi, code, ctx, out):
+    _walk_sync(fi, code, ctx, out, attrs=frozenset({"item"}),
+               msg_tail="forces a device->host sync; move it to a "
+                        "documented readback point or mark the line "
+                        "'# ra02-ok: why'")
+
+
+def _walk_loops(fi, code, ctx, out):
+    path = fi.module.path
+    mark = f"# {code.lower()}-ok: why"
+    for sub in ast.walk(fi.node):
+        if isinstance(sub, _LOOP_NODES):
+            out.append(Finding(
+                path, sub.lineno, code,
+                f"Python loop in {ctx} hot path {fi.name}() — per-row "
+                "iteration turns the vectorized path back into "
+                "per-command host work; vectorize (argsort/fancy "
+                f"indexing) or mark the line '{mark}'"))
+        elif isinstance(sub, ast.Dict):
+            out.append(Finding(
+                path, sub.lineno, code,
+                f"dict allocation in {ctx} hot path {fi.name}(); "
+                f"preallocate outside the hot path or mark the line "
+                f"'{mark}'"))
+        elif isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Name) and sub.func.id == "dict":
+            out.append(Finding(
+                path, sub.lineno, code,
+                f"dict() allocation in {ctx} hot path {fi.name}(); "
+                f"preallocate outside the hot path or mark the line "
+                f"'{mark}'"))
+
+
+def _call_name(call):
+    f = call.func
+    return f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else None
+
+
+def _is_encoder(fi):
+    for sub in ast.walk(fi.node):
+        if isinstance(sub, ast.Call) and \
+                _call_name(sub) in _RA10_ENCODE_NAMES:
+            return True
+    return False
+
+
+def _walk_per_entry(idx, fi, code, ctx, out, encoder_names):
+    """RA10: per-entry encode / WAL submit inside a loop, including a
+    call to a helper (same-module by name, or cross-module resolved)
+    that itself encodes."""
+    path = fi.module.path
+    seen = set()
+    for loop in ast.walk(fi.node):
+        if not isinstance(loop, _LOOP_NODES):
+            continue
+        for sub in ast.walk(loop):
+            if not isinstance(sub, ast.Call) or id(sub) in seen:
+                continue
+            cname = _call_name(sub)
+            f = sub.func
+            if cname in _RA10_SYNC_NAMES or (
+                    cname in ("write", "write_many") and
+                    isinstance(f, ast.Attribute) and
+                    isinstance(f.value, ast.Attribute) and
+                    f.value.attr == "wal"):
+                seen.add(id(sub))
+                out.append(Finding(
+                    path, sub.lineno, code,
+                    f"per-entry WAL submit/sync ({cname}) inside a "
+                    f"loop in {ctx} {fi.name}() — use the group-commit "
+                    "fan-in (write_many) outside the loop or mark the "
+                    "line '# ra10-ok: why'"))
+            elif cname in _RA10_ENCODE_NAMES or \
+                    cname in encoder_names or \
+                    any(_is_encoder(c) for c in idx.resolve_call(fi, sub)):
+                seen.add(id(sub))
+                out.append(Finding(
+                    path, sub.lineno, code,
+                    f"per-entry encode ({cname}) inside a loop in "
+                    f"{ctx} {fi.name}() — batch-encode outside the "
+                    "loop (one pickle per frame/run) or mark the line "
+                    "'# ra10-ok: why'"))
+
+
+_WALKERS = {
+    "sync": _walk_sync,
+    "sync_ra02": _walk_sync_ra02,
+    "loops": _walk_loops,
+}
+
+
+def _rule_roots(idx, rule):
+    # roots come from EVERY indexed source module, not just the lint
+    # targets: a scoped run (--changed, one file) must evaluate the
+    # same whole-program pool the full run does, or a tag in a changed
+    # helper reads as stale when the root module didn't change (the
+    # audit false-failure loop, review finding)
+    roots = []
+    per_module_names = {}
+    for mod in idx.by_path.values():
+        if mod.in_tests:
+            continue
+        names = set()
+        for scope in rule.scopes:
+            if scope.matches(mod.path):
+                names |= scope.roots
+        if not names:
+            continue
+        per_module_names[mod.path] = names
+        for n in names:
+            roots.extend(mod.func_defs.get(n, []))
+    return roots, per_module_names
+
+
+def evaluate_closure_rules(idx):
+    """RAW findings from every declarative closure rule plus the
+    bench dispatch-loop half of RA04."""
+    out = []
+    for rule in CLOSURE_RULES:
+        roots, per_module = _rule_roots(idx, rule)
+        if not roots:
+            continue
+        # per-ROOT-MODULE closures so each finding carries exactly the
+        # root modules that reach it: stamping the whole rule's root
+        # set would make a scoped run report findings only reachable
+        # from a DIFFERENT root module (review finding — linting
+        # telemetry.py must not surface a mesh-only escape)
+        reached_by = {}   # id(fi) -> set of root module paths
+        closure = {}
+        for mpath, names in per_module.items():
+            mod = idx.by_path[mpath]
+            mod_roots = []
+            for n in names:
+                mod_roots.extend(mod.func_defs.get(n, []))
+            for fid, fi in idx.closure(mod_roots).items():
+                closure[fid] = fi
+                reached_by.setdefault(fid, set()).add(mpath)
+        if rule.kind == "per_entry":
+            # same-module helper-encoder names (legacy superset: bare
+            # attr-name matching catches unresolvable self-ish calls)
+            encoder_names_by_mod = {}
+            for fi in closure.values():
+                mpath = fi.module.path
+                if mpath not in encoder_names_by_mod:
+                    names = set()
+                    for defs in fi.module.func_defs.values():
+                        for d in defs:
+                            if _is_encoder(d):
+                                names.add(d.name)
+                    encoder_names_by_mod[mpath] = names
+        walker = _WALKERS.get(rule.kind)
+        for fid, fi in closure.items():
+            fi_out = []
+            if rule.kind == "per_entry":
+                _walk_per_entry(idx, fi, rule.code, rule.msg_ctx,
+                                fi_out,
+                                encoder_names_by_mod[fi.module.path])
+            else:
+                walker(fi, rule.code, rule.msg_ctx, fi_out)
+            root_paths = tuple(sorted(reached_by[fid]))
+            for f in fi_out:
+                f.roots = root_paths
+            out.extend(fi_out)
+    out.extend(_evaluate_bench_loops(idx))
+    # dedup: overlapping scopes/roots may reach one function twice
+    uniq = {}
+    for f in out:
+        uniq.setdefault(f.key(), f)
+    return list(uniq.values())
+
+
+def _evaluate_bench_loops(idx):
+    """RA04's dispatch-loop half: direct syncs inside a bench/soak loop
+    that dispatches engine work, PLUS syncs anywhere in the resolvable
+    call closure of helpers the loop body invokes (the cross-module
+    escape ISSUE 14 closes)."""
+    out = []
+    tail = ("inside a bench dispatch loop forces a device->host sync "
+            "that serializes the measured pipeline; harvest async "
+            "readbacks instead or mark the line '# ra04-ok: why' "
+            "(window boundary)")
+    for mod in idx.by_path.values():
+        if mod.in_tests:
+            continue
+        if os.path.basename(mod.path) not in _BENCH_FILES:
+            continue
+        seen = set()
+        helper_roots = []
+        mod_out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+                continue
+            body = list(node.body) + list(node.orelse)
+            calls = [sub for stmt in body for sub in ast.walk(stmt)
+                     if isinstance(sub, ast.Call)
+                     and isinstance(sub.func, ast.Attribute)]
+            if not any(c.func.attr in _DISPATCH_ATTRS for c in calls):
+                continue
+            for c in calls:
+                if id(c) in seen:
+                    continue
+                seen.add(id(c))
+                attr = c.func.attr
+                if attr in ("item", "committed_total") and c.args:
+                    continue
+                if attr in _SYNC_ATTRS:
+                    mod_out.append(Finding(mod.path, c.lineno, "RA04",
+                                           f".{attr}() " + tail))
+                elif attr == "asarray" and \
+                        isinstance(c.func.value, ast.Name) and \
+                        c.func.value.id == "np":
+                    mod_out.append(Finding(mod.path, c.lineno, "RA04",
+                                           "np.asarray() " + tail))
+            # cross-module half: helpers the measured loop calls by
+            # name — a sync moved into one must not escape the gate
+            owner = _enclosing_func(mod, node)
+            if owner is None:
+                continue
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) and \
+                            isinstance(sub.func, ast.Name):
+                        helper_roots.extend(
+                            idx.resolve_call(owner, sub))
+        if helper_roots:
+            for fi in idx.closure(helper_roots).values():
+                if fi.node is None:
+                    continue
+                _walk_sync(fi, "RA04",
+                           "a helper reached from a bench dispatch "
+                           "loop:", mod_out)
+        for f in mod_out:
+            f.roots = (mod.path,)
+        out.extend(mod_out)
+    return out
+
+
+def _enclosing_func(mod, node):
+    """FuncInfo whose body (transitively) contains ``node``."""
+    for defs in mod.func_defs.values():
+        for fi in defs:
+            for sub in ast.walk(fi.node):
+                if sub is node:
+                    return fi
+    return None

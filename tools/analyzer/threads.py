@@ -1,0 +1,175 @@
+"""RA12 — thread-role / device-sync checker (ISSUE 14 tentpole part 3).
+
+Classifies functions by EXECUTING THREAD from spawn sites
+(``threading.Thread(target=...)`` — the WAL batch/encode workers,
+supervisors, TCP reader/sender/detector loops, the wire selector
+reader), computes each worker root's cross-module transitive call
+closure, and forbids device-touching operations inside it:
+
+* ``jax.*`` / ``jnp.*`` / ``lax.*`` calls — any jax API call from a
+  worker thread can compile+enqueue device work, and a multi-device
+  enqueue off the dispatch thread DEADLOCKS against an in-flight pjit
+  (the PR 11 mesh hang: a WAL encode worker sliced a sharded array)
+* bare ``device_put(...)``
+* ``.block_until_ready()`` — a worker blocking on device state couples
+  worker liveness to the dispatch pipeline
+
+The sanctioned escape is host materialization: the dispatch thread (or
+a single designated point, e.g. ``EngineDurability._host_aux``) pulls
+device values to host ONCE, workers slice numpy.  A deliberate
+worker-side device op carries ``# ra12-ok: <why>`` naming why its
+inputs are host-materialized / why no concurrent dispatch can be in
+flight.
+
+``np.asarray(...)`` and ``.copy_to_host_async()`` are NOT flagged:
+pure d2h transfers of ready values are the idiom the rule steers
+toward (documented readback points; RA02 governs those on the
+dispatch side).
+
+Scope: package code only (a directory with ``__init__.py``), tests
+exempt — test harnesses drive engines from ad-hoc threads on purpose,
+and the bench/tools CLIs own their whole process.
+"""
+from __future__ import annotations
+
+import ast
+
+from .rules import Finding
+
+__all__ = ["evaluate_thread_roles"]
+
+_DEVICE_MODULES = frozenset({"jax", "jnp", "lax"})
+
+
+def _is_thread_ctor(call):
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "Thread" and \
+            isinstance(fn.value, ast.Name) and \
+            fn.value.id == "threading":
+        return True
+    if isinstance(fn, ast.Name) and fn.id == "Thread":
+        return True
+    return False
+
+
+def _spawn_targets(idx, fi):
+    """(target FuncInfo, spawn lineno) for every Thread(...) spawned
+    inside ``fi``."""
+    out = []
+    for sub in ast.walk(fi.node):
+        if not (isinstance(sub, ast.Call) and _is_thread_ctor(sub)):
+            continue
+        target = None
+        for kw in sub.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if target is None and len(sub.args) >= 2:
+            # positional stdlib form: Thread(group, target, ...) — the
+            # FIRST positional is `group` (review finding: reading
+            # args[0] let positional spawns escape the gate)
+            target = sub.args[1]
+        elif target is None and len(sub.args) == 1 and not (
+                isinstance(sub.args[0], ast.Constant)
+                and sub.args[0].value is None):
+            # lenient: Thread(worker) is invalid stdlib (group must be
+            # None) but clearly MEANS a target — gate it anyway
+            target = sub.args[0]
+        if target is None:
+            continue
+        if isinstance(target, ast.Name):
+            got = idx.resolve_name(fi.module, target.id)
+            if got and got[0] == "func":
+                out.append((got[1], sub.lineno))
+            else:
+                for d in fi.module.func_defs.get(target.id, []):
+                    out.append((d, sub.lineno))
+        elif isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self" and fi.cls is not None:
+            m = idx.find_method(fi.cls, target.attr)
+            if m is not None:
+                out.append((m, sub.lineno))
+    return out
+
+
+def _root_name(expr):
+    """Leftmost Name of a dotted attribute chain, or None."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def evaluate_thread_roles(idx):
+    """RAW RA12 findings: device-touching ops reachable from worker-
+    thread spawn targets."""
+    # harvest spawn sites from every indexed package module, not just
+    # lint targets — scoped runs evaluate the whole program (see
+    # rules._rule_roots)
+    roots = []       # (FuncInfo, "file:line" spawn origin, spawn path)
+    for mod in idx.by_path.values():
+        if mod.in_tests or not mod.in_package:
+            continue
+        for defs in mod.func_defs.values():
+            for fi in defs:
+                for target, line in _spawn_targets(idx, fi):
+                    roots.append((target, f"{mod.stem}.py:{line}",
+                                  mod.path))
+    if not roots:
+        return []
+    # closure, remembering the first spawn origin that reaches a func
+    origin = {}
+    queue = list(roots)
+    closure = {}
+    while queue:
+        fi, org, opath = queue.pop(0)
+        if id(fi) in closure:
+            continue
+        closure[id(fi)] = fi
+        origin[id(fi)] = (org, opath)
+        for callee in idx.callees(fi):
+            queue.append((callee, org, opath))
+    out = []
+    for fi in closure.values():
+        mod = fi.module
+        if mod.in_tests or not mod.in_package:
+            continue
+        org, opath = origin[id(fi)]
+        for sub in ast.walk(fi.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if isinstance(fn, ast.Attribute):
+                root = _root_name(fn)
+                if root in _DEVICE_MODULES:
+                    out.append(Finding(
+                        mod.path, sub.lineno, "RA12",
+                        f"{root}.{fn.attr}() in worker-thread closure "
+                        f"{fi.name}() (spawned at {org}) — device "
+                        "work enqueued off the dispatch thread can "
+                        "deadlock an in-flight pjit (the PR 11 mesh "
+                        "hang); materialize to host on the dispatch "
+                        "thread and slice numpy, or mark the line "
+                        "'# ra12-ok: why' (host-materialized inputs)",
+                        roots=(opath,)))
+                elif fn.attr == "block_until_ready" and not sub.args:
+                    out.append(Finding(
+                        mod.path, sub.lineno, "RA12",
+                        ".block_until_ready() in worker-thread "
+                        f"closure {fi.name}() (spawned at {org}) — a "
+                        "worker blocking on device state couples its "
+                        "liveness to the dispatch pipeline; gate on "
+                        "is_ready() or mark the line "
+                        "'# ra12-ok: why'", roots=(opath,)))
+            elif isinstance(fn, ast.Name) and fn.id == "device_put":
+                out.append(Finding(
+                    mod.path, sub.lineno, "RA12",
+                    f"device_put() in worker-thread closure "
+                    f"{fi.name}() (spawned at {org}) — device "
+                    "placement off the dispatch thread is the PR 11 "
+                    "deadlock class; stage on the dispatch thread or "
+                    "mark the line '# ra12-ok: why'",
+                    roots=(opath,)))
+    uniq = {}
+    for f in out:
+        uniq.setdefault(f.key(), f)
+    return list(uniq.values())
