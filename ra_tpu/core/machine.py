@@ -44,7 +44,7 @@ def cond_concrete(pred, true_fn, false_fn, operands):
     from jax import lax
 
     try:
-        concrete = bool(pred)
+        concrete = bool(pred)  # ra13-ok: the sanctioned concreteness probe — TracerBoolConversionError is caught and routes traced preds to lax.cond
     except jax.errors.TracerBoolConversionError:
         return lax.cond(pred, true_fn, false_fn, operands)
     return true_fn(operands) if concrete else false_fn(operands)

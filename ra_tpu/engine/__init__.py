@@ -1,5 +1,7 @@
-from .lockstep import DispatchAheadDriver, LaneState, LockstepEngine
+from .lockstep import (CHECKPOINT_FIELD_DEFAULTS, DispatchAheadDriver,
+                       LaneState, LockstepEngine)
 from .durable import EngineDurability, open_engine
 
-__all__ = ["DispatchAheadDriver", "LaneState", "LockstepEngine",
-           "EngineDurability", "open_engine"]
+__all__ = ["CHECKPOINT_FIELD_DEFAULTS", "DispatchAheadDriver",
+           "LaneState", "LockstepEngine", "EngineDurability",
+           "open_engine"]

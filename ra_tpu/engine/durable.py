@@ -915,7 +915,13 @@ class EngineDurability:
                 raise TimeoutError("checkpoint: WAL confirms stalled")
         path = os.path.join(self.dir, "ckpt.npz")
         engine.save(path)
-        meta = {"step": self.step_seq, "wal_shards": self.wal_shards}
+        # the pytree schema rides the meta for post-mortem diagnostics:
+        # a reopen under a different engine version can say WHICH field
+        # set the archive carries before restore() decides (the archive
+        # itself is schema-named since ISSUE 15 and is authoritative)
+        from .lockstep import LaneState
+        meta = {"step": self.step_seq, "wal_shards": self.wal_shards,
+                "schema": list(LaneState._fields)}
         tmp = path + ".meta.tmp"
         with open(tmp, "w") as f:
             json.dump(meta, f)

@@ -174,6 +174,51 @@ class LaneState(NamedTuple):
     mac: Any              # machine state pytree, leading dims [N,P]
 
 
+#: RA15 checkpoint schema registry (ISSUE 15): per-field restore
+#: behaviour for archives written BEFORE the field existed.  Every
+#: LaneState field MUST have an entry (the static gate pins parity
+#: with ``LaneState._fields``), so adding a pytree field forces the
+#: author to declare its forward-compat default here — and
+#: :meth:`LockstepEngine.restore` fills it generically, so a
+#: checkpoint format bump can never strand a durable dir again (the
+#: PR 6 pre-telemetry ``restore()`` KeyError, closed for every future
+#: field, not just ``telem``).
+#:
+#:   "require" — consensus-bearing state every archive has always
+#:               carried; a missing leaf is a corrupt archive, refuse
+#:   "zeros"   — derived/health state that restarts from zero
+#:               (zeros_like the restoring engine's leaf)
+#:   "init"    — keep the restoring engine's CURRENT value for the
+#:               field (for fields whose zero is not the correct
+#:               default, e.g. a future all-ones mask).  In the
+#:               open_engine recovery path the engine is freshly
+#:               constructed before restore(), so this IS the
+#:               fresh-init value; a mid-run rollback keeps the live
+#:               value — callers wanting a true re-init must restore
+#:               into a fresh engine
+CHECKPOINT_FIELD_DEFAULTS = {
+    "term": "require",
+    "leader_slot": "require",
+    "term_start": "require",
+    "last_index": "require",
+    "last_written": "require",
+    "match": "require",
+    "next_index": "require",
+    "commit": "require",
+    "applied": "require",
+    "voter": "require",
+    "active": "require",
+    "ring": "require",
+    "ring_base": "require",
+    "total_committed": "require",
+    "query_index": "require",
+    "peer_query": "require",
+    "query_agreed": "require",
+    "telem": "zeros",       # health counters: restart from zero
+    "mac": "require",
+}
+
+
 def _init_state(n_lanes: int, n_members: int, ring_capacity: int,
                 payload_width: int, mac_state: Any,
                 payload_dtype=jnp.int32) -> LaneState:
@@ -1178,14 +1223,23 @@ class LockstepEngine:
     def save(self, path: str) -> None:
         """Write the full lane state to one .npz (atomic replace): the
         lockstep analogue of the checkpoint/snapshot subsystem — all
-        clusters' Raft cursors + machine states in one device pull."""
+        clusters' Raft cursors + machine states in one device pull.
+
+        Archive keys are SCHEMA-NAMED since ISSUE 15
+        (``<field>:<leaf-index>`` per LaneState field) so restore can
+        resolve fields by name and default the ones an old archive
+        predates — the forward-compat contract
+        ``CHECKPOINT_FIELD_DEFAULTS`` declares and rule RA15 pins."""
         import os
 
-        flat, treedef = jax.tree.flatten(self.state)
-        arrays = {f"a{i}": np.asarray(x) for i, x in enumerate(flat)}
+        arrays = {}
+        for name in LaneState._fields:
+            leaves = jax.tree.flatten(getattr(self.state, name))[0]
+            for j, x in enumerate(leaves):
+                arrays[f"{name}:{j}"] = np.asarray(x)
         meta = {"n_lanes": self.n_lanes, "n_members": self.n_members,
                 "ring_capacity": self.ring_capacity,
-                "treedef": str(treedef)}
+                "schema": list(LaneState._fields)}
         tmp = path + ".partial"
         with open(tmp, "wb") as f:
             np.savez(f, __meta__=np.frombuffer(
@@ -1199,36 +1253,100 @@ class LockstepEngine:
         geometry (lanes/members/ring) must match construction — the
         snapshot is state, not config.
 
-        Archives written before the telemetry plane existed (LaneState
-        without ``telem``) restore with zero-filled telemetry: the
-        accumulators are health counters, not consensus state, so an
-        upgraded node must not strand a durable dir behind a format
-        bump."""
+        Forward compat (ISSUE 15, generalizing the PR 6 pre-telemetry
+        fix): fields the archive predates restore through their
+        ``CHECKPOINT_FIELD_DEFAULTS`` entry — ``"zeros"`` zero-fills
+        (health counters), ``"init"`` keeps the restoring engine's
+        current value (the fresh-init value in the open_engine
+        recovery path), ``"require"`` refuses (consensus state every
+        archive has always carried).  A durable dir is never stranded behind a
+        pytree format bump.  Archives from a NEWER schema (unknown
+        field names) are refused — silently dropping consensus state
+        is not a degrade this layer may choose.  Positional pre-ISSUE-
+        15 archives (``a<i>`` keys, with or without the telemetry
+        plane) still restore."""
         with np.load(path) as z:
-            flat, treedef = jax.tree.flatten(self.state)
-            n = len(flat)
-            n_arch = sum(1 for k in z.files if k != "__meta__")
-            n_tel = len(LaneTelemetry._fields)
-            tel_at = len(jax.tree.flatten(
-                tuple(self.state[:LaneState._fields.index("telem")]))[0])
-            legacy = n_arch == n - n_tel
-            if not legacy and n_arch != n:
+            names = [k for k in z.files if k != "__meta__"]
+            if not any(":" in k for k in names):
+                self._restore_positional(z)
+                return
+            by_field: dict = {}
+            for k in names:
+                by_field.setdefault(k.split(":", 1)[0], []).append(k)
+            unknown = sorted(set(by_field) - set(LaneState._fields))
+            if unknown:
                 raise ValueError(
-                    f"checkpoint leaf count mismatch: archive has "
-                    f"{n_arch} arrays, engine state needs {n}")
-            loaded, j = [], 0
-            for i in range(n):
-                if legacy and tel_at <= i < tel_at + n_tel:
-                    loaded.append(jnp.zeros_like(flat[i]))
+                    f"checkpoint carries unknown schema fields "
+                    f"{unknown[:6]} (written by a newer engine?); "
+                    "refusing to drop state")
+            loaded = []
+            for name in LaneState._fields:
+                cur = getattr(self.state, name)
+                leaves, treedef = jax.tree.flatten(cur)
+                if not leaves:
+                    # a zero-leaf field (e.g. a stateless machine's
+                    # empty mac pytree) writes no archive keys — there
+                    # is nothing to load OR default; keep the
+                    # structure as-is (a 'require' mode must not
+                    # refuse a checkpoint the same engine just wrote)
+                    loaded.append(cur)
                     continue
-                got = jnp.asarray(z[f"a{j}"])
-                j += 1
-                if flat[i].shape != got.shape:
+                if name not in by_field:
+                    mode = CHECKPOINT_FIELD_DEFAULTS.get(name,
+                                                         "require")
+                    if mode == "require":
+                        raise ValueError(
+                            f"checkpoint is missing required field "
+                            f"{name!r}")
+                    new = [jnp.zeros_like(x) for x in leaves] \
+                        if mode == "zeros" else list(leaves)
+                elif len(by_field[name]) != len(leaves):
                     raise ValueError(
-                        f"checkpoint geometry mismatch: {got.shape} "
-                        f"!= {flat[i].shape}")
-                loaded.append(got)
-            self.state = jax.tree.unflatten(treedef, loaded)
+                        f"checkpoint leaf count mismatch for "
+                        f"{name!r}: archive has "
+                        f"{len(by_field[name])}, engine needs "
+                        f"{len(leaves)}")
+                else:
+                    new = []
+                    for j, x in enumerate(leaves):
+                        got = jnp.asarray(z[f"{name}:{j}"])
+                        if x.shape != got.shape:
+                            raise ValueError(
+                                f"checkpoint geometry mismatch: "
+                                f"{got.shape} != {x.shape}")
+                        new.append(got)
+                loaded.append(jax.tree.unflatten(treedef, new))
+            self.state = LaneState(*loaded)
+
+    def _restore_positional(self, z) -> None:
+        """Legacy archive format: index-flattened ``a<i>`` keys.
+        Archives written before the telemetry plane existed (LaneState
+        without ``telem``) restore with zero-filled telemetry — the
+        original PR 6 special case, kept verbatim for old dirs."""
+        flat, treedef = jax.tree.flatten(self.state)
+        n = len(flat)
+        n_arch = sum(1 for k in z.files if k != "__meta__")
+        n_tel = len(LaneTelemetry._fields)
+        tel_at = len(jax.tree.flatten(
+            tuple(self.state[:LaneState._fields.index("telem")]))[0])
+        legacy = n_arch == n - n_tel
+        if not legacy and n_arch != n:
+            raise ValueError(
+                f"checkpoint leaf count mismatch: archive has "
+                f"{n_arch} arrays, engine state needs {n}")
+        loaded, j = [], 0
+        for i in range(n):
+            if legacy and tel_at <= i < tel_at + n_tel:
+                loaded.append(jnp.zeros_like(flat[i]))
+                continue
+            got = jnp.asarray(z[f"a{j}"])
+            j += 1
+            if flat[i].shape != got.shape:
+                raise ValueError(
+                    f"checkpoint geometry mismatch: {got.shape} "
+                    f"!= {flat[i].shape}")
+            loaded.append(got)
+        self.state = jax.tree.unflatten(treedef, loaded)
 
     # -- readback ----------------------------------------------------------
 

@@ -46,7 +46,15 @@ def state_shardings(mesh: Mesh, state: LaneState) -> LaneState:
     [N] fields over 'lanes', [N,P] fields over ('lanes','members'), the
     [N,R,C] ring lane-sharded only (entries flow to member chips on demand),
     and machine state over ('lanes','members', replicated...) whatever its
-    per-member rank."""
+    per-member rank.
+
+    Rule RA15 derives the state schema from this function's ``state``
+    annotation and statically requires every ``LaneState`` field to be
+    covered by the dispatch below — the generic ``_fields`` loop is
+    full coverage, and a by-name special case (``"mac"``/``"telem"``/
+    ``"ring"``) naming a non-field is flagged as a stale arm.  The PR 6
+    shape (a new pytree field the tree-map didn't cover, rejected by
+    ``device_put`` one mesh boot later) cannot reland silently."""
     def by_shape(leaf, member_axis: bool):
         leaf = jax.numpy.asarray(leaf)
         if leaf.ndim == 0:
@@ -125,7 +133,11 @@ def superstep_block_shardings(mesh: Mesh) -> dict:
     the engine keeps any-election bookkeeping on the host
     (``LockstepEngine._host_mask``) so the hot path never reads the
     mask back from device; pre-staging it would reintroduce exactly
-    that sync."""
+    that sync.  Rule RA15 pins the other direction: every key the
+    dispatch-ahead staging path reads (``shardings.get("n_new")`` in
+    ``DispatchAheadDriver._stage``) must have an entry here, so a new
+    staged block component cannot silently repartition per dispatch
+    (the SNIPPETS.md matching-axis-resources rule, as a lint)."""
     vec = NamedSharding(mesh, P(None, "lanes"))
     return {
         "n_new": vec,

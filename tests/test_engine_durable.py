@@ -237,6 +237,53 @@ def test_recover_from_checkpoint_plus_wal(tmp_path):
     eng2.close()
 
 
+def test_durable_dir_with_old_format_checkpoint_reopens(tmp_path):
+    """ISSUE 15 forward-compat at the DURABLE-DIR level (the PR 6
+    verify probe promoted into tier-1 and generalized): a dir whose
+    ckpt.npz was written by an OLD engine — positional a<i> keys,
+    telemetry plane absent — reopens through restore()'s legacy branch
+    + the RA15 schema defaults, recovers every committed command, and
+    keeps committing.  A checkpoint format bump never strands a
+    durable dir."""
+    import jax
+
+    from ra_tpu.engine.lockstep import LaneState, LaneTelemetry
+
+    eng = make_engine(tmp_path)
+    drive(eng, 6, cmds=4)
+    eng.checkpoint()
+    drive(eng, 3, cmds=4)
+    settle(eng, 5)
+    committed = eng.committed_total()
+    state = eng.state
+    eng.close()
+
+    # rewrite ckpt.npz exactly as the pre-telemetry positional save
+    # wrote it: index-flattened keys, telem leaves dropped
+    ckpt = tmp_path / "ckpt.npz"
+    n_tel = len(LaneTelemetry._fields)
+    tel_at = len(jax.tree.flatten(
+        tuple(state[:LaneState._fields.index("telem")]))[0])
+    with np.load(str(ckpt)) as z:
+        meta = z["__meta__"]
+        arrays = []
+        for name in LaneState._fields:
+            n_leaves = len(jax.tree.flatten(getattr(state, name))[0])
+            arrays += [z[f"{name}:{j}"] for j in range(n_leaves)]
+    legacy = arrays[:tel_at] + arrays[tel_at + n_tel:]
+    np.savez(str(ckpt), __meta__=meta,
+             **{f"a{i}": a for i, a in enumerate(legacy)})
+
+    eng2 = make_engine(tmp_path)
+    settle(eng2, 5)
+    assert eng2.committed_total() >= committed
+    # telemetry zero-fills and accumulates from the reopen
+    drive(eng2, 2, cmds=4)
+    eng2.block_until_ready()
+    assert int(np.asarray(eng2.state.telem.steps).max()) > 0
+    eng2.close()
+
+
 def test_recover_with_election_truncation(tmp_path):
     eng = make_engine(tmp_path)
     drive(eng, 6)

@@ -256,14 +256,18 @@ def test_engine_restore_pre_telemetry_checkpoint(tmp_path):
     eng.save(path)
 
     # rewrite the archive exactly as the pre-telemetry save wrote it:
-    # drop the telem leaves and close the a{i} index gap
+    # index-flattened a{i} keys (the pre-ISSUE-15 positional format),
+    # with the telem leaves dropped and the index gap closed
     n_tel = len(LaneTelemetry._fields)
     tel_at = len(jax.tree.flatten(
         tuple(eng.state[:LaneState._fields.index("telem")]))[0])
     with np.load(path) as z:
         meta = z["__meta__"]
-        n_arch = sum(1 for k in z.files if k != "__meta__")
-        arrays = [z[f"a{i}"] for i in range(n_arch)]
+        arrays = []
+        for name in LaneState._fields:
+            n_leaves = len(jax.tree.flatten(
+                getattr(eng.state, name))[0])
+            arrays += [z[f"{name}:{j}"] for j in range(n_leaves)]
     legacy = arrays[:tel_at] + arrays[tel_at + n_tel:]
     np.savez(path, __meta__=meta,
              **{f"a{i}": a for i, a in enumerate(legacy)})
@@ -278,6 +282,107 @@ def test_engine_restore_pre_telemetry_checkpoint(tmp_path):
     eng2.step(n_new, pay)
     eng2.block_until_ready()
     assert int(np.asarray(eng2.state.telem.steps).sum()) == N
+
+
+def test_engine_restore_schema_defaults_cover_missing_fields(tmp_path):
+    """ISSUE 15: the schema-named checkpoint format restores a field
+    the archive predates through its CHECKPOINT_FIELD_DEFAULTS entry —
+    the PR 6 pre-telemetry special case generalized, so the NEXT
+    pytree field addition is covered automatically (rule RA15 pins
+    registry parity with LaneState._fields).  A missing REQUIRED field
+    and an unknown (newer-schema) field both refuse: consensus state
+    is never silently dropped."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+    from ra_tpu.engine import LockstepEngine
+    from ra_tpu.engine.lockstep import (CHECKPOINT_FIELD_DEFAULTS,
+                                        LaneState)
+    from ra_tpu.models import CounterMachine
+
+    # the static half of the contract, pinned at runtime too: every
+    # field has a declared default mode
+    assert set(CHECKPOINT_FIELD_DEFAULTS) == set(LaneState._fields)
+
+    N, K = 8, 4
+    eng = LockstepEngine(CounterMachine(), N, 3, ring_capacity=64,
+                         max_step_cmds=K, donate=False)
+    n_new = jnp.full((N,), K, jnp.int32)
+    pay = jnp.ones((N, K, 1), jnp.int32)
+    for _ in range(5):
+        eng.step(n_new, pay)
+    eng.block_until_ready()
+    path = str(tmp_path / "lanes.npz")
+    eng.save(path)
+
+    def rewrite(drop_prefix=None, add=None):
+        with np.load(path) as z:
+            arrays = {k: z[k] for k in z.files}
+        if drop_prefix is not None:
+            arrays = {k: v for k, v in arrays.items()
+                      if not k.startswith(drop_prefix + ":")}
+        if add is not None:
+            arrays.update(add)
+        out = str(tmp_path / "rewritten.npz")
+        np.savez(out, **arrays)
+        return out
+
+    def fresh():
+        return LockstepEngine(CounterMachine(), N, 3, ring_capacity=64,
+                              max_step_cmds=K, donate=False)
+
+    # a "zeros"-defaulted field missing from the archive zero-fills,
+    # everything else restores exactly (the old-format-checkpoint
+    # shape for ANY future defaultable field, not just telem)
+    assert CHECKPOINT_FIELD_DEFAULTS["telem"] == "zeros"
+    e2 = fresh()
+    e2.restore(rewrite(drop_prefix="telem"))
+    assert e2.committed_total() == eng.committed_total()
+    assert int(np.asarray(e2.state.telem.steps).sum()) == 0
+    e2.step(n_new, pay)
+    e2.block_until_ready()
+    assert int(np.asarray(e2.state.telem.steps).sum()) == N
+
+    # a required field missing is a corrupt archive: refuse loudly
+    with pytest.raises(ValueError, match="required field"):
+        fresh().restore(rewrite(drop_prefix="commit"))
+
+    # an archive from a NEWER schema (unknown field) refuses too —
+    # silently dropping state is not this layer's call
+    with pytest.raises(ValueError, match="unknown schema field"):
+        fresh().restore(rewrite(
+            add={"lease_ms:0": np.zeros((N,), np.int32)}))
+
+
+def test_checkpoint_roundtrip_with_zero_leaf_field(tmp_path):
+    """Review regression pin (ISSUE 15): a LaneState field whose
+    pytree flattens to ZERO leaves (a stateless machine's empty mac)
+    writes no archive keys — restore() must treat it as trivially
+    satisfied, not as a missing 'require' field refusing a checkpoint
+    the very same engine just wrote."""
+    import jax.numpy as jnp
+    from ra_tpu.core.machine import JitMachine
+    from ra_tpu.engine import LockstepEngine
+
+    class StatelessMachine(JitMachine):
+        command_spec = ("int32", ())
+        reply_spec = ("int32", ())
+
+        def jit_init(self, n_lanes):
+            return {}
+
+        def jit_apply(self, meta, command, state):
+            return state, jnp.int32(0)
+
+    eng = LockstepEngine(StatelessMachine(), 4, 3, ring_capacity=64,
+                         max_step_cmds=4, donate=False)
+    path = str(tmp_path / "stateless.npz")
+    eng.save(path)
+    eng2 = LockstepEngine(StatelessMachine(), 4, 3, ring_capacity=64,
+                          max_step_cmds=4, donate=False)
+    eng2.restore(path)  # must not raise "missing required field 'mac'"
+    assert eng2.committed_total() == 0
 
 
 def test_committed_lanes_async_readback():

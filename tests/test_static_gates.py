@@ -1196,10 +1196,12 @@ def test_suppression_tag_families_cover_shared_closures(tmp_path):
 
 
 def test_analyzer_runtime_budget():
-    """Satellite (ISSUE 14): the whole-repo pass stays well inside a
-    tier-1 budget — the gate must never become the slow step.  The
-    measured full pass is ~4s on the builder box; 60s absorbs shared-CI
-    noise with a wide margin."""
+    """Satellite (ISSUE 14, re-measured for ISSUE 15): the whole-repo
+    pass stays well inside a tier-1 budget — the gate must never
+    become the slow step.  With the three jit-plane rule families
+    (RA13/RA14/RA15) and the migrated FILE_RULES the measured full
+    pass is ~7.6s on the builder box (~4s at PR 14); 60s absorbs
+    shared-CI noise with a wide margin."""
     import time as _time
     t0 = _time.monotonic()
     r = run_lint()
@@ -1550,3 +1552,537 @@ def test_lint_changed_fails_loudly_when_git_unavailable():
                        env=env)
     assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
     assert "could not read the git diff" in r.stderr, r.stderr
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 — the jit-plane analyzer (tools/analyzer/jitplane.py): traced-
+# closure harvest, RA13 trace hazards, RA14 donation lifetime, RA15
+# pytree/sharding/checkpoint schema, and the RA05/06/07 migration onto
+# the engine's declarative FILE_RULES.
+# ---------------------------------------------------------------------------
+
+def test_checker_detects_trace_hazards(tmp_path):
+    """RA13: inside a traced closure (here rooted by a module-level
+    jax.jit), Python control flow on tracer-typed values, host-world
+    calls, and concretizing casts are flagged; keyword-only params are
+    static config (the repo's partial-bound idiom) and functions the
+    traced world never reaches are exempt."""
+    pkg = tmp_path / "plane"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    mod = pkg / "kernels.py"
+    mod.write_text(textwrap.dedent("""\
+        import time
+
+        import jax
+        import numpy as np
+
+
+        def _step(state, n_new, *, window):
+            if window:
+                n_new = n_new + 0
+            if state.sum() > 0:
+                n_new = n_new + 1
+            assert n_new.sum() >= 0
+            flag = bool(state[0])
+            t0 = time.time()
+            host = np.asarray(n_new)
+            v = state[0].item()
+            return state + n_new, (flag, t0, host, v)
+
+
+        STEP = jax.jit(_step)
+
+
+        def overview(state):
+            if state is None:
+                return 0
+            return state
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    out = r.stdout
+    assert out.count("RA13") == 6, out
+    for frag in ("Python `if` on a traced value", "`assert` on a traced",
+                 "bool() cast", "time.time()", "np.asarray() over a",
+                 ".item() on a traced"):
+        assert frag in out, (frag, out)
+    # the static-config branch and the untraced function stay clean
+    assert "overview" not in out, out
+    assert ":8:" not in out, out  # `if window:` — keyword-only = static
+    # tagged sites pass and stay audit-live
+    fixed = mod.read_text()
+    for line in ("if state.sum() > 0:", "assert n_new.sum() >= 0",
+                 "flag = bool(state[0])", "t0 = time.time()",
+                 "host = np.asarray(n_new)", "v = state[0].item()"):
+        fixed = fixed.replace(line, line + "  # ra13-ok: fixture why")
+    mod.write_text(fixed)
+    r = run_lint(str(pkg))
+    assert "RA13" not in r.stdout and "AUDIT" not in r.stdout, r.stdout
+    # the same content OUTSIDE a package is not gated (CLI tools and
+    # harnesses own their whole process, same boundary as RA12)
+    loose = tmp_path / "kernels.py"
+    loose.write_text(textwrap.dedent("""\
+        import jax
+
+
+        def _step(state):
+            if state.sum() > 0:
+                return state
+            return state + 1
+
+
+        STEP = jax.jit(_step)
+    """))
+    r = run_lint(str(loose))
+    assert "RA13" not in r.stdout, r.stdout
+
+
+def test_checker_traces_through_jit_wrapper_param(tmp_path):
+    """The tentpole resolution shape: the repo jits through a wrapper
+    (`_build_jit(fn, ...)` builds functools.partial(fn) and jits it),
+    so the traced callable is a PARAMETER — the harvest must chase the
+    wrapper's call sites and root the argument passed there."""
+    pkg = tmp_path / "eng"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "lockjit.py").write_text(textwrap.dedent("""\
+        import functools
+
+        import jax
+
+
+        def _step(state, n):
+            while state.sum() > 0:
+                state = state - n
+            return state
+
+
+        class Eng:
+            def _build_jit(self, fn, donate):
+                partial = functools.partial(fn, n=1)
+                return jax.jit(partial,
+                               donate_argnums=(0,) if donate else ())
+
+            def compile(self):
+                self._step = self._build_jit(_step, True)
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("RA13") == 1, r.stdout
+    assert "Python `while` on a traced value" in r.stdout, r.stdout
+    assert "_step" in r.stdout, r.stdout
+
+
+def test_checker_traces_scan_and_cond_bodies(tmp_path):
+    """lax.scan/cond body callables are traced roots even with no
+    jax.jit in sight — scan bodies run under trace wherever the scan
+    itself ends up."""
+    pkg = tmp_path / "fold"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "folds.py").write_text(textwrap.dedent("""\
+        from jax import lax
+
+
+        def fold(xs, init):
+            def body(carry, x):
+                if x > 0:
+                    carry = carry + x
+                return carry, x
+            return lax.scan(body, init, xs)
+
+
+        def pick(pred, a, b):
+            return lax.cond(pred,
+                            lambda t: int(t[0]),
+                            lambda t: 0,
+                            (a, b))
+
+
+        def route(i, x):
+            def br0(t):
+                return float(t)
+            def br1(t):
+                return t + 1
+            return lax.switch(i, [br0, br1], x)
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("RA13") == 3, r.stdout
+    assert "Python `if` on a traced value" in r.stdout
+    assert "int() cast of a traced value" in r.stdout
+    # switch branches ride ONE sequence argument — the harvest must
+    # unpack the list, and operands must NOT be chased as callables
+    # (review finding: positional slots 1-6 missed every real switch)
+    assert "float() cast of a traced value" in r.stdout, r.stdout
+
+
+def test_checker_detects_donated_buffer_read_after_call(tmp_path):
+    """RA14 (lifetime half): reading the donated argument after the
+    donating call is poison on backends where donation is real; the
+    rebind-the-result shape (`self.state, aux = self._step(self.state,
+    ...)`) is the sanctioned idiom and passes."""
+    pkg = tmp_path / "don"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "eng.py").write_text(textwrap.dedent("""\
+        import jax
+
+
+        class Eng:
+            def __init__(self, fn, state):
+                self._step = jax.jit(fn, donate_argnums=(0,))
+                self.state = state
+
+            def bad(self, n):
+                out, aux = self._step(self.state, n)
+                return self.state.sum()
+
+            def masked(self, n):
+                out, aux = self._step(self.state, n)
+                pre = self.state.sum()
+                self.state = out
+                return pre + self.state.sum()
+
+            def good(self, n):
+                self.state, aux = self._step(self.state, n)
+                return self.state.sum()
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    # bad() reads with no rebind; masked() reads BEFORE a later rebind
+    # (a post-rebind read must not mask it); good()'s rebind-at-call
+    # is the sanctioned shape
+    assert r.stdout.count("RA14") == 2, r.stdout
+    assert "after it was DONATED" in r.stdout, r.stdout
+    assert "self.state" in r.stdout, r.stdout
+    assert ":15:" in r.stdout, r.stdout  # masked()'s pre-rebind read
+
+
+def test_checker_detects_loop_carried_donation(tmp_path):
+    """Review regression pin: a donating call inside a loop that never
+    rebinds the donated key hands the invalidated buffer back in on
+    the next iteration — a read the linear before/after scan cannot
+    see.  A rebind in the loop body protects it, and a rebind inside a
+    nested def (deferred execution) does NOT."""
+    pkg = tmp_path / "loopdon"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "eng.py").write_text(textwrap.dedent("""\
+        import jax
+
+
+        class Eng:
+            def __init__(self, fn, state):
+                self._step = jax.jit(fn, donate_argnums=(0,))
+                self.state = state
+
+            def bad_loop(self, blocks):
+                for b in blocks:
+                    out, aux = self._step(self.state, b)
+                return out
+
+            def masked_by_nested_def(self, blocks):
+                for b in blocks:
+                    out, aux = self._step(self.state, b)
+
+                    def cb():
+                        self.state = out
+                    self._cbs.append(cb)
+                return out
+
+            def good_loop(self, blocks):
+                for b in blocks:
+                    self.state, aux = self._step(self.state, b)
+                return aux
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("RA14") == 2, r.stdout
+    assert "inside a loop that never rebinds it" in r.stdout, r.stdout
+    assert "good_loop" not in r.stdout
+
+
+def test_checker_detects_aliased_pytree_leaves(tmp_path):
+    """RA14 (aliasing half): the exact PR 6 shape as a fixture — ONE
+    buffer binding passed as two NamedTuple leaves (or splatted across
+    all of them) aliases one device buffer and trips the donating
+    path's 'donate same buffer twice'; one constructor per leaf is the
+    fix shape and passes."""
+    pkg = tmp_path / "tel"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    mod = pkg / "telem.py"
+    mod.write_text(textwrap.dedent("""\
+        from typing import NamedTuple
+
+        import jax.numpy as jnp
+
+
+        class Telem(NamedTuple):
+            a: object
+            b: object
+
+
+        def init_bad(n):
+            z = jnp.zeros((n,), jnp.int32)
+            return Telem(z, z)
+
+
+        def init_splat(n):
+            z = jnp.zeros((n,), jnp.int32)
+            return Telem(*(z for _ in range(2)))
+
+
+        def init_good(n):
+            return Telem(*(jnp.zeros((n,), jnp.int32)
+                           for _ in range(2)))
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("RA14") == 2, r.stdout
+    assert "as two leaves" in r.stdout, r.stdout
+    assert "splats ONE buffer binding" in r.stdout, r.stdout
+    assert "init_good" not in r.stdout
+    # tagged sites pass and stay audit-live
+    fixed = mod.read_text() \
+        .replace("return Telem(z, z)",
+                 "return Telem(z, z)  # ra14-ok: fixture why") \
+        .replace("return Telem(*(z for _ in range(2)))",
+                 "return Telem(*(z for _ in range(2)))"
+                 "  # ra14-ok: fixture why")
+    mod.write_text(fixed)
+    r = run_lint(str(pkg))
+    assert "RA14" not in r.stdout and "AUDIT" not in r.stdout, r.stdout
+
+
+def test_checker_enforces_state_shardings_coverage(tmp_path):
+    """RA15(a): every schema field must be covered by the shardings
+    dispatch — the fixture reproduces the PR 6 uncovered-telemetry
+    shape (explicit per-field dict that forgot `telem`); generic
+    `._fields` iteration is full coverage, but a by-name special case
+    naming a NON-field is a stale dispatch arm."""
+    pkg = tmp_path / "mesh"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    mod = pkg / "shards.py"
+    mod.write_text(textwrap.dedent("""\
+        from typing import NamedTuple
+
+
+        class LaneState(NamedTuple):
+            term: object
+            ring: object
+            telem: object
+
+
+        def state_shardings(mesh, state: LaneState):
+            return {"term": mesh, "ring": mesh}
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("RA15") == 1, r.stdout
+    assert "does not cover" in r.stdout and "telem" in r.stdout
+    # covering the field passes
+    mod.write_text(mod.read_text().replace(
+        'return {"term": mesh, "ring": mesh}',
+        'return {"term": mesh, "ring": mesh, "telem": mesh}'))
+    r = run_lint(str(pkg))
+    assert "RA15" not in r.stdout, r.stdout
+    # generic _fields iteration is full coverage; a special-case arm
+    # naming a non-field is stale
+    mod.write_text(textwrap.dedent("""\
+        from typing import NamedTuple
+
+
+        class LaneState(NamedTuple):
+            term: object
+            ring: object
+            telem: object
+
+
+        def state_shardings(mesh, state: LaneState):
+            specs = {}
+            for name in LaneState._fields:
+                if name == "mac":
+                    continue
+                specs[name] = mesh
+            return specs
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("RA15") == 1, r.stdout
+    assert "special-cases 'mac'" in r.stdout, r.stdout
+    mod.write_text(mod.read_text().replace('"mac"', '"ring"'))
+    r = run_lint(str(pkg))
+    assert "RA15" not in r.stdout, r.stdout
+
+
+def test_checker_enforces_checkpoint_defaults_registry(tmp_path):
+    """RA15(b): the schema module must declare a per-field
+    CHECKPOINT_FIELD_DEFAULTS registry (parity with the schema, no
+    stale keys) and restore() must consult it — the PR 6 pre-telemetry
+    restore() KeyError, closed for every FUTURE field addition."""
+    pkg = tmp_path / "ckpt"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    mod = pkg / "lanes.py"
+    base = textwrap.dedent("""\
+        from typing import NamedTuple
+
+
+        class LaneState(NamedTuple):
+            term: object
+            telem: object
+
+
+        def state_shardings(mesh, state: LaneState):
+            return {"term": mesh, "telem": mesh}
+
+
+        @REGISTRY@
+
+        class Eng:
+            def restore(self, path):
+                @RESTORE@
+    """)
+
+    def build(registry, restore_body):
+        return base.replace("@REGISTRY@", registry) \
+                   .replace("@RESTORE@", restore_body)
+
+    # no registry at all
+    mod.write_text(build("", "return path"))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("RA15") == 1, r.stdout
+    assert "no CHECKPOINT_FIELD_DEFAULTS registry" in r.stdout
+    # registry missing a field + stale key + restore not consulting it
+    mod.write_text(build(
+        'CHECKPOINT_FIELD_DEFAULTS = {"term": "require", '
+        '"mac": "zeros"}', "return path"))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    out = r.stdout
+    assert out.count("RA15") == 3, out
+    assert "missing" in out and "telem" in out
+    assert "names ['mac']" in out, out
+    assert "does not consult" in out, out
+    # complete registry + consulting restore passes
+    mod.write_text(build(
+        'CHECKPOINT_FIELD_DEFAULTS = {"term": "require", '
+        '"telem": "zeros"}',
+        "return CHECKPOINT_FIELD_DEFAULTS.get(path)"))
+    r = run_lint(str(pkg))
+    assert "RA15" not in r.stdout, r.stdout
+
+
+def test_checker_enforces_block_staging_coverage(tmp_path):
+    """RA15(c): a staged superstep-block key with no entry in
+    superstep_block_shardings repartitions the staged block on every
+    dispatch (or rejects on a mesh) — the staging path's `.get` keys
+    must all be covered."""
+    pkg = tmp_path / "stage"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    mod = pkg / "driver.py"
+    mod.write_text(textwrap.dedent("""\
+        def superstep_block_shardings(mesh):
+            return {"n_new": mesh, "payloads": mesh}
+
+
+        class Driver:
+            def _stage(self, blk):
+                a = self.shardings.get("n_new")
+                b = self.shardings.get("query")
+                return a, b, blk
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("RA15") == 1, r.stdout
+    assert "'query' has no entry" in r.stdout, r.stdout
+    # a documented `# ra15-ok` tag suppresses and stays audit-live
+    tagged = mod.read_text().replace(
+        'b = self.shardings.get("query")',
+        'b = self.shardings.get("query")  # ra15-ok: fixture why')
+    mod.write_text(tagged)
+    r = run_lint(str(pkg))
+    assert "RA15" not in r.stdout and "AUDIT" not in r.stdout, r.stdout
+    mod.write_text(tagged.replace("  # ra15-ok: fixture why", "")
+                   .replace('{"n_new": mesh, "payloads": mesh}',
+                            '{"n_new": mesh, "payloads": mesh, '
+                            '"query": mesh}'))
+    r = run_lint(str(pkg))
+    assert "RA15" not in r.stdout, r.stdout
+
+
+def test_jit_plane_modules_are_clean():
+    """ISSUE 15 acceptance pin: the engine, mesh, ingress, machine and
+    ops trees carry zero untagged RA13/RA14/RA15 findings — the jitted
+    arithmetic stays trace-pure, donation lifetimes hold, and the
+    schema contracts (shardings coverage, checkpoint defaults, block
+    staging) are satisfied on main."""
+    # one invocation, six targets: each full run rebuilds the whole-
+    # program index (~8s), so per-target subprocesses would pay that
+    # six times for the identical check (review finding)
+    r = run_lint(*(os.path.join(REPO, *m.split("/"))
+                   for m in ("ra_tpu/engine", "ra_tpu/parallel",
+                             "ra_tpu/ingress", "ra_tpu/models",
+                             "ra_tpu/core", "ra_tpu/ops")))
+    for code in ("RA13", "RA14", "RA15"):
+        assert code not in r.stdout, (code, r.stdout)
+
+
+def test_cond_concrete_probe_is_tagged_and_audit_live():
+    """The sanctioned concreteness probe (core/machine.py
+    cond_concrete's bool(pred)) is a SUPPRESSED RA13 finding, not an
+    absent one — the tag is live, so deleting the probe without
+    removing the tag trips the audit."""
+    import json as _json
+    r = run_lint("--json",
+                 os.path.join(REPO, "ra_tpu", "core", "machine.py"))
+    data = _json.loads(r.stdout)
+    assert data["findings"] == [], data["findings"]
+    assert any(s["code"] == "RA13" and "bool()" in s["msg"]
+               for s in data["suppressed"]), data["suppressed"]
+
+
+def test_audit_covers_jitplane_tags(tmp_path):
+    """The allowlist-rot audit extends to the new tag families: a
+    ra13/ra14/ra15-ok tag on a line its rule no longer flags is an
+    AUDIT error."""
+    pkg = tmp_path / "rot"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "m.py").write_text(textwrap.dedent("""\
+        X = 1  # ra13-ok: stale - nothing traced here
+        Y = 2  # ra14-ok: stale
+        Z = 3  # ra15-ok: stale
+    """))
+    r = run_lint(str(pkg))
+    assert r.returncode == 1
+    assert r.stdout.count("AUDIT") == 3, r.stdout
+
+
+def test_file_rules_ride_the_engine(tmp_path):
+    """ISSUE 15 satellite: RA05/RA06/RA07 are declarative FILE_RULES
+    evaluated by the analyzer engine (one engine owns every rule).
+    The behavioural contract is pinned by the per-rule tests above;
+    this pins the MIGRATION — the specs live in the engine's rule
+    table and the old lint-side walkers are gone."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from analyzer.rules import FILE_RULES
+    finally:
+        sys.path.pop(0)
+    codes = {r.code for r in FILE_RULES}
+    assert {"RA05", "RA06", "RA07"} <= codes, codes
+    import ast as _ast
+    lint_src = open(LINT, encoding="utf-8").read()
+    tree = _ast.parse(lint_src)
+    defs = {n.name for n in _ast.walk(tree)
+            if isinstance(n, (_ast.FunctionDef, _ast.AsyncFunctionDef))}
+    for gone in ("_check_field_registry", "_check_event_registry_use",
+                 "_check_autotune_contract"):
+        assert gone not in defs, gone

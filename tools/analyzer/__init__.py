@@ -18,14 +18,19 @@ from __future__ import annotations
 
 from .audit import apply_suppressions, audit_suppressions
 from .index import PackageIndex, build_index
+from .jitplane import (evaluate_donation, evaluate_schema,
+                       evaluate_trace_hazards)
 from .locks import evaluate_lock_order
-from .rules import CLOSURE_RULES, Finding, evaluate_closure_rules
+from .rules import (CLOSURE_RULES, Finding, evaluate_closure_rules,
+                    evaluate_file_rules)
 from .threads import evaluate_thread_roles
 
 __all__ = ["Finding", "PackageIndex", "build_index", "run_analysis",
            "CLOSURE_RULES", "apply_suppressions", "audit_suppressions",
            "evaluate_closure_rules", "evaluate_lock_order",
-           "evaluate_thread_roles"]
+           "evaluate_thread_roles", "evaluate_trace_hazards",
+           "evaluate_donation", "evaluate_schema",
+           "evaluate_file_rules"]
 
 
 def run_analysis(targets, repo=None, default_sources=None):
@@ -39,4 +44,8 @@ def run_analysis(targets, repo=None, default_sources=None):
     raw.extend(evaluate_closure_rules(idx))
     raw.extend(evaluate_lock_order(idx))
     raw.extend(evaluate_thread_roles(idx))
+    raw.extend(evaluate_trace_hazards(idx))
+    raw.extend(evaluate_donation(idx))
+    raw.extend(evaluate_schema(idx))
+    raw.extend(evaluate_file_rules(idx, repo=repo))
     return raw, idx

@@ -20,6 +20,12 @@ Two halves:
 Audit findings use the code ``AUDIT`` and name the tag in lowercase
 only, so per-rule cleanliness pins (``"RA04" not in output``) never
 trip on a stale-tag report.
+
+The tag vocabulary is open-ended by construction (the ``ra\\d\\d-ok``
+regex): the ISSUE 15 jit-plane families (``ra13-ok``/``ra14-ok``/
+``ra15-ok``) joined with zero audit changes — a new rule family only
+has to register in ``rules.TAG_FAMILIES`` to get both suppression and
+rot detection.
 """
 from __future__ import annotations
 

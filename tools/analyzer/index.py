@@ -165,6 +165,16 @@ def _lock_ctor_name(call):
     return None
 
 
+def root_name(expr):
+    """Leftmost Name of a dotted attribute chain, or None — shared by
+    the thread-role (RA12) and jit-plane (RA13-15) checkers (one
+    definition; the two copies had already started life identical,
+    review finding)."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
 def iter_scope(node):
     """``ast.walk`` that does not descend into NESTED function/lambda
     definitions: the enclosing function's own executable scope.  A

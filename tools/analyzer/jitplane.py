@@ -1,0 +1,1107 @@
+"""RA13/RA14/RA15 — the jit-plane analyzer (ISSUE 15 tentpole).
+
+The jit boundary is the one plane the ISSUE 14 engine did not see, and
+CHANGES.md records a shipped bug in every hazard class gated here: the
+PR 6 donation trip ("donate same buffer twice" on shared ``zeros()``
+leaves), the PR 6 ``mesh.state_shardings`` rejection of a pytree field
+the shardings tree-map didn't cover, the PR 6 ``restore()`` KeyError on
+pre-telemetry checkpoints, and the host-sync classes PRs 5/11 found by
+review.  Three rule families run over the cross-module index
+(tools/analyzer/index.py):
+
+**Traced-closure harvest.**  Roots are the functions that reach a jit
+entry point: first args of ``jax.jit(...)`` / ``pjit(...)`` calls and
+``@jax.jit``-style decorators (including ``functools.partial(jax.jit,
+static_argnames=...)``), plus the body/branch callables of
+``lax.scan`` / ``cond`` / ``while_loop`` / ``fori_loop`` / ``switch``
+/ ``associative_scan``.  A jitted expression that resolves to a
+PARAMETER (the ``_build_jit(fn, ...)`` wrapper idiom) is chased to the
+wrapper's call sites and the matching argument resolved there.  The
+closure additionally expands resolved METHOD callees to their indexed
+subclass overrides (``machine.jit_apply`` statically resolves to the
+JitMachine base; the machines actually traced are the overrides) —
+an over-approximation that only ever ADDS functions to the traced
+world, which is the safe direction for a hazard gate.
+
+**RA13 trace-hazard.**  Inside traced closures: Python ``if``/
+``while``/``assert`` on tracer-typed values, host-world calls
+(``time.*``/``random.*``/``print``/``open``, ``np.*`` over traced
+values), and ``.item()``/``float()``/``int()``/``bool()`` casts of
+traced values.  Tracer typing is proof-only: POSITIONAL params of a
+traced function are tracers (keyword-only params are the static-config
+idiom every jitted fn here uses, and names listed in the jit site's
+``static_argnames``/``static_argnums`` are static too); locals are
+tracers when assigned from ``jnp.``/``lax.``/``jax.``-rooted calls or
+expressions over tracer names.  ``.shape``/``.ndim``/``.dtype``/
+``.size`` reads and the flagged casts themselves yield HOST values and
+stop propagation (so ``concrete = bool(pred)`` marks only the probe,
+not everything downstream — the sanctioned ``cond_concrete`` shape
+carries one ``# ra13-ok`` on the probe line).
+
+**RA14 donation-lifetime.**  Donation-enabled jitted callables are
+discovered from ``jax.jit(..., donate_argnums=...)`` sites — directly
+assigned, or returned by a factory (``_build_jit``) whose result is
+stored on an attribute; a conditional ``donate_argnums=(0,) if d else
+()`` counts as donating (the gate is for the enabled path).  At every
+call site: a read of the donated argument expression AFTER the call,
+with no rebinding in between, is flagged — donation invalidates the
+buffer, and the read returns poison on backends where donation is real
+(``self.state, _ = self._step(self.state, ...)`` rebinds and is the
+sanctioned shape).  The second half is the exact PR 6 bug as a rule: a
+NamedTuple pytree construction where two leaves are the SAME buffer
+binding (one ``z = jnp.zeros(...)`` passed as two fields, or a
+``*(z for _ in fields)`` splat of one binding) aliases one device
+buffer N ways and trips the donating path's "donate same buffer
+twice"; one constructor call per leaf is the fix shape.
+
+**RA15 pytree/sharding/checkpoint schema.**  The state pytree schema
+is derived from the construction site: the NamedTuple class annotating
+``state_shardings``'s state parameter (cross-module).  Three
+contracts: (a) every schema field is covered by the shardings
+dispatch — generically (an iteration over ``<Class>._fields``) or by
+name; a field the tree-map does not cover is the PR 6 ``device_put``
+rejection one mesh boot later, and a by-name special case naming a
+NON-field is a stale dispatch arm; (b) the schema module's
+``CHECKPOINT_FIELD_DEFAULTS`` registry names every field (and nothing
+else), and ``restore()`` consults it — so a checkpoint written before
+a field existed restores with the field's declared default instead of
+stranding a durable dir (the PR 6 KeyError, generalized to every
+future field); (c) every staged superstep-block key
+(``shardings.get("n_new")`` in the dispatch-ahead staging path) exists
+in ``superstep_block_shardings``'s dict — a staged block with no
+matching sharding repartitions on every dispatch (the SNIPPETS.md pjit
+rule) or rejects outright on a mesh.
+
+Scope: package code only, tests exempt (same boundary as RA12 —
+harnesses drive jits from ad-hoc shapes on purpose).  Findings are RAW;
+``# ra13-ok``/``# ra14-ok``/``# ra15-ok`` line tags allowlist, and the
+ISSUE 14 audit keeps the tags from rotting.
+"""
+from __future__ import annotations
+
+import ast
+
+from .index import iter_scope, root_name as _root_name
+from .rules import Finding
+
+__all__ = ["evaluate_trace_hazards", "evaluate_donation",
+           "evaluate_schema", "harvest_traced"]
+
+#: callables whose N-th positional args are traced function refs.
+#: ``switch`` takes its branches as ONE sequence argument
+#: (``switch(index, branches, *operands)``) — the resolver unpacks
+#: list/tuple literals, so each element roots; naming tail positions
+#: here instead would treat data operands as callables (review
+#: finding: bogus param sinks chased from operand args)
+_TRACE_BODY_FNS = {
+    "scan": (0,),
+    "cond": (1, 2),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "associative_scan": (0,),
+    "switch": (1,),
+}
+_JIT_NAMES = frozenset({"jit", "pjit"})
+_DEVICE_ROOTS = frozenset({"jnp", "lax", "jax"})
+_CAST_FNS = frozenset({"bool", "int", "float", "complex"})
+_HOST_MODULES = frozenset({"time", "random"})
+#: attribute reads that yield HOST data even off a tracer
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+#: jnp/jax constructors that allocate (or re-view) one device buffer —
+#: the RA14 aliasing half keys on bindings to these
+_BUFFER_CTORS = frozenset({"zeros", "ones", "full", "empty", "arange",
+                           "zeros_like", "ones_like", "full_like",
+                           "empty_like", "broadcast_to"})
+
+
+def _dotted(expr):
+    """'self.state' / 'x' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_call(call):
+    """True when ``call`` is jax.jit(...)/pjit(...)/jit(...)."""
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id in _JIT_NAMES:
+        return True
+    if isinstance(fn, ast.Attribute) and fn.attr in _JIT_NAMES and \
+            _root_name(fn) in ("jax", "pjit"):
+        return True
+    return False
+
+
+def _static_param_names(call):
+    """Names pinned static at a jit site (static_argnames / argnums are
+    resolved by the caller for argnums; names here)."""
+    out = set()
+    nums = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.add(e.value)
+    return out, nums
+
+
+class _SynthFunc:
+    """FuncInfo-shaped wrapper for a traced Lambda (index FuncInfos only
+    cover ``def``s)."""
+
+    __slots__ = ("name", "qualname", "module", "node", "cls")
+
+    def __init__(self, module, node, cls):
+        self.name = "<lambda>"
+        self.qualname = "<lambda>"
+        self.module = module
+        self.node = node
+        self.cls = cls
+
+
+def _resolve_traced_expr(idx, fi, expr, sinks):
+    """FuncInfos a traced-callable expression may denote.  A parameter
+    reference is recorded in ``sinks`` as (fi, param_name) for the
+    caller-side chase."""
+    out = []
+    if isinstance(expr, (ast.List, ast.Tuple)):
+        # a sequence of branch callables (lax.switch's second arg)
+        for el in expr.elts:
+            out.extend(_resolve_traced_expr(idx, fi, el, sinks))
+        return out
+    if isinstance(expr, ast.Lambda):
+        return [_SynthFunc(fi.module, expr, fi.cls)]
+    if isinstance(expr, ast.Call):
+        # functools.partial(F, ...) — the partial's target is traced
+        fn = expr.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else \
+            fn.id if isinstance(fn, ast.Name) else None
+        if name == "partial" and expr.args:
+            return _resolve_traced_expr(idx, fi, expr.args[0], sinks)
+        return []
+    if isinstance(expr, ast.Name):
+        params = _positional_params(fi.node)
+        if expr.id in params:
+            sinks.add((id(fi), fi, expr.id))
+            return []
+        # prefer a def nested inside this function's own body
+        for d in fi.module.func_defs.get(expr.id, []):
+            out.append(d)
+        if out:
+            return out
+        got = idx.resolve_name(fi.module, expr.id)
+        if got and got[0] == "func":
+            return [got[1]]
+        return []
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id == "self" and \
+                fi.cls is not None:
+            m = idx.find_method(fi.cls, expr.attr)
+            return [m] if m is not None else []
+        if isinstance(base, ast.Name):
+            got = idx.resolve_name(fi.module, base.id)
+            if got and got[0] == "module":
+                got2 = idx.resolve_name(got[1], expr.attr)
+                if got2 and got2[0] == "func":
+                    return [got2[1]]
+            elif got and got[0] == "class":
+                m = idx.find_method(got[1], expr.attr)
+                return [m] if m is not None else []
+    return []
+
+
+def _positional_params(fn_node):
+    args = getattr(fn_node, "args", None)
+    if args is None:      # a Module pseudo-scope has no parameters
+        return []
+    return [a.arg for a in list(args.posonlyargs) + list(args.args)]
+
+
+def _local_partial_target(fi, name):
+    """RHS expr when ``name = functools.partial(X, ...)``-style binding
+    exists in ``fi`` (the _build_jit idiom: partial built locally, then
+    jitted)."""
+    for sub in ast.walk(fi.node):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                isinstance(sub.targets[0], ast.Name) and \
+                sub.targets[0].id == name:
+            return sub.value
+    return None
+
+
+def harvest_traced(idx):
+    """{id: (func, origin)} — the traced world: every function the
+    resolver can prove reaches a jit/pjit entry point or a control-flow
+    primitive body, with the ``"file.py:line"`` origin of the entry
+    point that roots it."""
+    roots = []            # (func_like, origin string)
+    sinks = set()         # (id(fi), fi, param_name): chase call sites
+
+    def _add_site(fi, call, exprs, origin):
+        static_names, static_nums = _static_param_names(call)
+        for e in exprs:
+            if isinstance(e, ast.Name):
+                # a local bound to functools.partial(...) one line up
+                bound = _local_partial_target(fi, e.id)
+                if isinstance(bound, ast.Call):
+                    e = bound
+            for target in _resolve_traced_expr(idx, fi, e, sinks):
+                roots.append((target, origin, static_names, static_nums))
+
+    for mod in idx.by_path.values():
+        if mod.in_tests or not mod.in_package:
+            continue
+        # function-body sites, plus module-level ones (a top-level
+        # ``STEP = jax.jit(_step)`` roots _step too) via a Module
+        # pseudo-scope — dedup below makes the overlap harmless
+        scopes = [fi for defs in mod.func_defs.values() for fi in defs]
+        scopes.append(_SynthFunc(mod, mod.tree, None))
+        for fi in scopes:
+            for sub in ast.walk(fi.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                origin = f"{mod.stem}.py:{sub.lineno}"
+                if _is_jit_call(sub) and sub.args:
+                    _add_site(fi, sub, [sub.args[0]], origin)
+                    continue
+                fn = sub.func
+                name = fn.attr if isinstance(fn, ast.Attribute) \
+                    else fn.id if isinstance(fn, ast.Name) else None
+                if name in _TRACE_BODY_FNS and (
+                        not isinstance(fn, ast.Attribute)
+                        or _root_name(fn) in ("jax", "lax")):
+                    exprs = [sub.args[i]
+                             for i in _TRACE_BODY_FNS[name]
+                             if i < len(sub.args)]
+                    _add_site(fi, sub, exprs, origin)
+        # decorator form: @jax.jit / @functools.partial(jax.jit, ...)
+        for defs in mod.func_defs.values():
+            for fi in defs:
+                for dec in getattr(fi.node, "decorator_list", []):
+                    call = dec if isinstance(dec, ast.Call) else None
+                    statics, nums = (set(), set())
+                    if call is not None and _is_jit_call(call):
+                        statics, nums = _static_param_names(call)
+                    elif call is not None:
+                        dfn = call.func
+                        dname = dfn.attr if isinstance(dfn, ast.Attribute) \
+                            else dfn.id if isinstance(dfn, ast.Name) else None
+                        if dname == "partial" and call.args and \
+                                isinstance(call.args[0], (ast.Name,
+                                                          ast.Attribute)) \
+                                and _is_jit_call(ast.Call(
+                                    func=call.args[0], args=[],
+                                    keywords=[])):
+                            statics, nums = _static_param_names(call)
+                        else:
+                            continue
+                    elif not (isinstance(dec, (ast.Name, ast.Attribute))
+                              and _is_jit_call(ast.Call(func=dec, args=[],
+                                                        keywords=[]))):
+                        continue
+                    roots.append((fi, f"{mod.stem}.py:{fi.node.lineno}",
+                                  statics, nums))
+
+    # chase parameter sinks: a jit wrapper's fn param resolves at the
+    # wrapper's call sites (self._build_jit(_step, ...))
+    chased = set()
+    rounds = 0
+    while sinks - chased and rounds < 4:
+        rounds += 1
+        todo = sinks - chased
+        chased |= todo
+        for (_sid, sink_fi, pname) in list(todo):
+            params = _positional_params(sink_fi.node)
+            p_idx = params.index(pname) if pname in params else -1
+            if p_idx < 0:
+                continue
+            for mod in idx.by_path.values():
+                if mod.in_tests:
+                    continue
+                for defs in mod.func_defs.values():
+                    for caller in defs:
+                        for sub in ast.walk(caller.node):
+                            if not isinstance(sub, ast.Call):
+                                continue
+                            if not any(c is sink_fi for c in
+                                       idx.resolve_call(caller, sub)):
+                                continue
+                            # bound-method calls drop self
+                            off = p_idx - 1 if (
+                                sink_fi.cls is not None and
+                                isinstance(sub.func, ast.Attribute)) \
+                                else p_idx
+                            arg = None
+                            if 0 <= off < len(sub.args):
+                                arg = sub.args[off]
+                            for kw in sub.keywords:
+                                if kw.arg == pname:
+                                    arg = kw.value
+                            if arg is None:
+                                continue
+                            origin = f"{mod.stem}.py:{sub.lineno}"
+                            for target in _resolve_traced_expr(
+                                    idx, caller, arg, sinks):
+                                roots.append((target, origin,
+                                              set(), set()))
+
+    # transitive closure + subclass-override expansion
+    out = {}
+    queue = list(roots)
+    override_memo = {}
+    while queue:
+        fi, origin, statics, nums = queue.pop(0)
+        if id(fi) in out:
+            continue
+        out[id(fi)] = (fi, origin, statics, nums)
+        callees = idx.callees(fi) if not isinstance(fi, _SynthFunc) \
+            else _lambda_callees(idx, fi)
+        for callee in callees:
+            queue.append((callee, origin, set(), set()))
+            for ov in _overrides(idx, callee, override_memo):
+                queue.append((ov, origin, set(), set()))
+    return out
+
+
+def _lambda_callees(idx, sfi):
+    out = []
+    seen = set()
+    for sub in ast.walk(sfi.node):
+        if isinstance(sub, ast.Call):
+            for callee in idx.resolve_call(sfi, sub):
+                if id(callee) not in seen:
+                    seen.add(id(callee))
+                    out.append(callee)
+    return out
+
+
+def _overrides(idx, fi, memo):
+    """Indexed subclass overrides of a resolved method — the traced
+    world's stand-in for virtual dispatch (jit_apply on the JitMachine
+    base resolves, the machines traced in production are overrides)."""
+    if fi.cls is None or fi.name.startswith("__"):
+        return []
+    got = memo.get(id(fi))
+    if got is not None:
+        return got
+    out = []
+    for mod in idx.by_path.values():
+        if mod.in_tests:
+            continue
+        for ci in mod.classes.values():
+            if ci is fi.cls:
+                continue
+            m = ci.methods.get(fi.name)
+            if m is not None and m is not fi and fi.cls in idx.mro(ci):
+                out.append(m)
+    memo[id(fi)] = out
+    return out
+
+
+# -- RA13: trace hazards ---------------------------------------------------
+
+def _tracer_names(fi, static_names, static_nums):
+    """Proof-only tracer typing for one traced function: positional
+    params (minus self/statics), plus locals derived from device calls
+    or other tracer names; casts and shape reads stop propagation."""
+    params = _positional_params(fi.node) if not isinstance(
+        fi.node, ast.Lambda) else [a.arg for a in fi.node.args.args]
+    traced = set()
+    for i, p in enumerate(params):
+        if p in ("self", "cls") or p in static_names or i in static_nums:
+            continue
+        traced.add(p)
+    # keyword-only params are the static-config idiom: never tracers
+    for _ in range(3):
+        changed = False
+        for sub in ast.walk(fi.node):
+            value = None
+            targets = []
+            if isinstance(sub, ast.Assign):
+                value, targets = sub.value, sub.targets
+            elif isinstance(sub, ast.AugAssign):
+                value, targets = sub.value, [sub.target]
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                value, targets = sub.value, [sub.target]
+            if value is None or not _expr_traced(value, traced):
+                continue
+            for t in targets:
+                for el in ast.walk(t):
+                    if isinstance(el, ast.Name) and el.id not in traced:
+                        traced.add(el.id)
+                        changed = True
+        if not changed:
+            break
+    return traced
+
+
+def _expr_traced(expr, traced):
+    """Does ``expr`` (or any reachable subexpression) carry a tracer?
+    Stops at .shape/.ndim/.dtype/.size reads and host casts — those
+    yield concrete host values."""
+    if isinstance(expr, ast.Attribute) and expr.attr in _STATIC_ATTRS:
+        return False
+    if isinstance(expr, ast.Call):
+        fn = expr.func
+        if isinstance(fn, ast.Name) and fn.id in _CAST_FNS:
+            return False
+        if isinstance(fn, ast.Attribute) and fn.attr == "item":
+            return False
+        if _root_name(fn) in _DEVICE_ROOTS:
+            return True
+        # a method call ON a tracer yields a tracer (state.sum())
+        if isinstance(fn, ast.Attribute) and \
+                _expr_traced(fn.value, traced):
+            return True
+        return any(_expr_traced(a, traced) for a in expr.args) or \
+            any(_expr_traced(kw.value, traced) for kw in expr.keywords)
+    if isinstance(expr, ast.Name):
+        return expr.id in traced
+    return any(_expr_traced(c, traced)
+               for c in ast.iter_child_nodes(expr)
+               if isinstance(c, ast.expr))
+
+
+def evaluate_trace_hazards(idx):
+    """RAW RA13 findings over the traced world."""
+    out = []
+    for fi, origin, statics, nums in harvest_traced(idx).values():
+        mod = fi.module
+        if mod.in_tests or not mod.in_package:
+            continue
+        traced = _tracer_names(fi, statics, nums)
+        ctx = f"traced closure {fi.name}() (traced via {origin})"
+        tail = ("— data-dependent Python control flow concretizes a "
+                "tracer and fails (or silently specializes) under jit; "
+                "use lax.cond/where or mark the line '# ra13-ok: why'")
+        for sub in iter_scope(fi.node):
+            if isinstance(sub, (ast.If, ast.While)) and \
+                    _expr_traced(sub.test, traced):
+                kind = "if" if isinstance(sub, ast.If) else "while"
+                out.append(Finding(
+                    mod.path, sub.lineno, "RA13",
+                    f"Python `{kind}` on a traced value in {ctx} "
+                    + tail, roots=(mod.path,)))
+            elif isinstance(sub, ast.Assert) and \
+                    _expr_traced(sub.test, traced):
+                out.append(Finding(
+                    mod.path, sub.lineno, "RA13",
+                    f"`assert` on a traced value in {ctx} — asserts "
+                    "vanish under tracing (checked once at trace time, "
+                    "never per step); use checkify or host-side "
+                    "validation, or mark the line '# ra13-ok: why'",
+                    roots=(mod.path,)))
+            elif isinstance(sub, ast.Call):
+                out.extend(_call_hazards(mod, fi, sub, traced, ctx))
+    uniq = {}
+    for f in out:
+        uniq.setdefault(f.key(), f)
+    return list(uniq.values())
+
+
+def _call_hazards(mod, fi, call, traced, ctx):
+    out = []
+    fn = call.func
+    root = _root_name(fn) if isinstance(fn, ast.Attribute) else None
+    name = fn.id if isinstance(fn, ast.Name) else None
+    if name in _CAST_FNS and any(_expr_traced(a, traced)
+                                 for a in call.args):
+        out.append(Finding(
+            mod.path, call.lineno, "RA13",
+            f"{name}() cast of a traced value in {ctx} — the cast "
+            "forces concretization (TracerBoolConversionError under "
+            "jit); keep the value symbolic or mark the line "
+            "'# ra13-ok: why'", roots=(mod.path,)))
+    elif name in ("print", "open"):
+        out.append(Finding(
+            mod.path, call.lineno, "RA13",
+            f"host-world call {name}() in {ctx} — side effects inside "
+            "a traced closure run at TRACE time only (once per "
+            "compile, never per step); hoist to the host caller or "
+            "mark the line '# ra13-ok: why'", roots=(mod.path,)))
+    elif root in _HOST_MODULES:
+        out.append(Finding(
+            mod.path, call.lineno, "RA13",
+            f"host-world call {root}.{fn.attr}() in {ctx} — wall-clock "
+            "and host RNG freeze at trace time (one value baked into "
+            "the compiled step); thread them in as operands or mark "
+            "the line '# ra13-ok: why'", roots=(mod.path,)))
+    elif root == "np" and any(_expr_traced(a, traced)
+                              for a in call.args):
+        out.append(Finding(
+            mod.path, call.lineno, "RA13",
+            f"np.{fn.attr}() over a traced value in {ctx} — numpy "
+            "concretizes the tracer (a device sync at best, a trace "
+            "error at worst); use jnp or mark the line "
+            "'# ra13-ok: why'", roots=(mod.path,)))
+    elif isinstance(fn, ast.Attribute) and fn.attr == "item" and \
+            not call.args and _expr_traced(fn.value, traced):
+        out.append(Finding(
+            mod.path, call.lineno, "RA13",
+            f".item() on a traced value in {ctx} — concretization "
+            "error under jit; return it as an output instead or mark "
+            "the line '# ra13-ok: why'", roots=(mod.path,)))
+    return out
+
+
+# -- RA14: donation lifetime -----------------------------------------------
+
+def _donated_positions(call):
+    """Set of donated positional indexes at a jax.jit site; a
+    conditional ``(0,) if donate else ()`` contributes both arms (the
+    gate polices the donation-ENABLED path)."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        vals = [kw.value]
+        out = set()
+        while vals:
+            v = vals.pop()
+            if isinstance(v, ast.IfExp):
+                vals.extend([v.body, v.orelse])
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                vals.extend(v.elts)
+            elif isinstance(v, ast.Constant) and isinstance(v.value, int):
+                out.add(v.value)
+        return out
+    return set()
+
+
+def _donating_factories(idx):
+    """{id(fi): positions} for functions returning a donating jit."""
+    out = {}
+    for mod in idx.by_path.values():
+        if mod.in_tests:
+            continue
+        for defs in mod.func_defs.values():
+            for fi in defs:
+                for sub in ast.walk(fi.node):
+                    if isinstance(sub, ast.Return) and \
+                            isinstance(sub.value, ast.Call) and \
+                            _is_jit_call(sub.value):
+                        pos = _donated_positions(sub.value)
+                        if pos:
+                            out.setdefault(id(fi), set()).update(pos)
+    return out
+
+
+def _donating_bindings(idx, factories):
+    """attr bindings: {(id(ClassInfo), attr): positions} for
+    ``self.attr = jax.jit(..., donate_argnums=...)`` or
+    ``self.attr = self._factory(...)``."""
+    attrs = {}
+    for mod in idx.by_path.values():
+        if mod.in_tests:
+            continue
+        for ci in mod.classes.values():
+            for m in ci.methods.values():
+                for sub in ast.walk(m.node):
+                    if not (isinstance(sub, ast.Assign) and
+                            len(sub.targets) == 1):
+                        continue
+                    t = sub.targets[0]
+                    if not (isinstance(t, ast.Attribute) and
+                            isinstance(t.value, ast.Name) and
+                            t.value.id == "self"):
+                        continue
+                    v = sub.value
+                    pos = set()
+                    if isinstance(v, ast.Call) and _is_jit_call(v):
+                        pos = _donated_positions(v)
+                    elif isinstance(v, ast.Call):
+                        for callee in idx.resolve_call(m, v):
+                            pos |= factories.get(id(callee), set())
+                    if pos:
+                        attrs.setdefault((id(ci), t.attr),
+                                         set()).update(pos)
+    return attrs
+
+
+def _assign_target_keys(node):
+    """Dotted keys stored by an assignment statement's targets."""
+    out = set()
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    for t in targets:
+        for el in ast.walk(t):
+            key = _dotted(el)
+            if key:
+                out.add(key)
+    return out
+
+
+def evaluate_donation(idx):
+    """RAW RA14 findings: donated-buffer reads after the donating call,
+    and pytree constructions aliasing one buffer across leaves."""
+    factories = _donating_factories(idx)
+    attrs = _donating_bindings(idx, factories)
+    out = []
+    for mod in idx.by_path.values():
+        if mod.in_tests or not mod.in_package:
+            continue
+        # module-level donating names: STEP = jax.jit(f, donate_...)
+        mod_donating = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_jit_call(node.value):
+                pos = _donated_positions(node.value)
+                if pos:
+                    mod_donating[node.targets[0].id] = pos
+        for defs in mod.func_defs.values():
+            for fi in defs:
+                out.extend(_donated_read_findings(idx, fi, attrs,
+                                                  mod_donating))
+                out.extend(_aliased_leaf_findings(idx, fi))
+    uniq = {}
+    for f in out:
+        uniq.setdefault(f.key(), f)
+    return list(uniq.values())
+
+
+def _donated_read_findings(idx, fi, attrs, mod_donating=None):
+    out = []
+    mod = fi.module
+    # local donating names: x = jax.jit(..., donate_argnums=...)
+    local = dict(mod_donating or {})
+    for sub in ast.walk(fi.node):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                isinstance(sub.targets[0], ast.Name) and \
+                isinstance(sub.value, ast.Call) and \
+                _is_jit_call(sub.value):
+            pos = _donated_positions(sub.value)
+            if pos:
+                local[sub.targets[0].id] = pos
+    # events: (lineno, key) stores from assignments, loads from
+    # name/attr reads — SAME-SCOPE only (iter_scope): a rebind inside
+    # a nested def is deferred execution and must not mask a real
+    # post-donation read in the enclosing scope (review finding)
+    stores = []
+    for sub in iter_scope(fi.node):
+        if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            for key in _assign_target_keys(sub):
+                stores.append((sub.lineno, key))
+    loops = [n for n in iter_scope(fi.node)
+             if isinstance(n, (ast.For, ast.AsyncFor, ast.While))]
+    for sub in iter_scope(fi.node):
+        if not isinstance(sub, ast.Call):
+            continue
+        fn = sub.func
+        pos = set()
+        via = None
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id == "self" and fi.cls is not None:
+            for anc in idx.mro(fi.cls):
+                pos |= attrs.get((id(anc), fn.attr), set())
+            via = f"self.{fn.attr}"
+        elif isinstance(fn, ast.Name) and fn.id in local:
+            pos = local[fn.id]
+            via = fn.id
+        if not pos:
+            continue
+        for p in sorted(pos):
+            if p >= len(sub.args):
+                continue
+            key = _dotted(sub.args[p])
+            if key is None:
+                continue
+            # loop-carried donation: a donating call INSIDE a loop
+            # with no rebind of the donated key anywhere in the loop
+            # hands the invalidated buffer back to the call on the
+            # next iteration — a read the linear before/after scan
+            # cannot see (review finding)
+            containing = [lp for lp in loops
+                          if any(n is sub for n in ast.walk(lp))]
+            if containing:
+                # the INNERMOST containing loop decides: a rebind in
+                # its body runs every iteration and protects all
+                # enclosing loops too
+                inner = max(containing, key=lambda lp: lp.lineno)
+                rebound = any(
+                    key in _assign_target_keys(n)
+                    for n in iter_scope(inner)
+                    if isinstance(n, (ast.Assign, ast.AugAssign,
+                                      ast.AnnAssign)))
+                if not rebound:
+                    out.append(Finding(
+                        mod.path, sub.lineno, "RA14",
+                        f"`{key}` is DONATED to {via}(...) inside a "
+                        "loop that never rebinds it — the next "
+                        "iteration passes the invalidated buffer "
+                        "back in; rebind the result "
+                        "(`x, aux = f(x, ...)`) or mark the line "
+                        "'# ra14-ok: why'", roots=(mod.path,)))
+            first_store = min((ln for ln, k in stores
+                               if k == key and ln >= sub.lineno),
+                              default=None)
+            # earliest same-scope read AFTER the donating call (sorted
+            # — ast order is not line order, and a post-rebind read
+            # visited first would mask an earlier pre-rebind one)
+            first_load = min(
+                (load.lineno for load in iter_scope(fi.node)
+                 if isinstance(load, (ast.Name, ast.Attribute))
+                 and _dotted(load) == key
+                 and load.lineno > sub.lineno),
+                default=None)
+            if first_load is not None and (
+                    first_store is None or first_load < first_store):
+                out.append(Finding(
+                    mod.path, first_load, "RA14",
+                    f"read of `{key}` after it was DONATED to "
+                    f"{via}(...) at line {sub.lineno} — donation "
+                    "invalidates the buffer (poison on backends where "
+                    "donation is real); rebind the result "
+                    "(`x, aux = f(x, ...)`) before any further read, "
+                    "or mark the line '# ra14-ok: why'",
+                    roots=(mod.path,)))
+    return out
+
+
+def _is_namedtuple_class(idx, ci):
+    for b in ci.base_exprs:
+        name = b.id if isinstance(b, ast.Name) else \
+            b.attr if isinstance(b, ast.Attribute) else None
+        if name == "NamedTuple":
+            return True
+        base = idx.resolve_type(ci.module, b)
+        if base is not None and base is not ci and \
+                _is_namedtuple_class(idx, base):
+            return True
+    return False
+
+
+def _buffer_bound_keys(idx, fi):
+    """Dotted keys in ``fi``'s scope bound to a single device-buffer
+    constructor call (jnp.zeros(...) and friends)."""
+    out = set()
+    for sub in ast.walk(fi.node):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+            v = sub.value
+            if isinstance(v, ast.Call) and \
+                    isinstance(v.func, ast.Attribute) and \
+                    v.func.attr in _BUFFER_CTORS and \
+                    _root_name(v.func) in _DEVICE_ROOTS:
+                key = _dotted(sub.targets[0])
+                if key:
+                    out.add(key)
+    if fi.cls is not None:
+        for m in fi.cls.methods.values():
+            for sub in ast.walk(m.node):
+                if isinstance(sub, ast.Assign) and \
+                        len(sub.targets) == 1:
+                    v = sub.value
+                    t = sub.targets[0]
+                    if isinstance(v, ast.Call) and \
+                            isinstance(v.func, ast.Attribute) and \
+                            v.func.attr in _BUFFER_CTORS and \
+                            _root_name(v.func) in _DEVICE_ROOTS and \
+                            isinstance(t, ast.Attribute):
+                        key = _dotted(t)
+                        if key:
+                            out.add(key)
+    return out
+
+
+def _aliased_leaf_findings(idx, fi):
+    out = []
+    mod = fi.module
+    buffers = None
+    for sub in ast.walk(fi.node):
+        if not isinstance(sub, ast.Call):
+            continue
+        fn = sub.func
+        target = None
+        if isinstance(fn, ast.Name):
+            got = idx.resolve_name(mod, fn.id)
+            if got and got[0] == "class":
+                target = got[1]
+        if target is None or not _is_namedtuple_class(idx, target):
+            continue
+        if buffers is None:
+            buffers = _buffer_bound_keys(idx, fi)
+        seen = {}
+        values = list(sub.args) + [kw.value for kw in sub.keywords]
+        for v in values:
+            if isinstance(v, ast.Starred):
+                inner = v.value
+                elt = inner.elt if isinstance(
+                    inner, (ast.GeneratorExp, ast.ListComp)) else None
+                key = _dotted(elt) if elt is not None else None
+                if key is not None and key in buffers:
+                    out.append(Finding(
+                        mod.path, sub.lineno, "RA14",
+                        f"pytree {target.name}(...) splats ONE buffer "
+                        f"binding `{key}` across every leaf — the "
+                        "leaves alias one device buffer, and the "
+                        "donating superstep path rejects a donated "
+                        "buffer appearing twice in an Execute() (the "
+                        "PR 6 shared-zeros() bug); construct one "
+                        "fresh buffer per leaf or mark the line "
+                        "'# ra14-ok: why'", roots=(mod.path,)))
+                continue
+            key = _dotted(v)
+            if key is None:
+                continue
+            if key in seen and key in buffers:
+                out.append(Finding(
+                    mod.path, sub.lineno, "RA14",
+                    f"pytree {target.name}(...) passes buffer binding "
+                    f"`{key}` as two leaves — aliased leaves share one "
+                    "device buffer and trip donation ('donate same "
+                    "buffer twice'); construct one buffer per leaf or "
+                    "mark the line '# ra14-ok: why'",
+                    roots=(mod.path,)))
+            seen[key] = True
+    return out
+
+
+# -- RA15: pytree / sharding / checkpoint schema ---------------------------
+
+def _namedtuple_fields(ci):
+    return [stmt.target.id for stmt in ci.node.body
+            if isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)]
+
+
+def _schema_from_shardings_fn(idx, fi):
+    """The schema class annotating state_shardings' state param."""
+    args = fi.node.args
+    pos = list(args.posonlyargs) + list(args.args)
+    for a in pos:
+        if a.arg in ("self", "mesh"):
+            continue
+        if a.annotation is not None:
+            ci = idx.resolve_type(fi.module, a.annotation)
+            if ci is not None and _is_namedtuple_class(idx, ci):
+                return ci
+    return None
+
+
+def _fields_iteration_present(fn_node):
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, (ast.For, ast.comprehension)):
+            it = sub.iter
+            if isinstance(it, ast.Attribute) and it.attr == "_fields":
+                return True, (sub.target.id if isinstance(
+                    sub.target, ast.Name) else None)
+    return False, None
+
+
+def evaluate_schema(idx):
+    """RAW RA15 findings for all three schema contracts."""
+    out = []
+    schemas = {}   # id(ci) -> (ci, discovered-at module path)
+    for mod in idx.by_path.values():
+        if mod.in_tests or not mod.in_package:
+            continue
+        for fi in mod.func_defs.get("state_shardings", []):
+            ci = _schema_from_shardings_fn(idx, fi)
+            if ci is None:
+                continue
+            schemas.setdefault(id(ci), (ci, mod.path))
+            out.extend(_shardings_coverage_findings(fi, ci))
+    for ci, via in schemas.values():
+        out.extend(_checkpoint_defaults_findings(idx, ci, via))
+    out.extend(_block_staging_findings(idx))
+    uniq = {}
+    for f in out:
+        uniq.setdefault(f.key(), f)
+    return list(uniq.values())
+
+
+def _shardings_coverage_findings(fi, ci):
+    """(a): every schema field covered by the shardings dispatch."""
+    out = []
+    mod = fi.module
+    fields = set(_namedtuple_fields(ci))
+    generic, loop_var = _fields_iteration_present(fi.node)
+    consts = set()
+    kw_names = set()
+    dict_keys = set()
+    for sub in ast.walk(fi.node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            consts.add(sub.value)
+        elif isinstance(sub, ast.Call):
+            for kw in sub.keywords:
+                if kw.arg is not None:
+                    kw_names.add(kw.arg)
+        elif isinstance(sub, ast.Dict):
+            for k in sub.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    dict_keys.add(k.value)
+    if generic:
+        # stale dispatch arms: a by-name special case must name a field
+        if loop_var:
+            for sub in ast.walk(fi.node):
+                if not isinstance(sub, ast.Compare):
+                    continue
+                names = {n.id for n in ast.walk(sub)
+                         if isinstance(n, ast.Name)}
+                if loop_var not in names:
+                    continue
+                for c in ast.walk(sub):
+                    if isinstance(c, ast.Constant) and \
+                            isinstance(c.value, str) and \
+                            c.value not in fields:
+                        out.append(Finding(
+                            mod.path, sub.lineno, "RA15",
+                            f"state_shardings special-cases "
+                            f"{c.value!r}, which is not a field of "
+                            f"{ci.name} — a stale dispatch arm (field "
+                            "renamed/removed without updating the "
+                            "shardings tree-map); drop it or mark the "
+                            "line '# ra15-ok: why'",
+                            roots=(mod.path, ci.module.path)))
+    else:
+        covered = consts | kw_names | dict_keys
+        missing = sorted(fields - covered)
+        if missing:
+            out.append(Finding(
+                mod.path, fi.node.lineno, "RA15",
+                f"state_shardings does not cover {ci.name} field(s) "
+                f"{missing[:6]} — an uncovered pytree field makes "
+                "device_put reject the sharded state one mesh boot "
+                "later (the PR 6 telemetry-field bug); cover every "
+                "field (iterate <Class>._fields for generic coverage) "
+                "or mark the line '# ra15-ok: why'",
+                roots=(mod.path, ci.module.path)))
+    return out
+
+
+def _checkpoint_defaults_findings(idx, ci, via):
+    """(b): the schema module's CHECKPOINT_FIELD_DEFAULTS registry
+    covers every field, and restore() consults it."""
+    out = []
+    mod = ci.module
+    restores = [fi for fi in mod.func_defs.get("restore", [])]
+    if not restores:
+        return out
+    fields = _namedtuple_fields(ci)
+    reg_node = None
+    reg_keys = []
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "CHECKPOINT_FIELD_DEFAULTS" and \
+                isinstance(node.value, ast.Dict):
+            reg_node = node
+            reg_keys = [k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+    roots = (mod.path, via)
+    if reg_node is None:
+        out.append(Finding(
+            mod.path, ci.node.lineno, "RA15",
+            f"{ci.name} has a restore() path but no "
+            "CHECKPOINT_FIELD_DEFAULTS registry — without a per-field "
+            "default, the next pytree field addition strands every "
+            "durable dir behind a checkpoint format bump (the PR 6 "
+            "restore() KeyError); declare the registry or mark the "
+            "line '# ra15-ok: why'", roots=roots))
+        return out
+    missing = sorted(set(fields) - set(reg_keys))
+    stale = sorted(set(reg_keys) - set(fields))
+    if missing:
+        out.append(Finding(
+            mod.path, reg_node.lineno, "RA15",
+            f"CHECKPOINT_FIELD_DEFAULTS is missing {ci.name} "
+            f"field(s) {missing[:6]} — an unregistered field has no "
+            "restore default, so archives written before it existed "
+            "strand their durable dirs; add '<field>: zeros' (or "
+            "'require' for fields every archive has always carried) "
+            "or mark the line '# ra15-ok: why'", roots=roots))
+    if stale:
+        out.append(Finding(
+            mod.path, reg_node.lineno, "RA15",
+            f"CHECKPOINT_FIELD_DEFAULTS names {stale[:6]} which are "
+            f"not fields of {ci.name} — a stale registry entry (field "
+            "renamed/removed); drop it or mark the line "
+            "'# ra15-ok: why'", roots=roots))
+    for fi in restores:
+        # the registry may be consulted by a helper restore() calls —
+        # check the resolvable call closure, not just the def body
+        refs = any(
+            isinstance(n, ast.Name) and
+            n.id == "CHECKPOINT_FIELD_DEFAULTS"
+            for member in idx.closure([fi]).values()
+            for n in ast.walk(member.node))
+        if not refs:
+            out.append(Finding(
+                mod.path, fi.node.lineno, "RA15",
+                f"restore() in {mod.stem}.py does not consult "
+                "CHECKPOINT_FIELD_DEFAULTS — a hand-rolled restore "
+                "path bypasses the schema defaults and re-opens the "
+                "pre-telemetry KeyError class; route missing fields "
+                "through the registry or mark the line "
+                "'# ra15-ok: why'", roots=roots))
+    return out
+
+
+def _block_staging_findings(idx):
+    """(c): every staged superstep-block key has a sharding entry."""
+    out = []
+    dict_keys = set()
+    providers = []
+    for mod in idx.by_path.values():
+        if mod.in_tests:
+            continue
+        for fi in mod.func_defs.get("superstep_block_shardings", []):
+            providers.append(mod.path)
+            for sub in ast.walk(fi.node):
+                if isinstance(sub, ast.Dict):
+                    for k in sub.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            dict_keys.add(k.value)
+    if not providers:
+        return out
+    for mod in idx.by_path.values():
+        if mod.in_tests or not mod.in_package:
+            continue
+        for node in ast.walk(mod.tree):
+            key = None
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                base = _dotted(node.func.value) or ""
+                if "shardings" in base:
+                    key = node.args[0].value
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                base = _dotted(node.value) or ""
+                if "shardings" in base:
+                    key = node.slice.value
+            if key is not None and key not in dict_keys:
+                out.append(Finding(
+                    mod.path, node.lineno, "RA15",
+                    f"staged superstep-block key {key!r} has no entry "
+                    "in superstep_block_shardings — a staged block "
+                    "with no matching sharding repartitions on every "
+                    "dispatch (or device_put rejects it on a mesh); "
+                    "add the entry or mark the line "
+                    "'# ra15-ok: why'", roots=tuple(providers)))
+    return out

@@ -31,7 +31,8 @@ import ast
 import os
 
 __all__ = ["Finding", "CLOSURE_RULES", "evaluate_closure_rules",
-           "TAG_FAMILIES", "family_codes"]
+           "TAG_FAMILIES", "family_codes", "FILE_RULES",
+           "evaluate_file_rules"]
 
 
 class Finding:
@@ -70,6 +71,9 @@ TAG_FAMILIES = (
     ("RA10",),
     ("RA11",),
     ("RA12",),
+    ("RA13",),
+    ("RA14",),
+    ("RA15",),
 )
 
 
@@ -433,3 +437,307 @@ def _enclosing_func(mod, node):
                 if sub is node:
                     return fi
     return None
+
+
+# -- declarative per-file rules (RA05/RA06/RA07, migrated from
+#    tools/lint.py so ONE engine evaluates every rule — ISSUE 15) --------
+
+class FileRule:
+    """A per-file contract: scope (which modules it applies to) + a
+    walker ``check(mod, ctx) -> [Finding]``.  Evaluated over EVERY
+    indexed module (tests exempt per rule), not just lint targets —
+    the same whole-program-pool principle as the closure rules, so a
+    scoped run feeds the audit the same raw findings the full run
+    does."""
+
+    def __init__(self, code, check, basenames=None, all_source=False):
+        self.code = code
+        self.check = check
+        self.basenames = frozenset(basenames) if basenames else None
+        self.all_source = all_source
+
+    def matches(self, mod):
+        if mod.in_tests:
+            return False
+        if self.basenames is not None:
+            return os.path.basename(mod.path) in self.basenames
+        return self.all_source
+
+
+class _FileRuleCtx:
+    """Shared resolution context: doc text and event-registry keys are
+    resolved NEXT TO the checked file first (self-contained fixtures),
+    else from the repo — cached per path."""
+
+    def __init__(self, repo):
+        self.repo = repo
+        self._doc_cache = {}
+        self._keys_cache = {}
+
+    def _read_adjacent(self, path, rel, repo_rel=None):
+        """Text of a collaborator file: the copy NEXT TO the checked
+        file wins (self-contained fixtures), else the repo's canonical
+        location (``repo_rel``, defaulting to ``rel`` off the repo
+        root).  ONE resolution helper — the doc, telemetry-overview
+        and event-registry lookups all ride it (review finding: three
+        hand-rolled copies of the same fallback)."""
+        cand = os.path.join(os.path.dirname(path), *rel)
+        if not os.path.exists(cand) and self.repo:
+            cand = os.path.join(self.repo, *(repo_rel or rel))
+        if not os.path.exists(cand):
+            return None
+        try:
+            with open(cand, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def doc_text(self, path):
+        key = os.path.dirname(path)
+        if key not in self._doc_cache:
+            self._doc_cache[key] = self._read_adjacent(
+                path, ("docs", "OBSERVABILITY.md"))
+        return self._doc_cache[key]
+
+    def telemetry_text(self, path):
+        return self._read_adjacent(path, ("telemetry.py",),
+                                   ("ra_tpu", "telemetry.py"))
+
+    def registry_keys(self, path):
+        """Keys of blackbox.EVENT_REGISTRY (adjacent-first)."""
+        key = os.path.dirname(path)
+        if key in self._keys_cache:
+            return self._keys_cache[key]
+        out = None
+        src = self._read_adjacent(path, ("blackbox.py",),
+                                  ("ra_tpu", "blackbox.py"))
+        if src is not None:
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                tree = None
+            if tree is not None:
+                for node in tree.body:
+                    if isinstance(node, ast.Assign) and \
+                            len(node.targets) == 1 and \
+                            isinstance(node.targets[0], ast.Name) and \
+                            node.targets[0].id == "EVENT_REGISTRY" and \
+                            isinstance(node.value, ast.Dict):
+                        out = {k.value for k in node.value.keys
+                               if isinstance(k, ast.Constant)
+                               and isinstance(k.value, str)}
+        self._keys_cache[key] = out
+        return out
+
+
+def _check_field_registry(mod, ctx):
+    """RA05 — the field-group registry contract (metrics.py): a counter
+    field FIELD_REGISTRY does not list escapes the registry parity
+    test, and one docs/OBSERVABILITY.md does not name is a number
+    nobody can interpret — both flagged at the definition site."""
+    out = []
+    doc_text = ctx.doc_text(mod.path)
+    groups = {}
+    registry_names = set()
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if name.endswith("_FIELDS") and isinstance(node.value, ast.Tuple):
+            fields = [e.value for e in node.value.elts
+                      if isinstance(e, ast.Constant)
+                      and isinstance(e.value, str)]
+            groups[name] = (node, fields)
+        elif name == "FIELD_REGISTRY" and isinstance(node.value, ast.Dict):
+            for v in node.value.values:
+                if isinstance(v, ast.Name):
+                    registry_names.add(v.id)
+    for name, (node, fields) in groups.items():
+        if name not in registry_names:
+            out.append(Finding(
+                mod.path, node.lineno, "RA05",
+                f"counter-field tuple {name} is not listed in "
+                "FIELD_REGISTRY; the registry parity test cannot "
+                "cover it"))
+        if doc_text is not None:
+            missing = [f for f in fields if f"`{f}`" not in doc_text]
+            if missing:
+                out.append(Finding(
+                    mod.path, node.lineno, "RA05",
+                    f"{name} fields undocumented in "
+                    f"docs/OBSERVABILITY.md: {missing[:6]}"))
+    return out
+
+
+def _check_event_registry_use(mod, ctx):
+    """RA06 (emit half) — every string-constant event type passed to
+    the recorder (record(...)/blackbox.record/RECORDER.record) or a
+    module-level tracer site (trace.span/trace.instant) must be a
+    blackbox.EVENT_REGISTRY key.  Tracer OBJECT spans (t.span) are
+    exempt — the registry governs the repo's own instrumentation
+    vocabulary."""
+    keys = ctx.registry_keys(mod.path)
+    if keys is None:
+        return []
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        via = None
+        if isinstance(fn, ast.Name) and fn.id == "record":
+            via = "record"
+        elif isinstance(fn, ast.Attribute) and fn.attr == "record" and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in ("blackbox", "RECORDER"):
+            via = f"{fn.value.id}.record"
+        elif isinstance(fn, ast.Attribute) and \
+                fn.attr in ("span", "instant") and \
+                isinstance(fn.value, ast.Name) and fn.value.id == "trace":
+            via = f"trace.{fn.attr}"
+        if via is None:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value not in keys:
+            out.append(Finding(
+                mod.path, node.lineno, "RA06",
+                f"event type {arg.value!r} emitted via {via}() is not "
+                "in blackbox.EVENT_REGISTRY; register and document it "
+                "(docs/OBSERVABILITY.md) or ra_trace/ra_top cannot "
+                "interpret it"))
+    return out
+
+
+def _check_event_registry_doc(mod, ctx):
+    """RA06 (doc half, blackbox.py only): every EVENT_REGISTRY key must
+    be backticked in docs/OBSERVABILITY.md."""
+    out = []
+    doc_text = ctx.doc_text(mod.path)
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "EVENT_REGISTRY" and \
+                isinstance(node.value, ast.Dict):
+            keys = [k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)]
+            if doc_text is not None:
+                missing = [k for k in keys if f"`{k}`" not in doc_text]
+                if missing:
+                    out.append(Finding(
+                        mod.path, node.lineno, "RA06",
+                        "EVENT_REGISTRY keys undocumented in "
+                        f"docs/OBSERVABILITY.md: {missing[:6]}"))
+    return out
+
+
+def _tunable_knobs(tree):
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "TUNABLE_KNOBS" and \
+                isinstance(node.value, ast.Tuple):
+            return [(node, e.value) for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+    return []
+
+
+def _check_autotune_contract(mod, ctx):
+    """RA07 — the autotuner contract (autotune.py, ISSUE 9): every
+    TUNABLE_KNOBS knob stamped in the engine_pipeline overview
+    (telemetry.py next to the file, else the repo's) and documented;
+    a knob-mutating function without a registered record(...) event is
+    a silent knob turn.  The tick-path no-host-sync half rides the
+    RA04 closure gate."""
+    out = []
+    tree = mod.tree
+    path = mod.path
+    doc_text = ctx.doc_text(path)
+    keys = ctx.registry_keys(path)
+    knobs = _tunable_knobs(tree)
+    knob_names = {k for _n, k in knobs}
+    tel_text = ctx.telemetry_text(path)
+    for node, knob in knobs:
+        if tel_text is not None and f'"{knob}"' not in tel_text \
+                and f"'{knob}'" not in tel_text:
+            out.append(Finding(
+                path, node.lineno, "RA07",
+                f"tunable knob {knob!r} is not stamped in the "
+                "engine_pipeline overview (telemetry.py engine "
+                "source); a knob the overview does not carry turns "
+                "invisibly"))
+        if doc_text is not None and f"`{knob}`" not in doc_text:
+            out.append(Finding(
+                path, node.lineno, "RA07",
+                f"tunable knob {knob!r} undocumented in "
+                "docs/OBSERVABILITY.md"))
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        mutates = None
+        for sub in ast.walk(node):
+            targets = []
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets = [sub.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    base = t.value
+                    name = base.attr if isinstance(base, ast.Attribute) \
+                        else base.id if isinstance(base, ast.Name) \
+                        else None
+                    if name == "knobs":
+                        mutates = sub
+                elif isinstance(t, ast.Attribute) and \
+                        t.attr in knob_names:
+                    mutates = sub
+        if mutates is None:
+            continue
+        recorded = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and sub.args and \
+                    isinstance(sub.args[0], ast.Constant) and \
+                    isinstance(sub.args[0].value, str):
+                fn = sub.func
+                name = fn.id if isinstance(fn, ast.Name) else \
+                    fn.attr if isinstance(fn, ast.Attribute) else None
+                if name == "record" and \
+                        (keys is None or sub.args[0].value in keys):
+                    recorded = True
+        if not recorded:
+            out.append(Finding(
+                path, mutates.lineno, "RA07",
+                f"{node.name}() mutates an autotuner knob without "
+                "emitting a registered record(...) event — silent "
+                "knob turns are unreconstructable (register the "
+                "decision in EVENT_REGISTRY)"))
+    return out
+
+
+FILE_RULES = [
+    FileRule("RA05", _check_field_registry, basenames={"metrics.py"}),
+    FileRule("RA06", _check_event_registry_use, all_source=True),
+    FileRule("RA06", _check_event_registry_doc,
+             basenames={"blackbox.py"}),
+    FileRule("RA07", _check_autotune_contract,
+             basenames={"autotune.py"}),
+]
+
+
+def evaluate_file_rules(idx, repo=None):
+    """RAW findings from the declarative per-file rules over every
+    indexed (non-test) module."""
+    ctx = _FileRuleCtx(repo)
+    out = []
+    for mod in idx.by_path.values():
+        for rule in FILE_RULES:
+            if rule.matches(mod):
+                out.extend(rule.check(mod, ctx))
+    uniq = {}
+    for f in out:
+        uniq.setdefault(f.key(), f)
+    return list(uniq.values())

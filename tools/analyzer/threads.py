@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import ast
 
+from .index import root_name as _root_name
 from .rules import Finding
 
 __all__ = ["evaluate_thread_roles"]
@@ -90,13 +91,6 @@ def _spawn_targets(idx, fi):
             if m is not None:
                 out.append((m, sub.lineno))
     return out
-
-
-def _root_name(expr):
-    """Leftmost Name of a dotted attribute chain, or None."""
-    while isinstance(expr, ast.Attribute):
-        expr = expr.value
-    return expr.id if isinstance(expr, ast.Name) else None
 
 
 def evaluate_thread_roles(idx):

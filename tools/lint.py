@@ -6,10 +6,14 @@ installing tools is off the table.
 Since ISSUE 14 the closure-gated rules are evaluated by the
 whole-program engine in ``tools/analyzer/`` (AST index + CROSS-MODULE
 call graph): a host sync or per-entry pickle moved into a helper one
-file away no longer escapes its gate.  This module keeps the CLI and
-output contract (``path:line: CODE msg`` + ``lint: N files, M
-findings``) and the cheap per-file checks; the engine owns everything
-closure-shaped plus RA11/RA12 and the suppression audit.
+file away no longer escapes its gate.  Since ISSUE 15 the engine also
+gates the JIT PLANE (RA13 trace hazards / RA14 donation lifetime /
+RA15 pytree schema, ``tools/analyzer/jitplane.py``) and evaluates the
+per-file registry rules (RA05/RA06/RA07) as declarative FILE_RULES in
+``tools/analyzer/rules.py``.  This module keeps the CLI and output
+contract (``path:line: CODE msg`` + ``lint: N files, M findings``)
+and the cheap generic checks (syntax/F/B/E/W + RA01/RA03); the engine
+owns every other rule plus the suppression audit.
 
 Checks (cheap, high-signal, zero-config):
 
@@ -99,6 +103,41 @@ Checks (cheap, high-signal, zero-config):
                 (np.asarray of ready values, copy_to_host_async) is
                 the sanctioned pattern; deliberate device ops carry
                 `# ra12-ok: <why>` naming the host-materialized inputs
+  RA13          (package code, tests exempt) trace hazards: inside the
+                harvested TRACED closures (functions reaching jax.jit/
+                pjit entry points and lax.scan/cond/while_loop bodies,
+                incl. through the _build_jit-style wrapper's fn param
+                and subclass overrides of resolved methods), no Python
+                `if`/`while`/`assert` on tracer-typed values, no
+                host-world calls (time.*/random.*/print/open, np.* on
+                traced values), no `.item()`/float()/int()/bool()
+                casts of traced values.  Positional params are tracers;
+                keyword-only params and static_argnames are config.
+                The sanctioned cond_concrete-style concreteness probe
+                carries `# ra13-ok: <why>`
+  RA14          (package code, tests exempt) donation lifetime: at a
+                call site of a donation-enabled jitted callable
+                (jax.jit(..., donate_argnums=...) — direct, or via a
+                factory like _build_jit), reading the donated argument
+                AFTER the call without rebinding is flagged (donated
+                buffers are invalidated); and a NamedTuple pytree
+                construction passing ONE buffer binding as two leaves
+                (or splatting it across all leaves) is the PR 6
+                "donate same buffer twice" bug as a rule.
+                `# ra14-ok: <why>` allowlists
+  RA15          (package code, tests exempt) pytree/sharding/checkpoint
+                schema: the state schema derives from the NamedTuple
+                class annotating state_shardings' state param; (a)
+                every field must be covered by the shardings dispatch
+                (generic `._fields` iteration or by name; stale
+                by-name arms flagged), (b) the schema module's
+                CHECKPOINT_FIELD_DEFAULTS registry must name every
+                field (and nothing else) and restore() must consult
+                it — forward-compat: an old archive restores with
+                declared defaults instead of stranding a durable dir,
+                (c) every staged superstep-block key
+                (shardings.get("n_new")) must exist in
+                superstep_block_shardings.  `# ra15-ok: <why>`
   AUDIT         every `raNN-ok` comment tag on a line its rule family
                 no longer flags is itself an error — allowlists can't
                 rot (tags inside string literals are ignored:
@@ -173,129 +212,6 @@ _LIFECYCLE_VERBS = frozenset({
 _ONE_SHOT_SENDS = frozenset({"send", "remote_call"})
 
 
-#: RA07 — the autotuner contract (files named autotune.py, ISSUE 9):
-#: see the docstring table; the tick-path no-host-sync half rides the
-#: RA04 closure gate in the analyzer engine.
-_AUTOTUNE_FILES = frozenset({"autotune.py"})
-
-
-def _tunable_knobs(tree: ast.Module) -> list:
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
-                isinstance(node.targets[0], ast.Name) and \
-                node.targets[0].id == "TUNABLE_KNOBS" and \
-                isinstance(node.value, ast.Tuple):
-            return [(node, e.value) for e in node.value.elts
-                    if isinstance(e, ast.Constant)
-                    and isinstance(e.value, str)]
-    return []
-
-
-def _check_autotune_contract(tree: ast.Module, err, path: str,
-                             doc_text, keys) -> None:
-    """RA07 (see the docstring table)."""
-    knobs = _tunable_knobs(tree)
-    knob_names = {k for _n, k in knobs}
-    # (a) knob stamping: the engine_pipeline overview lives in
-    # telemetry.py (the Observatory engine source) — prefer one next to
-    # the checked file (self-contained fixtures), else the repo's
-    tel = os.path.join(os.path.dirname(path), "telemetry.py")
-    if not os.path.exists(tel):
-        tel = os.path.join(REPO, "ra_tpu", "telemetry.py")
-    tel_text = None
-    if os.path.exists(tel):
-        with open(tel, encoding="utf-8") as f:
-            tel_text = f.read()
-    for node, knob in knobs:
-        if tel_text is not None and f'"{knob}"' not in tel_text \
-                and f"'{knob}'" not in tel_text:
-            err(node, "RA07",
-                f"tunable knob {knob!r} is not stamped in the "
-                "engine_pipeline overview (telemetry.py engine "
-                "source); a knob the overview does not carry turns "
-                "invisibly")
-        if doc_text is not None and f"`{knob}`" not in doc_text:
-            err(node, "RA07",
-                f"tunable knob {knob!r} undocumented in "
-                "docs/OBSERVABILITY.md")
-    # (b) no silent knob turns: a knob-mutating function must record a
-    # registered event
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        mutates = None
-        for sub in ast.walk(node):
-            targets = []
-            if isinstance(sub, ast.Assign):
-                targets = sub.targets
-            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
-                targets = [sub.target]
-            for t in targets:
-                if isinstance(t, ast.Subscript):
-                    base = t.value
-                    name = base.attr if isinstance(base, ast.Attribute) \
-                        else base.id if isinstance(base, ast.Name) else None
-                    if name == "knobs":
-                        mutates = sub
-                elif isinstance(t, ast.Attribute) and \
-                        t.attr in knob_names:
-                    mutates = sub
-        if mutates is None:
-            continue
-        recorded = False
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Call) and sub.args and \
-                    isinstance(sub.args[0], ast.Constant) and \
-                    isinstance(sub.args[0].value, str):
-                fn = sub.func
-                name = fn.id if isinstance(fn, ast.Name) else \
-                    fn.attr if isinstance(fn, ast.Attribute) else None
-                if name == "record" and \
-                        (keys is None or sub.args[0].value in keys):
-                    recorded = True
-        if not recorded:
-            err(mutates, "RA07",
-                f"{node.name}() mutates an autotuner knob without "
-                "emitting a registered record(...) event — silent "
-                "knob turns are unreconstructable (register the "
-                "decision in EVENT_REGISTRY)")
-
-
-#: RA05 — the field-group registry contract (metrics.py): a counter
-#: field that FIELD_REGISTRY does not list escapes the registry parity
-#: test, and one docs/OBSERVABILITY.md does not name is a number nobody
-#: can interpret — both are flagged at the definition site.
-def _check_field_registry(tree: ast.Module, err, doc_text) -> None:
-    groups: dict = {}
-    registry_names: set = set()
-    for node in tree.body:
-        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)):
-            continue
-        name = node.targets[0].id
-        if name.endswith("_FIELDS") and isinstance(node.value, ast.Tuple):
-            fields = [e.value for e in node.value.elts
-                      if isinstance(e, ast.Constant)
-                      and isinstance(e.value, str)]
-            groups[name] = (node, fields)
-        elif name == "FIELD_REGISTRY" and isinstance(node.value, ast.Dict):
-            for v in node.value.values:
-                if isinstance(v, ast.Name):
-                    registry_names.add(v.id)
-    for name, (node, fields) in groups.items():
-        if name not in registry_names:
-            err(node, "RA05",
-                f"counter-field tuple {name} is not listed in "
-                "FIELD_REGISTRY; the registry parity test cannot "
-                "cover it")
-        if doc_text is not None:
-            missing = [f for f in fields if f"`{f}`" not in doc_text]
-            if missing:
-                err(node, "RA05",
-                    f"{name} fields undocumented in "
-                    f"docs/OBSERVABILITY.md: {missing[:6]}")
-
-
 #: RA03 — durability-bearing I/O calls: an exception from one of these
 #: inside the log layer carries a durability verdict and must never be
 #: swallowed bare (fsyncgate: a confirmed write whose fsync error was
@@ -346,89 +262,6 @@ def _check_log_io_swallow(tree: ast.Module, err) -> None:
                     "'# ra03-ok: why' with a DISK_FAULT_FIELDS counter")
 
 
-#: RA06 — the event-type registry contract (ISSUE 7): an event type
-#: the registry does not know cannot be interpreted by ra_trace, the
-#: ra_top incident footer, or the docs — flagged at the emit site.
-#: Tests are exempt (fixtures emit throwaway span names); the real
-#: instrumentation lives in ra_tpu/ and tools/.
-
-def _event_registry_keys(path: str):
-    """Keys of blackbox.EVENT_REGISTRY: prefer a ``blackbox.py`` next
-    to the checked file (self-contained fixtures), else the repo's."""
-    cand = os.path.join(os.path.dirname(path), "blackbox.py")
-    if not os.path.exists(cand):
-        cand = os.path.join(REPO, "ra_tpu", "blackbox.py")
-    if not os.path.exists(cand):
-        return None
-    try:
-        with open(cand, encoding="utf-8") as f:
-            tree = ast.parse(f.read())
-    except (OSError, SyntaxError):
-        return None
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
-                isinstance(node.targets[0], ast.Name) and \
-                node.targets[0].id == "EVENT_REGISTRY" and \
-                isinstance(node.value, ast.Dict):
-            return {k.value for k in node.value.keys
-                    if isinstance(k, ast.Constant)
-                    and isinstance(k.value, str)}
-    return None
-
-
-def _check_event_registry_use(tree: ast.Module, err, keys: set) -> None:
-    """RA06: every string-constant event type passed to the recorder
-    (``record(...)``, ``blackbox.record``, ``RECORDER.record``) or to a
-    module-level tracer site (``trace.span``/``trace.instant``) must be
-    a registry key.  Tracer OBJECT spans (``t.span``) are exempt — user
-    code may span whatever it likes; the registry governs the repo's
-    own instrumentation vocabulary."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not node.args:
-            continue
-        fn = node.func
-        via = None
-        if isinstance(fn, ast.Name) and fn.id == "record":
-            via = "record"
-        elif isinstance(fn, ast.Attribute) and fn.attr == "record" and \
-                isinstance(fn.value, ast.Name) and \
-                fn.value.id in ("blackbox", "RECORDER"):
-            via = f"{fn.value.id}.record"
-        elif isinstance(fn, ast.Attribute) and \
-                fn.attr in ("span", "instant") and \
-                isinstance(fn.value, ast.Name) and fn.value.id == "trace":
-            via = f"trace.{fn.attr}"
-        if via is None:
-            continue
-        arg = node.args[0]
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
-                and arg.value not in keys:
-            err(node, "RA06",
-                f"event type {arg.value!r} emitted via {via}() is not "
-                "in blackbox.EVENT_REGISTRY; register and document it "
-                "(docs/OBSERVABILITY.md) or ra_trace/ra_top cannot "
-                "interpret it")
-
-
-def _check_event_registry_doc(tree: ast.Module, err, doc_text) -> None:
-    """RA06 (doc half, blackbox.py only): every EVENT_REGISTRY key must
-    be named (backticked) in docs/OBSERVABILITY.md."""
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
-                isinstance(node.targets[0], ast.Name) and \
-                node.targets[0].id == "EVENT_REGISTRY" and \
-                isinstance(node.value, ast.Dict):
-            keys = [k.value for k in node.value.keys
-                    if isinstance(k, ast.Constant)
-                    and isinstance(k.value, str)]
-            if doc_text is not None:
-                missing = [k for k in keys if f"`{k}`" not in doc_text]
-                if missing:
-                    err(node, "RA06",
-                        "EVENT_REGISTRY keys undocumented in "
-                        f"docs/OBSERVABILITY.md: {missing[:6]}")
-
-
 def _check_lifecycle_rpc(tree: ast.Module, err) -> None:
     """RA01: inside lifecycle verbs, forbid direct one-shot transport
     calls (they must go through the reliable RPC layer)."""
@@ -473,47 +306,10 @@ def check_file(path: str) -> list:
         _check_lifecycle_rpc(tree, err)
     if os.path.basename(os.path.dirname(path)) == "log":
         _check_log_io_swallow(tree, err)
-    if os.path.basename(path) in _AUTOTUNE_FILES:
-        doc = os.path.join(os.path.dirname(path), "docs",
-                           "OBSERVABILITY.md")
-        if not os.path.exists(doc):
-            doc = os.path.join(REPO, "docs", "OBSERVABILITY.md")
-        doc_text = None
-        if os.path.exists(doc):
-            with open(doc, encoding="utf-8") as fdoc:
-                doc_text = fdoc.read()
-        _check_autotune_contract(tree, err, path, doc_text,
-                                 _event_registry_keys(path))
-    if os.path.basename(path) == "blackbox.py":
-        doc = os.path.join(os.path.dirname(path), "docs",
-                           "OBSERVABILITY.md")
-        if not os.path.exists(doc):
-            doc = os.path.join(REPO, "docs", "OBSERVABILITY.md")
-        doc_text = None
-        if os.path.exists(doc):
-            with open(doc, encoding="utf-8") as fdoc:
-                doc_text = fdoc.read()
-        _check_event_registry_doc(tree, err, doc_text)
-    parts = set(os.path.normpath(path).split(os.sep))
-    in_tests = "tests" in parts or \
-        os.path.basename(path).startswith("test_")
-    if not in_tests:
-        keys = _event_registry_keys(path)
-        if keys is not None:
-            _check_event_registry_use(tree, err, keys)
-    if os.path.basename(path) == "metrics.py":
-        # the documented-field half of RA05 reads the observability
-        # registry doc: prefer one next to the checked file (self-
-        # contained fixtures), else the repo's
-        doc = os.path.join(os.path.dirname(path), "docs",
-                           "OBSERVABILITY.md")
-        if not os.path.exists(doc):
-            doc = os.path.join(REPO, "docs", "OBSERVABILITY.md")
-        doc_text = None
-        if os.path.exists(doc):
-            with open(doc, encoding="utf-8") as fdoc:
-                doc_text = fdoc.read()
-        _check_field_registry(tree, err, doc_text)
+    # RA05 (field registry), RA06 (event registry) and RA07 (autotuner
+    # knob contract) are evaluated by the analyzer engine's declarative
+    # FILE_RULES (tools/analyzer/rules.py) since ISSUE 15 — one engine
+    # owns every rule; this module keeps the cheap generic checks.
 
     # -- F401: unused module-level imports ------------------------------
     if os.path.basename(path) != "__init__.py":

@@ -1,0 +1,19 @@
+#!/bin/sh
+# Pre-commit gate (ISSUE 15 satellite): the fast local loop.
+#
+#   tools/precommit.sh            # lint what changed + the gate tests
+#
+# 1. `tools/lint.py --changed` lints only files differing from HEAD
+#    (staged, unstaged, untracked) — the whole-program engine still
+#    indexes the full tree, so cross-module closures and allowlist
+#    tags resolve exactly as in the full run; only REPORTING is scoped.
+# 2. `pytest tests/test_static_gates.py` runs the full gate suite
+#    (rule fixtures + clean pins + the analyzer runtime budget).
+#
+# Exit nonzero on any finding or test failure.  The full-tree lint
+# (`python tools/lint.py`, ~8s) is what CI runs; this script is the
+# subset worth paying before every commit.
+set -e
+cd "$(dirname "$0")/.."
+python tools/lint.py --changed
+exec python -m pytest tests/test_static_gates.py -q
