@@ -493,6 +493,10 @@ def _child_main() -> None:
     if sampler is not None:
         sampler.drain()  # run-end barrier, after measurement
     overview = eng.overview()
+    # device-plane tail (ISSUE 16): process-lifetime compile/transfer/
+    # watermark totals — bench_diff flags round-over-round n_compiles
+    # growth as a retrace regression
+    from ra_tpu import devicewatch
     print(json.dumps({
         "value": round(value, 1),
         "committed": int(committed),
@@ -540,6 +544,7 @@ def _child_main() -> None:
         # opt-in autotuner's decisions/knobs
         **({"slo": slo.evaluate()} if slo is not None else {}),
         **({"autotune": tuner.overview()} if tuner is not None else {}),
+        **devicewatch.bench_tail_keys(commands=int(committed)),
     }))
     sys.stdout.flush()
     # join the WAL plane's worker/supervisor threads before interpreter
@@ -889,6 +894,7 @@ def _multichip_main() -> None:
                   file=sys.stderr)
     ok = [r for r in rows if r["meets_p99_bar"]]
     best = max(ok or rows, key=lambda r: r["value"])
+    from ra_tpu import devicewatch
     print(json.dumps({
         "value": best["value"],
         "best_point": {"mesh": best["mesh"], "lanes": best["lanes"]},
@@ -901,6 +907,11 @@ def _multichip_main() -> None:
         "r05_2x4_cmds_per_s": R05_2X4_CMDS_PER_S,
         "platform": devices[0].platform,
         "host": _host_meta(),
+        # the sweep's whole-process compile budget: every frontier point
+        # reuses the jit cache, so n_compiles growing with the ladder
+        # length (instead of with the distinct-config count) is the
+        # retrace regression bench_diff flags
+        **devicewatch.bench_tail_keys(),
     }))
 
 
@@ -1183,6 +1194,21 @@ def _run_child(env_extra: dict, timeout_s: float):
     return None
 
 
+#: the device-plane bench-tail keys (ISSUE 16, devicewatch.bench_tail_keys)
+_DEVICE_TAIL_KEYS = ("n_compiles", "n_recompiles", "compile_time_s",
+                     "transfer_bytes", "transfer_bytes_per_cmd",
+                     "peak_live_bytes")
+
+
+def _promote_device_keys(child_row: dict) -> dict:
+    """Copy the device-plane tail keys from the child whose ``value``
+    becomes the parent headline onto the parent line itself — counters
+    are per-PROCESS, so the parent (which never dispatches) must
+    promote the measuring child's stamp for bench_diff to compare
+    headline rows across rounds."""
+    return {k: child_row[k] for k in _DEVICE_TAIL_KEYS if k in child_row}
+
+
 def _probe_platform() -> str | None:
     """Return the default jax platform, or None if backend init hangs/fails.
     Runs in a subprocess so a dead axon tunnel cannot hang the parent."""
@@ -1320,6 +1346,7 @@ def main() -> None:
                 "value": value,
                 "unit": "cmds/s",
                 "vs_baseline": round(value / BASELINE, 4),
+                **_promote_device_keys(best),
                 "detail": detail,
             }))
             return
@@ -1397,6 +1424,7 @@ def main() -> None:
             "unit": "cmds/s",
             "error": "tpu_unavailable",
             "vs_baseline": round(res["value"] / BASELINE, 4),
+            **_promote_device_keys(res),
             "detail": detail,
         }))
     else:

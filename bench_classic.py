@@ -434,12 +434,17 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — contract: always JSON
             detail["errors"][name] = repr(exc)[:500]
     value = (detail.get("tcp") or detail.get("local") or {}).get("value", 0.0)
+    # device-plane tail (ISSUE 16): the classic plane is host-only, so
+    # these stamp as zeros on purpose — a nonzero n_compiles here means
+    # something dragged jit dispatch into the classic path
+    from ra_tpu import devicewatch
     print(json.dumps({
         "metric": "classic_node_committed_cmds_per_sec",
         "value": value,
         "unit": "cmds/s",
         "vs_baseline": round(value / TARGET, 4),
         "detail": detail,
+        **devicewatch.bench_tail_keys(),
     }))
 
 

@@ -86,6 +86,11 @@ FSYNC_BOUND_PHASES = ("fsync_wait", "confirm_publish")
 DEFAULT_COOLDOWN_WINDOWS = 3
 DEFAULT_BREACH_WINDOWS = 2
 DEFAULT_INCIDENT_FREEZE_S = 30.0
+#: decision freeze horizon after the device-plane compile counter
+#: moves (ISSUE 16): a knob change that triggers recompilation must
+#: not be read as a latency regression mid-compile — the retraced
+#: variant's warm windows need to flush through the ring first
+DEFAULT_COMPILE_FREEZE_S = 10.0
 
 
 def default_freeze_guard() -> Optional[str]:
@@ -116,6 +121,7 @@ class AutoTuner:
                  cooldown_windows: int = DEFAULT_COOLDOWN_WINDOWS,
                  breach_windows: int = DEFAULT_BREACH_WINDOWS,
                  incident_freeze_s: float = DEFAULT_INCIDENT_FREEZE_S,
+                 compile_freeze_s: float = DEFAULT_COMPILE_FREEZE_S,
                  freeze_guard: Callable[[], Optional[str]] =
                  default_freeze_guard,
                  apply: Optional[dict] = None) -> None:
@@ -140,6 +146,12 @@ class AutoTuner:
         self.cooldown_windows = max(0, int(cooldown_windows))
         self.breach_windows = max(1, int(breach_windows))
         self.incident_freeze_s = float(incident_freeze_s)
+        self.compile_freeze_s = float(compile_freeze_s)
+        #: compile-storm state: the devicewatch compile count last seen
+        #: (None until the first tick baselines it — warm-up compiles
+        #: that happened before the controller existed are not a storm)
+        self._compiles_seen: Optional[int] = None
+        self._compile_quiet_until = 0.0
         self._freeze_guard = freeze_guard
         self._apply_hooks = dict(apply or {})
         self._breach_streak: dict = {}
@@ -164,6 +176,29 @@ class AutoTuner:
         if inc is not None and \
                 time.time() - inc.get("ts", 0.0) < self.incident_freeze_s:
             return "recent_incident"
+        return self._compile_storm_reason()
+
+    def _compile_storm_reason(self) -> Optional[str]:
+        """Freeze while the device plane is (re)compiling (ISSUE 16):
+        when the recompile sentinel's compile counter moves between
+        ticks, decisions suspend for ``compile_freeze_s`` — the
+        windows spanning a compile carry its wall time as latency and
+        must not be chased with knob turns.  Host dict reads only (the
+        tick path is RA04-gated)."""
+        try:
+            from .devicewatch import WATCH
+            seen = WATCH.counters["compiles"]
+        except Exception:  # noqa: BLE001 — devicewatch unavailable
+            return None
+        if self._compiles_seen is None:
+            self._compiles_seen = seen
+            return None
+        if seen > self._compiles_seen:
+            self._compiles_seen = seen
+            self._compile_quiet_until = time.time() + self.compile_freeze_s
+            return "compile_storm"
+        if time.time() < self._compile_quiet_until:
+            return "compile_storm"
         return None
 
     # -- phase attribution -------------------------------------------------

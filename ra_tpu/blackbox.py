@@ -137,6 +137,15 @@ EVENT_REGISTRY = {
                  "into a wire shed episode)",
     "wire.error": "protocol error (bad hello/version/record) closed "
                   "a connection",
+    # -- device plane (ra_tpu/devicewatch.py, ISSUE 16) ----------------
+    "device.recompile": "recompile sentinel caught a steady-state "
+                        "retrace of a wrapped jit entry point (fn tag "
+                        "+ which argument's shape/dtype/sharding "
+                        "drifted + compile wall ms)",
+    "profile.captured": "a jax_profile() capture finished; the profile "
+                        "dir rides along so the capture shows up in "
+                        "ra_trace timelines instead of being a side "
+                        "file nobody finds",
     # -- recorder meta -------------------------------------------------
     "bb.dump": "post-mortem bundle written",
     "bb.recover": "recovery stamped a join-able recovery report",

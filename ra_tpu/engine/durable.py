@@ -69,7 +69,7 @@ from typing import Optional
 
 import numpy as np
 
-from .. import trace
+from .. import devicewatch, trace
 from ..blackbox import RECORDER, record, stamp_recovery
 from ..log import faults
 from ..log.wal import Wal, WalDown, scan_wal_file
@@ -377,6 +377,15 @@ class _WalShard:
                                            n_acc.nbytes + n_s * k * item)
             ctr["encoded_blocks"] += 1
             ctr["encoded_bytes"] += len(blk)
+            # transfer-ledger mirror (ISSUE 16): the WAL encode pull is
+            # the third d2h budget line of a durable dispatch loop —
+            # same bytes as readback_bytes, attributed per site so the
+            # device plane's ledger is complete (host int increments
+            # only; RA12: no device work on this worker thread)
+            devicewatch.record_d2h(
+                "wal_readback",
+                hi.nbytes + n_app.nbytes + n_acc.nbytes +
+                csum.nbytes + flat.nbytes)
             self._appended[step] = hi
             self._blocks[step] = blk
             self._bases[step] = base

@@ -49,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import trace
+from .. import devicewatch, trace
 from ..blackbox import record
 from ..core.machine import JitMachine
 from ..metrics import ENGINE_PIPELINE_FIELDS, TELEMETRY_FIELDS
@@ -725,9 +725,13 @@ def telemetry_summary_fn(top_k: int = 8, hist_buckets: int = 16,
     key = (top_k, hist_buckets, stall_threshold)
     fn = _SUMMARY_JIT_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(functools.partial(
+        # recompile-sentinel wrap (ISSUE 16): the proxy lives in the
+        # cache next to the jitted fn, so samplers sharing a geometry
+        # share one compile count — a retrace of the summary path is
+        # as much a steady-state bug as one of the step path
+        fn = devicewatch.wrap_jit(jax.jit(functools.partial(
             _telemetry_summary, top_k=top_k, hist_buckets=hist_buckets,
-            stall_threshold=stall_threshold))
+            stall_threshold=stall_threshold)), "summary")
         _SUMMARY_JIT_CACHE[key] = fn
     return fn
 
@@ -845,11 +849,21 @@ class LockstepEngine:
                                 if k not in ("machine", "quorum_fn"))))
             jitted = _STEP_JIT_CACHE.get(key)
             if jitted is None:
-                jitted = jax.jit(partial,
-                                 donate_argnums=(0,) if donate else ())
+                # recompile-sentinel wrap (ISSUE 16): the sentinel
+                # proxy is stored IN the cache next to the jitted fn,
+                # so same-config engines share one compile count and a
+                # cache hit costs no extra wrapping.  The proxy itself
+                # is never traced (it wraps the jit OUTPUT) — RA13's
+                # static guarantee is untouched; this is its runtime
+                # mirror.
+                jitted = devicewatch.wrap_jit(
+                    jax.jit(partial,
+                            donate_argnums=(0,) if donate else ()),
+                    tag)
                 _STEP_JIT_CACHE[key] = jitted
             return jitted
-        return jax.jit(partial, donate_argnums=(0,) if donate else ())
+        return devicewatch.wrap_jit(
+            jax.jit(partial, donate_argnums=(0,) if donate else ()), tag)
 
     def _compile_step(self, durable: bool) -> None:
         self._step = self._build_jit(_step, durable, self._donate, "step")
@@ -1384,6 +1398,10 @@ class LockstepEngine:
             tc.copy_to_host_async()
         except AttributeError:  # pragma: no cover — older jax arrays
             pass
+        # transfer ledger (ISSUE 16): one d2h copy starts here —
+        # counted at copy START, so an awaited handle is never counted
+        # twice (.nbytes is host metadata, no sync)
+        devicewatch.record_d2h("lanes_async", tc.nbytes)
         return tc
 
     def machine_states(self) -> Any:
@@ -1485,6 +1503,12 @@ class DispatchAheadDriver:
         # the host pays, not the wire time — rule RA04: no sync here)
         self.engine.phases.note("host_staging", time.monotonic() - t0)
         self.engine.pipeline_counters["blocks_staged"] += 1
+        # transfer ledger (ISSUE 16): the steady-state loop's h2d
+        # budget is exactly these two staged blocks per submit —
+        # measured here so the "fixed per-window transfer budget" is a
+        # number, not an RA04 lint promise (.nbytes = host metadata)
+        devicewatch.record_h2d("driver_stage", n.nbytes + p.nbytes,
+                               events=2)
         self._staged = (n, p, elect_blk)
 
     def submit(self, n_new_blk, payloads_blk, elect_blk=None):
@@ -1505,6 +1529,10 @@ class DispatchAheadDriver:
             h.copy_to_host_async()
         except AttributeError:  # pragma: no cover — older jax arrays
             pass
+        # transfer ledger (ISSUE 16): one watermark readback per
+        # dispatch, counted at copy start (the window-boundary pop
+        # below observes the SAME copy — never double-counted)
+        devicewatch.record_d2h("driver_watermark", h.nbytes)
         self._handles.append((t_sub, h))
         while len(self._handles) > self.max_in_flight:
             # window boundary: await the OLDEST dispatch's watermark.

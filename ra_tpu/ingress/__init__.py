@@ -331,7 +331,12 @@ class IngressPlane:
     def bench_row(self, elapsed_s: float) -> dict:
         """A bench/soak tail row carrying the ingress regression keys
         tools/bench_diff.py compares (``ingress_cmds_per_s`` higher-is-
-        better, ``ingress_shed_rate`` lower-is-better)."""
+        better, ``ingress_shed_rate`` lower-is-better), plus the
+        device-plane stamp (ISSUE 16): the ingress pump is one of the
+        four steady-state dispatch loops, so its tail carries
+        ``n_compiles``/``compile_time_s``/``transfer_bytes``/
+        ``peak_live_bytes`` like the engine bench tails."""
+        from .. import devicewatch
         c = self.counters
         accepted = c["accepted"]
         submitted = max(1, c["submitted"])
@@ -343,4 +348,5 @@ class IngressPlane:
             "ingress_submitted": c["submitted"],
             "ingress_dup_dropped": c["dup_dropped"],
             "elapsed_s": elapsed_s,
+            **devicewatch.bench_tail_keys(commands=accepted),
         }

@@ -253,6 +253,33 @@ CLASSIC_FIELDS = (
     "records_per_fsync",
 )
 
+#: device-plane runtime observatory (ra_tpu/devicewatch.py, ISSUE 16):
+#: one process-wide dict behind the ``WATCH`` singleton, the runtime
+#: mirror of the jit-plane static gates (RA04/RA13/RA14 are proof-only
+#: — these fields are the measurement).  Recompile sentinel:
+#: ``compiles`` counts XLA compiles observed across every wrapped jit
+#: entry point (lockstep step/superstep, telemetry summary — warm-up
+#: compiles land here), ``recompiles`` the subset BEYOND the first per
+#: wrapped callable (a retrace: steady-state MUST stay 0, the runtime
+#: twin of RA13, and the ``steady_state_recompiles`` SLO objective),
+#: ``compile_ms`` cumulative wall time of compiling calls.  Transfer
+#: ledger (the measured number behind RA04's lint promise):
+#: ``h2d_events``/``h2d_bytes`` host->device transfers (driver block
+#: staging, mesh state sharding), ``d2h_events``/``d2h_bytes``
+#: device->host readbacks (driver window readbacks, telemetry
+#: harvests, WAL encode readbacks).  Memory watermarks (sampled on the
+#: TelemetrySampler harvest tick — zero new syncs): ``live_buffers``/
+#: ``live_bytes`` gauges of live device buffers at the last sample,
+#: ``peak_live_bytes`` the high-water mark, ``buffers_freed``
+#: cumulative buffer releases observed between samples (donation
+#: effectiveness, the runtime twin of RA14), ``watermark_samples``
+#: samples taken.
+DEVICE_FIELDS = (
+    "compiles", "recompiles", "compile_ms", "h2d_events", "h2d_bytes",
+    "d2h_events", "d2h_bytes", "live_buffers", "live_bytes",
+    "peak_live_bytes", "buffers_freed", "watermark_samples",
+)
+
 #: the complete field-group registry (rule RA05): every counter-field
 #: tuple in this module MUST be listed here, covered by the registry
 #: parity test (tests/test_telemetry.py) and documented in
@@ -276,6 +303,7 @@ FIELD_REGISTRY = {
     "ingress": INGRESS_FIELDS,
     "wire": WIRE_FIELDS,
     "classic": CLASSIC_FIELDS,
+    "device": DEVICE_FIELDS,
 }
 
 

@@ -27,6 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import devicewatch
 from ..engine.lockstep import DispatchAheadDriver, LaneState
 
 
@@ -115,6 +116,18 @@ def shard_engine_state(engine, mesh: Optional[Mesh] = None):
     engine._zero_fail = jax.device_put(
         engine._zero_fail, NamedSharding(mesh, P("lanes", "members")))
     engine._mesh = mesh
+    # transfer ledger (ISSUE 16): the one-time resharding of the full
+    # state pytree + zero masks is the mesh path's h2d budget — it
+    # must show up ONCE at shard time, never again per dispatch (a
+    # per-window h2d delta at this site is the repartition bug RA15
+    # guards statically).  .nbytes reads are host metadata.
+    devicewatch.record_h2d(
+        "mesh_shard",
+        sum(getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree.leaves(engine.state))
+        + engine._zero_elect.nbytes + engine._zero_confirm.nbytes
+        + engine._zero_fail.nbytes,
+        events=len(jax.tree.leaves(engine.state)) + 3)
     return mesh
 
 

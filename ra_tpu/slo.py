@@ -78,8 +78,14 @@ def default_objectives(*, commit_p99_ms: float = 25.0,
                        min_cmds_per_s: float = 1000.0) -> tuple:
     """The standard lane-engine objective set (docs/OBSERVABILITY.md
     "SLOs"): commit latency from the always-on phase attribution,
-    fsync latency from the per-shard WAL stats, and a throughput floor
-    rated from the device telemetry's committed counter."""
+    fsync latency from the per-shard WAL stats, a throughput floor
+    rated from the device telemetry's committed counter, and the
+    device-plane compile-stability pin (ISSUE 16): a warm dispatch
+    loop must not retrace, so the recompile-sentinel counter's rate
+    over any window must stay 0 — the runtime twin of static gate
+    RA13.  Absent devicewatch wiring the key never appears and the
+    objective reads ``no_data`` (which is ok), so classic-plane
+    deployments are unaffected."""
     return (
         Objective("commit_p99_ms",
                   "engine_phases_commit_e2e_p99_ms", "<=", commit_p99_ms),
@@ -88,6 +94,8 @@ def default_objectives(*, commit_p99_ms: float = 25.0,
         Objective("cmds_per_s",
                   "engine_telemetry_committed_total", ">=",
                   min_cmds_per_s, kind="rate", agg="sum"),
+        Objective("steady_state_recompiles",
+                  "device_recompiles", "<=", 0.0, kind="rate"),
     )
 
 

@@ -38,7 +38,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
-from . import trace
+from . import devicewatch, trace
 
 logger = logging.getLogger("ra_tpu.telemetry")
 
@@ -51,6 +51,14 @@ DEFAULT_CADENCE_STEPS = 64
 #: a lane is flagged STALLED once it has sat this many consecutive
 #: rounds with a commit backlog and zero commit progress
 DEFAULT_STALL_THRESHOLD = 8
+
+#: minimum seconds between device-memory censuses on the harvest tick
+#: (ISSUE 16): jax.live_arrays() is O(live buffers), so in a
+#: buffer-heavy process an every-harvest walk would tax the loop the
+#: watermarks exist to observe — 4 Hz bounds the walk while staying
+#: far inside any human-scale observation window.  A sampler's FIRST
+#: harvest censuses eagerly so short runs and tests always get one.
+CENSUS_MIN_INTERVAL_S = 0.25
 
 #: log2 millisecond buckets for phase histograms: bucket 0 = <1ms,
 #: bucket b = < 2^b ms, last bucket absorbs the tail (~9 hours)
@@ -168,6 +176,8 @@ class TelemetrySampler:
                                         self.stall_threshold)
         self._pending: collections.deque = collections.deque()
         self._steps_since = 0
+        #: first harvest censuses device memory eagerly (ISSUE 16)
+        self._censused = False
         #: newest harvested snapshot (plain dict), or None
         self.last: Optional[dict] = None
         #: sampler health (host ints): ``samples_started`` device
@@ -205,6 +215,14 @@ class TelemetrySampler:
                 v.copy_to_host_async()
             except AttributeError:  # pragma: no cover — older jax arrays
                 pass
+        # transfer ledger (ISSUE 16): the telemetry harvest IS the
+        # steady-state loop's other d2h budget line — one async copy
+        # per summary value, counted at copy start (.nbytes = host
+        # metadata, no sync; rule RA04 gates this path)
+        devicewatch.record_d2h(
+            "sampler_harvest",
+            sum(getattr(v, "nbytes", 0) for v in out.values()),
+            events=len(out))
         self.counters["samples_started"] += 1
         self._pending.append((time.time(),
                               self.engine.pipeline_counters["inner_steps"],
@@ -238,6 +256,16 @@ class TelemetrySampler:
             snap["stall_threshold"] = self.stall_threshold
             self.last = snap
             self.counters["samples_harvested"] += 1
+            # device-memory watermarks ride THIS tick (ISSUE 16): the
+            # harvest cadence is the one host-side rhythm the dispatch
+            # loop already pays for, and the census is pure metadata
+            # (jax.live_arrays + .nbytes) — zero new syncs, see
+            # docs/INTERNALS.md.  Eager on the sampler's first
+            # harvest, then throttled: the walk is O(live buffers)
+            if devicewatch.sample_watermarks(
+                    0.0 if not self._censused
+                    else CENSUS_MIN_INTERVAL_S):
+                self._censused = True
             self._feed_tracer(snap)
             for fn in self._observers:
                 # observability must never crash the plane it observes:
@@ -387,6 +415,14 @@ class Observatory:
             # flow gauges as their own source, so ring keys read
             # ``ingress_<field>`` (the SLO/bench_diff namespace)
             obs.add_source("ingress", ing.overview)
+        # the device plane (ISSUE 16): recompile sentinel + transfer
+        # ledger + memory watermarks as their own source — ring keys
+        # read ``device_<field>`` (DEVICE_FIELDS; the namespace the
+        # ``steady_state_recompiles`` SLO objective and bench_diff's
+        # compile/transfer keys resolve against).  Process-wide on
+        # purpose: compiles and live buffers are process facts, not
+        # per-engine ones.
+        obs.add_source("device", devicewatch.WATCH.overview)
         cls._wire_host_sources(obs, system, counters, router)
         return obs
 
@@ -475,6 +511,13 @@ class Observatory:
         "submitted", "_accepted", "dup_dropped", "slow_signals",
         "_deferred", "_rejected", "shed_rows", "blocks_built",
         "block_rows", "reconnects", "credits_released",
+        # device plane (ISSUE 16) — "compiles" also anchors
+        # device_recompiles (the steady_state_recompiles SLO rate).
+        # device_live_buffers stays an un-hinted gauge; live_bytes is
+        # swallowed by the "bytes" infix, which only omits its
+        # negative drift from rates — the gauge VALUE in snapshots is
+        # untouched (rates of a census gauge are not a signal anyway)
+        "compiles", "compile_ms", "_freed", "_samples",
     )
     _MONOTONE_INFIXES = (
         "bytes", "samples_", "encoded_", "readback_", "rpc_",

@@ -205,8 +205,18 @@ def jax_profile(log_dir: str) -> Iterator[None]:
     """Capture an XLA profiler trace (TensorBoard/XProf format) around
     the with-body — the device-timeline analogue of the reference's
     looking_glass hooks (ra_bench.erl:199-212).  Requires a live jax
-    backend; safe to nest around engine steps."""
+    backend; safe to nest around engine steps.
+
+    The capture is stamped into the flight recorder on exit
+    (``profile.captured`` + the profile dir), so a bench-time capture
+    shows up in ra_trace timelines next to the events it covers
+    instead of being a side file nobody finds (ISSUE 16)."""
     import jax
 
+    from .blackbox import record
+
+    t0 = time.perf_counter()
     with jax.profiler.trace(log_dir):
         yield
+    record("profile.captured", dir=str(log_dir),
+           wall_s=round(time.perf_counter() - t0, 3))

@@ -16,4 +16,19 @@ def host_envelope() -> dict:
             resource.getrlimit(resource.RLIMIT_NOFILE)[0]
     except Exception:  # noqa: BLE001 — optional on exotic platforms
         pass
+    # jax/jaxlib versions + backend platform (ISSUE 16): compile-time
+    # and device-memory numbers are meaningless across version drift —
+    # same rationale as the rlimit/cpu_count stamps above.  Guarded:
+    # host_envelope must work where jax is absent or backendless.
+    try:
+        import jax
+        env["jax_version"] = jax.__version__
+        try:
+            import jaxlib
+            env["jaxlib_version"] = jaxlib.__version__
+        except Exception:  # noqa: BLE001 — jaxlib not importable alone
+            pass
+        env["jax_backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001 — optional: no jax / no backend
+        pass
     return env

@@ -300,6 +300,11 @@ def test_classic_bench_contract():
     # ...and the classic stats ride the local Observatory snapshot
     assert detail["local"]["observatory"]["classic"][
         "aer_batches_sent"] > 0
+    # ISSUE 16: the classic tail stamps the device keys as ZEROS — the
+    # classic plane is host-only; a nonzero compile count here means
+    # jit dispatch leaked into the classic path
+    assert doc["n_compiles"] == 0 and doc["n_recompiles"] == 0
+    assert doc["transfer_bytes"] == 0
 
 
 def test_bench_diff_compares_classic_captures(tmp_path):
@@ -490,6 +495,75 @@ def test_bench_diff_compares_wire_keys(tmp_path):
     # value + wire_cmds_per_s + shed rate + recovery
     assert r.stdout.count("REGRESSION") == 4, r.stdout
     b.write_text(json.dumps(base))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_bench_tail_stamps_device_keys():
+    """ISSUE 16: the throughput tail stamps the device-plane keys
+    (format pinned — bench_diff compares them), and the real bench
+    dispatch path itself runs recompile-free: warm-up compiles are
+    counted, steady state adds none."""
+    doc = run_child({})
+    for k in ("n_compiles", "n_recompiles", "compile_time_s",
+              "transfer_bytes", "transfer_bytes_per_cmd",
+              "peak_live_bytes"):
+        assert k in doc, k
+    assert doc["n_compiles"] > 0          # warm-up compiles counted
+    assert doc["n_recompiles"] == 0       # the zero-retrace pin, live
+    assert doc["transfer_bytes"] > 0
+    assert doc["transfer_bytes_per_cmd"] > 0
+    assert doc["peak_live_bytes"] > 0     # watermarks rode the harvest
+
+
+def test_bench_parent_promotes_device_keys():
+    """The parent headline line carries the measuring CHILD's device
+    stamp (counters are per-process; the parent never dispatches), so
+    bench_diff can compare headline rows across rounds."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    row = {"value": 1.0, "n_compiles": 3, "n_recompiles": 0,
+           "transfer_bytes": 10, "unrelated": 7}
+    out = bench._promote_device_keys(row)
+    assert out == {"n_compiles": 3, "n_recompiles": 0,
+                   "transfer_bytes": 10}
+
+
+def test_bench_diff_compares_device_keys(tmp_path):
+    """ISSUE 16 satellite: n_compiles/n_recompiles compare ABSOLUTELY
+    (any growth flags — a one-per-round retrace hides inside a 10%
+    noise bar), the cost keys lower-is-better with 0 a healthy
+    baseline (classic tails stamp zeros)."""
+    diff_tool = os.path.join(REPO, "tools", "bench_diff.py")
+    base = {"value": 1000.0, "n_compiles": 6, "n_recompiles": 0,
+            "compile_time_s": 1.5, "transfer_bytes_per_cmd": 84.0,
+            "peak_live_bytes": 50_000}
+    a = tmp_path / "old.json"
+    b = tmp_path / "new.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(base))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # +1 compile is only ~17% of 6 but must flag regardless of bar;
+    # a recompile appearing from 0 must flag too
+    worse = {"value": 1000.0, "n_compiles": 7, "n_recompiles": 1,
+             "compile_time_s": 3.0, "transfer_bytes_per_cmd": 120.0,
+             "peak_live_bytes": 50_000}
+    b.write_text(json.dumps(worse))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b),
+                        "--noise-pct", "25"],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stdout
+    # n_compiles + n_recompiles (absolute) + compile_time_s +
+    # transfer_bytes_per_cmd (both past the 25% bar); peak unchanged
+    assert r.stdout.count("REGRESSION") == 4, r.stdout
+    # improvements are never regressions: dropping compiles is clean
+    b.write_text(json.dumps(dict(base, n_compiles=3)))
     r = subprocess.run([sys.executable, diff_tool, str(a), str(b)],
                        capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stdout + r.stderr

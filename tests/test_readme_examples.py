@@ -79,7 +79,8 @@ def test_slo_autotune_quickstart_block(tmp_path):
         exec(compile(src, "README.md[slo]", "exec"), ns)  # noqa: S102
         verdicts = ns["slo"].evaluate()["objectives"]
         assert set(verdicts) == {"commit_p99_ms", "fsync_p99_ms",
-                                 "cmds_per_s"}
+                                 "cmds_per_s",
+                                 "steady_state_recompiles"}
         ns["eng"]._dur.flush_all()  # settle async confirms -> e2e samples
         snap = ns["obs"].snapshot()
         assert snap["engine"]["phases"]["commit_e2e"]["count"] > 0

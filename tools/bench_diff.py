@@ -23,7 +23,15 @@ optional latency percentiles, found at the top level or nested under
   rung (``multichip/<mesh>/lanes<N>``, cmds_per_s higher-is-better) —
   a cross-round mesh delta is attributable via each row's stamped
   ``engine_pipeline`` config (superstep_k/dispatch_ahead/donation/
-  wal shard layout/mesh shape).
+  wal shard layout/mesh shape);
+* **device-plane regressions** (ISSUE 16): ``n_compiles`` /
+  ``n_recompiles`` are compile COUNTS at a fixed workload, so they are
+  compared absolutely — ANY growth flags, no noise bar (a retrace
+  regression compiles once per shape variant, which can hide inside a
+  10% bar); ``compile_time_s`` / ``transfer_bytes_per_cmd`` /
+  ``peak_live_bytes`` are lower-is-better with 0 a meaningful healthy
+  baseline (the classic tail stamps zeros), so they ride the shed-rate
+  comparison shape.
 
 The noise bar defaults to 10% — the builder-box numbers swing with
 host load (the BENCH_r02 vs r04 host-drift note), so a tight default
@@ -62,6 +70,15 @@ LATENCY_FIELDS = ("p50_commit_latency_ms", "p99_commit_latency_ms",
 INGRESS_RATE_FIELDS = ("ingress_cmds_per_s", "wire_cmds_per_s")
 INGRESS_SHED_FIELDS = ("ingress_shed_rate", "wire_shed_rate",
                        "wire_reconnect_recovery_s")
+
+#: device-plane compile counts (ISSUE 16): absolute comparison, any
+#: growth is a regression — the workload is fixed across rounds, so an
+#: extra compile means an extra traced shape variant, not noise
+DEVICE_COUNT_FIELDS = ("n_compiles", "n_recompiles")
+#: device-plane costs: lower-is-better, 0 = healthy baseline (classic
+#: tails stamp zeros), so the shed-rate absolute-floor shape applies
+DEVICE_COST_FIELDS = ("compile_time_s", "transfer_bytes_per_cmd",
+                      "peak_live_bytes")
 
 
 def _is_row(d) -> bool:
@@ -141,6 +158,25 @@ def compare_rows(old: dict, new: dict, noise_pct: float) -> list:
         if not isinstance(o, (int, float)) or \
                 not isinstance(n, (int, float)) or o < 0 or n < 0:
             continue  # negative = sentinel; 0 is a real (healthy) rate
+        base = o if o > 0 else 1.0
+        delta = (n - o) / base
+        out.append({"metric": f, "old": o, "new": n,
+                    "delta_pct": round(100 * delta, 2),
+                    "regression": delta > bar})
+    for f in DEVICE_COUNT_FIELDS:
+        o, n = old.get(f), new.get(f)
+        if not isinstance(o, (int, float)) or \
+                not isinstance(n, (int, float)) or o < 0 or n < 0:
+            continue
+        base = o if o > 0 else 1.0
+        out.append({"metric": f, "old": o, "new": n,
+                    "delta_pct": round(100 * (n - o) / base, 2),
+                    "regression": n > o})  # absolute: no noise bar
+    for f in DEVICE_COST_FIELDS:
+        o, n = old.get(f), new.get(f)
+        if not isinstance(o, (int, float)) or \
+                not isinstance(n, (int, float)) or o < 0 or n < 0:
+            continue  # negative = sentinel; 0 is a real healthy value
         base = o if o > 0 else 1.0
         delta = (n - o) / base
         out.append({"metric": f, "old": o, "new": n,

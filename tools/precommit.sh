@@ -7,7 +7,13 @@
 #    (staged, unstaged, untracked) — the whole-program engine still
 #    indexes the full tree, so cross-module closures and allowlist
 #    tags resolve exactly as in the full run; only REPORTING is scoped.
-# 2. `pytest tests/test_static_gates.py` runs the full gate suite
+# 2. `tools/soak.py --device-obs 0 1` runs ONE seed of the ISSUE 16
+#    device-observatory chaos episode (~4s): the recompile sentinel
+#    stays quiet under election/disk chaos and the deliberate
+#    mixed-shape probe is detected — the runtime mirror of the jit
+#    static gates, so a retrace regression fails the same local loop
+#    that catches a lint finding.
+# 3. `pytest tests/test_static_gates.py` runs the full gate suite
 #    (rule fixtures + clean pins + the analyzer runtime budget).
 #
 # Exit nonzero on any finding or test failure.  The full-tree lint
@@ -16,4 +22,5 @@
 set -e
 cd "$(dirname "$0")/.."
 python tools/lint.py --changed
+python tools/soak.py --device-obs 0 1
 exec python -m pytest tests/test_static_gates.py -q

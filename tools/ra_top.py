@@ -3,8 +3,10 @@
 Follows a JSONL snapshot ring (what ``tools/soak.py --obs`` or
 ``Observatory.to_jsonl`` writes) and renders the lane-health heat
 summary, the top-K offender lanes, per-shard WAL fsync latency + queue
-depth, and the dispatch-pipeline counters.  stdlib-only, works over
-ssh; the htop role of the reference's `ra:key_metrics` console habit.
+depth, the dispatch-pipeline counters, and the device plane (ISSUE 16:
+compiles/recompiles, transfer ledger, memory watermarks).  stdlib-only,
+works over ssh; the htop role of the reference's `ra:key_metrics`
+console habit.
 
 Usage:
     python tools/ra_top.py [path] [--interval S] [--once]
@@ -176,6 +178,33 @@ def render(snap: dict, prev: dict | None = None) -> str:
             f"paused={wire.get('paused_conns', 0)})  "
             f"credit[{_spark(hist)}] {hist_s or 'idle'}"
             + (f"  errs={errs}" if errs else ""))
+    # -- device plane (ISSUE 16) -------------------------------------------
+    dev = snap.get("device") or {}
+    if dev:
+        p_dev = (prev.get("device") or {}) if prev is not None else {}
+        dre = dev.get("recompiles", 0)
+        # <<< flag only on fresh recompiles (like the SHEDDING flag);
+        # the drift attribution line sticks around once any recompile
+        # happened — naming the drifting argument is the sentinel's job
+        flag = " <<< RECOMPILING" \
+            if prev is not None and dre > p_dev.get("recompiles", 0) else ""
+        drift = ""
+        if dre:
+            for tag, ent in sorted((dev.get("per_fn") or {}).items()):
+                if ent.get("last_drift"):
+                    drift = f"\ndrift   {tag}: {ent['last_drift'][:68]}"
+                    break
+        lines.append(
+            f"device  compiles={dev.get('compiles', 0)} re={dre} "
+            f"{dev.get('compile_ms', 0.0):.0f}ms  "
+            f"h2d={dev.get('h2d_events', 0)}/"
+            f"{_fmt_rate(dev.get('h2d_bytes', 0))}B "
+            f"d2h={dev.get('d2h_events', 0)}/"
+            f"{_fmt_rate(dev.get('d2h_bytes', 0))}B  "
+            f"live={dev.get('live_buffers', 0)}/"
+            f"{_fmt_rate(dev.get('live_bytes', 0))}B "
+            f"peak={_fmt_rate(dev.get('peak_live_bytes', 0))}B "
+            f"freed={dev.get('buffers_freed', 0)}{flag}{drift}")
     # -- WAL shards --------------------------------------------------------
     wal = eng.get("wal") or {}
     shards = wal.get("shards") or []

@@ -9,6 +9,7 @@ Usage:  python tools/soak.py [seeds_per_family] [offset]
         python tools/soak.py --blackbox SEED [n]
         python tools/soak.py --ingress SEED [n] [--mesh]
         python tools/soak.py --wire SEED [--durable] [--c1m]
+        python tools/soak.py --device-obs SEED [n]
 
 ``--wire`` climbs the ISSUE 12 connection ladder (ra_tpu/wire/soak.py
 run_wire_soak): C10k (with a real-socket side-car) → C100k loopback
@@ -58,6 +59,16 @@ top-K offenders, within one sampling window), not just recovered —
 while every harvested Observatory snapshot is appended to a JSONL
 ring (default ``obs.jsonl``; follow it live with
 ``python tools/ra_top.py <path>``).
+
+``--device-obs`` runs the device-plane observatory chaos family
+(tests/test_devicewatch.run_device_obs_chaos, ISSUE 16): ``n`` seeded
+episodes, each a DURABLE engine taking fixed-shape superstep traffic
+through election churn and a seeded WAL DiskFaultPlan — asserting the
+recompile sentinel stays QUIET (host-plane chaos is not shape drift),
+then that a deliberate mixed-shape probe (K=8 -> K=4) IS detected
+within one Observatory window and attributed to the drifting block
+shape.  Engine configs are seed-varied so every episode compiles
+fresh jit variants.
 
 Prints one line per family with pass/fail counts; exits nonzero on the
 first failing seed (which should then be added to the in-suite list).
@@ -259,6 +270,33 @@ def _wire_main(argv: list) -> int:
     return 0
 
 
+def _device_obs_main(argv: list) -> int:
+    """--device-obs SEED [n]: the device-observatory chaos family."""
+    import test_devicewatch as tdw
+
+    seed = int(argv[0]) if argv else 0
+    n = int(argv[1]) if len(argv) > 1 else 10
+    t0 = time.time()
+    failed = []
+    injected = probes = 0
+    for s in range(seed, seed + n):
+        with tempfile.TemporaryDirectory(prefix="soak_dw_") as d:
+            try:
+                res = tdw.run_device_obs_chaos(s, d)
+                injected += res["injected_faults"]
+                probes += res["probe_recompiles"]
+            except Exception:  # noqa: BLE001 — report seed + continue
+                failed.append(s)
+                if len(failed) == 1:
+                    traceback.print_exc()
+    print(f"device_obs: {n - len(failed)}/{n} ok in "
+          f"{time.time() - t0:.1f}s  injected_faults={injected} "
+          f"probe_recompiles_detected={probes}"
+          + (f"  FAILED seeds: {failed[:10]}" if failed else ""),
+          flush=True)
+    return 1 if failed else 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--wire":
         return _wire_main(sys.argv[2:])
@@ -272,6 +310,8 @@ def main() -> int:
         return _superstep_main(sys.argv[2:])
     if len(sys.argv) > 1 and sys.argv[1] == "--obs":
         return _obs_main(sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1] == "--device-obs":
+        return _device_obs_main(sys.argv[2:])
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 400
     off = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
     families = [
