@@ -146,6 +146,29 @@ EVENT_REGISTRY = {
                         "dir rides along so the capture shows up in "
                         "ra_trace timelines instead of being a side "
                         "file nobody finds",
+    # -- engine failure detector (supervisor tier, ISSUE 17) -----------
+    "detector.suspect": "failure detector escalated a peer/engine to "
+                        "suspect (silent beyond suspect_after; age = "
+                        "seconds since last heard)",
+    "detector.down": "failure detector confirmed a peer/engine down "
+                     "(silent beyond down_after AND suspect for the "
+                     "full hysteresis window; age rides along)",
+    # -- placement failover (ISSUE 17; `trace` = migrated-cmd ctx) -----
+    "placement.refuse": "a lane range's old home refused/was "
+                        "unreachable for a session (the client-visible "
+                        "start of a failover incident)",
+    "placement.migrate": "control plane committed a lane-range "
+                         "re-placement through the placement table "
+                         "(rid, victim -> survivor, new generation)",
+    "placement.adopt": "survivor restored a victim engine's durable "
+                       "lane state (checkpoint + WAL-shard merge, "
+                       "gated at the fsynced watermark)",
+    "placement.rehome": "sessions re-bound to the new home: epoch "
+                        "bump, dedup slots claimed, ack watermarks "
+                        "re-seeded",
+    "placement.giveup": "a bounded placement retry loop exhausted its "
+                        "deadline/attempts and gave up (RA16: no "
+                        "silent infinite retry in the control plane)",
     # -- recorder meta -------------------------------------------------
     "bb.dump": "post-mortem bundle written",
     "bb.recover": "recovery stamped a join-able recovery report",

@@ -280,6 +280,24 @@ DEVICE_FIELDS = (
     "peak_live_bytes", "buffers_freed", "watermark_samples",
 )
 
+#: placement failover control plane (ra_tpu/placement/, ISSUE 17):
+#: the EngineSupervisor's counter group.  Detector tier:
+#: ``heartbeats`` probe responses heard (delayed arrivals count when
+#: they land), ``suspects``/``downs`` verdict escalations (a suspect
+#: that recovers inside the hysteresis window never becomes a down —
+#: the slow-fsync guard), ``recoveries`` suspect→up de-escalations.
+#: Re-placement tier: ``migrations`` lane-range re-placements
+#: committed through the placement table, ``migrate_retries`` extra
+#: attempts the bounded commit loop needed beyond the first,
+#: ``giveups`` bounded loops that exhausted their deadline (each also
+#: emits ``placement.giveup``), ``adopts`` victim engines restored
+#: into a survivor's lane space, ``rehomed_sessions`` sessions
+#: re-bound to a new home (epoch bump + slot claim).
+PLACEMENT_FIELDS = (
+    "heartbeats", "suspects", "downs", "recoveries", "migrations",
+    "migrate_retries", "giveups", "adopts", "rehomed_sessions",
+)
+
 #: the complete field-group registry (rule RA05): every counter-field
 #: tuple in this module MUST be listed here, covered by the registry
 #: parity test (tests/test_telemetry.py) and documented in
@@ -304,6 +322,7 @@ FIELD_REGISTRY = {
     "wire": WIRE_FIELDS,
     "classic": CLASSIC_FIELDS,
     "device": DEVICE_FIELDS,
+    "placement": PLACEMENT_FIELDS,
 }
 
 

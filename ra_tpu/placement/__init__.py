@@ -1,0 +1,36 @@
+"""Placement failover: the classic control plane re-homing lane
+engines (ISSUE 17).
+
+Three tiers:
+
+* :mod:`~ra_tpu.placement.table` — the replicated PlacementTable
+  machine (lane-range → engine + generation), the single authority on
+  placement; everything else is a cache of it.
+* :mod:`~ra_tpu.placement.supervisor` — the detector + re-placement
+  committer: heartbeats engines, escalates up→suspect→down with
+  hysteresis, commits generation-gated migrations through the table.
+* :mod:`~ra_tpu.placement.host` — one engine id's serving stack
+  (durable engine + ingress plane + wire listener), with kill-9 and
+  adoption (recover a victim's durable directory and serve it).
+
+:mod:`~ra_tpu.placement.soak` wires all three under live wire traffic
+with a mid-traffic kill-9 and checks the exactly-once oracle over the
+union of both engines' state.  See docs/PLACEMENT.md.
+"""
+from .table import (MACHINE_NAME, PlacementCache, PlacementTableMachine,
+                    owned_ranges, placement_spec)
+from .supervisor import EngineSupervisor, PlacementError
+from .host import LaneEngineHost
+from .soak import run_failover_soak
+
+__all__ = [
+    "MACHINE_NAME",
+    "PlacementTableMachine",
+    "PlacementCache",
+    "placement_spec",
+    "owned_ranges",
+    "EngineSupervisor",
+    "PlacementError",
+    "LaneEngineHost",
+    "run_failover_soak",
+]

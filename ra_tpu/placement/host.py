@@ -1,0 +1,181 @@
+"""One lane-engine host: a durable engine + ingress plane + wire
+listener under a single engine id (the data-plane unit the placement
+table assigns lane ranges to, ISSUE 17).
+
+Failure model — **kill-9, not shutdown**: :meth:`LaneEngineHost.kill9`
+kills every WAL shard abruptly (queued-but-unfsynced writes are lost,
+exactly what SIGKILL loses), stops the shard supervisor (a kill-9'd
+process has no supervisor), and abandons the engine WITHOUT flush or
+checkpoint.  Because commits gate on the fsync confirm and ACK
+watermarks fan out only on commit, everything a client was ever ACKed
+is on disk — the never-acked tail is the only loss, and that loss is
+Raft-legal (docs/PLACEMENT.md).
+
+Recovery model — **adoption, not restart**: the survivor host opens
+the victim's durable directory through the standard recovery path
+(:func:`ra_tpu.engine.durable.open_engine`: checkpoint restore + RTB2
+WAL-shard merge of ANY layout + replay, gated at the fsynced
+watermark) and serves the recovered lane space through a fresh ingress
+plane + wire listener of its own.  The new listener re-seeds its
+per-lane dedup-slot cursors from the recovered machine's ``seq``
+watermarks (WireListener._recovered_lane_next) — the "ingress dedup
+watermarks re-seeded from recovered machine state" leg of the
+exactly-once contract; the client side claims its old slots through
+:meth:`ra_tpu.wire.server.WireListener.loopback_rehome`.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..blackbox import record
+from ..wire.framing import data_stride
+from ..wire.server import WireListener
+
+
+class LaneEngineHost:
+    """One engine id's serving stack.  ``machine_factory`` builds the
+    lane machine (one per engine incarnation — recovered adoptions
+    build their own); geometry kwargs mirror the wire soak's."""
+
+    def __init__(self, engine_id: str, data_dir: str, *,
+                 machine_factory, lanes: int = 64,
+                 ring_capacity: int = 512, max_step_cmds: int = 16,
+                 wal_shards: int = 2, superstep_k: int = 4,
+                 max_conns: int = 256, ring_records: int = 32) -> None:
+        from ..engine.durable import open_engine
+        from ..ingress import IngressPlane
+        self.engine_id = engine_id
+        self.data_dir = data_dir
+        self.lanes = int(lanes)
+        self._geometry = dict(ring_capacity=ring_capacity,
+                              max_step_cmds=max_step_cmds,
+                              wal_shards=wal_shards,
+                              superstep_k=superstep_k,
+                              max_conns=max_conns,
+                              ring_records=ring_records)
+        self._machine_factory = machine_factory
+        self.engine = open_engine(
+            machine_factory(), data_dir, self.lanes,
+            wal_shards=wal_shards, ring_capacity=ring_capacity,
+            max_step_cmds=max_step_cmds, donate=False)
+        self.plane = IngressPlane(self.engine, superstep_k=superstep_k,
+                                  window_s=0.001, soft_credit=1 << 20,
+                                  hard_credit=1 << 20)
+        self.listener = WireListener(
+            self.plane, port=None, max_conns=max_conns,
+            ring_bytes=ring_records * data_stride(
+                self.engine.payload_width))
+        self._alive = True
+        #: victim engine id -> (engine, plane, listener) restored into
+        #: this host's lane space by adopt()
+        self.adopted: dict = {}
+
+    # -- liveness ------------------------------------------------------
+
+    def alive(self) -> bool:
+        """The supervisor's heartbeat probe."""
+        return self._alive
+
+    def kill9(self) -> None:
+        """Abrupt whole-host death (the engine_kill nemesis op).  The
+        WAL loses queued-but-unfsynced writes, the engine keeps no
+        flush/checkpoint ceremony, and this host never serves again —
+        a survivor adopts its durable directory instead."""
+        if not self._alive:
+            return
+        self._alive = False
+        dur = getattr(self.engine, "_dur", None)
+        if dur is not None:
+            # a kill-9'd process has no shard supervisor either: stop
+            # it FIRST or it would resurrect the shards we kill
+            dur._sup_stop.set()
+            for wal in dur.wals:
+                wal.kill()
+
+    def close(self) -> None:
+        """Graceful teardown (test/soak cleanup — NOT the failure
+        path).  A kill-9'd host only releases its adopted stacks and
+        host-side listener state; its own engine died with kill9()."""
+        for eng, _plane, lst in self.adopted.values():
+            lst.close()
+            eng.close()
+        self.adopted.clear()
+        self.listener.close()
+        if self._alive:
+            self._alive = False
+            self.engine.close()
+
+    # -- serving -------------------------------------------------------
+
+    def cycle(self) -> None:
+        """One pump of every serving stack this host owns (its own
+        lane space + every adopted one)."""
+        if not self._alive:
+            return
+        self.listener.sweep()
+        self.plane.pump(force=True)
+        for _eng, plane, lst in self.adopted.values():
+            lst.sweep()
+            plane.pump(force=True)
+
+    def settle(self, timeout: float = 30.0) -> None:
+        if not self._alive:
+            return
+        self.plane.settle(timeout=timeout)
+        for _eng, plane, _lst in self.adopted.values():
+            plane.settle(timeout=timeout)
+
+    # -- adoption (lane-range migration as recovery) -------------------
+
+    def adopt(self, victim_id: str, victim_dir: str, *,
+              wal_shards: Optional[int] = None,
+              trace_ctx: Optional[str] = None) -> WireListener:
+        """Restore ``victim_dir``'s durable lane state into this
+        host's lane space and serve it: standard engine recovery
+        (checkpoint + WAL merge at ANY shard layout + replay to the
+        fsynced watermark) behind a fresh plane + listener.  Returns
+        the adopted listener — the new home re-homed sessions bind to.
+
+        The adopted ingress plane is constructed exactly like the
+        victim's (same lane count, default directory seed), so the
+        deterministic key→lane hashing re-places every re-homed
+        session on the lane its recovered machine state lives in."""
+        from ..engine.durable import open_engine
+        from ..ingress import IngressPlane
+        if victim_id in self.adopted:
+            # a re-delivered failover (retrying supervisor) adopts once
+            return self.adopted[victim_id][2]
+        g = self._geometry
+        eng = open_engine(
+            self._machine_factory(), victim_dir, self.lanes,
+            wal_shards=wal_shards if wal_shards is not None
+            else g["wal_shards"],
+            ring_capacity=g["ring_capacity"],
+            max_step_cmds=g["max_step_cmds"], donate=False)
+        plane = IngressPlane(eng, superstep_k=g["superstep_k"],
+                             window_s=0.001, soft_credit=1 << 20,
+                             hard_credit=1 << 20)
+        lst = WireListener(
+            plane, port=None, max_conns=g["max_conns"],
+            ring_bytes=g["ring_records"] * data_stride(
+                eng.payload_width))
+        self.adopted[victim_id] = (eng, plane, lst)
+        st = eng.state
+        lane = np.arange(self.lanes)
+        tail = np.asarray(st.last_index)[
+            lane, np.asarray(st.leader_slot)]
+        record("placement.adopt", trace=trace_ctx, victim=victim_id,
+               survivor=self.engine_id,
+               recovered_tail_max=int(tail.max(initial=0)),
+               wal_dirs=len([d for d in os.listdir(victim_dir)
+                             if d.startswith(("wal", "shard"))]))
+        return lst
+
+    def adopted_listener(self, victim_id: str) -> WireListener:
+        return self.adopted[victim_id][2]
+
+    def adopted_engine(self, victim_id: str):
+        return self.adopted[victim_id][0]

@@ -1,0 +1,233 @@
+"""The engine supervisor tier — the classic control plane watching
+lane engines (ISSUE 17 tentpole part 2).
+
+An :class:`EngineSupervisor` heartbeats every registered lane engine
+and escalates silence through the aten-style verdict ladder the TCP
+detector uses (up → suspect → down), with one addition the transport
+detector also gained in this PR: a **hysteresis window**.  A down
+verdict requires the engine to be BOTH silent beyond ``down_after``
+AND continuously suspect for ``hysteresis`` seconds — so a latency
+spike (a slow fsync, a CD-Raft cross-domain delay injected by the
+transport FaultPlan's delay matrix) rides out the window and recovers,
+while a kill-9 stays silent and escalates.  test_placement.py pins the
+distinction: a pure-delay FaultPlan never triggers a migration.
+
+On confirmed death the supervisor COMMITS the re-placement through the
+placement table (:mod:`ra_tpu.placement.table`) — never a local
+mutation: the table's generation gate makes redelivered/retried
+migrations idempotent, and a supervisor that dies mid-failover leaves
+a table any successor can read and finish from.  Every commit loop in
+this module is deadline-bounded and emits ``placement.giveup`` on
+exhaustion — the contract lint rule RA16 enforces over this whole
+package: no silent infinite retry in the control plane.
+
+The supervisor is **tick-driven** (call :meth:`tick` from the serving
+loop): deterministic under test, no thread of its own, and the soak
+drives it at whatever cadence the scenario needs.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+from ..blackbox import record
+from ..metrics import PLACEMENT_FIELDS
+from .table import owned_ranges
+
+_INF = float("inf")
+
+
+class PlacementError(RuntimeError):
+    """A bounded placement commit loop gave up (deadline exhausted)."""
+
+
+class EngineSupervisor:
+    """Monitors lane engines; commits re-placements on confirmed death.
+
+    ``table_sid``/``router`` name any member of the placement-table
+    cluster (leader redirects are the commit path's business).
+    ``probes`` maps engine id → zero-arg callable returning truthy
+    while the engine is alive — the in-process heartbeat; across hosts
+    the same callable wraps a reliable-RPC ping.  ``fault_plan`` (a
+    transport FaultPlan) is consulted per heartbeat on the ``ping``
+    frame class honoring BOTH drop and delay: a dropped probe is
+    silence, a delayed probe arrives late (``delay_s`` added to the
+    observed age) — which is exactly what lets the hysteresis pin
+    distinguish delay from death."""
+
+    def __init__(self, table_sid, router, *,
+                 probes: Optional[dict] = None,
+                 suspect_after: float = 1.0, down_after: float = 2.0,
+                 hysteresis: float = 0.5,
+                 fault_plan=None,
+                 on_migrate: Optional[Callable] = None,
+                 commit_timeout: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.table_sid = table_sid
+        self.router = router
+        self.suspect_after = float(suspect_after)
+        self.down_after = float(down_after)
+        self.hysteresis = float(hysteresis)
+        self.fault_plan = fault_plan
+        self.on_migrate = on_migrate
+        self.commit_timeout = float(commit_timeout)
+        self._clock = clock
+        self.counters = {f: 0 for f in PLACEMENT_FIELDS}
+        self._probe: dict[str, Callable] = {}
+        self._last_heard: dict[str, float] = {}
+        self._arrive: dict[str, float] = {}    # in-flight probe reply
+        self._verdict: dict[str, str] = {}
+        self._suspect_since: dict[str, float] = {}
+        self._migrated: set = set()
+        for eid, probe in (probes or {}).items():
+            self.watch(eid, probe)
+
+    # -- registration --------------------------------------------------
+
+    def watch(self, eid: str, probe: Callable[[], Any]) -> None:
+        now = self._clock()
+        self._probe[eid] = probe
+        self._last_heard[eid] = now
+        self._arrive[eid] = _INF
+        self._verdict[eid] = "up"
+
+    def verdict(self, eid: str) -> str:
+        return self._verdict.get(eid, "unknown")
+
+    def last_heard_age(self, eid: str,
+                       now: Optional[float] = None) -> float:
+        now = self._clock() if now is None else now
+        return now - self._last_heard.get(eid, now)
+
+    # -- the detector tick ---------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> list:
+        """One heartbeat round over every watched engine; returns the
+        engine ids newly confirmed DOWN this tick (the caller decides
+        whether to failover them — the nemesis heal path forces it)."""
+        now = self._clock() if now is None else now
+        newly_down: list = []
+        for eid, probe in self._probe.items():
+            if self._verdict[eid] == "down":
+                continue
+            # a previous probe's delayed reply landing now counts as
+            # heard AT ITS ARRIVAL TIME (not probe time): delay shows
+            # up as age, which is what the hysteresis must absorb
+            if self._arrive[eid] <= now:
+                self._last_heard[eid] = self._arrive[eid]
+                self._arrive[eid] = _INF
+                self.counters["heartbeats"] += 1
+            alive = False
+            try:
+                alive = bool(probe())
+            except Exception:
+                alive = False
+            if alive:
+                delay_s = 0.0
+                deliver = True
+                if self.fault_plan is not None:
+                    d = self.fault_plan.decide(eid, "ping", "send")
+                    deliver = d.action != "drop"
+                    delay_s = d.delay_s
+                if deliver:
+                    if delay_s <= 0.0:
+                        self._last_heard[eid] = now
+                        self.counters["heartbeats"] += 1
+                    else:
+                        self._arrive[eid] = min(self._arrive[eid],
+                                                now + delay_s)
+            silent = now - self._last_heard[eid]
+            verdict = self._verdict[eid]
+            if silent <= self.suspect_after:
+                if verdict == "suspect":
+                    self._verdict[eid] = "up"
+                    self._suspect_since.pop(eid, None)
+                    self.counters["recoveries"] += 1
+                continue
+            if verdict == "up":
+                self._verdict[eid] = "suspect"
+                self._suspect_since[eid] = now
+                self.counters["suspects"] += 1
+                record("detector.suspect", peer=eid, age=silent)
+                continue
+            if silent > self.down_after and \
+                    now - self._suspect_since.get(eid, now) >= \
+                    self.hysteresis:
+                self._verdict[eid] = "down"
+                self.counters["downs"] += 1
+                record("detector.down", peer=eid, age=silent)
+                newly_down.append(eid)
+        return newly_down
+
+    # -- re-placement --------------------------------------------------
+
+    def table_state(self) -> dict:
+        """A committed read of the placement table."""
+        from ..api import consistent_query
+        res = self._commit(lambda: consistent_query(
+            self.table_sid, lambda s: s, router=self.router,
+            timeout=self.commit_timeout), what="table_read")
+        return res.reply
+
+    def failover(self, victim: str, survivor: str,
+                 trace_ctx: Optional[str] = None) -> list:
+        """Commit the victim's death + one migrate per owned range,
+        all through the table (each command generation-gated, each
+        commit loop deadline-bounded).  Returns the committed
+        ``(rid, survivor, new_generation)`` placements; invokes
+        ``on_migrate(victim, survivor, placements, trace_ctx)`` so the
+        host tier performs the actual adoption + re-home."""
+        from ..api import process_command
+        state = self.table_state()
+        eng = state["engines"].get(victim)
+        if eng is not None and eng["status"] != "down":
+            self._commit(lambda: process_command(
+                self.table_sid,
+                ("engine_down", victim, eng["generation"]),
+                self.router, timeout=self.commit_timeout,
+                trace_ctx=trace_ctx), what="engine_down")
+        placements: list = []
+        for rid, ent in owned_ranges(state, victim):
+            new_gen = ent["generation"] + 1
+            res = self._commit(lambda: process_command(
+                self.table_sid,
+                ("migrate", rid, victim, survivor, new_gen),
+                self.router, timeout=self.commit_timeout,
+                trace_ctx=trace_ctx), what=f"migrate/{rid}")
+            _tag, _rid, home, gen = res.reply
+            record("placement.migrate", trace=trace_ctx, rid=rid,
+                   victim=victim, survivor=home, generation=gen)
+            self.counters["migrations"] += 1
+            placements.append((rid, home, gen))
+        self._migrated.add(victim)
+        if self.on_migrate is not None and placements:
+            self.on_migrate(victim, survivor, placements, trace_ctx)
+        return placements
+
+    def _commit(self, attempt: Callable[[], Any], *,
+                what: str) -> Any:
+        """The ONE retry shape this package allows (rule RA16): a
+        deadline-bounded loop that emits a registered give-up event
+        when exhausted."""
+        deadline = self._clock() + self.commit_timeout * 3
+        attempts = 0
+        last: Any = None
+        while self._clock() < deadline:
+            attempts += 1
+            try:
+                res = attempt()
+            except (TimeoutError, RuntimeError) as exc:
+                last = exc
+                self.counters["migrate_retries"] += 1
+                continue
+            from ..core.types import ErrorResult
+            if isinstance(res, ErrorResult):
+                last = res
+                self.counters["migrate_retries"] += 1
+                continue
+            return res
+        self.counters["giveups"] += 1
+        record("placement.giveup", what=what, attempts=attempts)
+        raise PlacementError(
+            f"placement commit {what} gave up after {attempts} "
+            f"attempts: {last!r}")

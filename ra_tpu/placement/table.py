@@ -1,0 +1,207 @@
+"""The replicated PlacementTable machine — lane-range → engine
+assignments on a classic control cluster (ISSUE 17, ROADMAP item 2's
+hierarchical-consensus shape: classic clusters as control plane over
+lane engines as data plane).
+
+The table is the ONLY authority on who serves which lane range.  It is
+mutated exclusively by committed commands, so every mutation inherits
+the classic plane's guarantees: a leader kill-9 mid-migration leaves
+the table either pre- or post-move (a migration is one command — there
+is no half-moved state to observe), and a re-delivered migration is a
+no-op because each assignment carries a **generation** number that
+only ever moves forward (the cross-plane twin of the session epoch).
+
+Everything downstream — the SessionDirectory's lane placements, the
+wire listener's session bindings, a client's notion of "home" — is a
+CACHE of this table (:class:`PlacementCache`), valid only at the
+generation it was read at; docs/PLACEMENT.md states the invalidation
+rules.
+
+State shape (plain dicts/tuples: picklable, snapshot-friendly,
+deepcopy-cheap at control-plane scale — tens of ranges, not millions)::
+
+    {"engines": {eid: {"status": "up"|"down", "generation": int}},
+     "ranges":  {rid: {"engine": eid, "generation": int,
+                       "lo": int, "hi": int}},
+     "rev": int}
+
+``rev`` bumps on every effective mutation — the cheap "did anything
+move" probe caches poll.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ..core.machine import ApplyMeta, Machine
+from ..machines import machine_spec, register_machine
+
+MACHINE_NAME = "placement_table"
+
+
+def _copy(state: dict) -> dict:
+    """Two-level copy-on-write: apply never mutates the input state in
+    place (queries may hold references to old snapshots)."""
+    return {
+        "engines": {k: dict(v) for k, v in state["engines"].items()},
+        "ranges": {k: dict(v) for k, v in state["ranges"].items()},
+        "rev": state["rev"],
+    }
+
+
+class PlacementTableMachine(Machine):
+    """Commands (tuples, picklable — they travel the control plane):
+
+    * ``("register_engine", eid)`` — add an engine as up at generation
+      1; idempotent (re-registration of a known engine is a no-op).
+    * ``("assign", rid, eid, lo, hi)`` — create the lane range ``[lo,
+      hi)`` on ``eid`` at generation 1.  Idempotent when identical;
+      re-assigning an EXISTING range to a different engine is refused
+      (that is what ``migrate`` is for — assignment churn must carry a
+      generation).
+    * ``("engine_down", eid, expect_gen)`` — mark an engine down, gated
+      on its current generation (a stale supervisor's verdict against
+      an engine that already re-registered is a no-op).
+    * ``("migrate", rid, from_eid, to_eid, new_gen)`` — move a range,
+      applied ONLY when the range is still on ``from_eid`` at a
+      generation below ``new_gen``.  The reply always carries the
+      post-apply assignment, so a re-delivered migrate (cumulative-ack
+      redelivery, a retrying supervisor) observes the move it already
+      made instead of applying it twice.
+
+    Every reply is ``("placed", rid_or_eid, engine, generation)`` /
+    ``("engines", ...)`` style plain data — safe to ship over any
+    transport.
+    """
+
+    def init(self, config: dict) -> dict:
+        return {"engines": {}, "ranges": {}, "rev": 0}
+
+    def apply(self, meta: ApplyMeta, command: Any, state: dict):
+        op = command[0]
+        if op == "register_engine":
+            _, eid = command
+            if eid in state["engines"]:
+                ent = state["engines"][eid]
+                return state, ("engine", eid, ent["status"],
+                               ent["generation"])
+            state = _copy(state)
+            state["engines"][eid] = {"status": "up", "generation": 1}
+            state["rev"] += 1
+            return state, ("engine", eid, "up", 1)
+        if op == "assign":
+            _, rid, eid, lo, hi = command
+            cur = state["ranges"].get(rid)
+            if cur is not None:
+                # identical re-assign is a no-op; anything else must
+                # be a migrate (generation-gated) — refuse with the
+                # current placement so the caller can see why
+                ok = cur["engine"] == eid and cur["lo"] == lo and \
+                    cur["hi"] == hi
+                return state, (("placed" if ok else "refused"), rid,
+                               cur["engine"], cur["generation"])
+            state = _copy(state)
+            state["ranges"][rid] = {"engine": eid, "generation": 1,
+                                    "lo": int(lo), "hi": int(hi)}
+            state["rev"] += 1
+            return state, ("placed", rid, eid, 1)
+        if op == "engine_down":
+            _, eid, expect_gen = command
+            ent = state["engines"].get(eid)
+            if ent is None:
+                return state, ("refused", eid, None, 0)
+            if ent["status"] == "down" or \
+                    ent["generation"] != expect_gen:
+                return state, ("engine", eid, ent["status"],
+                               ent["generation"])
+            state = _copy(state)
+            ent = state["engines"][eid]
+            ent["status"] = "down"
+            state["rev"] += 1
+            return state, ("engine", eid, "down", ent["generation"])
+        if op == "migrate":
+            _, rid, from_eid, to_eid, new_gen = command
+            cur = state["ranges"].get(rid)
+            if cur is None:
+                return state, ("refused", rid, None, 0)
+            if cur["engine"] == from_eid and \
+                    cur["generation"] < new_gen:
+                state = _copy(state)
+                ent = state["ranges"][rid]
+                ent["engine"] = to_eid
+                ent["generation"] = int(new_gen)
+                state["rev"] += 1
+                cur = ent
+            # already-moved (or stale) migrate: reply the placement
+            # that stands — the redelivery-idempotence contract
+            return state, ("placed", rid, cur["engine"],
+                           cur["generation"])
+        raise ValueError(f"placement_table: unknown command {op!r}")
+
+    def overview(self, state: dict) -> dict:
+        return {"rev": state["rev"],
+                "engines": len(state["engines"]),
+                "ranges": len(state["ranges"])}
+
+
+def placement_spec() -> tuple:
+    """The picklable machine spec cross-node starts ship."""
+    return machine_spec(MACHINE_NAME)
+
+
+def owned_ranges(state: dict, eid: str) -> list:
+    """[(rid, entry)] of every range currently homed on ``eid``."""
+    return sorted((rid, dict(ent))
+                  for rid, ent in state["ranges"].items()
+                  if ent["engine"] == eid)
+
+
+class PlacementCache:
+    """A client-side cache of the replicated table — the role the
+    SessionDirectory (and every other placement consumer) plays after
+    ISSUE 17: placements are only ever LEARNED from committed table
+    state, never invented locally, and a cached entry is valid exactly
+    while its generation matches the table's.
+
+    ``refresh(state)`` swallows a table snapshot (from consistent/
+    local query); ``lookup``/``lane_owner`` answer from the cache;
+    ``stale_against(state)`` reports whether a newer revision exists
+    (the cheap poll the re-home path uses)."""
+
+    def __init__(self) -> None:
+        self.rev = -1
+        self._ranges: dict = {}
+
+    def refresh(self, state: dict) -> bool:
+        """Adopt a table snapshot; returns True when it superseded the
+        cached revision (monotone: an older snapshot never rolls the
+        cache back — stale reads from a lagging follower are harmless)."""
+        if state["rev"] <= self.rev:
+            return False
+        self.rev = state["rev"]
+        self._ranges = {rid: dict(ent)
+                        for rid, ent in state["ranges"].items()}
+        return True
+
+    def invalidate(self) -> None:
+        self.rev = -1
+        self._ranges = {}
+
+    def lookup(self, rid: str):
+        """(engine, generation) or None."""
+        ent = self._ranges.get(rid)
+        return None if ent is None else (ent["engine"],
+                                         ent["generation"])
+
+    def lane_owner(self, lane: int):
+        """The engine id homing ``lane``, or None when no cached range
+        covers it."""
+        for ent in self._ranges.values():
+            if ent["lo"] <= lane < ent["hi"]:
+                return ent["engine"]
+        return None
+
+    def stale_against(self, state: dict) -> bool:
+        return state["rev"] > self.rev
+
+
+register_machine(MACHINE_NAME, lambda **kw: PlacementTableMachine())

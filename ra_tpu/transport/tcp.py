@@ -46,6 +46,7 @@ from collections import OrderedDict
 from dataclasses import replace as _dc_replace
 from typing import Optional
 
+from ..blackbox import record
 from ..core.types import (
     AppendEntriesRpc,
     CommandEvent,
@@ -141,6 +142,17 @@ class TcpRouter(LocalRouter):
         self.dropped_sends = 0
         self.last_heard: dict[str, float] = {}
         self.node_status: dict[str, str] = {}
+        #: detector windows — instance-configurable (ISSUE 17): the
+        #: module constants stay the defaults; ``detector_hysteresis``
+        #: is the minimum CONTINUOUS suspect time before a down
+        #: verdict, so a latency spike (slow fsync, injected delay)
+        #: that clears within the window never escalates.  0.0
+        #: preserves the historical silence-only behavior.
+        self.suspect_after = SUSPECT_AFTER
+        self.down_after = DOWN_AFTER
+        self.detector_hysteresis = 0.0
+        #: node -> monotonic time it ENTERED suspect (hysteresis clock)
+        self._suspect_since: dict[str, float] = {}
         #: nemesis hook: nodes whose traffic is blocked at the socket
         #: level (the inet_tcp_proxy role the reference's
         #: partitions_SUITE uses, partitions_SUITE.erl:29-57) — sends
@@ -970,6 +982,7 @@ class TcpRouter(LocalRouter):
 
     def _mark_heard(self, node: str) -> None:
         self.last_heard[node] = time.monotonic()
+        self._suspect_since.pop(node, None)
         status = self.node_status.get(node)
         if status == "down":
             self.node_status[node] = "up"
@@ -1014,14 +1027,26 @@ class TcpRouter(LocalRouter):
                     continue
                 status = self.node_status.get(node, "up")
                 silent = now - heard
-                if status != "down" and silent > DOWN_AFTER:
+                if status != "down" and silent > self.down_after and \
+                        now - self._suspect_since.get(node, now) >= \
+                        self.detector_hysteresis:
+                    # down needs BOTH silence beyond the window AND
+                    # (when hysteresis is configured) a continuous
+                    # suspect streak — a delayed-but-alive peer whose
+                    # frames land inside the streak never escalates
                     self.node_status[node] = "down"
+                    self._suspect_since.pop(node, None)
+                    record("detector.down", peer=node,
+                           age=round(silent, 4))
                     peer = self.peers.get(node)
                     if peer is not None:
                         self._close_peer(peer)
                     self._broadcast_node_event(node, "down")
-                elif status == "up" and silent > SUSPECT_AFTER:
+                elif status == "up" and silent > self.suspect_after:
                     self.node_status[node] = "suspect"
+                    self._suspect_since[node] = now
+                    record("detector.suspect", peer=node,
+                           age=round(silent, 4))
 
     def _broadcast_node_event(self, node: str, status: str) -> None:
         evt = NodeEvent(node, status)

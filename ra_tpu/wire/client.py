@@ -326,6 +326,7 @@ class LoopbackFleet:
         self.n_conns = int(n_conns)
         self.spc = int(sessions_per_conn)
         self.key = key
+        self.tenants = max(1, int(tenants))
         self.rng = np.random.default_rng(seed)
         self.conns = listener.loopback_connect(
             n_conns, sessions_per_conn=self.spc, key=key,
@@ -348,6 +349,11 @@ class LoopbackFleet:
         self.op_delta = np.zeros(self.max_ops, np.int32)
         self.op_state = np.zeros(self.max_ops, np.int8)
         self.op_rank = np.full(self.max_ops, -1, np.int64)
+        #: ever placed on SOME home — survives the rank reset a
+        #: re-home performs, so the refusal path can still tell "this
+        #: replay's first copy may have committed" (such ops are
+        #: dropped on refusal, never re-keyed; see _on_credit)
+        self.op_ever = np.zeros(self.max_ops, bool)
         self.n_ops = 0
         # (packed key -> op) pending-credit join, kept sorted
         self._pend_key = np.zeros(0, np.int64)
@@ -488,6 +494,7 @@ class LoopbackFleet:
         # was replayed); defensively mark placed WITHOUT a rank so the
         # server's committed-row watermark accounting stays aligned
         self.op_state[ops[st == DUP]] = PLACED
+        self.op_ever[ops[st == DUP]] = True
         p_ops = ops[placed]
         sess = self.op_sess[p_ops]
         # placement rank per session: credit rows arrive in placement
@@ -495,11 +502,13 @@ class LoopbackFleet:
         self.op_rank[p_ops] = self.placed_cnt[sess] + batch_rank(sess)
         np.add.at(self.placed_cnt, sess, 1)
         self.op_state[p_ops] = PLACED
+        self.op_ever[p_ops] = True
         refused = ops[~placed & (st != DUP)]
         # a refused REPLAY of an ever-placed op is simply dropped: its
         # first copy is placed and will commit — requeueing (let alone
-        # re-keying) it would double-apply
-        ever = self.op_rank[refused] >= 0
+        # re-keying) it would double-apply.  op_ever keeps this truth
+        # across a re-home's rank reset.
+        ever = (self.op_rank[refused] >= 0) | self.op_ever[refused]
         self.op_state[refused[ever]] = PLACED
         refused = refused[~ever]
         self.op_state[refused] = QUEUED
@@ -549,6 +558,77 @@ class LoopbackFleet:
         self._pend_per_sess = np.bincount(
             (self._pend_key >> self._SEQ_BITS) - self.base,
             minlength=self.n_sessions)
+        return np.flatnonzero(requeue)
+
+    # -- placement re-home (ISSUE 17) ---------------------------------------
+
+    def rehome(self, new_listener, trace_ctx=None) -> np.ndarray:
+        """Move the whole fleet to a NEW home serving this fleet's
+        recovered lane state (placement failover): bind the same key
+        on ``new_listener`` claiming the OLD dedup slots and seeding
+        the committed-row watermarks at the acked counts
+        (WireListener.loopback_rehome), then carry every in-flight op
+        across the move under the at-least-once contract — all unacked
+        ops requeue and replay; the recovered machine's per-slot op-id
+        watermarks absorb the ones whose first copy committed on the
+        old home before it died.
+
+        Rank bookkeeping restarts at the acked watermark: ranks the
+        old home assigned to rows it never durably committed are
+        burned with it (they would otherwise hold the cumulative ack
+        watermark below the replays forever).  ``op_ever`` is re-based
+        against the RECOVERED watermarks — an op the old home placed
+        but never fsynced is gone from every durable record, so its
+        replay is a first copy and may re-key on refusal like any
+        never-placed op.
+
+        Returns the indices of the requeued (replaying) ops."""
+        old_d = self.listener.plane.directory
+        old_lanes = old_d.lane[self.handles].copy()
+        self.conns = new_listener.loopback_rehome(
+            self.n_conns, sessions_per_conn=self.spc, key=self.key,
+            tenants=self.tenants, slots=self.slots,
+            committed=self.watermark, trace_ctx=trace_ctx)
+        self.listener = new_listener
+        self.base = int(new_listener.hbase[self.conns[0]])
+        self.handles = self.base + np.arange(self.n_sessions,
+                                             dtype=np.int64)
+        d = new_listener.plane.directory
+        lanes = d.lane[self.handles]
+        if not (lanes == old_lanes).all():
+            # key→lane hashing is deterministic per (seed, key): a
+            # mismatch means the new home's directory was built with a
+            # different seed/lane count and the recovered per-lane
+            # machine state would not line up with the new placements
+            raise RuntimeError(
+                "rehome: lane placement diverged between homes")
+        self.tenant_of = d.tenant[self.handles].astype(np.int64)
+        # per-session durably-applied op-id watermark, straight from
+        # the recovered machine state (the fsynced-watermark gate)
+        dur_sess = np.zeros(self.n_sessions, np.int64)
+        mac = getattr(new_listener.plane.engine.state, "mac", None)
+        if isinstance(mac, dict) and "seq" in mac:
+            seq = np.asarray(mac["seq"]).max(axis=1)
+            dur_sess = seq[lanes.astype(np.int64),
+                           self.slots.astype(np.int64)].astype(np.int64)
+        live = self.op_state[:self.n_ops]
+        rank = self.op_rank[:self.n_ops]
+        osess = self.op_sess[:self.n_ops]
+        acked = (live == PLACED) & (rank >= 0) & \
+            (rank < self.watermark[osess])
+        durable = self.op_id[:self.n_ops] <= dur_sess[osess]
+        self.op_ever[:self.n_ops] = \
+            ((rank >= 0) | self.op_ever[:self.n_ops]) & durable
+        requeue = (live != QUEUED) & ~acked
+        self.op_state[:self.n_ops][requeue] = QUEUED
+        self.op_rank[:self.n_ops][requeue] = -1
+        self.placed_cnt[:] = self.watermark
+        # old-home credits will never arrive: drop the whole pending
+        # window (the flush gate reopens with it)
+        self._pend_key = np.zeros(0, np.int64)
+        self._pend_op = np.zeros(0, np.int64)
+        self._pend_per_sess = np.zeros(self.n_sessions, np.int64)
+        self.reconnects += self.n_conns
         return np.flatnonzero(requeue)
 
     # -- progress / oracle --------------------------------------------------

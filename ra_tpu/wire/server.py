@@ -294,6 +294,87 @@ class WireListener:
         record("wire.conn", bulk=key, n=int(n_conns), reconnect=False)
         return slots
 
+    def loopback_rehome(self, n_conns: int, *, sessions_per_conn: int
+                        = 1, key: str = "fleet", tenants: int = 1,
+                        slots: np.ndarray, committed: np.ndarray,
+                        trace_ctx=None) -> np.ndarray:
+        """Adopt a re-homed loopback fleet (placement failover, ISSUE
+        17): bind ``key``'s session block on THIS listener while
+        honoring the fleet's existing machine-level identity —
+
+        * ``slots`` are the per-session dedup slots the OLD home
+          handed out, claimed verbatim: a replayed op's payload still
+          carries its old ``[slot, op_id, delta]``, and the recovered
+          machine's per-(lane, slot) watermark is what absorbs the
+          duplicate.  Handing out FRESH slots here would re-apply
+          every replayed committed op — the double-apply this method
+          exists to prevent.
+        * ``committed`` seeds the per-session committed-row watermark
+          at the client's ACKED count: ranks burned on the old home
+          (placed rows that never committed) are dropped client-side
+          at re-home, so rank ``committed[s]`` is exactly the next row
+          the new home will commit for session ``s``.
+
+        Every re-homed session's epoch bumps (the replay trigger of
+        the reconnect contract).  Returns the conn slot ids."""
+        spc = int(sessions_per_conn)
+        d = self.plane.directory
+        if f"wire/{key}" in d._bulk:
+            raise RuntimeError(
+                f"rehome of known key {key!r}: a fleet re-homes onto "
+                "a listener that never served it (same-listener "
+                "reconnects go through loopback_connect)")
+        if len(self._free) < n_conns:
+            raise RuntimeError(
+                f"wire listener full ({self.max_conns} conns)")
+        h = self.plane.connect_bulk(n_conns * spc, key=f"wire/{key}",
+                                    tenants=max(1, tenants))
+        handles = np.asarray(h, np.int64)
+        claim = np.asarray(slots, np.int32)
+        if len(claim) != len(handles):
+            raise ValueError("rehome: one claimed slot per session")
+        self._ensure_session_arrays()
+        with self._lock:
+            lanes = d.lane[handles].astype(np.int64)
+            packed = (lanes << 32) | claim.astype(np.int64)
+            if len(np.unique(packed)) != len(packed):
+                raise ValueError(
+                    "rehome: duplicate (lane, slot) claims")
+            bound = np.flatnonzero(self._slot >= 0)
+            bound = bound[~np.isin(bound, handles)]
+            if len(bound):
+                have = (d.lane[bound].astype(np.int64) << 32) | \
+                    self._slot[bound].astype(np.int64)
+                if np.isin(packed, have).any():
+                    raise ValueError(
+                        "rehome: claimed slot already bound to a "
+                        "live session on this listener")
+            self._slot[handles] = claim
+            # later FRESH binds must allocate above every claim
+            np.maximum.at(self._lane_next, lanes,
+                          claim.astype(np.int64) + 1)
+            c = np.asarray(committed, np.int64)
+            self._committed[handles] = c
+            self._acked_sent[handles] = c
+        conn_slots = np.array([self._alloc_slot()
+                               for _ in range(n_conns)], np.int64)
+        self.cstate[conn_slots] = _S_DATA
+        self.hbase[conn_slots] = int(h[0]) + np.arange(
+            n_conns, dtype=np.int64) * spc
+        self.nsess[conn_slots] = spc
+        self._lb_slots.update(int(s) for s in conn_slots)
+        self._is_lb[conn_slots] = True
+        for s in conn_slots:
+            self._lb_key[int(s)] = key
+        self._base_dirty = True
+        d.epoch[handles] += 1
+        self.plane.counters["reconnects"] += len(handles)
+        self.counters["conns_opened"] += n_conns
+        self.counters["hello_reconnects"] += n_conns
+        record("placement.rehome", trace=trace_ctx, key=key,
+               sessions=len(handles), conns=int(n_conns))
+        return conn_slots
+
     def loopback_feed(self, conns: np.ndarray, rec_bytes: bytes,
                       counts: np.ndarray) -> np.ndarray:
         """Scatter encoded DATA records into the fleet's rings (the

@@ -119,6 +119,24 @@ class Nemesis:
         if system is not None and system.wal.alive:
             system.wal.kill()
 
+    # -- placement plane (ISSUE 17) -----------------------------------------
+
+    def _op_engine_kill(self, host) -> None:
+        """Kill-9 a whole lane-engine host (ra_tpu.placement.host
+        .LaneEngineHost): WAL shards die abruptly, unfsynced tail
+        lost, no shutdown ceremony.  The heal is placement_failover —
+        the classic control plane re-homes the lane space, the host
+        itself never comes back."""
+        host.kill9()
+
+    def _op_placement_failover(self, supervisor, victim: str,
+                               survivor: str, trace_ctx=None) -> None:
+        """Heal for engine_kill: drive the supervisor's committed
+        re-placement of ``victim``'s lane ranges onto ``survivor``
+        (generation-gated table commands; the supervisor's on_migrate
+        hook performs the adoption + session re-homing)."""
+        supervisor.failover(victim, survivor, trace_ctx=trace_ctx)
+
 
 def current_leader(router: LocalRouter,
                    sids: Iterable[ServerId]) -> Optional[ServerId]:

@@ -1,0 +1,389 @@
+"""Placement failover pins (ISSUE 17): the replicated PlacementTable
+machine (never half-moved, redelivery-idempotent), the engine
+supervisor's hysteresis detector (delay is not death), the bounded
+commit loop (RA16's runtime twin), the wire listener's re-home claims
+(old dedup slots or nothing), and the end-to-end failover soak with
+its exactly-once oracle + trace timeline."""
+import numpy as np
+import pytest
+
+from harness import SimCluster
+from ra_tpu.core.machine import ApplyMeta
+from ra_tpu.core.types import ErrorResult
+from ra_tpu.placement import (EngineSupervisor, PlacementCache,
+                              PlacementError, PlacementTableMachine,
+                              owned_ranges, run_failover_soak)
+from ra_tpu.transport.rpc import FaultPlan, FaultSpec
+
+# the soak's kill-9 dies loudly in the victim's WAL threads by design
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+META = ApplyMeta(index=1, term=1)
+
+
+def _mk(*commands):
+    """Apply a command sequence to a fresh table; -> (machine, state)."""
+    m = PlacementTableMachine()
+    state = m.init({})
+    for cmd in commands:
+        state, _reply = m.apply(META, cmd, state)
+    return m, state
+
+
+# -- the table machine ----------------------------------------------------
+
+def test_table_register_assign_migrate():
+    m, st = _mk(("register_engine", "engA"),
+                ("register_engine", "engB"),
+                ("assign", "r0", "engA", 0, 8))
+    assert st["ranges"]["r0"] == {"engine": "engA", "generation": 1,
+                                  "lo": 0, "hi": 8}
+    st, reply = m.apply(META, ("migrate", "r0", "engA", "engB", 2), st)
+    assert reply == ("placed", "r0", "engB", 2)
+    assert owned_ranges(st, "engA") == []
+    assert [rid for rid, _ in owned_ranges(st, "engB")] == ["r0"]
+
+
+def test_migrate_redelivery_is_idempotent():
+    """A re-delivered migrate (cumulative-ack redelivery, a retrying
+    supervisor) observes the move it already made — same reply, zero
+    state churn."""
+    m, st = _mk(("register_engine", "engA"),
+                ("register_engine", "engB"),
+                ("assign", "r0", "engA", 0, 8),
+                ("migrate", "r0", "engA", "engB", 2))
+    rev = st["rev"]
+    st2, reply = m.apply(META, ("migrate", "r0", "engA", "engB", 2), st)
+    assert reply == ("placed", "r0", "engB", 2)
+    assert st2["rev"] == rev          # no-op: rev did not move
+    assert st2["ranges"]["r0"]["engine"] == "engB"
+
+
+def test_migrate_stale_generation_absorbed():
+    """A migrate against a generation that already moved on is answered
+    with the standing placement, never applied."""
+    m, st = _mk(("register_engine", "engA"),
+                ("register_engine", "engB"),
+                ("assign", "r0", "engA", 0, 8),
+                ("migrate", "r0", "engA", "engB", 2))
+    # stale supervisor still thinks engA owns r0 at gen <= 2
+    st2, reply = m.apply(META, ("migrate", "r0", "engA", "engC", 2), st)
+    assert reply == ("placed", "r0", "engB", 2)
+    assert st2["ranges"]["r0"]["engine"] == "engB"
+
+
+def test_engine_down_generation_gated():
+    m, st = _mk(("register_engine", "engA"))
+    st2, reply = m.apply(META, ("engine_down", "engA", 99), st)
+    assert reply == ("engine", "engA", "up", 1)   # wrong gen: no-op
+    assert st2["engines"]["engA"]["status"] == "up"
+    st3, reply = m.apply(META, ("engine_down", "engA", 1), st2)
+    assert reply == ("engine", "engA", "down", 1)
+    assert st3["engines"]["engA"]["status"] == "down"
+
+
+def test_assign_refuses_cross_engine_reassign():
+    """Re-homing an existing range must go through migrate (generation
+    gated); a bare re-assign is refused with the standing placement."""
+    m, st = _mk(("register_engine", "engA"),
+                ("register_engine", "engB"),
+                ("assign", "r0", "engA", 0, 8))
+    st2, reply = m.apply(META, ("assign", "r0", "engB", 0, 8), st)
+    assert reply == ("refused", "r0", "engA", 1)
+    assert st2["ranges"]["r0"]["engine"] == "engA"
+    # identical re-assign is the idempotent no-op
+    _st3, reply = m.apply(META, ("assign", "r0", "engA", 0, 8), st2)
+    assert reply == ("placed", "r0", "engA", 1)
+
+
+def test_placement_cache_is_revision_monotone():
+    _m, st = _mk(("register_engine", "engA"),
+                 ("assign", "r0", "engA", 0, 8))
+    cache = PlacementCache()
+    assert cache.refresh(st) is True
+    assert cache.lookup("r0") == ("engA", 1)
+    assert cache.lane_owner(3) == "engA"
+    assert cache.lane_owner(9) is None
+    stale = {"rev": st["rev"] - 1, "ranges": {}}
+    assert cache.refresh(stale) is False       # lagging follower read
+    assert cache.lookup("r0") == ("engA", 1)   # ...never rolls back
+    assert cache.stale_against({"rev": st["rev"] + 1}) is True
+    cache.invalidate()
+    assert cache.lookup("r0") is None
+
+
+# -- never half-moved under leader kill-9 ---------------------------------
+
+def test_leader_kill9_mid_migration_never_half_moved():
+    """Kill-9 the classic leader mid-migration: the table lands pre- or
+    post-move (one committed command — there is no half-moved state),
+    and re-delivering the migration is idempotent."""
+    c = SimCluster(3, machine_factory=lambda: PlacementTableMachine())
+    n1, n2, n3 = c.ids
+    c.elect(n1)
+    for cmd in (("register_engine", "engA"),
+                ("register_engine", "engB"),
+                ("assign", "r0", "engA", 0, 8)):
+        c.command(n1, cmd)
+    # the leader accepts the migrate but dies (isolated) before any
+    # AppendEntries lands — the uncommitted entry must vanish
+    c.isolate(n1)
+    c.command(n1, ("migrate", "r0", "engA", "engB", 2))
+    assert c.servers[n1].machine_state["ranges"]["r0"]["engine"] == \
+        "engA"                       # appended, NOT applied
+    c.elect(n2)
+    c.heal()
+    c.run()
+    for sid in c.ids:                # pre-move everywhere: no half state
+        ent = c.servers[sid].machine_state["ranges"]["r0"]
+        assert (ent["engine"], ent["generation"]) == ("engA", 1)
+    # the supervisor re-delivers the same migration to the new leader
+    c.command(n2, ("migrate", "r0", "engA", "engB", 2))
+    c.run()
+    for sid in c.ids:                # post-move everywhere
+        ent = c.servers[sid].machine_state["ranges"]["r0"]
+        assert (ent["engine"], ent["generation"]) == ("engB", 2)
+    rev = c.servers[n2].machine_state["rev"]
+    c.command(n2, ("migrate", "r0", "engA", "engB", 2))  # and again
+    c.run()
+    assert c.servers[n2].machine_state["rev"] == rev     # absorbed
+    for sid in c.ids:
+        ent = c.servers[sid].machine_state["ranges"]["r0"]
+        assert (ent["engine"], ent["generation"]) == ("engB", 2)
+
+
+# -- the detector: delay is not death -------------------------------------
+
+def _sup(probe, *, fault_plan=None, suspect_after=0.15, down_after=1.0,
+         hysteresis=0.5):
+    """A supervisor on a fake clock; -> (sup, clock cell)."""
+    t = [0.0]
+    sup = EngineSupervisor(
+        None, None, probes={"eng": probe}, suspect_after=suspect_after,
+        down_after=down_after, hysteresis=hysteresis,
+        fault_plan=fault_plan, clock=lambda: t[0])
+    return sup, t
+
+
+def test_pure_delay_never_migrates():
+    """The ISSUE 17 pin: a FaultPlan that only DELAYS probe replies
+    (every delay under down_after) makes the engine look slow — late
+    arrivals show up as last-heard age, suspects fire, recoveries
+    follow — but never yields a down verdict."""
+    plan = FaultPlan(7, by_class={"ping": FaultSpec(
+        delay=1.0, delay_ms=(200.0, 900.0))})
+    sup, t = _sup(lambda: True, fault_plan=plan)
+    downs = []
+    for _ in range(400):
+        t[0] += 0.05
+        downs.extend(sup.tick())
+    plan.unregister()
+    assert downs == []
+    assert sup.counters["downs"] == 0
+    assert sup.verdict("eng") != "down"
+    assert sup.counters["suspects"] > 0       # the delay WAS visible
+    assert sup.counters["recoveries"] > 0     # ...and rode out
+
+
+def test_hysteresis_absorbs_spike_then_kill_downs():
+    """A silence spike shorter than the hysteresis window recovers; a
+    kill-9 (permanent silence) escalates to down exactly once."""
+    alive = [True]
+    sup, t = _sup(lambda: alive[0], suspect_after=0.1, down_after=0.3,
+                  hysteresis=0.5)
+    alive[0] = False                 # spike: 0.55s of silence
+    while t[0] < 0.55:
+        t[0] += 0.05
+        assert sup.tick() == []      # > down_after but < hysteresis
+    alive[0] = True
+    t[0] += 0.05
+    sup.tick()
+    assert sup.verdict("eng") == "up"
+    assert sup.counters["recoveries"] == 1
+    assert sup.counters["downs"] == 0
+    alive[0] = False                 # the real kill-9
+    downs = []
+    while t[0] < 2.0:
+        t[0] += 0.05
+        downs.extend(sup.tick())
+    assert downs == ["eng"]
+    assert sup.verdict("eng") == "down"
+    assert sup.counters["downs"] == 1
+
+
+def test_drop_plan_downs():
+    """Dropped probes ARE silence: a drop-everything plan escalates to
+    down even though the engine's probe callable still answers."""
+    plan = FaultPlan(3, by_class={"ping": FaultSpec(drop=1.0)})
+    sup, t = _sup(lambda: True, fault_plan=plan)
+    downs = []
+    for _ in range(100):
+        t[0] += 0.05
+        downs.extend(sup.tick())
+    plan.unregister()
+    assert downs == ["eng"]
+    assert sup.counters["downs"] == 1
+
+
+# -- the bounded commit loop (RA16's runtime twin) ------------------------
+
+def test_commit_loop_gives_up_on_deadline():
+    t = [0.0]
+    sup = EngineSupervisor(None, None, commit_timeout=0.1,
+                           clock=lambda: t[0])
+
+    def attempt():
+        t[0] += 0.05
+        raise RuntimeError("leader gone")
+
+    with pytest.raises(PlacementError):
+        sup._commit(attempt, what="migrate/r0")
+    assert sup.counters["giveups"] == 1
+    assert sup.counters["migrate_retries"] > 0
+
+
+def test_commit_loop_retries_returned_error_results():
+    """The classic API reports churn by RETURNING ErrorResult, not by
+    raising — the commit loop must treat that as a retryable failure."""
+    t = [0.0]
+    sup = EngineSupervisor(None, None, commit_timeout=0.1,
+                           clock=lambda: t[0])
+    results = [ErrorResult("not_leader"), ErrorResult("timeout"), "ok"]
+
+    def attempt():
+        t[0] += 0.01
+        return results.pop(0)
+
+    assert sup._commit(attempt, what="migrate/r0") == "ok"
+    assert sup.counters["migrate_retries"] == 2
+    assert sup.counters["giveups"] == 0
+
+
+# -- the re-home claim path -----------------------------------------------
+
+def _stack(lanes, slots=64):
+    from ra_tpu.engine import LockstepEngine
+    from ra_tpu.ingress import IngressPlane
+    from ra_tpu.wire import DedupCounterMachine, WireListener
+    eng = LockstepEngine(DedupCounterMachine(slots=slots), lanes, 3,
+                         ring_capacity=128, max_step_cmds=8,
+                         donate=False)
+    plane = IngressPlane(eng, superstep_k=2, window_s=0.0)
+    lst = WireListener(plane, port=None)
+    return eng, lst
+
+
+def test_rehome_claims_are_validated():
+    """loopback_rehome claims the OLD dedup slots or nothing: known
+    keys, short claims, duplicate (lane, slot) pairs and collisions
+    with live sessions are all refused before any state changes."""
+    from ra_tpu.wire import LoopbackFleet
+    eng, lst = _stack(lanes=1)       # one lane: claims are deterministic
+    try:
+        fleet = LoopbackFleet(lst, 2, key="k")
+        zeros = np.zeros(2, np.int64)
+        with pytest.raises(RuntimeError, match="known key"):
+            lst.loopback_rehome(2, key="k", slots=fleet.slots,
+                                committed=zeros)
+        with pytest.raises(ValueError, match="one claimed slot"):
+            lst.loopback_rehome(2, key="short",
+                                slots=np.array([7], np.int32),
+                                committed=zeros)
+        with pytest.raises(ValueError, match="duplicate"):
+            lst.loopback_rehome(2, key="dup",
+                                slots=np.array([5, 5], np.int32),
+                                committed=zeros)
+        with pytest.raises(ValueError, match="already bound"):
+            lst.loopback_rehome(2, key="clash", slots=fleet.slots,
+                                committed=zeros)
+        # a clean claim above every live slot succeeds and bumps epochs
+        before = lst.plane.directory.epoch[fleet.handles].copy()
+        conns = lst.loopback_rehome(2, key="fresh",
+                                    slots=np.array([10, 11], np.int32),
+                                    committed=zeros)
+        assert len(conns) == 2
+        assert (lst.plane.directory.epoch[fleet.handles] ==
+                before).all()        # other sessions untouched
+    finally:
+        lst.close()
+        eng.close()
+
+
+def test_rehome_refuses_diverged_lane_placement():
+    """fleet.rehome adopts a new home only when the deterministic
+    directory hash lands every session on the SAME lane there — a
+    different lane geometry must refuse, not silently mis-place."""
+    from ra_tpu.wire import LoopbackFleet
+    eng_a, lst_a = _stack(lanes=2)
+    eng_b, lst_b = _stack(lanes=4)
+    try:
+        # one session whose key is pinned to hash onto DIFFERENT lanes
+        # at 2 vs 4 lanes (the splitmix64 placement is seed-stable, so
+        # this divergence is deterministic)
+        fleet = LoopbackFleet(lst_a, 1, key="div")
+        with pytest.raises(RuntimeError, match="diverged"):
+            fleet.rehome(lst_b)
+    finally:
+        lst_a.close()
+        eng_a.close()
+        lst_b.close()
+        eng_b.close()
+
+
+# -- end to end: the failover soak + the trace timeline -------------------
+
+#: CPU-scaled bar on kill -> first-commit-on-new-home (the TPU bench
+#: stamps the real number; this pins "bounded", not "fast")
+RECOVERY_BAR_S = 60.0
+
+
+@pytest.fixture(scope="module")
+def failover_run(tmp_path_factory):
+    from ra_tpu.blackbox import RECORDER
+    row = run_failover_soak(
+        0, conns=4, sessions_per_conn=2, lanes=8, waves=4,
+        wave_ops=200, kill_wave=2,
+        data_dir=str(tmp_path_factory.mktemp("failover")),
+        recovery_bar=RECOVERY_BAR_S)
+    return row, RECORDER.events()
+
+
+def test_failover_soak_exactly_once(failover_run):
+    row, _events = failover_run
+    assert row["failover_lost_acked"] == 0
+    assert row["failover_double_applied"] == 0
+    assert 0 < row["failover_recovery_s"] <= RECOVERY_BAR_S
+    assert row["migrations"] >= 1
+    assert row["detector"]["downs"] == 1
+    assert row["rehomed_sessions"] == 8
+
+
+def test_failover_trace_timeline(failover_run):
+    """One failover trace joins the cross-tier hops in causal order:
+    client refusal at the old home -> table commit on the classic
+    cluster -> adoption + re-home on the survivor — and ra_trace
+    --explain renders that timeline."""
+    import tools.ra_trace as rt
+    _row, events = failover_run
+    refusals = [e for e in events
+                if e[1] == "placement.refuse" and e[2].get("trace")]
+    assert refusals, "soak recorded no traced placement.refuse"
+    tid = refusals[-1][2]["trace"]
+    traces = rt.index_traces([(ts, et, f, "soak")
+                              for ts, et, f in events])
+    tl = traces[tid]
+    first_ts = {}
+    for ts, etype, _f, _o in tl["hops"]:
+        first_ts.setdefault(etype, ts)
+    for hop in ("placement.refuse", "cmd.submit", "cmd.commit",
+                "placement.migrate", "placement.adopt",
+                "placement.rehome"):
+        assert hop in first_ts, f"trace missing {hop} hop"
+    assert first_ts["placement.refuse"] <= first_ts["cmd.submit"] \
+        <= first_ts["cmd.commit"] <= first_ts["placement.adopt"] \
+        <= first_ts["placement.rehome"]
+    text = rt.explain(tid, tl)
+    for needle in ("placement.refuse", "cmd.commit", "placement.adopt",
+                   "placement.rehome"):
+        assert needle in text

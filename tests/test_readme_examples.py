@@ -4,6 +4,8 @@ patched), so the documented first-contact API can never rot."""
 import os
 import re
 
+import pytest
+
 
 def _patch(src, old, new):
     """Replace that REFUSES to no-op: README drift must fail the test,
@@ -19,8 +21,8 @@ def _blocks():
         text = f.read()
     return re.findall(r"```python\n(.*?)```", text, re.DOTALL)
 
-def test_readme_has_seven_python_blocks():
-    assert len(_blocks()) == 7
+def test_readme_has_eight_python_blocks():
+    assert len(_blocks()) == 8
 
 def test_classic_quickstart_block(tmp_path):
     src = _blocks()[0]
@@ -151,6 +153,26 @@ def test_wire_quickstart_block():
             ns["cli"].close()
         if "eng" in ns:
             ns["eng"].close()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_failover_quickstart_block(tmp_path):
+    """The ISSUE 17 failover block: one small-geometry failover soak
+    runs as written and the exactly-once oracle closes (the kill-9
+    dies loudly in the victim's WAL thread by design)."""
+    src = _blocks()[7]
+    assert "run_failover_soak" in src
+    # route the soak's durable dirs into the test sandbox
+    src = _patch(src, "kill_wave=2)",
+                 "kill_wave=2, data_dir=str(tmp_path))")
+    ns: dict = {"tmp_path": tmp_path}
+    exec(compile(src, "README.md[failover]", "exec"), ns)  # noqa: S102
+    row = ns["row"]
+    assert row["failover_lost_acked"] == 0
+    assert row["failover_double_applied"] == 0
+    assert row["failover_recovery_s"] > 0
+    assert row["migrations"] >= 1
 
 
 def test_telemetry_quickstart_block(tmp_path):

@@ -2077,7 +2077,7 @@ def test_file_rules_ride_the_engine(tmp_path):
     finally:
         sys.path.pop(0)
     codes = {r.code for r in FILE_RULES}
-    assert {"RA05", "RA06", "RA07"} <= codes, codes
+    assert {"RA05", "RA06", "RA07", "RA16"} <= codes, codes
     import ast as _ast
     lint_src = open(LINT, encoding="utf-8").read()
     tree = _ast.parse(lint_src)
@@ -2086,3 +2086,135 @@ def test_file_rules_ride_the_engine(tmp_path):
     for gone in ("_check_field_registry", "_check_event_registry_use",
                  "_check_autotune_contract"):
         assert gone not in defs, gone
+
+
+# -- RA16: placement retry bounds (ISSUE 17) ------------------------------
+
+_RA16_BB = 'EVENT_REGISTRY = {"placement.giveup": "doc"}\n'
+
+
+def _ra16_fixture(tmp_path, body):
+    """A fixture module inside a `placement/` dir (the rule's scope)
+    with a local blackbox.py registering the give-up event."""
+    pdir = tmp_path / "placement"
+    pdir.mkdir(exist_ok=True)
+    (pdir / "blackbox.py").write_text(_RA16_BB)
+    mod = pdir / "sup.py"
+    mod.write_text(body)
+    return mod
+
+
+def test_ra16_flags_unbounded_and_silent_retry_loops(tmp_path):
+    """RA16: an unbounded escalation loop is flagged, and a bounded
+    loop whose function never emits a registered give-up event is
+    flagged too (exhaustion must be visible to the flight recorder)."""
+    mod = _ra16_fixture(tmp_path, textwrap.dedent("""\
+        import time
+        from blackbox import record
+
+
+        def unbounded(sid, cmd, router):
+            while True:                     # RA16: no bound evidence
+                res = process_command(sid, cmd, router)
+                if res:
+                    return res
+                time.sleep(0.1)
+
+
+        def bounded_but_silent(sid, cmd, router, clock):
+            deadline = clock() + 5.0
+            while clock() < deadline:       # RA16: bounded, no giveup
+                res = process_command(sid, cmd, router)
+                if res:
+                    return res
+            return None
+    """))
+    r = run_lint(str(mod))
+    assert r.returncode == 1
+    assert r.stdout.count("RA16") == 2, r.stdout
+    assert "no deadline/bounded-attempt evidence" in r.stdout
+    assert "never emits a registered record" in r.stdout
+
+
+def test_ra16_full_shape_is_clean(tmp_path):
+    """The supervisor's canonical shape passes: deadline in the loop
+    test + a registered give-up record on exhaustion.  A bound-guarded
+    break inside the body is accepted as bound evidence too."""
+    mod = _ra16_fixture(tmp_path, textwrap.dedent("""\
+        from blackbox import record
+
+
+        def commit(attempt_fn, clock, timeout):
+            deadline = clock() + timeout * 3
+            attempts = 0
+            while clock() < deadline:
+                attempts += 1
+                res = attempt_fn()
+                if res is not None:
+                    return res
+            record("placement.giveup", what="commit",
+                   attempts=attempts)
+            raise RuntimeError("gave up")
+
+
+        def poll(attempt_fn, max_tries):
+            tries = 0
+            while True:
+                res = attempt_fn()
+                if res is not None:
+                    return res
+                tries += 1
+                if tries >= max_tries:      # bound-guarded raise
+                    record("placement.giveup", what="poll",
+                           attempts=tries)
+                    raise RuntimeError("gave up")
+    """))
+    r = run_lint(str(mod))
+    assert "RA16" not in r.stdout, r.stdout
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_ra16_scope_and_suppression(tmp_path):
+    """RA16 only gates files inside a `placement/` directory; inside
+    the scope `# ra16-ok: <why>` allowlists a site and the audit
+    flags the tag once the loop stops being a finding."""
+    body = textwrap.dedent("""\
+        import time
+
+
+        def unbounded(sid, cmd, router):
+            while True:
+                res = process_command(sid, cmd, router)
+                if res:
+                    return res
+                time.sleep(0.1)
+    """)
+    # same content OUTSIDE a placement/ dir: out of scope, clean
+    other = tmp_path / "elsewhere.py"
+    other.write_text(body)
+    r = run_lint(str(other))
+    assert "RA16" not in r.stdout, r.stdout
+    # inside the scope, the tag suppresses (and stays audit-live)
+    mod = _ra16_fixture(tmp_path, body.replace(
+        "while True:",
+        "while True:  # ra16-ok: fixture, externally watchdogged"))
+    r = run_lint(str(mod))
+    assert "RA16" not in r.stdout and "AUDIT" not in r.stdout, r.stdout
+    # a tag on a line the rule no longer flags is itself an error
+    stale = _ra16_fixture(tmp_path, textwrap.dedent("""\
+        def fine():  # ra16-ok: stale
+            return 1
+    """))
+    r = run_lint(str(stale))
+    assert "stale suppression" in r.stdout, r.stdout
+
+
+def test_placement_package_is_ra16_clean():
+    """The live pin: every retry loop the real placement package ships
+    satisfies its own rule (the supervisor's _commit deadline loop and
+    the soak's recovery/drain loops carry bounds + give-up events)."""
+    pkg = os.path.join(REPO, "ra_tpu", "placement")
+    mods = [os.path.join(pkg, f) for f in sorted(os.listdir(pkg))
+            if f.endswith(".py")]
+    r = run_lint(*mods)
+    assert "RA16" not in r.stdout, r.stdout

@@ -74,6 +74,7 @@ TAG_FAMILIES = (
     ("RA13",),
     ("RA14",),
     ("RA15",),
+    ("RA16",),
 )
 
 
@@ -450,17 +451,25 @@ class FileRule:
     scoped run feeds the audit the same raw findings the full run
     does."""
 
-    def __init__(self, code, check, basenames=None, all_source=False):
+    def __init__(self, code, check, basenames=None, dirnames=None,
+                 all_source=False):
         self.code = code
         self.check = check
         self.basenames = frozenset(basenames) if basenames else None
+        self.dirnames = frozenset(dirnames) if dirnames else None
         self.all_source = all_source
 
     def matches(self, mod):
         if mod.in_tests:
             return False
-        if self.basenames is not None:
-            return os.path.basename(mod.path) in self.basenames
+        if self.basenames is not None and \
+                os.path.basename(mod.path) in self.basenames:
+            return True
+        if self.dirnames is not None and os.path.basename(
+                os.path.dirname(mod.path)) in self.dirnames:
+            return True
+        if self.basenames is not None or self.dirnames is not None:
+            return False
         return self.all_source
 
 
@@ -718,6 +727,108 @@ def _check_autotune_contract(mod, ctx):
     return out
 
 
+#: control-plane calls whose presence makes a While loop a RETRY loop
+#: (RA16): commit/query submission, reliable RPC, and pacing sleeps —
+#: the verbs a placement/failover escalation loop is built from
+_RA16_RETRY_CALLS = frozenset({
+    "process_command", "consistent_query", "local_query", "node_call",
+    "reliable_node_call", "send_rpc", "sleep", "attempt"})
+_RA16_BOUND = ("deadline", "attempt", "tries", "remaining", "budget",
+               "retry", "giveup")
+
+
+def _ra16_local_walk(root):
+    """Nodes of ``root`` excluding nested function/lambda bodies (each
+    function is judged exactly once, against ITS loops)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _ra16_idents(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _ra16_has_bound(name_iter):
+    return any(b in n.lower() for n in name_iter for b in _RA16_BOUND)
+
+
+def _check_retry_bounds(mod, ctx):
+    """RA16 — no silent infinite retry in the placement/failover
+    control plane: a While loop that submits commands / reliable RPCs
+    / pacing sleeps must (a) carry deadline-or-attempt bound evidence
+    (bound names in the loop test, or a bound-guarded break/raise in
+    the body) and (b) live in a function that emits a REGISTERED
+    ``record(...)`` event — the give-up a post-mortem can grep for.
+    An unbounded escalation loop against a dead peer is exactly how a
+    failover wedges forever with nothing in the flight recorder."""
+    keys = ctx.registry_keys(mod.path) or set()
+    out = []
+    funcs = [n for n in ast.walk(mod.tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        gives_up = False
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) and sub.args and \
+                    isinstance(sub.args[0], ast.Constant) and \
+                    sub.args[0].value in keys:
+                f = sub.func
+                name = f.id if isinstance(f, ast.Name) else \
+                    f.attr if isinstance(f, ast.Attribute) else None
+                if name == "record":
+                    gives_up = True
+                    break
+        for loop in _ra16_local_walk(fn):
+            if not isinstance(loop, ast.While):
+                continue
+            retry = None
+            for sub in ast.walk(loop):
+                if isinstance(sub, ast.Call):
+                    f = sub.func
+                    name = f.id if isinstance(f, ast.Name) else \
+                        f.attr if isinstance(f, ast.Attribute) else None
+                    if name in _RA16_RETRY_CALLS:
+                        retry = name
+                        break
+            if retry is None:
+                continue
+            bounded = _ra16_has_bound(_ra16_idents(loop.test))
+            if not bounded:
+                for sub in ast.walk(loop):
+                    if isinstance(sub, ast.If) and \
+                            _ra16_has_bound(_ra16_idents(sub.test)) and \
+                            any(isinstance(s, (ast.Break, ast.Raise,
+                                               ast.Return))
+                                for b in sub.body for s in ast.walk(b)):
+                        bounded = True
+                        break
+            if not bounded:
+                out.append(Finding(
+                    mod.path, loop.lineno, "RA16",
+                    f"{fn.name}(): retry loop around {retry}() has no "
+                    "deadline/bounded-attempt evidence (no bound name "
+                    "in the loop test, no bound-guarded break/raise) — "
+                    "an unreachable peer wedges this control-plane "
+                    "loop forever"))
+            elif not gives_up:
+                out.append(Finding(
+                    mod.path, loop.lineno, "RA16",
+                    f"{fn.name}(): bounded retry loop around "
+                    f"{retry}() never emits a registered record(...) "
+                    "give-up event — exhaustion is invisible to the "
+                    "flight recorder (register one in EVENT_REGISTRY "
+                    "and emit it on the give-up path)"))
+    return out
+
+
 FILE_RULES = [
     FileRule("RA05", _check_field_registry, basenames={"metrics.py"}),
     FileRule("RA06", _check_event_registry_use, all_source=True),
@@ -725,6 +836,7 @@ FILE_RULES = [
              basenames={"blackbox.py"}),
     FileRule("RA07", _check_autotune_contract,
              basenames={"autotune.py"}),
+    FileRule("RA16", _check_retry_bounds, dirnames={"placement"}),
 ]
 
 

@@ -67,9 +67,15 @@ LATENCY_FIELDS = ("p50_commit_latency_ms", "p99_commit_latency_ms",
 #: lower-is-better with 0 a meaningful healthy baseline (recovery
 #: carries a -1 "no storm ran" sentinel, skipped like the latency
 #: sentinels)
+#: placement-failover keys (ISSUE 17) ride the shed shape too:
+#: ``failover_recovery_s`` (kill-9 → first commit on the new home)
+#: lower-is-better with a -1 "no failover ran" sentinel, and
+#: ``failover_lost_acked`` lower-is-better where 0 is THE healthy
+#: baseline — any acked-but-lost delta appearing from 0 must flag
 INGRESS_RATE_FIELDS = ("ingress_cmds_per_s", "wire_cmds_per_s")
 INGRESS_SHED_FIELDS = ("ingress_shed_rate", "wire_shed_rate",
-                       "wire_reconnect_recovery_s")
+                       "wire_reconnect_recovery_s",
+                       "failover_recovery_s", "failover_lost_acked")
 
 #: device-plane compile counts (ISSUE 16): absolute comparison, any
 #: growth is a regression — the workload is fixed across rounds, so an

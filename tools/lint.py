@@ -9,7 +9,8 @@ call graph): a host sync or per-entry pickle moved into a helper one
 file away no longer escapes its gate.  Since ISSUE 15 the engine also
 gates the JIT PLANE (RA13 trace hazards / RA14 donation lifetime /
 RA15 pytree schema, ``tools/analyzer/jitplane.py``) and evaluates the
-per-file registry rules (RA05/RA06/RA07) as declarative FILE_RULES in
+per-file registry rules (RA05/RA06/RA07, and since ISSUE 17 the
+RA16 placement retry-bound rule) as declarative FILE_RULES in
 ``tools/analyzer/rules.py``.  This module keeps the CLI and output
 contract (``path:line: CODE msg`` + ``lint: N files, M findings``)
 and the cheap generic checks (syntax/F/B/E/W + RA01/RA03); the engine
@@ -138,6 +139,16 @@ Checks (cheap, high-signal, zero-config):
                 (c) every staged superstep-block key
                 (shardings.get("n_new")) must exist in
                 superstep_block_shardings.  `# ra15-ok: <why>`
+  RA16          (files in a `placement/` directory only) retry/
+                escalation loops in the failover control plane: a
+                While loop around process_command / consistent_query /
+                reliable RPC / pacing sleep must carry deadline-or-
+                bounded-attempt evidence (bound name in the loop test,
+                or a bound-guarded break/raise) AND live in a function
+                that emits a registered `record(...)` give-up event —
+                an unbounded escalation loop against a dead peer is
+                how a failover wedges forever with nothing in the
+                flight recorder.  `# ra16-ok: <why>` allowlists
   AUDIT         every `raNN-ok` comment tag on a line its rule family
                 no longer flags is itself an error — allowlists can't
                 rot (tags inside string literals are ignored:

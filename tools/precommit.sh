@@ -13,7 +13,12 @@
 #    mixed-shape probe is detected — the runtime mirror of the jit
 #    static gates, so a retrace regression fails the same local loop
 #    that catches a lint finding.
-# 3. `pytest tests/test_static_gates.py` runs the full gate suite
+# 3. `tools/soak.py --failover 0` runs ONE seed of the ISSUE 17
+#    placement-failover soak (~10s): a lane engine kill-9'd
+#    mid-traffic, the classic control plane commits the re-placement,
+#    sessions re-home, and the exactly-once oracle closes over the
+#    union of both engines' state.
+# 4. `pytest tests/test_static_gates.py` runs the full gate suite
 #    (rule fixtures + clean pins + the analyzer runtime budget).
 #
 # Exit nonzero on any finding or test failure.  The full-tree lint
@@ -23,4 +28,5 @@ set -e
 cd "$(dirname "$0")/.."
 python tools/lint.py --changed
 python tools/soak.py --device-obs 0 1
+python tools/soak.py --failover 0
 exec python -m pytest tests/test_static_gates.py -q

@@ -270,6 +270,30 @@ def _wire_main(argv: list) -> int:
     return 0
 
 
+def _failover_main(argv: list) -> int:
+    """--failover SEED [SEED...] [--disk-faults]: placement-failover
+    soak — kill-9 one lane engine mid-traffic, classic control plane
+    commits the re-placement, sessions re-home, exactly-once oracle
+    over the union of both engines' state."""
+    from ra_tpu.placement.soak import failover_main
+
+    disk = "--disk-faults" in argv
+    argv = [a for a in argv if not a.startswith("--")]
+    seeds = [int(a) for a in argv] or [0]
+    t0 = time.time()
+    try:
+        rows = failover_main(seeds, disk_faults=disk)
+    except Exception:  # noqa: BLE001 — report + nonzero exit
+        traceback.print_exc()
+        print(f"failover: FAILED in {time.time() - t0:.1f}s",
+              flush=True)
+        return 1
+    lost = sum(r["failover_lost_acked"] for r in rows)
+    print(f"failover: {len(rows)}/{len(seeds)} seeds ok in "
+          f"{time.time() - t0:.1f}s  lost_acked={lost}", flush=True)
+    return 1 if lost else 0
+
+
 def _device_obs_main(argv: list) -> int:
     """--device-obs SEED [n]: the device-observatory chaos family."""
     import test_devicewatch as tdw
@@ -312,6 +336,8 @@ def main() -> int:
         return _obs_main(sys.argv[2:])
     if len(sys.argv) > 1 and sys.argv[1] == "--device-obs":
         return _device_obs_main(sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1] == "--failover":
+        return _failover_main(sys.argv[2:])
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 400
     off = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
     families = [
