@@ -153,21 +153,26 @@ class _Client:
                     starved_since = None
                 continue
             starved_since = None
-            sent = 0
+            corrs = []
+            sampled = []
+            for _ in range(take):
+                corr = (self.cid, seq)
+                if not (seq & 15):  # sample 1/16
+                    sampled.append(corr)
+                seq += 1
+                corrs.append(corr)
+            if sampled:
+                t0 = time.perf_counter()
+                with self._lock:
+                    for corr in sampled:
+                        self.inflight[corr] = t0
             try:
-                for _ in range(take):
-                    corr = (self.cid, seq)
-                    if not (seq & 15):  # sample 1/16
-                        t0 = time.perf_counter()
-                        with self._lock:
-                            self.inflight[corr] = t0
-                    seq += 1
-                    send(payload, corr, self.on_notify)
-                    sent += 1
+                send(payload, corrs, self.on_notify)
             except Exception:  # noqa: BLE001 — leader moved; retry path
                 with self._lock:
-                    self.credit += take - sent
-                    self.inflight.pop((self.cid, seq - 1), None)
+                    self.credit += take
+                    for corr in sampled:
+                        self.inflight.pop(corr, None)
                 time.sleep(0.05)
 
 
@@ -243,13 +248,14 @@ def _phase_local() -> dict:
         assert res is not None, "no leader elected"
         leader = res.leader
 
-        def send(payload, corr, cb):
+        def send(payload, corrs, cb):
             # untraced bulk pipelining (the reference's cast carries no
             # tracing either) — the measured path is the data plane,
-            # not the per-command observability plane
-            ra_tpu.pipeline_command(leader, payload, correlation=corr,
-                                    notify_to=cb, router=router,
-                                    trace_ctx=False)
+            # not the per-command observability plane; the whole credit
+            # burst rides ONE ingress call (ISSUE 18)
+            ra_tpu.pipeline_commands(leader, [(payload, c) for c in corrs],
+                                     notify_to=cb, router=router,
+                                     trace_ctx=False)
 
         def warm(payload):
             ra_tpu.process_command(leader, payload, router=router)
@@ -266,6 +272,10 @@ def _phase_local() -> dict:
             **node.classic_stats(),
             "records_per_fsync": wal_stats["records_per_fsync"],
         }
+        # codec encode share at the row top level (ISSUE 18): the
+        # lower-better key bench_diff compares across rounds
+        row["encode_share_pct"] = row["classic_batch"].get(
+            "encode_share_pct", -1.0)
         # unified Observatory snapshot of the shared system (WAL fsync
         # p50/p99 + queue depth, segment writer, disk faults) with the
         # classic batching stats wired in as their own source
@@ -365,14 +375,15 @@ def _phase_tcp() -> dict:
         assert res is not None, "no leader elected over TCP"
         leader = res.leader
 
-        def send(payload, corr, cb):
+        def send(payload, corrs, cb):
             # the remote pipeline fan-in (ISSUE 13): commands buffer
             # client-side and ship as multi-command frames; followers
             # relay a stale-leader batch, so a mid-run election costs
-            # one hop, not an exception storm
-            ra_tpu.pipeline_command(leader, payload, correlation=corr,
-                                    notify_to=cb, router=client,
-                                    trace_ctx=False)
+            # one hop, not an exception storm; the burst rides ONE
+            # buffer-lock cycle (ISSUE 18)
+            ra_tpu.pipeline_commands(leader, [(payload, c) for c in corrs],
+                                     notify_to=cb, router=client,
+                                     trace_ctx=False)
 
         def warm(payload):
             ra_tpu.process_command(leader, payload, router=client)
@@ -393,6 +404,10 @@ def _phase_tcp() -> dict:
                 timeout=30)
         except (RuntimeError, TimeoutError) as exc:
             row["classic_batch"] = {"error": repr(exc)[:200]}
+        # codec encode share at the row top level (ISSUE 18), same key
+        # as the local phase so bench_diff tracks both rows
+        row["encode_share_pct"] = row["classic_batch"].get(
+            "encode_share_pct", -1.0)
         # client-side Observatory: the reliable-RPC counters (retries,
         # dedup hits, unreachable) ride the classic JSON tail like the
         # WAL stats do on the local phase (ISSUE 7 satellite — the

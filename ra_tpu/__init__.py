@@ -28,6 +28,7 @@ from .api import (  # noqa: E402,F401
     overview,
     ping,
     pipeline_command,
+    pipeline_commands,
     process_command,
     remove_member,
     restart_server,

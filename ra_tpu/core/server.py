@@ -1277,7 +1277,8 @@ class RaServer:
         if isinstance(event, CommandEvent):
             return self._leader_command(event.command, event.from_)
         if isinstance(event, CommandsEvent):
-            effects = self._leader_append_batch(event.commands)
+            effects = self._leader_append_batch(event.commands,
+                                                event.images)
             effects.extend(self._make_pipelined_rpcs())
             return effects
         if isinstance(event, WrittenEvent):
@@ -1528,7 +1529,8 @@ class RaServer:
         effects.extend(self._make_pipelined_rpcs())
         return effects
 
-    def _leader_append_batch(self, commands: tuple) -> list:
+    def _leader_append_batch(self, commands: tuple,
+                             images: Optional[tuple] = None) -> list:
         """Drain one {commands, Batch} flush into the log as RUNS of
         plain user commands (ISSUE 13): one contiguous-index Entry run,
         ONE log batch-append (= one memtable lock cycle + one WAL
@@ -1536,9 +1538,15 @@ class RaServer:
         the reply-mode/trace checks.  Anything that is not a plain
         UserCommand (membership ops, machine-internal commands) closes
         the run and takes the per-command append path — those are rare
-        and carry their own effect logic."""
+        and carry their own effect logic.
+
+        ``images`` (ISSUE 18) — codec payload images aligned with
+        ``commands``, shipped by the wire receiver: the run's images
+        ride into append_batch as the durable payloads, so a command
+        that arrived over TCP is never re-encoded at the leader."""
         effects: list = []
         run: list = []
+        run_imgs: Optional[list] = [] if images is not None else None
         append_batch = self._log_append_batch
         log = self.log
 
@@ -1550,7 +1558,7 @@ class RaServer:
             entries = [Entry(idx0 + i, term, cmd)
                        for i, cmd in enumerate(run)]
             if append_batch is not None:
-                append_batch(entries)
+                append_batch(entries, run_imgs if run_imgs else None)
             else:
                 for e in entries:
                     log.append(e)
@@ -1566,10 +1574,14 @@ class RaServer:
                                          CommandResult(idx0 + i, term,
                                                        None, self.id)))
             run.clear()
+            if run_imgs is not None:
+                run_imgs.clear()
 
-        for cmd in commands:
+        for i, cmd in enumerate(commands):
             if type(cmd) is UserCommand:
                 run.append(cmd)
+                if run_imgs is not None:
+                    run_imgs.append(images[i])
             else:
                 _flush_run()
                 effects.extend(self._leader_append(cmd, None))

@@ -340,9 +340,16 @@ class CommandEvent:
 
 @dataclass(frozen=True)
 class CommandsEvent:
-    """Flushed batch of low-priority commands ({commands, Cmds})."""
+    """Flushed batch of low-priority commands ({commands, Cmds}).
+
+    ``images`` (ISSUE 18) optionally carries the commands' codec payload
+    images, aligned 1:1 with ``commands``: a batch that arrived over the
+    wire was already encoded ONCE at the client, so the leader appends
+    the shipped bytes (and a follower relays them) instead of
+    re-encoding — the encode-once contract of ra_tpu.codec."""
 
     commands: tuple
+    images: Optional[tuple] = None
 
 
 @dataclass(frozen=True)

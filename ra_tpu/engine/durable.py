@@ -356,7 +356,12 @@ class _WalShard:
                 r0 = int(csum[0]) if lo else 0
                 r1 = int(csum[-1])
                 flat = np.asarray(aux["flat_rows"][r0:r1])
+            t_blk = time.monotonic()
             blk = encode_block_flat(hi, n_app, n_acc, flat, lane_lo=lo)
+            # encode phase stamp (ISSUE 18): just the block encode+CRC,
+            # the lane plane's contribution to encode_share_pct (the
+            # classic plane's half lands in DurableLog._put_batch)
+            self.bridge.phases.note("encode", time.monotonic() - t_blk)
         # wal_encode phase stamp: readback pull + encode + CRC for one
         # step's block on this shard (runs off the dispatch thread)
         self.bridge.phases.note("wal_encode", time.monotonic() - t_enc)

@@ -29,12 +29,12 @@ import os
 import pickle
 import struct
 import threading
+import time
 import zlib
 from typing import Any, Callable, Iterable, Optional
 
-from ..core.types import (Entry, IdxTerm, ReplyMode, SnapshotMeta,
-                          UserCommand, WalUpEvent, WrittenEvent,
-                          strip_local_handles)
+from ..core.types import (Entry, IdxTerm, SnapshotMeta, WalUpEvent,
+                          WrittenEvent)
 from ..metrics import LOG_FIELDS
 from ..utils.flru import Flru
 from .faults import IO, note as _fault_note
@@ -55,41 +55,13 @@ _MISS = object()
 
 MAX_CHECKPOINTS = 10  # ra.hrl:234
 
-#: fast-path frame marker for the durable command image.  Pickle streams
-#: (protocol >= 2) always start with 0x80, so 0x01 is collision-free and
-#: old WAL/segment payloads keep decoding through the generic branch.
-_CMD_FAST = b"\x01"
-
-
-def encode_command(cmd: Any) -> bytes:
-    """Durable image of a log command.  UserCommand — the hot path, every
-    client write — gets a compact tuple frame (~9x faster to encode and
-    ~30% smaller than the dataclass pickle: no class/enum metadata per
-    record, the WAL-density concern of ra_log_wal.erl:404-421); anything
-    else (noop/membership/cluster ops — rare) takes the generic pickle of
-    its handle-stripped form.  Process-local reply handles are dropped
-    either way; remote (tuple) handles survive, a failed-over leader owes
-    those notifications."""
-    if type(cmd) is UserCommand:
-        from_ = cmd.from_ if isinstance(cmd.from_, (str, int, tuple)) \
-            else None
-        notify = cmd.notify_to \
-            if isinstance(cmd.notify_to, (str, int, tuple)) else None
-        return _CMD_FAST + pickle.dumps(
-            (cmd.data, cmd.reply_mode.value, cmd.correlation, from_,
-             notify, cmd.reply_from), protocol=pickle.HIGHEST_PROTOCOL)
-    return pickle.dumps(strip_local_handles(cmd))
-
-
-def decode_command(payload: bytes) -> Any:
-    if payload[:1] == _CMD_FAST:
-        fields = pickle.loads(payload[1:])
-        data, rm, corr, from_, notify = fields[:5]
-        # frames written before the reply_from field carry five entries
-        reply_from = fields[5] if len(fields) > 5 else None
-        return UserCommand(data, ReplyMode(rm), corr, notify, from_,
-                           reply_from)
-    return pickle.loads(payload)
+#: the durable command image is owned by ra_tpu.codec since ISSUE 18 —
+#: one schema'd layout from socket to segment, with the pre-codec 0x01
+#: fast-tuple frame and raw-pickle images kept as decode-only legacy
+#: branches so r06-era WAL/segment dirs still recover.  Re-exported here
+#: because every log-plane consumer (and lint rule RA10's encoder-name
+#: resolution) imports the pair from this module.
+from ..codec import decode_command, encode_command  # noqa: E402  (re-export)
 
 
 def _write_snapshot_file(path: str, meta: SnapshotMeta, data: bytes) -> None:
@@ -229,7 +201,11 @@ class DurableLog:
         # reads); ordering discipline: _io_lock before _lock, never inverse
         self._io_lock = threading.Lock()
         self._events: list = []            # pending events for the shell
-        self._memtable: dict[int, tuple] = {}  # idx -> (term, command_obj)
+        # idx -> Entry: reads hand back the stored object, so the apply
+        # fold and AER build pay ZERO per-entry construction (the
+        # Entry-per-read rebuild was ~5 namedtuple ctors per command on
+        # the classic plane, ISSUE 18)
+        self._memtable: dict[int, Entry] = {}
         self._mem_bytes: dict[int, bytes] = {}  # idx -> payload (for flush)
         # creation order, newest LAST — load-bearing: _segment_read scans
         # reversed so a newer segment's entries supersede older ones where
@@ -373,7 +349,7 @@ class DurableLog:
             if idx <= snap_idx:
                 continue
             cmd = decode_command(payload)
-            self._memtable[idx] = (term, cmd)
+            self._memtable[idx] = Entry(idx, term, cmd)
             self._mem_bytes[idx] = payload
             if idx >= last:
                 last, last_term = idx, term
@@ -405,7 +381,7 @@ class DurableLog:
             if probe == snap_idx and self._snapshot is not None:
                 last_term = self._snapshot[0].term
             elif probe in self._memtable:
-                last_term = self._memtable[probe][0]
+                last_term = self._memtable[probe].term
             else:
                 got = self._segment_read(probe) if probe else None
                 last_term = got[0] if got else 0
@@ -487,7 +463,7 @@ class DurableLog:
                     raw = self._mem_bytes.get(idx)
                     if ent is not None and raw is not None:
                         self.counters["write_resends"] += 1
-                        self.wal.write(self.uid, idx, ent[0], raw)
+                        self.wal.write(self.uid, idx, ent.term, raw)
                 return
             self._events.append(WrittenEvent(lo, hi, term))
 
@@ -516,7 +492,7 @@ class DurableLog:
         from .wal import WalDown
         self._wal_generation = self.wal.generation
         lw = self._last_written.index
-        items = [(i, self._memtable[i][0], self._mem_bytes[i])
+        items = [(i, self._memtable[i].term, self._mem_bytes[i])
                  for i in sorted(self._mem_bytes)
                  if lw < i <= self._last_index]
         try:
@@ -602,9 +578,16 @@ class DurableLog:
         hand the WAL one contiguous fan-in submit."""
         if payloads is None or len(payloads) != len(entries):
             # local/fallback encode — the leader's own append, or a
-            # catch-up resend whose source bytes were segment-flushed
+            # catch-up resend whose source bytes were segment-flushed.
+            # This is the classic plane's encode phase stamp (ISSUE 18):
+            # wire-shipped batches skip this branch entirely, so a
+            # falling encode_share_pct is the encode-once proof.
+            ph = self.wal.phases
+            t0 = time.monotonic() if ph is not None else 0.0
             payloads = [encode_command(e.command)  # ra10-ok: fallback when no shipped payloads ride the frame
                         for e in entries]
+            if ph is not None:
+                ph.note("encode", time.monotonic() - t0)
         self.counters["write_ops"] += len(entries)
         first = entries[0].index
         last_e = entries[-1]
@@ -633,7 +616,7 @@ class DurableLog:
             truncate = self._truncate_next
             self._truncate_next = False
             for e, payload in zip(entries, payloads):
-                memtable[e.index] = (e.term, e.command)
+                memtable[e.index] = e
                 mem_bytes[e.index] = payload
                 items.append((e.index, e.term, payload, truncate))
                 truncate = False
@@ -666,7 +649,7 @@ class DurableLog:
                 if self._last_written.index >= entry.index:
                     self._last_written = IdxTerm(entry.index - 1,
                                                  rewind_term)
-            self._memtable[entry.index] = (entry.term, entry.command)
+            self._memtable[entry.index] = entry
             self._mem_bytes[entry.index] = payload
             self._last_index = entry.index
             self._last_term = entry.term
@@ -746,7 +729,7 @@ class DurableLog:
                         raw = self._mem_bytes.get(idx)
                         if ent is not None and raw is not None:
                             self.counters["write_resends"] += 1
-                            self.wal.write(self.uid, idx, ent[0], raw)
+                            self.wal.write(self.uid, idx, ent.term, raw)
                     return
             if evt.from_index > self._last_index:
                 # reverted below the whole range (explicit reset or
@@ -803,7 +786,7 @@ class DurableLog:
             ent = self._memtable.get(idx)
             if ent is not None:
                 self.counters["read_cache"] += 1
-                return Entry(idx, ent[0], ent[1])
+                return ent
         got = self._segment_read(idx)
         if got is None:
             return None
@@ -835,7 +818,7 @@ class DurableLog:
             return None
         ent = self._memtable.get(idx)
         if ent is not None:
-            return ent[0]
+            return ent.term
         return _MISS
 
     def fetch_term(self, idx: int) -> Optional[int]:
@@ -875,7 +858,7 @@ class DurableLog:
             for i in range(lo, hi + 1):
                 ent = mt.get(i)
                 if ent is not None:
-                    out.append(Entry(i, ent[0], ent[1]))
+                    out.append(ent)    # the stored Entry, no rebuild
                 else:
                     out.append(i)  # placeholder: resolve via segments
                     misses += 1
@@ -913,7 +896,7 @@ class DurableLog:
                 raw = mb.get(i)
                 if ent is None or raw is None:
                     break
-                entries.append(Entry(i, ent[0], ent[1]))
+                entries.append(ent)
                 payloads.append(raw)
                 total += len(raw)
                 if max_bytes and total >= max_bytes:
@@ -967,7 +950,8 @@ class DurableLog:
         with self._io_lock:
             with self._lock:
                 snap_idx = self._snapshot[0].index if self._snapshot else 0
-                items = sorted((i, self._mem_bytes[i], self._memtable[i][0])
+                items = sorted((i, self._mem_bytes[i],
+                                self._memtable[i].term)
                                for i in self._mem_bytes
                                if i <= up_to and i > snap_idx
                                and i <= self._last_index)

@@ -23,10 +23,31 @@ entry is always recoverable from exactly one of {wal files, segments}.
 Hot path (encode+write+sync) goes through ra_tpu.native with the GIL
 released.
 
-File format "RTW1": magic(4B) then records:
+File format "RTW3": magic(4B) then records:
   type:u8
     1 = writer registration: wid:u32 uid_len:u16 uid
     2 = entry: wid:u32 idx:u64 term:u64 len:u32 crc:u32 payload
+    3 = batch run (ISSUE 18): wid:u32 count:u32 body_len:u32 crc:u32
+        body = count x (idx:u64 term:u64 slot:u32) triplets
+        (body_len == count*20 exactly).  ``slot`` indexes the file's
+        CUMULATIVE payload table (type 4): payload images are interned
+        once per file, so the three co-hosted members of a cluster
+        writing the same entry burst into the shared WAL cost one
+        payload image plus three 20-byte triplet runs — the payload
+        fan-out was the dominant share of WAL bytes (and of the crc +
+        write(2) time under them) once group commit amortized the
+        fsync (ISSUE 13 -> 18).  One writer's contiguous burst is ONE
+        record with ONE streaming crc over header+body.
+    4 = payload-table append (ISSUE 18): n:u32 body_len:u32 crc:u32
+        body = n x len:u32, then the n payload images concatenated.
+        Appends n images to the file-scope payload table consumed by
+        every later type-3 record; the writer emits one per batch run
+        that carries images not already interned in this file.
+        Payloads are ra_tpu.codec images, relayed byte-for-byte from
+        whoever encoded them first.
+RTW2 (same layout, no types 3/4, per-entry header crc) and RTW1
+(payload-only entry crc) files remain readable — the format version
+rides the file magic, so pre-codec data dirs recover unchanged.
 """
 from __future__ import annotations
 
@@ -42,11 +63,15 @@ from .. import trace
 from ..blackbox import RECORDER, record
 from .faults import IO, note as _fault_note
 
-MAGIC = b"RTW2"
+MAGIC = b"RTW3"
+MAGIC_V2 = b"RTW2"   # no batch-run records (read-compatible)
 MAGIC_V1 = b"RTW1"   # payload-only entry crc (read-compatible)
 _REG = struct.Struct("<BIH")        # type, wid, uid_len
 _ENT = struct.Struct("<BIQQII")     # type, wid, idx, term, len, crc
 _ENT_HDR = struct.Struct("<BIQQI")  # the crc-covered prefix of _ENT
+_RUN_HDR = struct.Struct("<BIII")   # type, wid, count, body_len
+_RUN_ENT = struct.Struct("<QQI")    # idx, term, slot (run-table triplet)
+_PAY_HDR = struct.Struct("<BII")    # type, n, body_len (payload table)
 _CRC = struct.Struct("<I")
 
 
@@ -61,7 +86,12 @@ def _entry_crc(header: bytes, payload: bytes) -> int:
     on the batch thread's hot loop (ISSUE 13)."""
     return IO.crc32(header + payload)
 
-DEFAULT_MAX_SIZE = 64 * 1024 * 1024   # ra.hrl:191 uses 256MB; scaled down
+#: ra.hrl:191's wal_max_size_bytes.  Matching the reference matters
+#: beyond parity: rollover triggers the segment flush, and a larger
+#: file lets release cursors truncate most of the memtable BEFORE the
+#: flush sees it — at 64MB the classic bench segment-flushed ~1.3
+#: entries per applied command, at 256MB ~0.2 (ISSUE 18)
+DEFAULT_MAX_SIZE = 256 * 1024 * 1024
 DEFAULT_MAX_BATCH = 8192              # ra.hrl:192
 
 #: consecutive faulted batches before the poison/rollover ladder gives
@@ -89,9 +119,10 @@ def _parse_wal_bytes(data: bytes) -> tuple:
     ("ent", wid, idx, term, payload) — pure parsing, no table mutation,
     so a corrupt read can be retried without double-applying."""
     records: list = []
-    if data[:4] not in (MAGIC, MAGIC_V1):
+    if data[:4] not in (MAGIC, MAGIC_V2, MAGIC_V1):
         return records, None
-    header_crc = data[:4] == MAGIC
+    header_crc = data[:4] != MAGIC_V1
+    payloads: list = []   # file-scope table type-4 appends / type-3 reads
     pos = 4
     while pos + 1 <= len(data):
         rtype = data[pos]
@@ -118,6 +149,55 @@ def _parse_wal_bytes(data: bytes) -> tuple:
             if len(payload) < plen or want != crc:
                 return records, ValueError("crc mismatch")  # torn tail
             records.append(("ent", wid, idx, term, payload))
+        elif rtype == 3:
+            # batch run: validate the WHOLE run (one streaming crc, then
+            # the triplet table against body_len) before appending any
+            # of its entries — a run lands atomically or not at all,
+            # which is exactly the confirm contract (nothing in a batch
+            # is confirmed before its full write + sync)
+            if pos + _RUN_HDR.size + _CRC.size > len(data):
+                return records, ValueError("torn run header")
+            _, wid, count, body_len = _RUN_HDR.unpack_from(data, pos)
+            (crc,) = _CRC.unpack_from(data, pos + _RUN_HDR.size)
+            body_start = pos + _RUN_HDR.size + _CRC.size
+            body = data[body_start:body_start + body_len]
+            if len(body) < body_len or IO.crc32(
+                    body, IO.crc32(data[pos:pos + _RUN_HDR.size])) != crc:
+                return records, ValueError("crc mismatch")  # torn tail
+            if body_len != count * _RUN_ENT.size:
+                return records, ValueError("run table size mismatch")
+            navail = len(payloads)
+            for i in range(count):
+                idx, term, slot = _RUN_ENT.unpack_from(
+                    body, i * _RUN_ENT.size)
+                if slot >= navail:
+                    return records, ValueError("run slot out of range")
+                records.append(("ent", wid, idx, term, payloads[slot]))
+            pos = body_start + body_len
+        elif rtype == 4:
+            # payload-table append: crc-validate the whole record, then
+            # extend the file-scope table — later type-3 runs reference
+            # these images by slot
+            if pos + _PAY_HDR.size + _CRC.size > len(data):
+                return records, ValueError("torn payload-table header")
+            _, n, body_len = _PAY_HDR.unpack_from(data, pos)
+            (crc,) = _CRC.unpack_from(data, pos + _PAY_HDR.size)
+            body_start = pos + _PAY_HDR.size + _CRC.size
+            body = data[body_start:body_start + body_len]
+            if len(body) < body_len or IO.crc32(
+                    body, IO.crc32(data[pos:pos + _PAY_HDR.size])) != crc:
+                return records, ValueError("crc mismatch")  # torn tail
+            lens_len = n * 4
+            if lens_len > body_len:
+                return records, ValueError("payload lens overrun body")
+            lens = struct.unpack_from("<%dI" % n, body)
+            if lens_len + sum(lens) != body_len:
+                return records, ValueError("payload blobs overrun body")
+            off = lens_len
+            for ln in lens:
+                payloads.append(body[off:off + ln])
+                off += ln
+            pos = body_start + body_len
         else:
             break
     return records, None
@@ -266,6 +346,13 @@ class Wal:
     @property
     def alive(self) -> bool:
         return self._thread.is_alive() and not self._stop
+
+    @property
+    def phases(self):
+        """The phase accumulator this WAL stamps (None when the owner
+        didn't wire one) — DurableLog adds its encode stamps to the
+        same accumulator so one overview covers the whole plane."""
+        return self._phases
 
     # -- registration -------------------------------------------------------
 
@@ -520,12 +607,49 @@ class Wal:
                         buf += _REG.pack(1, w.wid, len(ub))
                         buf += ub
                         new_regs.add(w.wid)
-                    wid = w.wid
+                    # the run lands as ONE type-3 record: a bulk-packed
+                    # triplet table, one streaming crc — no per-entry
+                    # pack/crc/append on the batch thread.  Payload
+                    # images intern into the file-scope table (type 4):
+                    # co-hosted members writing the same replicated
+                    # burst pay the image bytes once, not once per
+                    # member — the fan-out was most of the WAL's crc +
+                    # write(2) volume
+                    intern = self._intern
+                    nslot = self._intern_n
+                    new_lens: list = []
+                    new_blobs: list = []
+                    flat: list = []
+                    grow = flat.append
                     for index, term, payload, _trunc in items:
-                        hdr = pack_hdr(2, wid, index, term, len(payload))
-                        buf += hdr
-                        buf += pack_crc(_entry_crc(hdr, payload))
-                        buf += payload
+                        slot = intern.get(payload)
+                        if slot is None:
+                            slot = intern[payload] = nslot
+                            nslot += 1
+                            new_lens.append(len(payload))
+                            new_blobs.append(payload)
+                        grow(index)
+                        grow(term)
+                        grow(slot)
+                    if new_blobs:
+                        lens = struct.pack("<%dI" % len(new_lens),
+                                           *new_lens)
+                        cat = b"".join(new_blobs)
+                        phdr = _PAY_HDR.pack(4, len(new_blobs),
+                                             len(lens) + len(cat))
+                        pcrc = IO.crc32(cat, IO.crc32(lens,
+                                                      IO.crc32(phdr)))
+                        buf += phdr
+                        buf += pack_crc(pcrc)
+                        buf += lens
+                        buf += cat
+                        self._intern_n = nslot
+                    tab = struct.pack("<" + "QQI" * len(items), *flat)
+                    hdr = _RUN_HDR.pack(3, w.wid, len(items), len(tab))
+                    crc = IO.crc32(tab, IO.crc32(hdr))
+                    buf += hdr
+                    buf += pack_crc(crc)
+                    buf += tab
                     n_entries += len(items)
                     last_item = items[-1]
                     pending_last[muid] = last_item[0]
@@ -777,6 +901,12 @@ class Wal:
         self._file_entries = 0
         self._registered_in_file = set()
         self._file_ranges = {}
+        # payload interning is file-scope: type-3 slots index the table
+        # accumulated by THIS file's type-4 records, so the dict resets
+        # with the file (also on the fault-rollover path — a poisoned
+        # file's slots must not leak into the fresh one)
+        self._intern: dict = {}
+        self._intern_n = 0
 
     def _rollover(self) -> None:
         self._retire_current_file()

@@ -166,10 +166,15 @@ TELEMETRY_FIELDS = (
 #: block, ``fsync_wait`` the durability syscall, ``confirm_publish``
 #: fsync-to-confirm-notify fan-out, ``commit_e2e`` the full
 #: submit->all-shards-confirmed edge (the continuous commit-latency
-#: signal the `commit_p99_ms` SLO reads).
+#: signal the `commit_p99_ms` SLO reads), ``encode`` time spent
+#: producing codec payload images (ISSUE 18) — fed by BOTH planes: the
+#: classic leader/follower encode sites in DurableLog and the
+#: lane-engine WAL workers' block encode; its share of total phase time
+#: is the `encode_share_pct` key bench tails carry (lower is better —
+#: encode-once should drive it toward zero).
 PHASE_FIELDS = (
     "host_staging", "device_dispatch", "queue_wait", "wal_encode",
-    "fsync_wait", "confirm_publish", "commit_e2e",
+    "fsync_wait", "confirm_publish", "commit_e2e", "encode",
 )
 
 #: ingress-plane counter fields (ra_tpu/ingress/, ISSUE 10): one dict

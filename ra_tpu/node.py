@@ -626,6 +626,22 @@ class RaNode:
             self._wake.set()
         return True
 
+    def submit_commands(self, name: str, commands: list,
+                        priority: Priority = Priority.LOW) -> bool:
+        """Burst submit: one queue extend + one wake check for the whole
+        batch instead of a per-command submit_command round."""
+        shell = self.shells.get(name)
+        if shell is None or shell.stopped:
+            return False
+        if priority == Priority.LOW:
+            shell.low_queue.extend(commands)
+        else:
+            shell.inbox.extend(CommandEvent(c, from_=None)
+                               for c in commands)
+        if not self._wake.is_set():
+            self._wake.set()
+        return True
+
     # -- event loop ---------------------------------------------------------
 
     def _run(self) -> None:
@@ -979,6 +995,16 @@ class RaNode:
             sizes.extend(got)
         sizes.sort()
         n = len(sizes)
+        # encode share (ISSUE 18): co-hosted members fan into ONE wal
+        # carrying the system-wide phase accumulator — the first shell
+        # that reaches it answers for the node
+        enc_pct = -1.0
+        for shell in list(self.shells.values()):
+            ph = getattr(getattr(shell.server.log, "wal", None),
+                         "phases", None)
+            if ph is not None:
+                enc_pct = ph.encode_share_pct()
+                break
         return {
             "aer_batches_sent": batches,
             "aer_batch_entries": entries,
@@ -987,6 +1013,7 @@ class RaNode:
             "entries_per_batch_p50": sizes[n // 2] if n else -1,
             "entries_per_batch_p99":
                 sizes[min(n - 1, int(n * 0.99))] if n else -1,
+            "encode_share_pct": enc_pct,
         }
 
     def overview(self) -> dict:

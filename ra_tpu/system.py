@@ -128,6 +128,12 @@ class RaSystem:
         self.on_flush_escalation = None
         self.segment_writer = SegmentWriter(resolve=self._resolve,
                                             on_escalate=self._escalate)
+        #: classic-plane phase attribution (ISSUE 18): one accumulator
+        #: for every co-hosted server — the WAL stamps fsync_wait /
+        #: confirm_publish, the DurableLogs stamp encode — surfaced via
+        #: node.classic_stats() as encode_share_pct in bench tails
+        from .telemetry import PhaseStats
+        self.phase_stats = PhaseStats()
         # group-commit tunables ride through to the node-wide WAL (flush
         # on bytes OR interval; 0/0 keeps the drain-the-mailbox policy)
         self.wal = Wal(data_dir, sync_mode=wal_sync_mode,
@@ -135,7 +141,8 @@ class RaSystem:
                        max_entries=wal_max_entries,
                        max_batch_bytes=wal_max_batch_bytes,
                        max_batch_interval_ms=wal_max_batch_interval_ms,
-                       segment_writer=self.segment_writer)
+                       segment_writer=self.segment_writer,
+                       phase_stats=self.phase_stats)
         # Recovered WAL entries are purged at boot ONLY for uids with an
         # explicit force-delete tombstone.  Absence from the registry is
         # not proof of deletion (the directory file may predate the

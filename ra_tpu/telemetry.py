@@ -136,6 +136,17 @@ class PhaseStats:
         out["dropped"] = self.dropped
         return out
 
+    def encode_share_pct(self) -> float:
+        """Codec encode time as a percentage of ALL phase time this
+        accumulator has seen (ISSUE 18) — the lower-better bench-tail
+        key bench_diff tracks: encode-once should drive it toward zero
+        as shipped images replace per-entry object encode.  -1.0 until
+        any phase sample lands (sentinel, skipped by bench_diff)."""
+        with self._lock:
+            tot = sum(self._total_ms.values())
+            enc = self._total_ms.get("encode", 0.0)
+        return round(100.0 * enc / tot, 2) if tot > 0 else -1.0
+
 
 def _host_scalar(x) -> Any:
     """Device/np scalar -> python int/float; small vectors -> lists.

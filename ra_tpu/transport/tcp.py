@@ -60,7 +60,7 @@ from ..core.types import (
     UserCommand,
     strip_msg_handles,
 )
-from ..log.durable import decode_command
+from ..codec import decode_command, decode_user_parts, encode_user
 from ..metrics import RPC_FIELDS
 from ..node import LocalRouter
 from .rpc import RpcReceiver, stamp_origin
@@ -363,10 +363,14 @@ class TcpRouter(LocalRouter):
           the payload IS the handle-stripped durable image so no strip
           pass is needed;
         * a CommandsEvent of plain pipelined notify-mode commands ships
-          as per-command (data, correlation, notify-handle, trace)
-          tuples — the handle swap (_notify_id) happens here, memoized
-          per batch, instead of one dataclass replace + lock per
-          command on the caller's thread.
+          as per-command codec payload images (``__cmds2__``, ISSUE 18)
+          — the SAME bytes the leader will append, the WAL will write,
+          and segments will store, so this one encode is the only
+          object-encode the command ever sees.  The notify-handle swap
+          (_notify_id) happens first, memoized per batch, so the remote
+          handle is baked into the image.  A CommandsEvent that already
+          CARRIES images (a follower relaying a wire batch to the
+          leader) re-ships them byte-for-byte — relay is a memcpy.
 
         The receiver thread rebuilds the objects (decode off BOTH
         nodes' event-loop threads)."""
@@ -381,8 +385,14 @@ class TcpRouter(LocalRouter):
                               msg.payloads))
         if tm is CommandsEvent:
             cmds = msg.commands
+            images = msg.images
+            if images is not None and len(images) == len(cmds):
+                traces = tuple(c.trace for c in cmds) \
+                    if any(c.trace is not None for c in cmds) else None
+                return (to, src, ("__cmds2__", images, traces))
             handles: dict = {}  # per-batch memo: id(fn) -> handle
             rows = []
+            any_trace = False
             for c in cmds:
                 if type(c) is not UserCommand or \
                         c.reply_mode is not ReplyMode.NOTIFY or \
@@ -397,9 +407,18 @@ class TcpRouter(LocalRouter):
                             "rnotify", tuple(self.listen_addr),
                             self._router_id, self._notify_id(nt))
                     nt = h
-                rows.append((c.data, c.correlation, nt, c.trace))
+                img = encode_user(c.data, ReplyMode.NOTIFY,
+                                  c.correlation, nt, None, None)
+                if img is None:  # shape outside the fixed layout
+                    rows = None
+                    break
+                rows.append(img)
+                if c.trace is not None:
+                    any_trace = True
             if rows is not None:
-                return (to, src, ("__cmds__", tuple(rows)))
+                traces = tuple(c.trace for c in cmds) if any_trace \
+                    else None
+                return (to, src, ("__cmds2__", tuple(rows), traces))
             # mixed batch (rare): the legacy per-command rewrite + strip
             msg = CommandsEvent(tuple(self._rewrite_cmd(c)
                                       for c in cmds))
@@ -422,7 +441,20 @@ class TcpRouter(LocalRouter):
                     term=term, leader_id=leader_id, prev_log_index=pli,
                     prev_log_term=plt, leader_commit=commit,
                     entries=entries, payloads=payloads)
+            if tag == "__cmds2__":
+                _tag, images, traces = msg
+                if traces is None:
+                    cmds = tuple(decode_command(img) for img in images)
+                else:
+                    cmds = tuple(
+                        UserCommand(*decode_user_parts(img), trace=tr)
+                        for img, tr in zip(images, traces))
+                # keep the shipped images: the leader appends these
+                # exact bytes (no re-encode), a relaying follower
+                # re-ships them
+                return CommandsEvent(cmds, images)
             if tag == "__cmds__":
+                # pre-codec compact form — decode-only compatibility
                 return CommandsEvent(tuple(
                     UserCommand(data, reply_mode=ReplyMode.NOTIFY,
                                 correlation=corr, notify_to=nt,
@@ -436,19 +468,19 @@ class TcpRouter(LocalRouter):
         to, msg, src = (item if len(item) == 3 else (*item, None))
         try:
             if to == "__reply__":
-                frame = bytes([FRAME_REPLY]) + pickle.dumps(
+                frame = bytes([FRAME_REPLY]) + pickle.dumps(  # ra10-ok: control-plane single (reply), rare by design
                     msg, protocol=pickle.HIGHEST_PROTOCOL)
             elif to == "__notify__":
-                frame = bytes([FRAME_NOTIFY]) + pickle.dumps(
+                frame = bytes([FRAME_NOTIFY]) + pickle.dumps(  # ra10-ok: control-plane single (notify), rare by design
                     msg, protocol=pickle.HIGHEST_PROTOCOL)
             elif to == "__rpc_req__":
-                frame = bytes([FRAME_RPC_REQ]) + pickle.dumps(
+                frame = bytes([FRAME_RPC_REQ]) + pickle.dumps(  # ra10-ok: control-plane single (rpc req), rare by design
                     msg, protocol=pickle.HIGHEST_PROTOCOL)
             elif to == "__rpc_resp__":
-                frame = bytes([FRAME_RPC_RESP]) + pickle.dumps(
+                frame = bytes([FRAME_RPC_RESP]) + pickle.dumps(  # ra10-ok: control-plane single (rpc resp), rare by design
                     msg, protocol=pickle.HIGHEST_PROTOCOL)
             else:
-                payload = pickle.dumps(self._wire_form(to, msg, src),
+                payload = pickle.dumps(self._wire_form(to, msg, src),  # ra10-ok: ONE frame envelope; command payloads inside are codec images (bytes)
                                        protocol=pickle.HIGHEST_PROTOCOL)
                 frame = bytes([FRAME_MSG]) + payload
         except (pickle.PicklingError, TypeError, AttributeError):
@@ -467,7 +499,7 @@ class TcpRouter(LocalRouter):
         try:
             triples = [self._wire_form(to, msg, src)
                        for to, msg, src in items]
-            frame = bytes([FRAME_MSG_BATCH]) + pickle.dumps(
+            frame = bytes([FRAME_MSG_BATCH]) + pickle.dumps(  # ra10-ok: ONE envelope per coalesced batch; commands inside are codec images
                 triples, protocol=pickle.HIGHEST_PROTOCOL)
         except (pickle.PicklingError, TypeError, AttributeError):
             return None
@@ -613,6 +645,34 @@ class TcpRouter(LocalRouter):
                 del self._pipe_bufs[target]
                 return self.send("?", target, CommandsEvent(tuple(buf)))
         if n == 1:
+            if self._pipe_thread is None or \
+                    not self._pipe_thread.is_alive():
+                with self._pipe_lock:
+                    if self._pipe_thread is None or \
+                            not self._pipe_thread.is_alive():
+                        self._pipe_thread = threading.Thread(
+                            target=self._pipe_flusher, daemon=True,
+                            name="ra-tcp-pipe-flush")
+                        self._pipe_thread.start()
+            self._pipe_evt.set()
+        return True
+
+    def pipeline_cast_many(self, target: ServerId, cmds) -> bool:
+        """Burst twin of pipeline_cast: one lock cycle and one extend for
+        the whole batch (api.pipeline_commands' cross-host half).  A
+        burst may overfill the buffer past PIPELINE_FLUSH_SIZE; it
+        flushes as one oversized CommandsEvent rather than splitting —
+        the leader's batcher re-chunks on its side."""
+        with self._pipe_lock:
+            buf = self._pipe_bufs.get(target)
+            if buf is None:
+                buf = self._pipe_bufs[target] = []
+            n0 = len(buf)
+            buf.extend(cmds)
+            if len(buf) >= self.PIPELINE_FLUSH_SIZE:
+                del self._pipe_bufs[target]
+                return self.send("?", target, CommandsEvent(tuple(buf)))
+        if n0 == 0:
             if self._pipe_thread is None or \
                     not self._pipe_thread.is_alive():
                 with self._pipe_lock:

@@ -36,9 +36,9 @@ import numpy as np
 
 from ..ingress.coalesce import batch_rank
 from .framing import (DEFER, DUP, OK, REJECT, SHED, SLOW, T_ACK, T_CREDIT,
-                      T_HELLO_ACK, decode_ack, decode_credit,
-                      decode_hello_ack, encode_data, encode_hello,
-                      read_frame)
+                      T_ERR, T_HELLO_ACK, decode_ack, decode_credit,
+                      decode_error, decode_hello_ack, encode_data,
+                      encode_hello, read_frame)
 
 #: op replay states
 QUEUED, SENT, PLACED = 0, 1, 2
@@ -92,11 +92,24 @@ class WireClient:
                                              timeout=self.timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.sendall(encode_hello(self.key, self.n_sessions,
-                                       tenants=self.tenants))
+                                       tenants=self.tenants,
+                                       payload_width=self.payload_width))
         body = self._read_frame_blocking()
-        if body is None or body[0] != T_HELLO_ACK:
+        if body is None:
+            raise ConnectionError("wire: no HELLO_ACK")
+        if body[0] == T_ERR:
+            # the listener refused the handshake (version or
+            # payload-width mismatch): surface its reason verbatim
+            err = decode_error(body[1])
+            raise ConnectionError("wire: refused: %s" % err["message"])
+        if body[0] != T_HELLO_ACK:
             raise ConnectionError("wire: no HELLO_ACK")
         ack = decode_hello_ack(body[1])
+        srv_width = ack.get("payload_width", 0)
+        if srv_width and srv_width != self.payload_width:
+            raise ConnectionError(
+                "wire: payload_width %d != listener's %d"
+                % (self.payload_width, srv_width))
         new_epoch = ack["epoch"]
         self.handle_base = ack["handle_base"]
         self.slots = ack["slots"][:self.n_sessions] \

@@ -22,14 +22,23 @@ Control frames are variable-length and rare (connect-time / credit
 return), so they may be built and parsed per frame:
 
 * ``HELLO``      client→server  ``<ver u8> <tenants u8> <keylen u16>
-  <n_sessions u32> <key bytes>`` — resolves/creates the connection's
-  session block (same key ⇒ same sessions, epoch bumped: a reconnect).
-* ``HELLO_ACK``  server→client  ``<ver u8> <flags u8> <pad u16>
-  <epoch u32> <handle_base u64> <nslots u32> <i32 slot x nslots>`` —
-  the epoch is the at-least-once client's re-enqueue trigger
-  (docs/INGRESS.md "Delivery guarantees"); the per-session dedup
-  SLOTS are the machine-level identity a client embeds in payloads
-  for exactly-once-observable workloads (wire/dedup.py).
+  <n_sessions u32> <payload_width u8> <key bytes>`` — resolves/creates
+  the connection's session block (same key ⇒ same sessions, epoch
+  bumped: a reconnect).  ``payload_width`` (v2+) declares the client's
+  DATA column count C; the listener refuses a mismatch with an ERR
+  frame BEFORE any data record is interpreted — a C=4 client talking
+  to a C=3 sweep would otherwise misparse every frame boundary.
+* ``HELLO_ACK``  server→client  ``<ver u8> <flags u8>
+  <payload_width u16> <epoch u32> <handle_base u64> <nslots u32>
+  <i32 slot x nslots>`` — the epoch is the at-least-once client's
+  re-enqueue trigger (docs/INGRESS.md "Delivery guarantees"); the
+  per-session dedup SLOTS are the machine-level identity a client
+  embeds in payloads for exactly-once-observable workloads
+  (wire/dedup.py); ``payload_width`` echoes the server's accepted C.
+* ``ERR``        server→client  ``<code u8> <msglen u16> <utf-8 msg>``
+  — a refused handshake's reason (version / payload-width mismatch),
+  sent once before close so the client raises a protocol error
+  instead of timing out on a silently dropped connection.
 * ``CREDIT``     server→client  ``<level u8> <pad u8> <count u16>`` +
   ``count`` records ``<sess u16> <seqno u64> <status u8>`` — the
   CreditLadder verdict for every swept row, serialized back per
@@ -60,27 +69,39 @@ from ..ingress.backpressure import (DEFER, DUP, OK, REJECT, SHED, SLOW,
 
 __all__ = [
     "WIRE_VERSION", "T_HELLO", "T_HELLO_ACK", "T_DATA", "T_CREDIT",
-    "T_ACK", "data_dtype", "credit_dtype", "ack_dtype", "data_stride",
+    "T_ACK", "T_ERR", "E_VERSION", "E_PAYLOAD_WIDTH", "data_dtype",
+    "credit_dtype", "ack_dtype", "data_stride",
     "encode_hello", "decode_hello", "encode_hello_ack",
-    "decode_hello_ack", "encode_data", "decode_data", "encode_credit",
+    "decode_hello_ack", "encode_error", "decode_error",
+    "encode_data", "decode_data", "encode_credit",
     "decode_credit", "encode_ack", "decode_ack", "read_frame",
     "OK", "SLOW", "DEFER", "REJECT", "DUP", "SHED", "STATUS_NAMES",
 ]
 
-#: protocol version (HELLO/HELLO_ACK version byte)
-WIRE_VERSION = 1
+#: protocol version (HELLO/HELLO_ACK version byte).  v2 adds the
+#: payload-width negotiation + the ERR refusal frame; a v1 HELLO still
+#: parses (width reads as 0 = "not declared") but is refused with an
+#: ERR so the client fails loudly instead of misparsing DATA frames.
+WIRE_VERSION = 2
 
 T_HELLO = 1
 T_HELLO_ACK = 2
 T_DATA = 3
 T_CREDIT = 4
 T_ACK = 5
+T_ERR = 6
+
+#: ERR frame codes
+E_VERSION = 1        # HELLO version byte != WIRE_VERSION
+E_PAYLOAD_WIDTH = 2  # client's DATA column count != the listener's
 
 _LEN = struct.Struct("<I")
 _HELLO = struct.Struct("<BBBHI")       # type, ver, tenants, keylen, n_sessions
-_HELLO_ACK = struct.Struct("<BBBHIQ")  # type, ver, flags, pad, epoch, base
+_HELLO_W = struct.Struct("<B")         # v2+: payload_width (after _HELLO)
+_HELLO_ACK = struct.Struct("<BBBHIQ")  # type, ver, flags, width, epoch, base
 _CREDIT_HDR = struct.Struct("<BBBH")   # type, level, pad, count
 _ACK_HDR = struct.Struct("<BBHH")      # type, pad, pad, count
+_ERR_HDR = struct.Struct("<BBH")       # type, code, msglen
 
 
 def data_dtype(payload_width: int) -> np.dtype:
@@ -105,10 +126,12 @@ ack_dtype = np.dtype([("sess", "<u2"), ("acked", "<u8")])
 
 # -- control frames (rare; per-frame Python is fine here) -------------------
 
-def encode_hello(key: str, n_sessions: int, *, tenants: int = 1) -> bytes:
+def encode_hello(key: str, n_sessions: int, *, tenants: int = 1,
+                 payload_width: int = 3) -> bytes:
     kb = key.encode()
     body = _HELLO.pack(T_HELLO, WIRE_VERSION, tenants, len(kb),
-                       n_sessions) + kb
+                       n_sessions) \
+        + _HELLO_W.pack(payload_width) + kb
     return _LEN.pack(len(body)) + body
 
 
@@ -116,30 +139,52 @@ def decode_hello(body: bytes) -> dict:
     t, ver, tenants, keylen, n_sessions = _HELLO.unpack_from(body)
     if t != T_HELLO:
         raise ValueError(f"not a HELLO frame (type {t})")
-    key = body[_HELLO.size:_HELLO.size + keylen].decode()
+    # v1 bodies have no width byte: report 0 ("not declared") so the
+    # listener can refuse with a precise reason instead of a parse error
+    off = _HELLO.size
+    width = 0
+    if ver >= 2:
+        (width,) = _HELLO_W.unpack_from(body, off)
+        off += _HELLO_W.size
+    key = body[off:off + keylen].decode()
     return {"version": ver, "tenants": tenants, "key": key,
-            "n_sessions": n_sessions}
+            "n_sessions": n_sessions, "payload_width": width}
 
 
 def encode_hello_ack(epoch: int, handle_base: int,
-                     slots=None) -> bytes:
+                     slots=None, *, payload_width: int = 0) -> bytes:
     slots = np.zeros(0, np.int32) if slots is None else \
         np.asarray(slots, np.int32)
-    body = _HELLO_ACK.pack(T_HELLO_ACK, WIRE_VERSION, 0, 0,
+    body = _HELLO_ACK.pack(T_HELLO_ACK, WIRE_VERSION, 0, payload_width,
                            epoch, handle_base) \
         + struct.pack("<I", len(slots)) + slots.tobytes()
     return _LEN.pack(len(body)) + body
 
 
 def decode_hello_ack(body: bytes) -> dict:
-    t, ver, _fl, _p, epoch, base = _HELLO_ACK.unpack_from(body)
+    t, ver, _fl, width, epoch, base = _HELLO_ACK.unpack_from(body)
     if t != T_HELLO_ACK:
         raise ValueError(f"not a HELLO_ACK frame (type {t})")
     (n,) = struct.unpack_from("<I", body, _HELLO_ACK.size)
     slots = np.frombuffer(body, "<i4", n, _HELLO_ACK.size + 4) \
         if n else None
     return {"version": ver, "epoch": epoch, "handle_base": base,
-            "slots": slots}
+            "slots": slots, "payload_width": width}
+
+
+def encode_error(code: int, message: str) -> bytes:
+    mb = message.encode()[:65535]
+    body = _ERR_HDR.pack(T_ERR, code, len(mb)) + mb
+    return _LEN.pack(len(body)) + body
+
+
+def decode_error(body: bytes) -> dict:
+    t, code, msglen = _ERR_HDR.unpack_from(body)
+    if t != T_ERR:
+        raise ValueError(f"not an ERR frame (type {t})")
+    msg = body[_ERR_HDR.size:_ERR_HDR.size + msglen].decode(
+        errors="replace")
+    return {"code": code, "message": msg}
 
 
 # -- the data stream (vectorized both ways) ---------------------------------

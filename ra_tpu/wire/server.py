@@ -48,8 +48,9 @@ import numpy as np
 
 from ..blackbox import record
 from ..metrics import WIRE_FIELDS
-from .framing import (SHED, T_DATA, WIRE_VERSION, ack_dtype,
-                      credit_dtype, data_stride, decode_hello,
+from .framing import (E_PAYLOAD_WIDTH, E_VERSION, SHED, T_DATA,
+                      WIRE_VERSION, ack_dtype, credit_dtype,
+                      data_stride, decode_hello, encode_error,
                       encode_hello_ack)
 
 _LEN = struct.Struct("<I")
@@ -614,6 +615,19 @@ class WireListener:
             self.counters["protocol_errors"] += 1
             record("wire.error", slot=int(slot), why="version",
                    got=hello["version"])
+            self._refuse(slot, E_VERSION,
+                         "wire version %d != %d"
+                         % (hello["version"], WIRE_VERSION))
+            return None
+        if hello["payload_width"] != self.payload_width:
+            # a mismatched C would desynchronize the fixed-stride sweep
+            # on the very first DATA frame — refuse loudly instead
+            self.counters["protocol_errors"] += 1
+            record("wire.error", slot=int(slot), why="payload_width",
+                   got=hello["payload_width"], want=self.payload_width)
+            self._refuse(slot, E_PAYLOAD_WIDTH,
+                         "payload_width %d != listener's %d"
+                         % (hello["payload_width"], self.payload_width))
             return None
         if not (1 <= hello["n_sessions"] <= 1 << 16):
             self.counters["protocol_errors"] += 1
@@ -630,7 +644,8 @@ class WireListener:
         if sock is not None:
             h = base + np.arange(hello["n_sessions"], dtype=np.int64)
             if not _sendall_nb(sock, encode_hello_ack(
-                    epoch, base, slots=self.session_slots(h)),
+                    epoch, base, slots=self.session_slots(h),
+                    payload_width=self.payload_width),
                     deadline_s=2.0):
                 return None
             # replay the authoritative committed watermarks: a
@@ -646,6 +661,14 @@ class WireListener:
                 self.counters["ack_rows"] += len(rec)
                 _sendall_nb(sock, self._ack_frame(rec))
         return rest
+
+    def _refuse(self, slot: int, code: int, msg: str) -> None:
+        """Best-effort ERR frame before the caller closes the slot — a
+        refused client should see WHY, not a silent hangup it can only
+        diagnose as a timeout."""
+        sock = self._socks.get(slot)
+        if sock is not None:
+            _sendall_nb(sock, encode_error(code, msg), deadline_s=1.0)
 
     def _ring_write(self, slot: int, data) -> int:
         """Wrap-aware copy of ``data`` into the slot's ring; returns

@@ -1,0 +1,430 @@
+"""Codec format tests (ISSUE 18): the golden byte-exact corpus pin
+(layout change without a CODEC_VERSION bump fails here), old-format
+decode compatibility (legacy fast-tuple / raw-pickle / v1 corpus),
+seeded truncation/bit-flip corruption properties for every record type,
+and the WAL batch-run record's torn-tail / crc / old-magic recovery
+contract.
+"""
+import pickle
+import random
+import struct
+
+import pytest
+
+from ra_tpu.codec import (CODEC_VERSION, CodecError, TAG_FALLBACK,
+                          TAG_LEGACY_FAST, TAG_USER, decode_command,
+                          decode_user_parts, encode_command,
+                          encode_fallback, encode_user)
+from ra_tpu.core.types import ReplyMode, UserCommand
+from ra_tpu.log.faults import IO
+from ra_tpu.log.wal import (MAGIC, MAGIC_V2, _CRC, _ENT, _ENT_HDR,
+                            _PAY_HDR, _REG, _RUN_ENT, _RUN_HDR,
+                            _entry_crc, _parse_wal_bytes)
+
+# ---------------------------------------------------------------------------
+# golden corpus — BYTE-EXACT v1 images.  If any of these pins fails,
+# the wire/WAL/segment layout moved: bump CODEC_VERSION, keep the old
+# decode branch alive, and append (never rewrite) a new corpus — files
+# and peers running the old layout must keep decoding forever.
+# ---------------------------------------------------------------------------
+
+GOLDEN_V1 = [
+    # (name, encode_user args, pinned image)
+    ("raw_notify",
+     (b"hello", ReplyMode.NOTIFY, (7, 1),
+      ("rnotify", ("10.0.0.1", 5000), 3, 9), None, None),
+     bytes.fromhex(
+         "0201020105000000120048000100010068656c6c6f060207000000000000"
+         "00010000000000000004040800000003726e6f746966791c000000040209"
+         "0000000331302e302e302e31090000000188130000000000000900000001"
+         "0300000000000000090000000109000000000000000000")),
+    ("tuple_data",
+     (("set", "k", 1), ReplyMode.AWAIT_CONSENSUS, None, None, None,
+      None),
+     bytes.fromhex(
+         "020101001d00000001000100010001000403040000000373657402000000"
+         "036b0900000001010000000000000000000000")),
+    ("int_corr",
+     (1234, ReplyMode.AFTER_LOG_APPEND, 99, None, None, None),
+     bytes.fromhex(
+         "0201000009000000090001000100010001d2040000000000000163000000"
+         "00000000000000")),
+    ("noreply_str",
+     ("ping", ReplyMode.NOREPLY, None, None, None, None),
+     bytes.fromhex("020103000500000001000100010001000370696e6700000000")),
+]
+
+
+def test_codec_version_is_pinned():
+    # layout changes REQUIRE a version bump + a new appended corpus;
+    # this pin forces the editor through that checklist
+    assert CODEC_VERSION == 1
+
+
+@pytest.mark.parametrize("name,args,image",
+                         [(n, a, i) for n, a, i in GOLDEN_V1])
+def test_golden_corpus_byte_exact(name, args, image):
+    assert encode_user(*args) == image, \
+        f"{name}: USER layout changed — bump CODEC_VERSION and append " \
+        "a new corpus generation (old images must keep decoding)"
+
+
+@pytest.mark.parametrize("name,args,image",
+                         [(n, a, i) for n, a, i in GOLDEN_V1])
+def test_golden_corpus_decodes(name, args, image):
+    data, rm, corr, notify, from_, reply_from = args
+    got = decode_command(image)
+    assert type(got) is UserCommand
+    assert got.data == data and got.reply_mode is rm
+    assert got.correlation == corr and got.notify_to == notify
+    assert got.from_ == from_ and got.reply_from == reply_from
+    # the parts decoder (the wire receiver's trace-attaching path)
+    # agrees field-for-field
+    assert decode_user_parts(image) == (data, rm, corr, notify, from_,
+                                        reply_from)
+
+
+def test_golden_header_fields():
+    # spot-pin the header itself: tag, version, reply-mode codes
+    tag, ver, rm, flags, dlen = struct.unpack_from(
+        "<BBBBI", GOLDEN_V1[0][2], 0)
+    assert (tag, ver, rm, flags, dlen) == (TAG_USER, 1, 2, 1, 5)
+    for image, code in ((GOLDEN_V1[1][2], 1), (GOLDEN_V1[2][2], 0),
+                        (GOLDEN_V1[3][2], 3)):
+        assert image[2] == code     # AWAIT=1, AFTER_LOG_APPEND=0, NOREPLY=3
+
+
+# ---------------------------------------------------------------------------
+# round-trips and demotion rules
+# ---------------------------------------------------------------------------
+
+def _mk(data, rm=ReplyMode.NOTIFY, corr=None, notify=None, from_=None,
+        reply_from=None):
+    return UserCommand(data, rm, corr, notify, from_, reply_from)
+
+
+def test_encode_command_round_trips_every_shape():
+    cases = [
+        _mk(b"x" * 1000, corr=(123456789, 42)),
+        _mk((1, 2, 3, 4), rm=ReplyMode.AWAIT_CONSENSUS),
+        _mk(None, rm=ReplyMode.NOREPLY),
+        _mk("utf-8 ☃", corr="corr-id"),
+        _mk(b"", corr=0, notify=("rnotify", ("h", 1), 0, 0)),
+        _mk((("nested", (1, 2)), b"mix", None), from_="m1",
+            reply_from="m2"),
+        _mk(tuple(range(300))),          # >255 tuple -> field pickle
+        _mk(1 << 100),                   # bignum -> field pickle
+    ]
+    for cmd in cases:
+        img = encode_command(cmd)
+        got = decode_command(img)
+        assert type(got) is UserCommand
+        assert (got.data, got.reply_mode, got.correlation,
+                got.notify_to, got.from_, got.reply_from) == \
+            (cmd.data, cmd.reply_mode, cmd.correlation, cmd.notify_to,
+             cmd.from_, cmd.reply_from)
+
+
+def test_local_handles_never_leave_the_process():
+    # callables/futures are process-local: the image carries None
+    cmd = _mk(b"d", notify=lambda *_: None, from_=lambda *_: None)
+    got = decode_command(encode_command(cmd))
+    assert got.notify_to is None and got.from_ is None
+
+
+def test_non_user_commands_demote_to_tagged_fallback():
+    obj = {"op": "membership", "add": ("m4", ("h", 1))}
+    img = encode_command(obj)
+    assert img[0] == TAG_FALLBACK and img[1] == CODEC_VERSION
+    assert decode_command(img) == obj
+
+
+def test_oversized_section_demotes_whole_record():
+    # a correlation too big for its u16 length field cannot fit USER v1
+    big = b"c" * 70000
+    assert encode_user(big, ReplyMode.NOTIFY, big, None, None,
+                       None) is None
+    img = encode_command(_mk(b"d", corr=big))
+    assert img[0] == TAG_FALLBACK
+    assert decode_command(img).correlation == big
+
+
+# ---------------------------------------------------------------------------
+# legacy decode-only branches (the r06 dirs / mixed-version peers)
+# ---------------------------------------------------------------------------
+
+def test_legacy_fast_tuple_frames_decode():
+    # the pre-codec durable image: 0x01 + pickle of the field tuple —
+    # both the 5-field (pre-reply_from) and 6-field generations
+    data, rm, corr = ("set", "k", 1), ReplyMode.NOTIFY, (9, 9)
+    notify, from_, reply_from = ("rnotify", ("h", 1), 0, 3), "m2", "m1"
+    five = bytes([TAG_LEGACY_FAST]) + pickle.dumps(
+        (data, rm.value, corr, from_, notify))
+    six = bytes([TAG_LEGACY_FAST]) + pickle.dumps(
+        (data, rm.value, corr, from_, notify, reply_from))
+    got5 = decode_command(five)
+    assert (got5.data, got5.reply_mode, got5.correlation, got5.notify_to,
+            got5.from_, got5.reply_from) == \
+        (data, rm, corr, notify, from_, None)
+    got6 = decode_command(six)
+    assert got6.reply_from == reply_from
+
+
+def test_legacy_raw_pickle_images_decode():
+    # oldest generation: a bare pickle (first byte >= 0x80)
+    cmd = _mk((1, "two"), corr=7)
+    img = pickle.dumps(cmd, protocol=pickle.HIGHEST_PROTOCOL)
+    assert img[0] >= 0x80
+    got = decode_command(img)
+    assert got == cmd
+
+
+def test_newer_version_records_refuse_loudly():
+    img = bytearray(GOLDEN_V1[0][2])
+    img[1] = CODEC_VERSION + 1
+    with pytest.raises(CodecError, match="newer codec"):
+        decode_command(bytes(img))
+    fb = bytearray(encode_fallback({"x": 1}))
+    fb[1] = CODEC_VERSION + 1
+    with pytest.raises(CodecError, match="newer codec"):
+        decode_command(bytes(fb))
+
+
+# ---------------------------------------------------------------------------
+# seeded corruption properties: decode NEVER raises anything but
+# CodecError, for any truncation or single-bit flip, on any record type
+# ---------------------------------------------------------------------------
+
+def _corpus_all_types():
+    out = [img for _n, _a, img in GOLDEN_V1]
+    out.append(encode_command(_mk(b"payload-bytes" * 7,
+                                  corr=(1, 2),
+                                  notify=("rnotify", ("h", 1), 0, 5))))
+    out.append(encode_fallback({"op": "noop", "why": "corruption-test"}))
+    out.append(bytes([TAG_LEGACY_FAST]) + pickle.dumps(
+        ((1, 2), ReplyMode.NOTIFY.value, None, None, None, None)))
+    out.append(pickle.dumps(_mk(b"old"), protocol=pickle.HIGHEST_PROTOCOL))
+    return out
+
+
+def test_truncation_never_crashes_decode():
+    rng = random.Random(18)
+    for img in _corpus_all_types():
+        cuts = {0, 1, 2, len(img) - 1}
+        cuts.update(rng.randrange(len(img)) for _ in range(24))
+        for cut in sorted(cuts):
+            try:
+                decode_command(img[:cut])
+            except CodecError:
+                pass        # the only sanctioned failure mode
+
+
+def test_bit_flips_never_crash_decode():
+    # a flip in a pickle length field asks the decoder for a multi-GB
+    # buffer; cap the heap during the fuzz so those fail FAST (the
+    # MemoryError wraps into CodecError like any other decode fault)
+    # instead of zeroing gigabytes per sample
+    import resource
+    soft, hard = resource.getrlimit(resource.RLIMIT_DATA)
+    rng = random.Random(181)
+    try:
+        resource.setrlimit(resource.RLIMIT_DATA, (1 << 31, hard))
+        for img in _corpus_all_types():
+            positions = {0, 1, len(img) - 1}
+            positions.update(rng.randrange(len(img)) for _ in range(48))
+            for p in sorted(positions):
+                flipped = bytearray(img)
+                flipped[p] ^= 1 << rng.randrange(8)
+                try:
+                    decode_command(bytes(flipped))
+                except CodecError:
+                    pass    # flips may also decode to a DIFFERENT value
+                            # (e.g. inside raw data) — that layer's
+                            # integrity is the WAL/segment/frame crc's job
+    finally:
+        resource.setrlimit(resource.RLIMIT_DATA, (soft, hard))
+
+
+def test_user_length_mismatch_is_codec_error():
+    img = GOLDEN_V1[0][2]
+    with pytest.raises(CodecError):
+        decode_command(img + b"trailing")
+    with pytest.raises(CodecError):
+        decode_command(img[:-1])
+    with pytest.raises(CodecError):
+        decode_command(bytes([0x07]) + img[1:])   # unknown tag
+
+
+# ---------------------------------------------------------------------------
+# WAL batch-run records (RTW3 type 3): torn tails, flipped bits, and
+# the old-magic (RTW2) read path
+# ---------------------------------------------------------------------------
+
+def _pay_record(payloads):
+    """One type-4 payload-table append exactly as Wal._write_batch
+    packs it: header + chained crc + length table + concatenated
+    images."""
+    lens = struct.pack("<%dI" % len(payloads),
+                       *[len(p) for p in payloads])
+    cat = b"".join(payloads)
+    hdr = _PAY_HDR.pack(4, len(payloads), len(lens) + len(cat))
+    crc = IO.crc32(cat, IO.crc32(lens, IO.crc32(hdr)))
+    return hdr + _CRC.pack(crc) + lens + cat
+
+
+def _run_parts(wid, entries, intern):
+    """(type-4 payload-table bytes, type-3 run bytes) for one batch
+    run, exactly as Wal._write_batch packs them; ``intern`` is the
+    file-scope payload->slot dict shared across one file's runs (new
+    images intern in first-seen order)."""
+    new = []
+    trips = []
+    for i, t, p in entries:
+        slot = intern.get(p)
+        if slot is None:
+            slot = intern[p] = len(intern)
+            new.append(p)
+        trips.append(_RUN_ENT.pack(i, t, slot))
+    tab = b"".join(trips)
+    hdr = _RUN_HDR.pack(3, wid, len(entries), len(tab))
+    rec = hdr + _CRC.pack(IO.crc32(tab, IO.crc32(hdr))) + tab
+    return (_pay_record(new) if new else b""), rec
+
+
+def _run_record(wid, entries, intern=None):
+    pay, rec = _run_parts(wid, entries,
+                          {} if intern is None else intern)
+    return pay + rec
+
+
+def _reg_record(wid, uid):
+    ub = uid.encode()
+    return _REG.pack(1, wid, len(ub)) + ub
+
+
+def test_run_record_parses_and_is_atomic_on_torn_tail():
+    run1 = [(1, 1, b"alpha"), (2, 1, b"beta"), (3, 1, b"gamma")]
+    run2 = [(4, 2, b"delta"), (5, 2, b"epsilon")]
+    intern: dict = {}
+    blob = MAGIC + _reg_record(7, "m1") + _run_record(7, run1, intern) \
+        + _run_record(7, run2, intern)
+    records, err = _parse_wal_bytes(blob)
+    assert err is None
+    assert records[0] == ("reg", 7, "m1")
+    ents = [r for r in records if r[0] == "ent"]
+    assert [(i, t, bytes(p)) for _k, _w, i, t, p in ents] == run1 + run2
+    # tear run2 at EVERY byte boundary: run1 always survives whole,
+    # run2 lands atomically or not at all
+    intern = {}
+    base = MAGIC + _reg_record(7, "m1") + _run_record(7, run1, intern)
+    pay2, rec2 = _run_parts(7, run2, intern)
+    r2 = pay2 + rec2
+    for cut in range(len(r2)):
+        records, err = _parse_wal_bytes(base + r2[:cut])
+        ents = [r for r in records if r[0] == "ent"]
+        assert len(ents) == len(run1), cut       # never a partial run2
+        if cut > 0 and cut != len(pay2):
+            assert err is not None               # damage was reported
+        # cut == len(pay2) is the ONE clean boundary inside the pair: a
+        # complete payload-table append whose run was lost to the tear.
+        # Table growth alone adds no entries, so recovery stays exact —
+        # the orphaned images are garbage the next rollover drops
+
+
+def test_run_record_bit_flip_is_caught_by_crc():
+    run1 = [(1, 1, b"alpha"), (2, 1, b"beta")]
+    prefix = MAGIC + _reg_record(3, "m2")
+    rec = _run_record(3, run1)
+    rng = random.Random(7)
+    hits = 0
+    for _ in range(64):
+        p = rng.randrange(len(rec))
+        flipped = bytearray(rec)
+        flipped[p] ^= 1 << rng.randrange(8)
+        records, err = _parse_wal_bytes(prefix + bytes(flipped))
+        ents = [r for r in records if r[0] == "ent"]
+        # a flip may hit the type byte (unknown record -> clean stop) or
+        # anywhere else (crc/table mismatch) — NEVER a silently altered
+        # entry set of the same length with different bytes
+        if ents:
+            assert [(i, t, bytes(pl)) for _k, _w, i, t, pl in ents] == \
+                [(i, t, p) for i, t, p in run1]
+        else:
+            hits += 1
+    assert hits > 0
+
+
+def test_payload_interning_writes_each_image_once():
+    # the fan-out cut (ISSUE 18): three co-hosted members writing the
+    # same replicated burst share ONE payload-table entry per image —
+    # the image bytes appear once in the file, each member's run is
+    # 20 bytes/entry of slot triplets
+    img = b"shared-payload-image-" * 8
+    intern: dict = {}
+    blob = MAGIC
+    for wid in (1, 2, 3):
+        blob += _reg_record(wid, f"m{wid}")
+        blob += _run_record(wid, [(1, 1, img), (2, 1, img + b"x")],
+                            intern)
+    assert blob.count(img + b"x") == 1          # interned, not fanned out
+    records, err = _parse_wal_bytes(blob)
+    assert err is None
+    ents = [r for r in records if r[0] == "ent"]
+    assert len(ents) == 6
+    for _k, _w, idx, _t, p in ents:
+        assert bytes(p) == (img if idx == 1 else img + b"x")
+
+
+def test_run_slot_out_of_range_stops_recovery():
+    # a type-3 run referencing a slot the file never interned is
+    # damage, not a silent empty payload
+    tab = _RUN_ENT.pack(1, 1, 5)                 # slot 5, empty table
+    hdr = _RUN_HDR.pack(3, 2, 1, len(tab))
+    rec = hdr + _CRC.pack(IO.crc32(tab, IO.crc32(hdr))) + tab
+    records, err = _parse_wal_bytes(MAGIC + _reg_record(2, "m1") + rec)
+    assert [r for r in records if r[0] == "ent"] == []
+    assert err is not None and "slot" in str(err)
+
+
+def test_old_magic_rtw2_files_still_recover():
+    # an r06-era file: RTW2 magic, per-entry type-2 records only
+    def ent2(wid, idx, term, payload):
+        hdr = _ENT_HDR.pack(2, wid, idx, term, len(payload))
+        return _ENT.pack(2, wid, idx, term, len(payload),
+                         _entry_crc(hdr, payload)) + payload
+    blob = MAGIC_V2 + _reg_record(1, "old-m1") \
+        + ent2(1, 10, 3, b"old-payload-a") + ent2(1, 11, 3, b"old-b")
+    records, err = _parse_wal_bytes(blob)
+    assert err is None
+    assert records == [("reg", 1, "old-m1"),
+                       ("ent", 1, 10, 3, b"old-payload-a"),
+                       ("ent", 1, 11, 3, b"old-b")]
+
+
+def test_type2_singles_still_parse_under_rtw3():
+    # the single-write path (resend/recovery) still emits type-2 records
+    # into RTW3 files; both types interleave in one file
+    def ent2(wid, idx, term, payload):
+        hdr = _ENT_HDR.pack(2, wid, idx, term, len(payload))
+        return _ENT.pack(2, wid, idx, term, len(payload),
+                         _entry_crc(hdr, payload)) + payload
+    blob = MAGIC + _reg_record(2, "m3") + ent2(2, 1, 1, b"single") \
+        + _run_record(2, [(2, 1, b"run-a"), (3, 1, b"run-b")]) \
+        + ent2(2, 4, 1, b"single-2")
+    records, err = _parse_wal_bytes(blob)
+    assert err is None
+    idxs = [r[2] for r in records if r[0] == "ent"]
+    assert idxs == [1, 2, 3, 4]
+
+
+def test_codec_images_ride_wal_run_records_unmodified():
+    # end-to-end byte identity: a codec image stored through a run
+    # record comes back the exact bytes that went in (encode once,
+    # relay bytes — the ISSUE 18 contract at the WAL layer)
+    img = encode_command(_mk(b"e2e", corr=(5, 6)))
+    blob = MAGIC + _reg_record(9, "m1") + _run_record(9, [(1, 1, img)])
+    records, err = _parse_wal_bytes(blob)
+    assert err is None
+    stored = bytes(records[-1][4])
+    assert stored == img
+    assert decode_command(stored).correlation == (5, 6)

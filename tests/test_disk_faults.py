@@ -450,7 +450,7 @@ def test_flush_skips_already_segment_durable_duplicates(tmp_path):
         assert log.overview()["num_mem_entries"] == 0
         # a recovered duplicate re-enters the memtable (same term/value)
         with log._lock:
-            log._memtable[5] = (1, UC(5))
+            log._memtable[5] = Entry(5, 1, UC(5))
             log._mem_bytes[5] = encode_command(UC(5))
         log.flush_mem_to_segments(20)
         # nothing above 5 was wiped; the duplicate pruned (it IS durable)
@@ -459,7 +459,7 @@ def test_flush_skips_already_segment_durable_duplicates(tmp_path):
             assert log.fetch(i).command.data == i, i
         # term mismatch = real overwrite: the stale tail must go
         with log._lock:
-            log._memtable[5] = (2, UC(500))
+            log._memtable[5] = Entry(5, 2, UC(500))
             log._mem_bytes[5] = encode_command(UC(500))
             log._last_index, log._last_term = 5, 2
             log._last_written = type(log._last_written)(4, 1)

@@ -714,11 +714,13 @@ def test_wire_package_is_ra09_clean():
 
 
 def test_checker_enforces_classic_hot_path(tmp_path):
-    """RA10 (ISSUE 13): per-entry pickle.dumps/encode_command and
-    per-entry WAL submits inside loops in the classic replication hot
-    paths are flagged — including a pickle moved into a same-module
-    helper called from the loop; `# ra10-ok:` allowlists deliberate
-    per-item sites; unscoped filenames are not gated."""
+    """RA10 (ISSUE 13 + the ISSUE 18 codec family): per-entry
+    pickle.dumps/encode_command and per-entry WAL submits inside loops
+    in the classic replication hot paths are flagged — including a
+    pickle moved into a same-module helper called from the loop — AND
+    any raw pickle.dumps anywhere in the closure (loop or not) that
+    bypasses the codec's tagged fallback; `# ra10-ok:` allowlists
+    deliberate sites; unscoped filenames are not gated."""
     bad = tmp_path / "tcp.py"
     bad.write_text(textwrap.dedent("""\
         import pickle
@@ -732,7 +734,11 @@ def test_checker_enforces_classic_hot_path(tmp_path):
                 return bytes(buf)
 
             def _encode_item(self, item):
-                return pickle.dumps(item)
+                return pickle.dumps(item)           # RA10: raw pickle
+
+            def _wire_form(self, to, msg, src):
+                # the codec family: no loop, still a hot closure
+                return pickle.dumps(msg)            # RA10: raw pickle
 
             def overview(self):
                 # not on the sender path: per-item work is fine here
@@ -740,19 +746,25 @@ def test_checker_enforces_classic_hot_path(tmp_path):
     """))
     r = run_lint(str(bad))
     assert r.returncode == 1
-    assert r.stdout.count("RA10") == 2, r.stdout
+    assert r.stdout.count("RA10") == 4, r.stdout
     assert "_send_items" in r.stdout
+    assert "encode_fallback" in r.stdout    # the codec-family message
     assert "overview" not in r.stdout
     # allowlisted lines pass
     fixed = bad.read_text() \
         .replace("buf += pickle.dumps(item)       # RA10: per-item",
                  "buf += pickle.dumps(item)  # ra10-ok: singles") \
         .replace("buf += self._encode_item(item)  # RA10: via helper",
-                 "buf += self._encode_item(item)  # ra10-ok: fallback")
+                 "buf += self._encode_item(item)  # ra10-ok: fallback") \
+        .replace("return pickle.dumps(item)           # RA10: raw pickle",
+                 "return pickle.dumps(item)  # ra10-ok: envelope") \
+        .replace("return pickle.dumps(msg)            # RA10: raw pickle",
+                 "return pickle.dumps(msg)  # ra10-ok: envelope")
     bad.write_text(fixed)
     r = run_lint(str(bad))
     assert "RA10" not in r.stdout, r.stdout
-    # log/durable.py: per-entry WAL submits in the batch-append path
+    # log/durable.py: per-entry WAL submits in the batch-append path,
+    # plus the helper encoder's own raw pickle (the codec family)
     logdir = tmp_path / "log"
     logdir.mkdir()
     dlog = logdir / "durable.py"
@@ -769,8 +781,9 @@ def test_checker_enforces_classic_hot_path(tmp_path):
     """))
     r = run_lint(str(dlog))
     assert r.returncode == 1
-    assert r.stdout.count("RA10") == 2, r.stdout
+    assert r.stdout.count("RA10") == 3, r.stdout
     assert "per-entry WAL submit" in r.stdout
+    assert "raw pickle.dumps" in r.stdout
     # the same content under another parent dir is not gated
     other = tmp_path / "durable.py"
     other.write_text(dlog.read_text())
@@ -790,11 +803,14 @@ def test_checker_enforces_classic_hot_path(tmp_path):
 
 
 def test_classic_hot_paths_are_ra10_clean():
-    """The real sender loop, batch-append, and commit-advance closures
-    pass the per-entry gate (covered by the repo-wide run too; pinned
+    """The real sender loop, batch-append, WAL batch-writer, segment
+    flush, codec, and commit-advance closures pass the per-entry +
+    raw-pickle gate (covered by the repo-wide run too; pinned
     separately so a regression names the rule)."""
     for mod in ("ra_tpu/transport/tcp.py", "ra_tpu/log/durable.py",
-                "ra_tpu/core/server.py"):
+                "ra_tpu/log/wal.py", "ra_tpu/log/segment.py",
+                "ra_tpu/codec.py", "ra_tpu/core/server.py",
+                "ra_tpu/wire/server.py"):
         r = run_lint(os.path.join(REPO, *mod.split("/")))
         assert "RA10" not in r.stdout, (mod, r.stdout)
 

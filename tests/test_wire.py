@@ -44,17 +44,27 @@ def mk_plane(eng, **kw):
 # ---------------------------------------------------------------------------
 
 def test_framing_round_trips():
-    f = framing.encode_hello("acme/alice", 3, tenants=2)
+    f = framing.encode_hello("acme/alice", 3, tenants=2,
+                             payload_width=3)
     t, body, off = framing.read_frame(f)
     assert t == framing.T_HELLO and off == len(f)
     h = framing.decode_hello(body)
     assert h == {"version": framing.WIRE_VERSION, "tenants": 2,
-                 "key": "acme/alice", "n_sessions": 3}
-    a = framing.encode_hello_ack(7, 1234, slots=[4, 5, 6])
+                 "key": "acme/alice", "n_sessions": 3,
+                 "payload_width": 3}
+    a = framing.encode_hello_ack(7, 1234, slots=[4, 5, 6],
+                                 payload_width=3)
     _t, body, _ = framing.read_frame(a)
     d = framing.decode_hello_ack(body)
     assert d["epoch"] == 7 and d["handle_base"] == 1234
+    assert d["payload_width"] == 3
     assert d["slots"].tolist() == [4, 5, 6]
+    # ERR: the refusal frame round-trips its code + reason
+    e = framing.encode_error(framing.E_PAYLOAD_WIDTH, "width 4 != 3")
+    _t, body, _ = framing.read_frame(e)
+    err = framing.decode_error(body)
+    assert err == {"code": framing.E_PAYLOAD_WIDTH,
+                   "message": "width 4 != 3"}
     # data: fixed stride, vectorized both ways
     pay = np.arange(6, dtype=np.int32).reshape(2, 3)
     blob = framing.encode_data([0, 1], [10, 11], pay)
@@ -293,8 +303,20 @@ def test_version_mismatch_refuses_connection():
     bad[5] = framing.WIRE_VERSION + 1      # version byte inside HELLO
     sock.sendall(bytes(bad))
     sock.settimeout(5.0)
-    assert sock.recv(64) == b""            # server closed it
+    # the refusal is LOUD: an ERR frame names the reason, then close
+    buf, fr = b"", None
     deadline = time.monotonic() + 5.0
+    while fr is None:
+        assert time.monotonic() < deadline
+        chunk = sock.recv(64)
+        if not chunk:
+            break
+        buf += chunk
+        fr = framing.read_frame(buf)
+    assert fr is not None and fr[0] == framing.T_ERR
+    err = framing.decode_error(fr[1])
+    assert err["code"] == framing.E_VERSION
+    assert sock.recv(64) == b""            # then the server closed it
     while lst.counters["protocol_errors"] == 0:
         assert time.monotonic() < deadline
         time.sleep(0.01)
@@ -302,6 +324,29 @@ def test_version_mismatch_refuses_connection():
     lst.close()
     eng.close()
     _ = struct  # (layout documented by the slice above)
+
+
+def test_payload_width_mismatch_refused_with_protocol_error():
+    """A client declaring a different DATA column count C must be
+    refused at HELLO with a protocol error — NOT accepted and misparsed
+    at the first data frame (the mixed-machine listener hazard)."""
+    eng = mk_engine(lanes=4, cmds=4)
+    plane = mk_plane(eng)
+    lst = WireListener(plane, port=0, max_conns=4, ring_bytes=2048)
+    assert lst.payload_width == 3
+    with pytest.raises(ConnectionError, match="payload_width"):
+        WireClient(lst.address, key="wide/c1", payload_width=4)
+    deadline = time.monotonic() + 5.0
+    while lst.counters["protocol_errors"] == 0:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    # a correctly-declared client on the same listener still connects
+    ok = WireClient(lst.address, key="wide/c2",
+                    payload_width=lst.payload_width)
+    assert ok.epoch == 1
+    ok.close()
+    lst.close()
+    eng.close()
 
 
 def test_refused_op_rekeys_and_is_not_lost():

@@ -17,7 +17,11 @@ Rules here (the doc-of-record for codes is tools/lint.py's docstring):
         loops / dict allocation
   RA09  wire reader sweep path: same, extended to the socket path
   RA10  classic replication hot paths: no per-entry encode/WAL submit
-        inside loops
+        inside loops, and (the ISSUE 18 codec family) no raw
+        ``pickle.dumps`` ANYWHERE in an append/AER/WAL/segment/sweep
+        closure — object payloads must ride the codec's tagged
+        fallback (ra_tpu.codec.encode_fallback) so every stored or
+        shipped byte stays versioned and decodable
 
 Findings are RAW (unsuppressed): tools/analyzer/audit.py applies the
 ``# raNN-ok`` line allowlists and audits them for staleness.  Tag
@@ -144,9 +148,16 @@ CLOSURE_RULES = [
                 [Scope({"sweep"}, dirname="wire")],
                 "wire sweep"),
     ClosureRule("RA10", "per_entry",
-                [Scope({"_send_items"}, basenames={"tcp.py"}),
-                 Scope({"write", "append_batch", "_put_batch"},
+                [Scope({"_send_items", "_wire_form"},
+                       basenames={"tcp.py"}),
+                 Scope({"write", "append_batch", "_put_batch", "_put",
+                        "flush_mem_to_segments"},
                        basenames={"durable.py"}, parent="log"),
+                 Scope({"_write_batch"}, basenames={"wal.py"},
+                       parent="log"),
+                 Scope({"flush"}, basenames={"segment.py"},
+                       parent="log"),
+                 Scope({"sweep"}, dirname="wire"),
                  Scope({"_leader_aer_reply", "_evaluate_quorum"},
                        basenames={"server.py"}, parent="core")],
                 "classic hot path"),
@@ -239,6 +250,18 @@ def _is_encoder(fi):
     return False
 
 
+def _is_raw_pickle(call):
+    """``pickle.dumps(...)`` or a bare ``dumps``/``_dumps`` alias call
+    (the codec's own module-level alias shape) — the construct the
+    ISSUE 18 codec family bans from hot closures outside the codec's
+    tagged fallback."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "dumps" and isinstance(f.value, ast.Name) \
+            and f.value.id == "pickle"
+    return isinstance(f, ast.Name) and f.id in ("dumps", "_dumps")
+
+
 def _walk_per_entry(idx, fi, code, ctx, out, encoder_names):
     """RA10: per-entry encode / WAL submit inside a loop, including a
     call to a helper (same-module by name, or cross-module resolved)
@@ -275,6 +298,21 @@ def _walk_per_entry(idx, fi, code, ctx, out, encoder_names):
                     f"{ctx} {fi.name}() — batch-encode outside the "
                     "loop (one pickle per frame/run) or mark the line "
                     "'# ra10-ok: why'"))
+    # the codec family (ISSUE 18): raw pickle ANYWHERE in the closure,
+    # loop or not — a hot-path object-encode that bypasses the codec's
+    # tagged fallback ships unversioned bytes to the WAL/wire/segments
+    for sub in ast.walk(fi.node):
+        if not isinstance(sub, ast.Call) or id(sub) in seen:
+            continue
+        if _is_raw_pickle(sub):
+            seen.add(id(sub))
+            out.append(Finding(
+                path, sub.lineno, code,
+                f"raw pickle.dumps in {ctx} closure {fi.name}() — "
+                "object payloads must ride the codec's tagged "
+                "fallback (ra_tpu.codec.encode_fallback) so every "
+                "stored/shipped byte stays versioned and decodable, "
+                "or mark the line '# ra10-ok: why'"))
 
 
 _WALKERS = {

@@ -73,9 +73,14 @@ LATENCY_FIELDS = ("p50_commit_latency_ms", "p99_commit_latency_ms",
 #: ``failover_lost_acked`` lower-is-better where 0 is THE healthy
 #: baseline — any acked-but-lost delta appearing from 0 must flag
 INGRESS_RATE_FIELDS = ("ingress_cmds_per_s", "wire_cmds_per_s")
+#: ``encode_share_pct`` (ISSUE 18) rides the shed shape as well: the
+#: codec's encode phase share of total phase time — lower-better,
+#: 0 a meaningful healthy value (everything arrived pre-encoded), and
+#: -1 the "no phase samples" sentinel skipped like the others
 INGRESS_SHED_FIELDS = ("ingress_shed_rate", "wire_shed_rate",
                        "wire_reconnect_recovery_s",
-                       "failover_recovery_s", "failover_lost_acked")
+                       "failover_recovery_s", "failover_lost_acked",
+                       "encode_share_pct")
 
 #: device-plane compile counts (ISSUE 16): absolute comparison, any
 #: growth is a regression — the workload is fixed across rounds, so an
