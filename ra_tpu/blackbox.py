@@ -75,6 +75,10 @@ EVENT_REGISTRY = {
     # -- transport fault plan ------------------------------------------
     "net.fault": "transport FaultPlan injected a fault (kind, peer, "
                  "frame class)",
+    "rpc.domain_delay": "latency-domain matrix stretched a frame "
+                        "crossing (src -> dst) domains (ISSUE 19: "
+                        "geography, not chaos — rides the same "
+                        "per-(peer, class, direction) streams)",
     # -- WAL plane (per shard) -----------------------------------------
     "wal.batch": "span: one group-commit batch (write + sync + notify)",
     "wal.write": "one group-commit batch reached the file (per-uid "
@@ -169,6 +173,20 @@ EVENT_REGISTRY = {
     "placement.giveup": "a bounded placement retry loop exhausted its "
                         "deadline/attempts and gave up (RA16: no "
                         "silent infinite retry in the control plane)",
+    # -- cross-host placement serving path (ISSUE 19) ------------------
+    "placement.rehome_hint": "listener refused a frame routed on a "
+                             "stale placement revision with a typed "
+                             "REHOME hint (engine, generation, rev) — "
+                             "never a silent misroute into a dead "
+                             "engine's lanes",
+    "placement.adopt_rpc": "a survivor host committed an adoption "
+                           "requested over the reliable control-plane "
+                           "RPC tier (host_adopt — retried, "
+                           "deduplicated, deadline-bounded)",
+    "placement.stale_probe": "supervisor discarded a probe reply from "
+                             "a superseded engine generation (a stale "
+                             "reply must not reset the new incumbent's "
+                             "suspect streak)",
     # -- recorder meta -------------------------------------------------
     "bb.dump": "post-mortem bundle written",
     "bb.recover": "recovery stamped a join-able recovery report",

@@ -108,6 +108,11 @@ class SessionDirectory:
         lane = self._hash_id(external_id) % self.n_lanes
         return tenant, lane, self.shard_of(lane)
 
+    def lanes_of(self, handles) -> np.ndarray:
+        """Vectorized handle → lane gather (the serving-path placement
+        check reads this per sweep batch, ISSUE 19)."""
+        return self.lane[np.asarray(handles, np.int64)]
+
     def shard_of(self, lane) -> np.ndarray:
         """Lane → WAL/engine shard bucket (contiguous lane slices, the
         EngineDurability layout)."""

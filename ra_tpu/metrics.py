@@ -297,10 +297,17 @@ DEVICE_FIELDS = (
 #: ``giveups`` bounded loops that exhausted their deadline (each also
 #: emits ``placement.giveup``), ``adopts`` victim engines restored
 #: into a survivor's lane space, ``rehomed_sessions`` sessions
-#: re-bound to a new home (epoch bump + slot claim).
+#: re-bound to a new home (epoch bump + slot claim).  Cross-host tier
+#: (ISSUE 19): ``stale_probe_drops`` probe replies discarded because
+#: the slot was re-provisioned to a newer generation while the probe
+#: was in flight (each also emits ``placement.stale_probe``), and
+#: ``rehome_hints`` frames refused by a serving listener with a typed
+#: REHOME hint because the lane's home moved (each refusal batch also
+#: emits ``placement.rehome_hint``).
 PLACEMENT_FIELDS = (
     "heartbeats", "suspects", "downs", "recoveries", "migrations",
     "migrate_retries", "giveups", "adopts", "rehomed_sessions",
+    "stale_probe_drops", "rehome_hints",
 )
 
 #: the complete field-group registry (rule RA05): every counter-field

@@ -217,6 +217,13 @@ class RaNode:
         self.directory: dict[str, ServerConfig] = {}  # uid -> config
         self.leaderboard: dict[str, tuple] = {}    # cluster -> (leader, members)
         self._crash_times: dict[str, list] = {}    # supervised restarts
+        #: pluggable control verbs (ISSUE 19): op name -> fn(args) ->
+        #: result, consulted before the unknown-op fallback.  The
+        #: cross-host placement fabric registers its engine-host verbs
+        #: (host_status/host_adopt/...) here so they ride the SAME
+        #: reliable-RPC control plane as the builtin lifecycle ops —
+        #: dedup cache, deadline propagation and all.
+        self.control_ops: dict[str, Callable] = {}
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -491,6 +498,8 @@ class RaNode:
                 # bench/ops client collect the leader's CLASSIC_FIELDS
                 # from a remote worker process over the control plane
                 result = self.classic_stats()
+            elif op in self.control_ops:
+                result = self.control_ops[op](args)
             else:
                 result = ErrorResult(f"unknown_control_op:{op}", None)
         except Exception as exc:  # noqa: BLE001 — errors travel to caller

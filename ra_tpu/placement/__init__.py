@@ -16,12 +16,22 @@ Three tiers:
 :mod:`~ra_tpu.placement.soak` wires all three under live wire traffic
 with a mid-traffic kill-9 and checks the exactly-once oracle over the
 union of both engines' state.  See docs/PLACEMENT.md.
+
+ISSUE 19 stretches the same tiers across REAL processes:
+:mod:`~ra_tpu.placement.fabric` carries the probe/adopt/re-home paths
+over the reliable control-plane RPC tier (host_* verbs), and
+:mod:`~ra_tpu.placement.geo` is the geo-distributed survival soak —
+latency-domain matrices, SIGKILL of an engine host, and the
+exactly-once oracle read back over RPC.
 """
 from .table import (MACHINE_NAME, PlacementCache, PlacementTableMachine,
                     owned_ranges, placement_spec)
 from .supervisor import EngineSupervisor, PlacementError
 from .host import LaneEngineHost
+from .fabric import (HostAgent, RpcEngineProbe, push_placement,
+                     remote_adopt, remote_lane_sums, remote_rehome)
 from .soak import run_failover_soak
+from .geo import run_geo_soak
 
 __all__ = [
     "MACHINE_NAME",
@@ -32,5 +42,12 @@ __all__ = [
     "EngineSupervisor",
     "PlacementError",
     "LaneEngineHost",
+    "HostAgent",
+    "RpcEngineProbe",
+    "remote_adopt",
+    "remote_rehome",
+    "remote_lane_sums",
+    "push_placement",
     "run_failover_soak",
+    "run_geo_soak",
 ]

@@ -1,0 +1,328 @@
+"""The cross-host placement fabric (ISSUE 19): the supervisor's
+probe/commit path and the host tier's adoption verbs running over the
+reliable control-plane RPC layer (:mod:`ra_tpu.transport.rpc`) between
+real processes.
+
+Two halves:
+
+* :class:`RpcEngineProbe` — the supervisor side.  A zero-arg probe
+  callable (the :meth:`EngineSupervisor.watch` contract) that issues a
+  ``host_status`` reliable RPC on its own daemon thread and returns
+  **None** ("asynchronous") immediately, so a cross-domain round trip
+  never blocks the detector tick.  A completed round trip lands via
+  :meth:`EngineSupervisor.probe_reply` stamped with the probe's ISSUE
+  time — cross-domain RTT reads as age, which the hysteresis window
+  absorbs (CD-Raft: delay is not death) — and with the slot generation
+  captured at issue, so a reply straggling in after the slot was
+  re-provisioned is discarded instead of resetting the new incumbent's
+  suspect streak.
+
+* :class:`HostAgent` — the engine-host side.  Registers the host
+  verbs (``host_status``/``host_adopt``/``host_lane_sums``/
+  ``host_address``/``host_stop``) on a :class:`~ra_tpu.node.RaNode`'s
+  pluggable ``control_ops``, so they ride the SAME reliable-RPC
+  control plane as the builtin lifecycle ops: retry/backoff/deadline
+  on the caller, receiver-side request dedup — a duplicated or
+  reordered ``host_adopt`` adopts once (and the placement table's
+  generation gate makes the matching ``migrate`` commit idempotent
+  end to end).  Control ops execute on the node's control threads;
+  verbs that mutate the serving stack are bridged onto the host's
+  main serving loop through a queue + event handshake
+  (:meth:`HostAgent.pump`), because an engine/plane/listener is
+  single-threaded by construction.
+
+Every RPC call site in this module carries an explicit ``timeout=``
+— the deadline discipline rule RA16 enforces across this package.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..blackbox import record
+from ..transport.rpc import reliable_node_call
+from .table import PlacementCache
+
+__all__ = ["RpcEngineProbe", "HostAgent", "remote_adopt",
+           "remote_lane_sums", "remote_rehome", "push_placement"]
+
+
+class RpcEngineProbe:
+    """An asynchronous cross-host heartbeat for one engine slot.
+
+    Calling the instance (what :meth:`EngineSupervisor.tick` does)
+    starts at most ONE in-flight ``host_status`` RPC — paced by
+    ``min_interval`` — and returns ``None`` immediately; the reply
+    completes via ``sup.probe_reply(eid, heard_at=<issue time>,
+    generation=<captured at issue>)``.  :meth:`bind` attaches the
+    supervisor after :meth:`~EngineSupervisor.watch` registered the
+    slot (the probe needs the supervisor for the generation capture
+    and the reply path)."""
+
+    def __init__(self, router, node: str, eid: str, *,
+                 timeout: float = 2.0, min_interval: float = 0.05,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.router = router
+        self.node = node
+        self.eid = eid
+        self.timeout = float(timeout)
+        self.min_interval = float(min_interval)
+        self._clock = clock
+        self.sup = None
+        self._in_flight = False
+        self._last_issue = -float("inf")
+        self._lock = threading.Lock()
+        self.replies = 0
+        self.failures = 0
+
+    def bind(self, sup) -> None:
+        self.sup = sup
+
+    def __call__(self) -> None:
+        now = self._clock()
+        with self._lock:
+            if self._in_flight or \
+                    now - self._last_issue < self.min_interval:
+                return None
+            self._in_flight = True
+            self._last_issue = now
+        gen = self.sup.generation(self.eid) if self.sup is not None \
+            else None
+        threading.Thread(target=self._probe_once, args=(now, gen),
+                         daemon=True,
+                         name=f"rpc-probe-{self.eid}").start()
+        return None
+
+    def _probe_once(self, issued_at: float, generation) -> None:
+        try:
+            res = reliable_node_call(self.router, self.node,
+                                     "host_status", {"eid": self.eid},
+                                     timeout=self.timeout)
+            alive = bool(res.get("alive")) if isinstance(res, dict) \
+                else False
+            if alive and self.sup is not None:
+                # heard AT ISSUE TIME: a completed round trip proves
+                # the engine was alive when the probe left, so the
+                # cross-domain RTT shows up as age — never as a fresher
+                # heartbeat than the evidence supports
+                self.sup.probe_reply(self.eid, heard_at=issued_at,
+                                     generation=generation)
+                self.replies += 1
+        except (RuntimeError, TimeoutError):
+            # unreachable/timed out/remote error: silence IS the
+            # signal — the supervisor's verdict ladder judges it
+            self.failures += 1
+        finally:
+            with self._lock:
+                self._in_flight = False
+
+
+class HostAgent:
+    """Serves one :class:`~ra_tpu.placement.host.LaneEngineHost` over
+    the node control plane.  Construct it in the host's process with
+    the host and its RaNode; call :meth:`pump` from the host's serving
+    loop every cycle (it executes the loop-bridged verbs)."""
+
+    #: bound every loop-bridged verb waits for the serving loop
+    BRIDGE_TIMEOUT_S = 60.0
+
+    def __init__(self, host, node, *, generation: int = 1,
+                 placement_rid: Optional[str] = None) -> None:
+        self.host = host
+        self.node = node
+        self.generation = int(generation)
+        self.stopped = threading.Event()
+        self._actions: queue.Queue = queue.Queue()
+        #: the serving-path placement view (ISSUE 19): the control
+        #: plane PUSHES committed table state here (``host_placement``)
+        #: and every listener this host serves derives its lane mask
+        #: from it — revision-monotone, fail-open while empty
+        self.cache = PlacementCache()
+        self.placement_rid = placement_rid
+        if placement_rid is not None:
+            host.listener.bind_placement(self.cache, {host.engine_id},
+                                         rids={placement_rid})
+        node.control_ops.update({
+            "host_status": self._op_status,
+            "host_address": self._op_address,
+            "host_adopt": self._op_adopt,
+            "host_rehome": self._op_rehome,
+            "host_placement": self._op_placement,
+            "host_lane_sums": self._op_lane_sums,
+            "host_stop": self._op_stop,
+        })
+
+    # -- the serving-loop bridge ---------------------------------------
+
+    def pump(self) -> int:
+        """Execute queued loop-bridged verbs (call from the serving
+        loop).  Returns the number executed."""
+        done = 0
+        while True:
+            try:
+                fn, box, ev = self._actions.get_nowait()
+            except queue.Empty:
+                return done
+            try:
+                box["res"] = fn()
+            except Exception as exc:  # noqa: BLE001 — travels to caller
+                box["exc"] = exc
+            ev.set()
+            done += 1
+
+    def _run_on_loop(self, fn: Callable[[], Any]) -> Any:
+        box: dict = {}
+        ev = threading.Event()
+        self._actions.put((fn, box, ev))
+        if not ev.wait(self.BRIDGE_TIMEOUT_S):
+            raise TimeoutError("host serving loop did not pump the "
+                               "bridged control verb within deadline")
+        if "exc" in box:
+            raise box["exc"]
+        return box["res"]
+
+    # -- control verbs (executed on node control threads) --------------
+
+    def _op_status(self, args: dict) -> dict:
+        # answered IMMEDIATELY (no loop bridge): alive is a plain bool
+        # read, and the probe path must stay cheap and non-blocking
+        return {"eid": self.host.engine_id,
+                "alive": bool(self.host.alive()),
+                "generation": self.generation}
+
+    def _op_address(self, args: dict) -> dict:
+        eid = args.get("engine", self.host.engine_id)
+        if eid == self.host.engine_id:
+            addr = self.host.listener.address
+        else:
+            addr = self.host.adopted_listener(eid).address
+        return {"engine": eid,
+                "address": list(addr) if addr is not None else None}
+
+    def _op_adopt(self, args: dict) -> dict:
+        victim = args["victim"]
+
+        def do():
+            lst = self.host.adopt(victim, args["victim_dir"],
+                                  trace_ctx=args.get("trace_ctx"))
+            rid = args.get("rid")
+            if rid is not None:
+                # the adopted range's post-migration home is THIS
+                # host's engine id; while the pushed cache is still
+                # empty/stale the mask fails open
+                lst.bind_placement(self.cache, {self.host.engine_id},
+                                   rids={rid})
+            return lst.address
+        addr = self._run_on_loop(do)
+        record("placement.adopt_rpc", victim=victim,
+               survivor=self.host.engine_id,
+               address=str(addr) if addr else "loopback")
+        return {"victim": victim, "survivor": self.host.engine_id,
+                "address": list(addr) if addr is not None else None}
+
+    def _op_rehome(self, args: dict) -> dict:
+        """Pre-claim a re-homed wire client's session block on the
+        ADOPTED listener (WireListener.claim_sessions): old dedup
+        slots claimed verbatim, committed watermarks seeded at the
+        client's acked counts.  Returns the recovered durable op-id
+        watermarks the client re-bases against."""
+        victim = args["victim"]
+
+        def do():
+            lst = self.host.adopted_listener(victim)
+            dur = lst.claim_sessions(
+                args["key"], int(args["n_sessions"]),
+                slots=np.asarray(args["slots"], np.int64),
+                committed=np.asarray(args["committed"], np.int64),
+                tenants=int(args.get("tenants", 1)),
+                trace_ctx=args.get("trace_ctx"))
+            return dur.tolist()
+        return {"victim": victim, "durable": self._run_on_loop(do)}
+
+    def _op_placement(self, args: dict) -> dict:
+        """Adopt a committed placement-table snapshot (the cache-
+        invalidation-on-commit push): revision-monotone, so a stale
+        push from a lagging control member is a no-op."""
+        state = args["state"]
+
+        def do():
+            changed = self.cache.refresh(state)
+            return {"rev": int(self.cache.rev),
+                    "changed": bool(changed)}
+        return self._run_on_loop(do)
+
+    def _op_lane_sums(self, args: dict) -> dict:
+        eid = args.get("engine", self.host.engine_id)
+
+        def do():
+            eng = self.host.engine if eid == self.host.engine_id \
+                else self.host.adopted_engine(eid)
+            lanes = np.arange(self.host.lanes)
+            vals = np.asarray(eng.consistent_read(lanes)["value"])
+            return vals.astype(np.int64).tolist()
+        return {"engine": eid, "sums": self._run_on_loop(do)}
+
+    def _op_stop(self, args: dict) -> str:
+        self.stopped.set()
+        return "stopping"
+
+
+# -- supervisor-side helpers over the fabric ---------------------------
+
+
+def remote_adopt(router, node: str, victim: str, victim_dir: str, *,
+                 survivor: str, rid: Optional[str] = None,
+                 timeout: float = 30.0,
+                 trace_ctx: Optional[str] = None):
+    """Commit an adoption on a remote survivor host; returns the
+    adopted listener's ``(host, port)`` (or None for loopback).
+    Rides reliable RPC end to end: a redelivered call re-adopts
+    nothing (LaneEngineHost.adopt is idempotent per victim) and the
+    receiver's request dedup absorbs duplicated attempts."""
+    res = reliable_node_call(router, node, "host_adopt",
+                             {"victim": victim,
+                              "victim_dir": victim_dir,
+                              "survivor": survivor, "rid": rid,
+                              "trace_ctx": trace_ctx},
+                             timeout=timeout, trace_ctx=trace_ctx)
+    addr = res.get("address") if isinstance(res, dict) else None
+    return tuple(addr) if addr is not None else None
+
+
+def remote_rehome(router, node: str, victim: str, client, *,
+                  timeout: float = 30.0,
+                  trace_ctx: Optional[str] = None):
+    """Pre-claim ``client``'s session block on the survivor's adopted
+    listener, then return the durable op-id watermarks for
+    :meth:`WireClient.rehome_to`."""
+    res = reliable_node_call(
+        router, node, "host_rehome",
+        {"victim": victim, "key": client.key,
+         "n_sessions": client.n_sessions,
+         "slots": np.asarray(client.slots, np.int64).tolist(),
+         "committed": client.watermark.tolist(),
+         "tenants": client.tenants, "trace_ctx": trace_ctx},
+        timeout=timeout, trace_ctx=trace_ctx)
+    return np.asarray(res["durable"], np.int64)
+
+
+def push_placement(router, node: str, state: dict, *,
+                   timeout: float = 10.0) -> dict:
+    """Push a committed table snapshot to one host's serving-path
+    cache (the cache-invalidation-on-commit fan-out)."""
+    return reliable_node_call(router, node, "host_placement",
+                              {"state": state}, timeout=timeout)
+
+
+def remote_lane_sums(router, node: str, engine: str, *,
+                     timeout: float = 30.0) -> np.ndarray:
+    """The exactly-once oracle's cross-process read: the per-lane
+    machine sums an engine host serves for ``engine`` (its own id or
+    an adopted victim's)."""
+    res = reliable_node_call(router, node, "host_lane_sums",
+                             {"engine": engine}, timeout=timeout)
+    return np.asarray(res["sums"], np.int64)

@@ -44,7 +44,8 @@ class LaneEngineHost:
                  machine_factory, lanes: int = 64,
                  ring_capacity: int = 512, max_step_cmds: int = 16,
                  wal_shards: int = 2, superstep_k: int = 4,
-                 max_conns: int = 256, ring_records: int = 32) -> None:
+                 max_conns: int = 256, ring_records: int = 32,
+                 port: Optional[int] = None) -> None:
         from ..engine.durable import open_engine
         from ..ingress import IngressPlane
         self.engine_id = engine_id
@@ -64,8 +65,12 @@ class LaneEngineHost:
         self.plane = IngressPlane(self.engine, superstep_k=superstep_k,
                                   window_s=0.001, soft_credit=1 << 20,
                                   hard_credit=1 << 20)
+        # port=None keeps the in-process loopback shape (the classic
+        # soaks); port=0 binds an ephemeral TCP listener so a geo
+        # child process serves real wire clients (ISSUE 19)
+        self._port = port
         self.listener = WireListener(
-            self.plane, port=None, max_conns=max_conns,
+            self.plane, port=port, max_conns=max_conns,
             ring_bytes=ring_records * data_stride(
                 self.engine.payload_width))
         self._alive = True
@@ -158,8 +163,12 @@ class LaneEngineHost:
         plane = IngressPlane(eng, superstep_k=g["superstep_k"],
                              window_s=0.001, soft_credit=1 << 20,
                              hard_credit=1 << 20)
+        # a TCP-serving host (geo child) gives the adopted stack its
+        # own ephemeral TCP listener — the address a REHOME hint's
+        # resolver hands back to re-homed wire clients
         lst = WireListener(
-            plane, port=None, max_conns=g["max_conns"],
+            plane, port=0 if self._port is not None else None,
+            max_conns=g["max_conns"],
             ring_bytes=g["ring_records"] * data_stride(
                 eng.payload_width))
         self.adopted[victim_id] = (eng, plane, lst)

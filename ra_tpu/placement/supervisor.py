@@ -47,8 +47,11 @@ class EngineSupervisor:
     ``table_sid``/``router`` name any member of the placement-table
     cluster (leader redirects are the commit path's business).
     ``probes`` maps engine id → zero-arg callable returning truthy
-    while the engine is alive — the in-process heartbeat; across hosts
-    the same callable wraps a reliable-RPC ping.  ``fault_plan`` (a
+    while the engine is alive — the in-process heartbeat.  Across
+    hosts the callable wraps a reliable-RPC ping and returns **None**
+    ("asynchronous: the completion arrives via :meth:`probe_reply`"),
+    so a slow round trip never blocks the tick and RTT reads as age
+    (:mod:`ra_tpu.placement.fabric`).  ``fault_plan`` (a
     transport FaultPlan) is consulted per heartbeat on the ``ping``
     frame class honoring BOTH drop and delay: a dropped probe is
     silence, a delayed probe arrives late (``delay_s`` added to the
@@ -74,6 +77,7 @@ class EngineSupervisor:
         self._clock = clock
         self.counters = {f: 0 for f in PLACEMENT_FIELDS}
         self._probe: dict[str, Callable] = {}
+        self._gen: dict[str, int] = {}         # watched slot generation
         self._last_heard: dict[str, float] = {}
         self._arrive: dict[str, float] = {}    # in-flight probe reply
         self._verdict: dict[str, str] = {}
@@ -84,12 +88,25 @@ class EngineSupervisor:
 
     # -- registration --------------------------------------------------
 
-    def watch(self, eid: str, probe: Callable[[], Any]) -> None:
+    def watch(self, eid: str, probe: Callable[[], Any],
+              generation: int = 1) -> None:
+        """(Re)register an engine slot.  Re-watching with a HIGHER
+        generation is a re-provision: the old incumbent's in-flight
+        probe replies become stale (see :meth:`probe_reply`) and the
+        detector state resets for the new incumbent."""
         now = self._clock()
         self._probe[eid] = probe
+        self._gen[eid] = int(generation)
         self._last_heard[eid] = now
         self._arrive[eid] = _INF
         self._verdict[eid] = "up"
+        self._suspect_since.pop(eid, None)
+
+    def generation(self, eid: str) -> int:
+        """The watched slot's current generation — async probes capture
+        this when the probe is issued and hand it back to
+        :meth:`probe_reply` with the reply."""
+        return self._gen.get(eid, 0)
 
     def verdict(self, eid: str) -> str:
         return self._verdict.get(eid, "unknown")
@@ -98,6 +115,37 @@ class EngineSupervisor:
                        now: Optional[float] = None) -> float:
         now = self._clock() if now is None else now
         return now - self._last_heard.get(eid, now)
+
+    # -- asynchronous probe completion ---------------------------------
+
+    def probe_reply(self, eid: str, *, heard_at: Optional[float] = None,
+                    generation: Optional[int] = None) -> bool:
+        """Complete a probe whose reply arrived OUTSIDE the tick (the
+        cross-host path: a reliable-RPC ping finishing on its own
+        thread).  ``heard_at`` is the time the probe was ISSUED — a
+        completed round trip proves the engine was alive at send time,
+        so cross-domain RTT reads as age and the hysteresis window
+        absorbs it (CD-Raft: delay is not death).
+
+        ``generation`` is the slot generation captured when the probe
+        was issued.  A reply from a SUPERSEDED generation — the slot
+        was re-provisioned while the probe was in flight — is
+        discarded: counting it would reset the NEW incumbent's suspect
+        streak with evidence about a different engine (the ISSUE 19
+        bug-hardening pin).  Returns True when the reply counted."""
+        if eid not in self._probe:
+            return False
+        if generation is not None and generation != self._gen.get(eid):
+            self.counters["stale_probe_drops"] += 1
+            record("placement.stale_probe", peer=eid,
+                   reply_generation=generation,
+                   generation=self._gen.get(eid, 0))
+            return False
+        heard = self._clock() if heard_at is None else heard_at
+        if heard > self._last_heard.get(eid, -_INF):
+            self._last_heard[eid] = heard
+            self.counters["heartbeats"] += 1
+        return True
 
     # -- the detector tick ---------------------------------------------
 
@@ -117,11 +165,19 @@ class EngineSupervisor:
                 self._last_heard[eid] = self._arrive[eid]
                 self._arrive[eid] = _INF
                 self.counters["heartbeats"] += 1
-            alive = False
+            res: Any = False
             try:
-                alive = bool(probe())
+                res = probe()
             except Exception:
+                res = False
+            if res is None:
+                # asynchronous probe: it issued (or has in flight) a
+                # real RPC whose completion lands via probe_reply() —
+                # the silence ladder below still judges what has
+                # actually been heard
                 alive = False
+            else:
+                alive = bool(res)
             if alive:
                 delay_s = 0.0
                 deliver = True

@@ -200,6 +200,11 @@ class PlacementCache:
                 return ent["engine"]
         return None
 
+    def ranges(self) -> dict:
+        """The cached ``rid -> entry`` view (entries are copies — a
+        serving listener derives its lane masks from these, ISSUE 19)."""
+        return {rid: dict(ent) for rid, ent in self._ranges.items()}
+
     def stale_against(self, state: dict) -> bool:
         return state["rev"] > self.rev
 

@@ -388,13 +388,41 @@ class FaultPlan:
     (control plane), ``reply``, ``notify``, ``ping``, ``hello``.
     Partitions are binary per peer: every frame both ways drops until
     :meth:`heal`.
+
+    **Latency domains** (ISSUE 19): ``domains`` declares a named-domain
+    delay matrix so a whole geo topology is one object::
+
+        FaultPlan(seed, domains={
+            "local": "ctl",                        # where THIS plan runs
+            "members": {"ctl": ["ctl0"],
+                        "geo": ["gf1", "gf2"],
+                        "eng": ["engA", "engB"]},
+            "matrix": {("ctl", "geo"):             # per (src, dst) pair
+                       {"delay_ms": 80.0, "jitter_ms": 70.0}},
+        })
+
+    Matrix values are :class:`FaultSpec` objects or dicts compiled to
+    one (``delay_ms`` as a number with optional ``jitter_ms``, or an
+    explicit ``(lo, hi)`` tuple; optional ``drop`` probability; a pure
+    delay spec gets ``delay=1.0`` — network distance is deterministic,
+    not probabilistic).  Resolution: an exact ``(src, dst)`` key wins,
+    else the reversed pair (cross-domain RTT is symmetric unless the
+    matrix says otherwise).  A peer's domain comes from ``members``;
+    ``send`` frames cross ``(local, domain_of(peer))``, ``recv`` frames
+    ``(domain_of(peer), local)``.  The matrix ranks below every
+    explicit per-peer/per-class spec and above the default, and it
+    compiles onto the SAME per-(peer, frame-class, direction) RNG
+    streams as everything else (docs/INTERNALS.md §20) — no new
+    replay machinery, and a matrix delay records ``rpc.domain_delay``
+    so timelines show which domain crossing stretched a frame.
     """
 
     def __init__(self, seed: int = 0,
                  default: Optional[FaultSpec] = None,
                  by_class: Optional[dict] = None,
                  by_peer: Optional[dict] = None,
-                 by_peer_class: Optional[dict] = None) -> None:
+                 by_peer_class: Optional[dict] = None,
+                 domains: Optional[dict] = None) -> None:
         self.seed = seed
         self.default = default or FaultSpec()
         self.by_class = dict(by_class or {})
@@ -407,7 +435,40 @@ class FaultPlan:
         #: injected-fault counters by kind (drop/delay/duplicate/
         #: reorder/partition), merged into the router overview
         self.counters: dict = {}
+        self.domains = dict(domains or {})
+        self._local_domain = self.domains.get("local", "")
+        #: peer name -> domain name (compiled from domains["members"])
+        self._domain_of: dict = {
+            peer: dname
+            for dname, peers in self.domains.get("members", {}).items()
+            for peer in peers}
+        #: (src, dst) -> FaultSpec (compiled from domains["matrix"])
+        self._matrix: dict = {
+            tuple(pair): self._compile_domain_spec(v)
+            for pair, v in self.domains.get("matrix", {}).items()}
         _LIVE_PLANS.add(self)  # post-mortem bundles name active plans
+
+    @staticmethod
+    def _compile_domain_spec(value) -> FaultSpec:
+        """A matrix cell → FaultSpec.  Dicts name network distance
+        declaratively: ``delay_ms`` (number → uniform over
+        [delay, delay + jitter_ms], or an explicit (lo, hi) tuple) and
+        an optional ``drop`` probability.  Any nonzero delay range gets
+        probability 1.0 — every frame crossing the boundary pays the
+        distance."""
+        if isinstance(value, FaultSpec):
+            return value
+        v = dict(value)
+        delay_ms = v.get("delay_ms", 0.0)
+        if isinstance(delay_ms, (tuple, list)):
+            lo, hi = float(delay_ms[0]), float(delay_ms[1])
+        else:
+            lo = float(delay_ms)
+            hi = lo + float(v.get("jitter_ms", 0.0))
+        drop = float(v.get("drop", 0.0))
+        return FaultSpec(drop=drop,
+                         delay=1.0 if hi > 0.0 else 0.0,
+                         delay_ms=(lo, hi))
 
     # -- schedule control ---------------------------------------------------
 
@@ -418,11 +479,18 @@ class FaultPlan:
         quiet — the autotuner's freeze guard reads this, because a
         plan object pinned by a router after the chaos exercise ended
         must not freeze the controller for the rest of the process
-        (liveness is not activity)."""
+        (liveness is not activity).  Domain matrices are judged from
+        THIS plan's vantage: only cells touching the local domain can
+        ever inject here, so a standing 100 ms control-tier matrix
+        leaves an engine-tier plan (same topology, different
+        ``local``) quiet — the freeze guard must not freeze the
+        engine hosts' tuners for latency they never see."""
         if self.partitioned:
             return False
         specs = [self.default, *self.by_class.values(),
                  *self.by_peer.values(), *self.by_peer_class.values()]
+        specs += [spec for (src, dst), spec in self._matrix.items()
+                  if self._local_domain in (src, dst)]
         return all(s.drop == 0 and s.delay == 0 and s.duplicate == 0
                    and s.reorder == 0 for s in specs)
 
@@ -448,14 +516,40 @@ class FaultPlan:
     # -- decision -----------------------------------------------------------
 
     def _spec_for(self, peer: str, frame_class: str) -> FaultSpec:
+        return self._resolve(peer, frame_class, "send")[0]
+
+    def _domain_pair(self, peer: str, direction: str):
+        """The (src, dst) matrix cell a frame to/from ``peer`` crosses,
+        or None when the peer has no domain or no cell applies.  An
+        exact key wins; the reversed pair covers the symmetric-RTT
+        common case."""
+        dom = self._domain_of.get(peer)
+        if dom is None or not self._matrix:
+            return None
+        pair = (self._local_domain, dom) if direction == "send" \
+            else (dom, self._local_domain)
+        if pair in self._matrix:
+            return pair
+        rev = (pair[1], pair[0])
+        if rev in self._matrix:
+            return rev
+        return None
+
+    def _resolve(self, peer: str, frame_class: str, direction: str):
+        """(spec, domain_pair) — domain_pair is the matrix cell the
+        spec came from, None for explicitly-keyed specs (which rank
+        above the matrix) and the default (which ranks below)."""
         for key in ((peer, frame_class),):
             if key in self.by_peer_class:
-                return self.by_peer_class[key]
+                return self.by_peer_class[key], None
         if peer in self.by_peer:
-            return self.by_peer[peer]
+            return self.by_peer[peer], None
         if frame_class in self.by_class:
-            return self.by_class[frame_class]
-        return self.default
+            return self.by_class[frame_class], None
+        pair = self._domain_pair(peer, direction)
+        if pair is not None:
+            return self._matrix[pair], pair
+        return self.default, None
 
     def _note(self, kind: str, peer: str = "",
               frame_class: str = "") -> None:
@@ -494,7 +588,7 @@ class FaultPlan:
         if peer in self.partitioned:
             self._note("partition", peer, frame_class)
             return _DROP
-        spec = self._spec_for(peer, frame_class)
+        spec, domain_pair = self._resolve(peer, frame_class, direction)
         if spec.drop == spec.delay == spec.duplicate == spec.reorder == 0:
             return _DELIVER
         key = (peer, frame_class, direction)
@@ -522,17 +616,29 @@ class FaultPlan:
                     return _DROP
                 if kind == "delay":
                     lo, hi = spec.delay_ms
-                    return FaultDecision(
-                        delay_s=rng.uniform(lo, hi) / 1000.0)
+                    delay_s = rng.uniform(lo, hi) / 1000.0
+                    if domain_pair is not None:
+                        # a matrix-sourced stretch is geography, not
+                        # chaos — timelines name the domain crossing
+                        record("rpc.domain_delay", peer=peer,
+                               cls=frame_class, src=domain_pair[0],
+                               dst=domain_pair[1],
+                               delay_ms=round(delay_s * 1000.0, 3))
+                    return FaultDecision(delay_s=delay_s)
                 if kind == "duplicate":
                     return FaultDecision(duplicate=True)
                 return FaultDecision(reorder=True)
         return _DELIVER
 
     def overview(self) -> dict:
-        return {"seed": self.seed,
-                "partitioned": sorted(self.partitioned),
-                "injected": dict(self.counters)}
+        out = {"seed": self.seed,
+               "partitioned": sorted(self.partitioned),
+               "injected": dict(self.counters)}
+        if self._matrix:
+            out["local_domain"] = self._local_domain
+            out["domain_matrix"] = sorted(
+                f"{src}->{dst}" for src, dst in self._matrix)
+        return out
 
 
 def stamp_origin(req: RpcRequest, origin: tuple,

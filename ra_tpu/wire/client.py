@@ -36,9 +36,10 @@ import numpy as np
 
 from ..ingress.coalesce import batch_rank
 from .framing import (DEFER, DUP, OK, REJECT, SHED, SLOW, T_ACK, T_CREDIT,
-                      T_ERR, T_HELLO_ACK, decode_ack, decode_credit,
-                      decode_error, decode_hello_ack, encode_data,
-                      encode_hello, read_frame)
+                      T_ERR, T_HELLO_ACK, T_REHOME, decode_ack,
+                      decode_credit, decode_error, decode_hello_ack,
+                      decode_rehome, encode_data, encode_hello,
+                      read_frame)
 
 #: op replay states
 QUEUED, SENT, PLACED = 0, 1, 2
@@ -72,11 +73,25 @@ class WireClient:
         self.op_pay: list = []
         self.op_state: list = []
         self.op_rank: list = []       # placement rank per session
+        #: ever placed on SOME home (survives the rank reset a re-home
+        #: performs): such an op's refused replay is dropped, never
+        #: re-keyed — its first copy is placed and will commit
+        self.op_ever: list = []
         self._queued: list = []       # op indices awaiting (re)send
         self._pending: dict = {}      # (sess, seqno) -> op index
         self._placed_order: dict = {} # sess -> [op index] in rank order
         self._rx = b""
         self.last_credit_level = 0
+        #: REHOME hint handling (ISSUE 19): ``rehome_resolver`` maps an
+        #: engine id to its listener address (the client's service-
+        #: discovery hook); a received hint is followed — reconnect to
+        #: the resolved home, epoch bump, unacked window replayed — AT
+        #: MOST ONCE per connection epoch, so a burst of hints from
+        #: frames already on the wire cannot reconnect-storm the client
+        self.rehome_resolver = None
+        self.rehome_hint = None          # latest (engine, gen, rev)
+        self.rehome_follows = 0
+        self._followed_epoch = -1
         self.sock: Optional[socket.socket] = None
         self._connect()
 
@@ -144,6 +159,50 @@ class WireClient:
             self.op_state[i] = QUEUED
         self._queued = sorted(set(self._queued) | set(requeue))
 
+    def rehome_to(self, address, durable=None) -> None:
+        """Move this client to a NEW home serving its recovered
+        session state (placement failover over TCP, ISSUE 19) — the
+        WireClient twin of :meth:`LoopbackFleet.rehome`.  The new
+        listener must have PRE-CLAIMED this client's session block
+        (:meth:`WireListener.claim_sessions` — the ``host_rehome``
+        control verb) with the old dedup slots and the acked
+        watermarks, so replayed payloads hit the recovered machine's
+        per-(lane, slot) dedup.
+
+        Rank bookkeeping restarts at the acked watermark (ranks the
+        old home burned on rows it never durably committed die with
+        it), the pending window drops (old-home credits never
+        arrive), and every unacked op requeues for at-least-once
+        replay.  ``durable`` — the per-session durably-applied op-id
+        watermarks ``claim_sessions`` returned — re-bases
+        ``op_ever``: an op the old home placed but never fsynced is
+        gone from every durable record, so its replay may re-key on
+        refusal like any never-placed op.  Without it (``None``, the
+        self-serve hint-follow path) every previously-placed op stays
+        ever-placed — conservatively never double-applies, at the
+        cost that a shed replay of a LOST copy is dropped rather than
+        re-keyed."""
+        self.address = tuple(address)
+        n = len(self.op_state)
+        dur = None if durable is None else np.asarray(durable, np.int64)
+        for i in range(n):
+            ever = self.op_rank[i] >= 0 or self.op_ever[i]
+            if dur is not None:
+                ever = ever and \
+                    self.op_id[i] <= int(dur[self.op_sess[i]])
+            self.op_ever[i] = ever
+            if self.op_state[i] != QUEUED and not self._acked(i):
+                self.op_state[i] = QUEUED
+                self.op_rank[i] = -1
+                self._queued.append(i)
+        self._queued = sorted(set(self._queued))
+        self._pending.clear()
+        self.placed_cnt[:] = self.watermark
+        self._placed_order = {}
+        self.close(keep_state=True)
+        self.reconnects += 1
+        self._connect()
+
     def close(self, keep_state: bool = False) -> None:
         if self.sock is not None:
             try:
@@ -169,6 +228,7 @@ class WireClient:
         self.op_pay.append(int(delta))
         self.op_state.append(QUEUED)
         self.op_rank.append(-1)
+        self.op_ever.append(False)
         self._queued.append(idx)
         return idx
 
@@ -274,6 +334,30 @@ class WireClient:
                 s = int(r["sess"])
                 self.watermark[s] = max(self.watermark[s],
                                         int(r["acked"]))
+        elif t == T_REHOME:
+            hint = decode_rehome(body)
+            self.rehome_hint = (hint["engine"], hint["generation"],
+                                hint["rev"])
+            self._maybe_follow_rehome(hint)
+
+    def _maybe_follow_rehome(self, hint: dict) -> None:
+        """Follow a REHOME hint at most once per connection epoch
+        (ISSUE 19).  The gate is recorded BEFORE the redial: any
+        further hints already buffered from the old socket (or drained
+        by reconnect()'s best-effort poll) find the epoch spent and
+        are kept as data only — no reconnect storm.  Without a
+        resolver the hint is surfaced (``rehome_hint``) but never
+        acted on; the caller owns service discovery."""
+        if self.rehome_resolver is None:
+            return
+        if self._followed_epoch == self.epoch:
+            return
+        addr = self.rehome_resolver(hint["engine"])
+        if addr is None:
+            return
+        self._followed_epoch = self.epoch
+        self.rehome_follows += 1
+        self.rehome_to(addr)
 
     def _on_verdict(self, sess: int, seqno: int, status: int) -> None:
         i = self._pending.pop((sess, seqno), None)
@@ -282,10 +366,11 @@ class WireClient:
         if status in (OK, SLOW):
             self.op_state[i] = PLACED
             self.op_rank[i] = int(self.placed_cnt[sess])
+            self.op_ever[i] = True
             self.placed_cnt[sess] += 1
             self._placed_order.setdefault(sess, []).append(i)
         elif status in (DEFER, REJECT, SHED):
-            if self.op_rank[i] >= 0:
+            if self.op_rank[i] >= 0 or self.op_ever[i]:
                 # refused REPLAY of an ever-placed op: the first copy
                 # is placed and will commit — drop the replay
                 self.op_state[i] = PLACED
@@ -303,6 +388,7 @@ class WireClient:
         elif status == DUP:
             # already placed under an earlier seqno: nothing to replay
             self.op_state[i] = PLACED
+            self.op_ever[i] = True
 
     # -- progress -----------------------------------------------------------
 
@@ -375,6 +461,12 @@ class LoopbackFleet:
         #: flush gate (see send_queued)
         self._pend_per_sess = np.zeros(self.n_sessions, np.int64)
         self.reconnects = 0
+        #: REHOME hints drained from the listener (ISSUE 19): the
+        #: latest ``(slot, engine, generation, rev)`` plus a count —
+        #: the driver (soak / rehome harness) owns the follow action,
+        #: mirroring WireClient.rehome_resolver
+        self.rehome_hint = None
+        self.rehome_hints = 0
         # per-tenant verdict tallies (the soak's shed-fairness evidence)
         d = listener.plane.directory
         self.tenant_of = d.tenant[self.handles].astype(np.int64)
@@ -488,6 +580,13 @@ class LoopbackFleet:
             sess = handles - self.base
             np.maximum.at(self.watermark, sess,
                           rec["acked"].astype(np.int64))
+        collect_hints = getattr(self.listener, "collect_rehome_hints",
+                                None)
+        if collect_hints is not None:
+            hints = collect_hints()
+            if hints:
+                self.rehome_hint = hints[-1]
+                self.rehome_hints += len(hints)
 
     def _on_credit(self, handles, seqnos, statuses) -> None:
         key = (handles << self._SEQ_BITS) | seqnos

@@ -51,6 +51,13 @@ return), so they may be built and parsed per frame:
   committed placed-row watermarks (flow-control grade: duplicate row
   commits can run a watermark ahead; exactness is machine-level — see
   docs/INGRESS.md).
+* ``REHOME``     server→client  ``<generation u32> <revision u64>
+  <namelen u16> <utf-8 engine>`` — a typed placement-staleness refusal
+  (ISSUE 19): the frames the client just sent hit lanes whose home
+  moved per the listener's PlacementCache view.  The named engine +
+  generation + table revision are the hint a client follows (at most
+  once per connection epoch) to the new home instead of silently
+  misrouting into a dead engine's lanes (docs/PLACEMENT.md).
 
 The version byte rides HELLO/HELLO_ACK; a mismatch refuses the
 connection before any data record is interpreted.
@@ -69,12 +76,13 @@ from ..ingress.backpressure import (DEFER, DUP, OK, REJECT, SHED, SLOW,
 
 __all__ = [
     "WIRE_VERSION", "T_HELLO", "T_HELLO_ACK", "T_DATA", "T_CREDIT",
-    "T_ACK", "T_ERR", "E_VERSION", "E_PAYLOAD_WIDTH", "data_dtype",
-    "credit_dtype", "ack_dtype", "data_stride",
+    "T_ACK", "T_ERR", "T_REHOME", "E_VERSION", "E_PAYLOAD_WIDTH",
+    "data_dtype", "credit_dtype", "ack_dtype", "data_stride",
     "encode_hello", "decode_hello", "encode_hello_ack",
     "decode_hello_ack", "encode_error", "decode_error",
     "encode_data", "decode_data", "encode_credit",
-    "decode_credit", "encode_ack", "decode_ack", "read_frame",
+    "decode_credit", "encode_ack", "decode_ack",
+    "encode_rehome", "decode_rehome", "read_frame",
     "OK", "SLOW", "DEFER", "REJECT", "DUP", "SHED", "STATUS_NAMES",
 ]
 
@@ -90,6 +98,7 @@ T_DATA = 3
 T_CREDIT = 4
 T_ACK = 5
 T_ERR = 6
+T_REHOME = 7
 
 #: ERR frame codes
 E_VERSION = 1        # HELLO version byte != WIRE_VERSION
@@ -102,6 +111,7 @@ _HELLO_ACK = struct.Struct("<BBBHIQ")  # type, ver, flags, width, epoch, base
 _CREDIT_HDR = struct.Struct("<BBBH")   # type, level, pad, count
 _ACK_HDR = struct.Struct("<BBHH")      # type, pad, pad, count
 _ERR_HDR = struct.Struct("<BBH")       # type, code, msglen
+_REHOME_HDR = struct.Struct("<BHIQH")  # type, pad, generation, rev, namelen
 
 
 def data_dtype(payload_width: int) -> np.dtype:
@@ -185,6 +195,26 @@ def decode_error(body: bytes) -> dict:
     msg = body[_ERR_HDR.size:_ERR_HDR.size + msglen].decode(
         errors="replace")
     return {"code": code, "message": msg}
+
+
+def encode_rehome(engine: str, generation: int, rev: int) -> bytes:
+    """The typed placement-staleness refusal (ISSUE 19): "your lanes'
+    home is ``engine`` at ``generation`` per table revision ``rev`` —
+    reconnect there".  Sent at most once per affected connection per
+    sweep; a client honors it at most once per connection epoch."""
+    nb = engine.encode()[:65535]
+    body = _REHOME_HDR.pack(T_REHOME, 0, int(generation) & 0xFFFFFFFF,
+                            int(rev) & 0xFFFFFFFFFFFFFFFF, len(nb)) + nb
+    return _LEN.pack(len(body)) + body
+
+
+def decode_rehome(body: bytes) -> dict:
+    t, _pad, generation, rev, namelen = _REHOME_HDR.unpack_from(body)
+    if t != T_REHOME:
+        raise ValueError(f"not a REHOME frame (type {t})")
+    engine = body[_REHOME_HDR.size:_REHOME_HDR.size + namelen].decode(
+        errors="replace")
+    return {"engine": engine, "generation": generation, "rev": rev}
 
 
 # -- the data stream (vectorized both ways) ---------------------------------

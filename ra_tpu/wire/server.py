@@ -51,7 +51,7 @@ from ..metrics import WIRE_FIELDS
 from .framing import (E_PAYLOAD_WIDTH, E_VERSION, SHED, T_DATA,
                       WIRE_VERSION, ack_dtype, credit_dtype,
                       data_stride, decode_hello, encode_error,
-                      encode_hello_ack)
+                      encode_hello_ack, encode_rehome)
 
 _LEN = struct.Struct("<I")
 
@@ -138,6 +138,22 @@ class WireListener:
         #: conn ids) collected by the fleet after each sweep/commit
         self._lb_credit: list = []
         self._lb_ack: list = []
+        #: loopback rehome-hint outbox: (slot, engine, generation, rev)
+        #: tuples drained via collect_rehome_hints() — the in-process
+        #: twin of the TCP T_REHOME frame (ISSUE 19)
+        self._lb_rehome: list = []
+        #: serving-path placement view (ISSUE 19): a revision-monotone
+        #: PlacementCache + the engine ids served HERE; None = every
+        #: lane is local (the single-host default)
+        self._placement = None
+        self._local_engines: set = set()
+        self._placement_rids = None
+        self._placement_rev = -2      # forces a mask build on bind
+        self._lane_local = None
+        self._lane_home = None
+        self._owner_names: list = []
+        self._owner_gens: list = []
+        self.rehome_hints = 0         # PLACEMENT_FIELDS counter
         self._lb_slots: set = set()
         self._lb_key: dict[int, str] = {}
         #: loopback membership as a flat mask: the sweep path fans
@@ -295,40 +311,19 @@ class WireListener:
         record("wire.conn", bulk=key, n=int(n_conns), reconnect=False)
         return slots
 
-    def loopback_rehome(self, n_conns: int, *, sessions_per_conn: int
-                        = 1, key: str = "fleet", tenants: int = 1,
-                        slots: np.ndarray, committed: np.ndarray,
-                        trace_ctx=None) -> np.ndarray:
-        """Adopt a re-homed loopback fleet (placement failover, ISSUE
-        17): bind ``key``'s session block on THIS listener while
-        honoring the fleet's existing machine-level identity —
-
-        * ``slots`` are the per-session dedup slots the OLD home
-          handed out, claimed verbatim: a replayed op's payload still
-          carries its old ``[slot, op_id, delta]``, and the recovered
-          machine's per-(lane, slot) watermark is what absorbs the
-          duplicate.  Handing out FRESH slots here would re-apply
-          every replayed committed op — the double-apply this method
-          exists to prevent.
-        * ``committed`` seeds the per-session committed-row watermark
-          at the client's ACKED count: ranks burned on the old home
-          (placed rows that never committed) are dropped client-side
-          at re-home, so rank ``committed[s]`` is exactly the next row
-          the new home will commit for session ``s``.
-
-        Every re-homed session's epoch bumps (the replay trigger of
-        the reconnect contract).  Returns the conn slot ids."""
-        spc = int(sessions_per_conn)
+    def _claim_block(self, key: str, n_sessions: int, tenants: int,
+                     slots, committed) -> np.ndarray:
+        """Bind ``key``'s session block on this listener with the OLD
+        home's dedup slots claimed verbatim and the committed-row
+        watermarks seeded at the client's acked counts — the shared
+        core of :meth:`loopback_rehome` and :meth:`claim_sessions`."""
         d = self.plane.directory
         if f"wire/{key}" in d._bulk:
             raise RuntimeError(
                 f"rehome of known key {key!r}: a fleet re-homes onto "
                 "a listener that never served it (same-listener "
                 "reconnects go through loopback_connect)")
-        if len(self._free) < n_conns:
-            raise RuntimeError(
-                f"wire listener full ({self.max_conns} conns)")
-        h = self.plane.connect_bulk(n_conns * spc, key=f"wire/{key}",
+        h = self.plane.connect_bulk(n_sessions, key=f"wire/{key}",
                                     tenants=max(1, tenants))
         handles = np.asarray(h, np.int64)
         claim = np.asarray(slots, np.int32)
@@ -357,6 +352,70 @@ class WireListener:
             c = np.asarray(committed, np.int64)
             self._committed[handles] = c
             self._acked_sent[handles] = c
+        return handles
+
+    def claim_sessions(self, key: str, n_sessions: int, *, slots,
+                       committed, tenants: int = 1,
+                       trace_ctx=None) -> np.ndarray:
+        """Pre-claim a re-homed TCP client's session block (ISSUE 19):
+        the cross-process twin of :meth:`loopback_rehome`, minus the
+        loopback conn plumbing.  The orchestrator calls this on the
+        NEW home (over the ``host_rehome`` control verb) before
+        pointing the client at it; the client's subsequent HELLO under
+        the same key then finds its sessions bound with the OLD dedup
+        slots — so replayed ``[slot, op_id, delta]`` payloads still
+        hit the recovered machine's per-(lane, slot) watermarks, the
+        dedup that makes the at-least-once replay exactly-once.
+
+        Returns the per-session DURABLY-APPLIED op-id watermarks from
+        the recovered machine state — the client re-bases its
+        ever-placed bookkeeping against these
+        (:meth:`WireClient.rehome_to`)."""
+        handles = self._claim_block(key, n_sessions, tenants, slots,
+                                    committed)
+        d = self.plane.directory
+        lanes = d.lane[handles].astype(np.int64)
+        dur = np.zeros(n_sessions, np.int64)
+        mac = getattr(self.plane.engine.state, "mac", None)
+        if isinstance(mac, dict) and "seq" in mac:
+            seq = np.asarray(mac["seq"]).max(axis=1)
+            dur = seq[lanes, np.asarray(slots, np.int64)] \
+                .astype(np.int64)
+        record("placement.rehome", trace=trace_ctx, key=key,
+               sessions=int(n_sessions), conns=0)
+        return dur
+
+    def loopback_rehome(self, n_conns: int, *, sessions_per_conn: int
+                        = 1, key: str = "fleet", tenants: int = 1,
+                        slots: np.ndarray, committed: np.ndarray,
+                        trace_ctx=None) -> np.ndarray:
+        """Adopt a re-homed loopback fleet (placement failover, ISSUE
+        17): bind ``key``'s session block on THIS listener while
+        honoring the fleet's existing machine-level identity —
+
+        * ``slots`` are the per-session dedup slots the OLD home
+          handed out, claimed verbatim: a replayed op's payload still
+          carries its old ``[slot, op_id, delta]``, and the recovered
+          machine's per-(lane, slot) watermark is what absorbs the
+          duplicate.  Handing out FRESH slots here would re-apply
+          every replayed committed op — the double-apply this method
+          exists to prevent.
+        * ``committed`` seeds the per-session committed-row watermark
+          at the client's ACKED count: ranks burned on the old home
+          (placed rows that never committed) are dropped client-side
+          at re-home, so rank ``committed[s]`` is exactly the next row
+          the new home will commit for session ``s``.
+
+        Every re-homed session's epoch bumps (the replay trigger of
+        the reconnect contract).  Returns the conn slot ids."""
+        spc = int(sessions_per_conn)
+        d = self.plane.directory
+        if len(self._free) < n_conns:
+            raise RuntimeError(
+                f"wire listener full ({self.max_conns} conns)")
+        handles = self._claim_block(key, n_conns * spc, tenants,
+                                    slots, committed)
+        h = handles
         conn_slots = np.array([self._alloc_slot()
                                for _ in range(n_conns)], np.int64)
         self.cstate[conn_slots] = _S_DATA
@@ -692,6 +751,113 @@ class WireListener:
         return take
 
     # ------------------------------------------------------------------
+    # serving-path placement view (ISSUE 19)
+    # ------------------------------------------------------------------
+
+    def bind_placement(self, cache, local_engines, rids=None) -> None:
+        """Wire a revision-monotone :class:`PlacementCache` into the
+        sweep: rows whose lane the cache places on an engine NOT served
+        here are refused with a typed REHOME hint instead of submitted
+        — a frame routed on a stale client-side view never silently
+        misroutes into a foreign (possibly dead) engine's lanes.  The
+        cache is shared with whatever refreshes it on table commits;
+        the sweep re-derives its lane mask whenever the cache revision
+        moves (including an :meth:`PlacementCache.invalidate`, which
+        fails OPEN: no view is not the same as a foreign view).
+
+        ``rids`` names the table range ids THIS listener's lane space
+        belongs to.  PR 17's per-engine lane spaces overlap (every
+        engine's range covers ``[0, lanes)`` under its own rid), so
+        the mask must be derived only from the ranges this listener
+        serves — a foreign engine's range over the same lane numbers
+        says nothing about these sessions.  ``None`` keeps the
+        all-ranges view (globally partitioned lane spaces)."""
+        self._placement = cache
+        self._local_engines = set(local_engines)
+        self._placement_rids = None if rids is None else frozenset(rids)
+        self._placement_rev = -2
+
+    def add_local_engine(self, engine_id: str) -> None:
+        """Adoption hook: lanes the cache places on ``engine_id`` are
+        local from now on (the survivor serves the victim's ranges)."""
+        self._local_engines.add(engine_id)
+        self._placement_rev = -2
+
+    def _refresh_placement_mask(self) -> None:
+        cache = self._placement
+        if int(cache.rev) == self._placement_rev:
+            return
+        n_lanes = int(self.plane.engine.n_lanes)
+        local = np.ones(n_lanes, bool)   # fail open: unknown = local
+        home = np.full(n_lanes, -1, np.int64)
+        names: list = []
+        gens: list = []
+        if int(cache.rev) >= 0:
+            # per-RANGE Python (a handful of ranges, control plane) —
+            # the per-ROW path below stays one mask gather
+            for rid, ent in sorted(cache.ranges().items()):  # ra09-ok: iterates placement RANGES (control-plane scale), rows stay vectorized
+                if self._placement_rids is not None and \
+                        rid not in self._placement_rids:
+                    continue
+                lo = max(0, int(ent["lo"]))
+                hi = min(n_lanes, int(ent["hi"]))
+                if hi <= lo:
+                    continue
+                eng = ent["engine"]
+                local[lo:hi] = eng in self._local_engines
+                home[lo:hi] = len(names)
+                names.append(eng)
+                gens.append(int(ent["generation"]))
+        self._lane_local = local
+        self._lane_home = home
+        self._owner_names = names
+        self._owner_gens = gens
+        self._placement_rev = int(cache.rev)
+
+    def _stale_rows(self, handles: np.ndarray,
+                    ok: np.ndarray) -> Optional[np.ndarray]:
+        """Mask of swept rows whose lane's home is NOT served here per
+        the bound placement view (one gather — RA09-clean)."""
+        self._refresh_placement_mask()
+        if self._lane_local is None or self._lane_local.all():
+            return None
+        lanes = self.plane.directory.lanes_of(handles)
+        return ok & ~self._lane_local[lanes]
+
+    def _send_rehome(self, conn_of: np.ndarray, handles: np.ndarray,
+                     stale: np.ndarray) -> None:
+        """One typed REHOME hint per affected connection: the new home
+        (engine, generation, table revision) of the FIRST refused lane
+        — enough for the client to re-resolve and reconnect.  Rare
+        (the post-migration window only), so per-connection Python is
+        acceptable here like every other control-plane frame."""
+        rows = np.flatnonzero(stale)
+        lanes = self.plane.directory.lanes_of(handles[rows])
+        conns, counts = self._runs(conn_of[rows])
+        firsts = np.cumsum(counts) - counts
+        rev = int(self._placement.rev)
+        self.rehome_hints += len(conns)
+        for i in range(len(conns)):  # ra09-ok: per-CONNECTION rehome hint (rare, post-migration only)
+            owner = int(self._lane_home[int(lanes[firsts[i]])])
+            engine = self._owner_names[owner] if owner >= 0 else ""
+            gen = self._owner_gens[owner] if owner >= 0 else 0
+            slot = int(conns[i])
+            record("placement.rehome_hint", slot=slot, engine=engine,
+                   generation=gen, rev=rev, rows=int(counts[i]))
+            if self._is_lb[slot]:
+                self._lb_rehome.append((slot, engine, gen, rev))
+            else:
+                self._send_frame_to(slot, encode_rehome(engine, gen,
+                                                        rev))
+
+    def collect_rehome_hints(self) -> list:
+        """Drain the loopback rehome-hint outbox: ``(slot, engine,
+        generation, rev)`` tuples (the fleet-side twin of T_REHOME)."""
+        with self._lock:
+            out, self._lb_rehome = self._lb_rehome, []
+        return out
+
+    # ------------------------------------------------------------------
     # sweep — the RA09-gated vectorized hot path
     # ------------------------------------------------------------------
 
@@ -739,6 +905,16 @@ class WireListener:
         sess = rec["sess"].astype(np.int64)
         handles = self.hbase[conn_of] + sess
         seqnos = rec["seqno"].astype(np.int64)
+        if self._placement is not None and ok.any():
+            # placement staleness gate (ISSUE 19): rows whose lane
+            # moved to a foreign engine get a typed REHOME hint, not a
+            # submit — they earn neither credit nor a shed verdict
+            # (the client re-sends them at the new home after
+            # following the hint)
+            stale = self._stale_rows(handles, ok)
+            if stale is not None and stale.any():
+                self._send_rehome(conn_of, handles, stale)
+                ok &= ~stale
         status = np.full(len(rec), SHED, np.int8)
         if ok.any():
             status[ok] = self.plane.submit(handles[ok], seqnos[ok],

@@ -3,7 +3,16 @@ machine (never half-moved, redelivery-idempotent), the engine
 supervisor's hysteresis detector (delay is not death), the bounded
 commit loop (RA16's runtime twin), the wire listener's re-home claims
 (old dedup slots or nothing), and the end-to-end failover soak with
-its exactly-once oracle + trace timeline."""
+its exactly-once oracle + trace timeline.
+
+ISSUE 19 pins ride at the end: stale-generation probe replies are
+discarded, the latency-domain matrix resolves/injects from the local
+vantage (and the autotune freeze guard honors that), and the serving
+path's placement staleness gate refuses with a typed REHOME hint the
+client follows at most once per connection epoch."""
+import threading
+import time
+
 import numpy as np
 import pytest
 
@@ -262,7 +271,7 @@ def test_commit_loop_retries_returned_error_results():
 
 # -- the re-home claim path -----------------------------------------------
 
-def _stack(lanes, slots=64):
+def _stack(lanes, slots=64, port=None):
     from ra_tpu.engine import LockstepEngine
     from ra_tpu.ingress import IngressPlane
     from ra_tpu.wire import DedupCounterMachine, WireListener
@@ -270,7 +279,7 @@ def _stack(lanes, slots=64):
                          ring_capacity=128, max_step_cmds=8,
                          donate=False)
     plane = IngressPlane(eng, superstep_k=2, window_s=0.0)
-    lst = WireListener(plane, port=None)
+    lst = WireListener(plane, port=port)
     return eng, lst
 
 
@@ -387,3 +396,316 @@ def test_failover_trace_timeline(failover_run):
     for needle in ("placement.refuse", "cmd.commit", "placement.adopt",
                    "placement.rehome"):
         assert needle in text
+
+
+# -- ISSUE 19: stale probe generations ------------------------------------
+
+def test_stale_probe_generation_discarded():
+    """An async probe reply captured under a SUPERSEDED slot generation
+    is dropped — a stale incumbent's straggler must not vouch for the
+    slot's new incumbent — while current-generation replies count."""
+    sup, t = _sup(lambda: None)      # async probe: replies land out of band
+    sup.tick()
+    t[0] += 0.05
+    assert sup.probe_reply("eng", heard_at=t[0], generation=1)
+    assert sup.counters["heartbeats"] == 1
+    # the slot is re-provisioned while a probe is still in flight
+    sup.watch("eng", lambda: None, generation=2)
+    t[0] += 0.2                      # > suspect_after: incumbent suspect
+    sup.tick()
+    assert sup.verdict("eng") == "suspect"
+    # the old incumbent's straggler: dropped, suspect streak intact
+    assert not sup.probe_reply("eng", heard_at=t[0], generation=1)
+    assert sup.counters["stale_probe_drops"] == 1
+    assert sup.counters["heartbeats"] == 1
+    sup.tick()
+    assert sup.verdict("eng") == "suspect"   # not rescued
+    # a reply from the CURRENT generation clears the suspicion
+    assert sup.probe_reply("eng", heard_at=t[0], generation=2)
+    sup.tick()
+    assert sup.verdict("eng") == "up"
+    assert sup.counters["recoveries"] == 1
+    # an unwatched engine's reply is refused outright
+    assert not sup.probe_reply("ghost", generation=1)
+
+
+# -- ISSUE 19: latency domains --------------------------------------------
+
+_GEO_MEMBERS = {"ctl": ["ctl"], "far": ["gf1", "gf2"],
+                "eng": ["nA", "nB"]}
+_GEO_MATRIX = {("ctl", "far"): {"delay_ms": (80.0, 150.0)}}
+
+
+def test_domain_matrix_quiet_is_vantage_local():
+    """quiet() judges the matrix from THIS plan's vantage: a standing
+    control-tier delay cell leaves an engine-tier plan (same topology,
+    different ``local``) quiet, and an all-zero matrix injects nothing."""
+    ctl = FaultPlan(0, domains={"local": "ctl", "members": _GEO_MEMBERS,
+                                "matrix": _GEO_MATRIX})
+    eng = FaultPlan(0, domains={"local": "eng", "members": _GEO_MEMBERS,
+                                "matrix": _GEO_MATRIX})
+    zero = FaultPlan(0, domains={
+        "local": "ctl", "members": _GEO_MEMBERS,
+        "matrix": {("ctl", "far"): {"delay_ms": 0.0}}})
+    try:
+        assert not ctl.quiet()       # its frames cross the delayed cell
+        assert eng.quiet()           # engines never see that geography
+        assert zero.quiet()
+    finally:
+        for p in (ctl, eng, zero):
+            p.unregister()
+
+
+def test_freeze_guard_is_domain_aware():
+    """The autotune freeze guard freezes a host only when a live plan
+    can inject from ITS vantage — a standing control-tier matrix must
+    not freeze the engine tier's tuners."""
+    from ra_tpu.autotune import default_freeze_guard
+    base = default_freeze_guard()
+    eng = FaultPlan(0, domains={"local": "eng", "members": _GEO_MEMBERS,
+                                "matrix": _GEO_MATRIX})
+    try:
+        assert default_freeze_guard() == base   # quiet plan: no freeze
+        ctl = FaultPlan(0, domains={"local": "ctl",
+                                    "members": _GEO_MEMBERS,
+                                    "matrix": _GEO_MATRIX})
+        try:
+            assert default_freeze_guard() == \
+                "transport_fault_plan_active"
+        finally:
+            ctl.unregister()
+        assert default_freeze_guard() == base
+    finally:
+        eng.unregister()
+
+
+def test_domain_matrix_resolution_and_precedence():
+    """The matrix keys (src, dst) domain cells: send crosses
+    (local, peer-domain), recv the reverse (with the reversed pair as
+    the symmetric-RTT fallback), peers outside every domain ride the
+    zero default, and explicitly-keyed specs rank ABOVE the matrix."""
+    plan = FaultPlan(7, by_peer={"gf2": FaultSpec()},
+                     domains={"local": "ctl", "members": _GEO_MEMBERS,
+                              "matrix": {("ctl", "far"):
+                                         {"delay_ms": (5.0, 5.0)}}})
+    try:
+        d = plan.decide("gf1", "append", "send")
+        assert d.action == "deliver"
+        assert abs(d.delay_s - 0.005) < 1e-9
+        # recv crosses (far, ctl): no exact cell, so the reversed pair
+        # covers the symmetric-RTT common case
+        assert plan.decide("gf1", "append", "recv").delay_s > 0.0
+        # a peer in no domain rides the (zero) default
+        assert plan.decide("stranger", "append", "send").delay_s == 0.0
+        # an explicit per-peer spec ranks above the matrix
+        assert plan.decide("gf2", "append", "send").delay_s == 0.0
+    finally:
+        plan.unregister()
+
+
+def test_domain_delay_streams_replay_deterministically():
+    """Matrix delays ride the seeded per-(peer, class, direction)
+    streams: two plans with one seed draw identical jitter."""
+    def mk():
+        return FaultPlan(11, domains={"local": "ctl",
+                                      "members": _GEO_MEMBERS,
+                                      "matrix": _GEO_MATRIX})
+    a, b = mk(), mk()
+    try:
+        seq_a = [a.decide("gf1", "append", "send").delay_s
+                 for _ in range(8)]
+        seq_b = [b.decide("gf1", "append", "send").delay_s
+                 for _ in range(8)]
+        assert seq_a == seq_b
+        assert len(set(seq_a)) > 1       # jitter is real, not a constant
+        assert all(0.080 <= s <= 0.150 for s in seq_a)
+    finally:
+        a.unregister()
+        b.unregister()
+
+
+# -- ISSUE 19: the serving-path placement staleness gate ------------------
+
+def test_stale_placement_rows_get_rehome_hint_not_submit():
+    """Rows whose lane the bound PlacementCache homes on a FOREIGN
+    engine are refused with a typed REHOME hint — never submitted,
+    never shed — and an empty view or a foreign RID over the same lane
+    numbers fails OPEN (no view is not a foreign view)."""
+    from ra_tpu.wire import LoopbackFleet
+    eng, lst = _stack(lanes=4)
+    try:
+        cache = PlacementCache()
+        lst.bind_placement(cache, {"engA"}, rids={"r0"})
+        fleet = LoopbackFleet(lst, 2, key="stale")
+        sess = np.arange(2)
+        # empty cache: ops flow
+        fleet.new_ops(sess, np.full(2, 3, np.int32))
+        fleet.send_queued()
+        assert lst.sweep() == 2
+        fleet.collect()
+        assert (fleet.op_state[:2] == 2).all()       # PLACED
+        # committed table state homes every lane on engB: refuse + hint
+        cache.refresh({"rev": 5, "ranges": {
+            "r0": {"engine": "engB", "generation": 3, "lo": 0,
+                   "hi": 4}}})
+        swept0 = lst.counters["swept_rows"]
+        fleet.new_ops(sess, np.full(2, 3, np.int32))
+        fleet.send_queued()
+        assert lst.sweep() == 0
+        fleet.collect()
+        assert lst.counters["swept_rows"] == swept0  # nothing submitted
+        assert (fleet.op_state[2:4] == 1).all()      # SENT: no verdict
+        assert fleet.tenant_shed.sum() == 0          # ...and no shed
+        assert lst.rehome_hints >= 1
+        assert fleet.rehome_hints >= 1
+        _slot, engine, gen, rev = fleet.rehome_hint
+        assert (engine, gen, rev) == ("engB", 3, 5)
+        # a FOREIGN rid over the same lane numbers says nothing about
+        # this listener's sessions (per-engine lane spaces overlap)
+        cache.refresh({"rev": 6, "ranges": {
+            "r0": {"engine": "engA", "generation": 4, "lo": 0, "hi": 4},
+            "rX": {"engine": "engB", "generation": 9, "lo": 0,
+                   "hi": 4}}})
+        fresh = LoopbackFleet(lst, 2, key="healed")
+        fresh.new_ops(np.arange(2), np.full(2, 3, np.int32))
+        fresh.send_queued()
+        assert lst.sweep() == 2
+        fresh.collect()
+        assert (fresh.op_state[:2] == 2).all()
+    finally:
+        lst.close()
+        eng.close()
+
+
+def _pump_tcp(lsts, cli, done, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while not done():
+        cli.flush()
+        for lst in lsts:
+            lst.sweep()
+            lst.plane.pump(force=True)
+            lst.plane.settle()
+        cli.poll()
+        assert time.monotonic() < deadline
+
+
+def test_wire_client_follows_rehome_hint_once_per_epoch():
+    """Over real TCP: a stale home refuses with a typed REHOME frame;
+    with a resolver the client follows it — redials the pre-claimed new
+    home, replays its unacked window against the recovered dedup slots
+    — and duplicate hints within one connection epoch follow once."""
+    from ra_tpu.wire import WireClient
+    eng_a, lst_a = _stack(lanes=4, port=0)
+    eng_b, lst_b = _stack(lanes=4, port=0)
+    cli = None
+    try:
+        cache = PlacementCache()
+        lst_a.bind_placement(cache, {"engA"}, rids={"r0"})
+        cli = WireClient(lst_a.address, key="geo/c1", n_sessions=1,
+                         timeout=10.0)
+        cli.enqueue(5)
+        cli.flush()
+        _pump_tcp([lst_a], cli, lambda: cli.acked_count() >= 1)
+        # the new home PRE-CLAIMS the session block (the host_rehome
+        # verb): old dedup slots verbatim, watermarks at acked counts
+        lst_b.claim_sessions("geo/c1", 1,
+                             slots=np.asarray(cli.slots, np.int64),
+                             committed=cli.watermark.copy())
+        cli.rehome_resolver = {"engB": lst_b.address}.get
+        # the table moves every lane to engB; the next swept row is
+        # refused with the hint and the client follows it to engB
+        cache.refresh({"rev": 2, "ranges": {
+            "r0": {"engine": "engB", "generation": 2, "lo": 0,
+                   "hi": 4}}})
+        cli.enqueue(7)
+        cli.flush()
+        _pump_tcp([lst_a, lst_b], cli,
+                  lambda: cli.acked_count() >= 2)
+        assert cli.rehome_follows == 1
+        assert cli.rehome_hint == ("engB", 2, 2)
+        assert cli.address == tuple(lst_b.address)
+        assert lst_a.rehome_hints >= 1
+        # exactly-once across the move: the acked op stayed on A, only
+        # the refused op landed on B
+        lanes = np.arange(4)
+        sum_a = int(np.asarray(
+            eng_a.consistent_read(lanes)["value"]).sum())
+        sum_b = int(np.asarray(
+            eng_b.consistent_read(lanes)["value"]).sum())
+        assert (sum_a, sum_b) == (5, 7)
+        # duplicate hints buffered within ONE epoch follow exactly
+        # once: the gate is recorded before the redial
+        follows = []
+        real = cli.rehome_to
+        cli.rehome_to = lambda addr, durable=None: \
+            follows.append(tuple(addr))
+        hint = {"engine": "engB", "generation": 2, "rev": 2}
+        cli._maybe_follow_rehome(hint)
+        cli._maybe_follow_rehome(hint)
+        cli.rehome_to = real
+        assert follows == [tuple(lst_b.address)]
+        assert cli.rehome_follows == 2
+        # without a resolver a hint is surfaced, never acted on
+        cli.rehome_resolver = None
+        cli._maybe_follow_rehome({"engine": "engC", "generation": 9,
+                                  "rev": 9})
+        assert cli.rehome_follows == 2
+    finally:
+        if cli is not None:
+            cli.close()
+        lst_a.close()
+        lst_b.close()
+        eng_a.close()
+        eng_b.close()
+
+
+# -- ISSUE 19: the host agent's serving-loop bridge -----------------------
+
+class _FakeNode:
+    def __init__(self):
+        self.control_ops = {}
+
+
+class _FakeHost:
+    engine_id = "engX"
+    lanes = 4
+    listener = None
+
+    @staticmethod
+    def alive():
+        return True
+
+
+def test_host_agent_bridges_mutating_verbs_onto_serving_loop():
+    """host_status answers immediately (the probe path must never wait
+    on the serving loop); mutating verbs block until pump() executes
+    them ON the loop; placement pushes stay revision-monotone."""
+    from ra_tpu.placement.fabric import HostAgent
+    node = _FakeNode()
+    agent = HostAgent(_FakeHost(), node)
+    assert node.control_ops["host_status"]({}) == \
+        {"eid": "engX", "alive": True, "generation": 1}
+    assert agent.pump() == 0
+
+    def push(rev):
+        out = {}
+        th = threading.Thread(
+            target=lambda: out.update(node.control_ops
+                                      ["host_placement"]
+                                      ({"state": {"rev": rev,
+                                                  "ranges": {}}})))
+        th.start()
+        deadline = time.monotonic() + 5.0
+        while agent.pump() == 0:         # the serving loop's half
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        th.join(5.0)
+        assert not th.is_alive()
+        return out
+
+    assert push(3) == {"rev": 3, "changed": True}
+    assert agent.cache.rev == 3
+    assert push(1) == {"rev": 3, "changed": False}   # stale: no-op
+    # host_stop flips the stop flag without touching the loop
+    assert node.control_ops["host_stop"]({}) == "stopping"
+    assert agent.stopped.is_set()

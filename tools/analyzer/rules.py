@@ -867,6 +867,37 @@ def _check_retry_bounds(mod, ctx):
     return out
 
 
+def _check_rpc_deadlines(mod, ctx):
+    """RA16 (ISSUE 19 extension) — every control-plane RPC call site
+    in the placement package must carry an EXPLICIT deadline: a
+    ``node_call``/``reliable_node_call`` without a timeout=/deadline
+    keyword rides the callee's default budget, which is invisible at
+    the call site — the escalation loop that owns the call can no
+    longer reason about its own deadline arithmetic (a 60 s hidden
+    default inside a 10 s commit window is how a 'bounded' failover
+    overshoots its bound)."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else \
+            f.attr if isinstance(f, ast.Attribute) else None
+        if name not in ("node_call", "reliable_node_call"):
+            continue
+        if any(kw.arg and ("timeout" in kw.arg.lower()
+                           or "deadline" in kw.arg.lower())
+               for kw in node.keywords):
+            continue
+        out.append(Finding(
+            mod.path, node.lineno, "RA16",
+            f"{name}() call site without an explicit timeout=/deadline "
+            "keyword — placement-package RPC calls must state their "
+            "own deadline budget (a hidden callee default breaks the "
+            "caller's deadline arithmetic)"))
+    return out
+
+
 FILE_RULES = [
     FileRule("RA05", _check_field_registry, basenames={"metrics.py"}),
     FileRule("RA06", _check_event_registry_use, all_source=True),
@@ -875,6 +906,7 @@ FILE_RULES = [
     FileRule("RA07", _check_autotune_contract,
              basenames={"autotune.py"}),
     FileRule("RA16", _check_retry_bounds, dirnames={"placement"}),
+    FileRule("RA16", _check_rpc_deadlines, dirnames={"placement"}),
 ]
 
 

@@ -77,10 +77,17 @@ INGRESS_RATE_FIELDS = ("ingress_cmds_per_s", "wire_cmds_per_s")
 #: codec's encode phase share of total phase time — lower-better,
 #: 0 a meaningful healthy value (everything arrived pre-encoded), and
 #: -1 the "no phase samples" sentinel skipped like the others
+#: geo-soak keys (ISSUE 19) ride the same shape:
+#: ``geo_failover_recovery_s`` (SIGKILL → first commit on the new
+#: home, across real processes + the latency matrix) lower-is-better,
+#: and ``geo_false_migrations`` lower-is-better where 0 is THE healthy
+#: baseline — any migration during a delay-only episode must flag
 INGRESS_SHED_FIELDS = ("ingress_shed_rate", "wire_shed_rate",
                        "wire_reconnect_recovery_s",
                        "failover_recovery_s", "failover_lost_acked",
-                       "encode_share_pct")
+                       "encode_share_pct",
+                       "geo_failover_recovery_s",
+                       "geo_false_migrations")
 
 #: device-plane compile counts (ISSUE 16): absolute comparison, any
 #: growth is a regression — the workload is fixed across rounds, so an

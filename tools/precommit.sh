@@ -18,7 +18,13 @@
 #    mid-traffic, the classic control plane commits the re-placement,
 #    sessions re-home, and the exactly-once oracle closes over the
 #    union of both engines' state.
-# 4. `pytest tests/test_static_gates.py` runs the full gate suite
+# 4. `tools/soak.py --geo 0` runs ONE seed of the ISSUE 19
+#    geo-distributed survival soak: control quorum + two engine hosts
+#    as separate processes behind a latency-domain matrix, a
+#    delay-only episode that must migrate nothing, then a SIGKILL
+#    failover over the reliable RPC tier with the exactly-once oracle
+#    read back over RPC.
+# 5. `pytest tests/test_static_gates.py` runs the full gate suite
 #    (rule fixtures + clean pins + the analyzer runtime budget).
 #
 # Exit nonzero on any finding or test failure.  The full-tree lint
@@ -29,4 +35,5 @@ cd "$(dirname "$0")/.."
 python tools/lint.py --changed
 python tools/soak.py --device-obs 0 1
 python tools/soak.py --failover 0
+python tools/soak.py --geo 0
 exec python -m pytest tests/test_static_gates.py -q

@@ -10,6 +10,8 @@ Usage:  python tools/soak.py [seeds_per_family] [offset]
         python tools/soak.py --ingress SEED [n] [--mesh]
         python tools/soak.py --wire SEED [--durable] [--c1m]
         python tools/soak.py --device-obs SEED [n]
+        python tools/soak.py --failover SEED [SEED...]
+        python tools/soak.py --geo SEED [SEED...]
 
 ``--wire`` climbs the ISSUE 12 connection ladder (ra_tpu/wire/soak.py
 run_wire_soak): C10k (with a real-socket side-car) → C100k loopback
@@ -294,6 +296,32 @@ def _failover_main(argv: list) -> int:
     return 1 if lost else 0
 
 
+def _geo_main(argv: list) -> int:
+    """--geo SEED [SEED...]: the geo-distributed survival soak —
+    control cluster + two engine hosts as separate OS processes behind
+    a latency-domain matrix (control quorum 80-150 ms away), live TCP
+    wire traffic, a delay-only episode that must migrate NOTHING, then
+    SIGKILL of one engine host: detection over the reliable RPC tier,
+    adoption + re-home over host_* control verbs, exactly-once oracle
+    over both engines read back over RPC."""
+    from ra_tpu.placement.geo import geo_main
+
+    seeds = [int(a) for a in argv if not a.startswith("--")] or [0]
+    t0 = time.time()
+    try:
+        rows = geo_main(seeds)
+    except Exception:  # noqa: BLE001 — report + nonzero exit
+        traceback.print_exc()
+        print(f"geo: FAILED in {time.time() - t0:.1f}s", flush=True)
+        return 1
+    lost = sum(r["geo_lost_acked"] for r in rows)
+    false_mig = sum(r["geo_false_migrations"] for r in rows)
+    print(f"geo: {len(rows)}/{len(seeds)} seeds ok in "
+          f"{time.time() - t0:.1f}s  lost_acked={lost} "
+          f"false_migrations={false_mig}", flush=True)
+    return 1 if (lost or false_mig) else 0
+
+
 def _device_obs_main(argv: list) -> int:
     """--device-obs SEED [n]: the device-observatory chaos family."""
     import test_devicewatch as tdw
@@ -338,6 +366,8 @@ def main() -> int:
         return _device_obs_main(sys.argv[2:])
     if len(sys.argv) > 1 and sys.argv[1] == "--failover":
         return _failover_main(sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1] == "--geo":
+        return _geo_main(sys.argv[2:])
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 400
     off = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
     families = [
