@@ -952,6 +952,249 @@ def _wire_bench_main() -> None:
 
 
 # ---------------------------------------------------------------------------
+# reads mode: the mixed read/write frontier (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def _reads_bench_main() -> None:
+    """Mixed consistent-read / write workload through the ingress plane
+    (ISSUE 20): per wave, ``read_share`` of the rows are lease/read-index
+    reads riding the SAME fused dispatches as the writes, the rest are
+    durable puts.  Three measured sections on one warm engine:
+
+    1. per-call baseline — ``consistent_read`` one lane at a time, the
+       host-path consistent_query it replaces (``percall_reads_per_s``);
+    2. write-only reference — the write plane alone at the mixed run's
+       write arrival rate (``write_only_p99_ms``: the frontier the mixed
+       run must stay within 10% of);
+    3. the mixed run — stamps ``read_cmds_per_s`` / ``read_p99_ms``
+       (per-read submit→reply e2e, measured at the reply callback) /
+       ``reads_per_dispatch`` / ``read_plane_speedup_vs_percall`` plus
+       the write keys and BOTH SLO verdicts from the live SloEngine.
+
+    The tail carries the devicewatch stamp and a ``steady_state_*``
+    compile delta over the measured sections — reads interleaving with
+    writes must not retrace the fused step."""
+    import collections
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from ra_tpu import devicewatch
+    from ra_tpu.engine.durable import open_engine
+    from ra_tpu.ingress import IngressPlane
+    from ra_tpu.models import JitKvMachine
+    from ra_tpu.slo import SloEngine
+    from ra_tpu.telemetry import Observatory
+
+    lanes = int(os.environ.get("RA_TPU_BENCH_LANES", "1024"))
+    members = int(os.environ.get("RA_TPU_BENCH_MEMBERS", "3"))
+    seconds = float(os.environ.get("RA_TPU_BENCH_SECONDS", "3.0"))
+    read_share = min(0.99, max(0.01, float(
+        os.environ.get("RA_TPU_BENCH_READ_SHARE", "0.9"))))
+    kr = int(os.environ.get("RA_TPU_BENCH_READ_WINDOW", "16"))
+    cmds = int(os.environ.get("RA_TPU_BENCH_CMDS", "8"))
+    superstep_k = int(os.environ.get("RA_TPU_BENCH_SUPERSTEP", "4")
+                      if os.environ.get("RA_TPU_BENCH_SUPERSTEP", "4")
+                      .isdigit() else 4)
+    # rows offered per wave: ~2 rows/lane keeps a single read block
+    # (<= Kr rows/lane) carrying the whole wave's read half — the
+    # >=1000 reads/dispatch shape at 1024 lanes
+    wave_rows = int(os.environ.get("RA_TPU_BENCH_READS_WAVE",
+                                   str(2 * lanes)))
+    n_w = max(1, int(round(wave_rows * (1.0 - read_share))))
+    n_r = max(1, wave_rows - n_w)
+    n_keys = 64
+    rng = np.random.default_rng(
+        int(os.environ.get("RA_TPU_BENCH_SEED", "0")))
+
+    with tempfile.TemporaryDirectory(prefix="bench_reads_") as wal_dir:
+        eng = open_engine(JitKvMachine(n_keys=n_keys), wal_dir, lanes,
+                          members, wal_shards=2,
+                          ring_capacity=max(64, superstep_k * cmds * 4),
+                          max_step_cmds=cmds, max_step_reads=kr,
+                          lease_ttl=8, donate=False)
+        plane = IngressPlane(eng, superstep_k=superstep_k,
+                             window_s=0.001, soft_credit=1 << 20,
+                             hard_credit=1 << 20)
+        obs = Observatory.for_engine(eng)
+        # verdict stamping only — deliberately NOT wired into the
+        # plane's credit ladder: on an oversubscribed host the write
+        # p99 breaches its objective, the ladder bias would shed every
+        # read at admission, and the frontier this mode exists to
+        # measure would read 0.  The bias itself is test-pinned.
+        slo = SloEngine(obs)
+        sess = plane.directory.connect_bulk(4096, key="bench-reads")
+        n_sess = len(sess)
+
+        # write-plane wave latency: cumulative accepted-row targets
+        # joined against the block-commit callback's released rows
+        # (the frontier's observed-commit edge, through ingress)
+        write_waves: collections.deque = collections.deque()
+        write_lats: list = []
+        released_rows = 0
+
+        def _on_commit(handles) -> None:
+            nonlocal released_rows
+            released_rows += len(handles)
+            t = time.perf_counter()
+            while write_waves and write_waves[0][0] <= released_rows:
+                _tgt, ts = write_waves.popleft()
+                write_lats.append(t - ts)
+
+        plane.on_block_committed = _on_commit
+
+        # read e2e: submit wall clock per read wave (seqnos encode the
+        # wave), latency measured at the reply callback for SERVED rows
+        SEQ_STRIDE = 1 << 20
+        wave_t = np.zeros(1 << 16, np.float64)
+        read_lats: list = []
+
+        def _on_reads(handles, seqnos, statuses, wms, payloads) -> None:
+            now = time.perf_counter()
+            ok = np.asarray(statuses) == 0
+            if ok.any():
+                w = np.asarray(seqnos)[ok] // SEQ_STRIDE
+                read_lats.extend((now - wave_t[w]).tolist())
+
+        plane.on_reads_done = _on_reads
+
+        wave_idx = 0
+        last_snap = 0.0
+
+        def _wave(do_reads: bool) -> None:
+            nonlocal wave_idx, last_snap
+            wh = sess[rng.choice(n_sess, size=n_w, replace=False)]
+            pay = np.zeros((n_w, 4), np.int32)
+            pay[:, 0] = 1  # put
+            pay[:, 1] = rng.integers(0, n_keys, n_w)
+            pay[:, 2] = rng.integers(0, 1 << 20, n_w)
+            plane.submit_auto(wh, pay)
+            write_waves.append((plane.counters["accepted"],
+                                time.perf_counter()))
+            if do_reads:
+                rh = sess[rng.choice(n_sess, size=n_r, replace=False)]
+                q = np.zeros((n_r, 2), np.int32)
+                q[:, 0] = 1  # get
+                q[:, 1] = rng.integers(0, n_keys, n_r)
+                seq = wave_idx * SEQ_STRIDE + np.arange(n_r)
+                wave_t[wave_idx] = time.perf_counter()
+                plane.submit_reads(rh, seq, q)
+            wave_idx += 1
+            plane.pump(force=True)
+            now = time.perf_counter()
+            if now - last_snap > 0.1:
+                last_snap = now
+                obs.snapshot()
+
+        # -- warmup: compile the mixed-dispatch shapes ------------------
+        for _ in range(3):
+            _wave(do_reads=True)
+        plane.settle(timeout=120.0)
+
+        # -- per-call host-path baseline (the path reads replace) -------
+        eng.consistent_read([0])  # warm the single-step path
+        n_calls = 5
+        t0 = time.perf_counter()
+        for i in range(n_calls):
+            eng.consistent_read([i % lanes])
+        percall_s = (time.perf_counter() - t0) / n_calls
+
+        # measured sections start here: fresh percentile reservoirs
+        # (warmup/compile samples out of the p99 tails) and the
+        # steady-state compile baseline — reads interleaved with writes
+        # must not retrace past this line
+        eng.phases.reset_reservoirs()
+        write_lats.clear()
+        read_lats.clear()
+        dw0 = dict(devicewatch.WATCH.counters)
+
+        # -- write-only reference at the mixed run's write rate ---------
+        t_w0 = time.perf_counter()
+        while time.perf_counter() - t_w0 < seconds * 0.5:
+            _wave(do_reads=False)
+        plane.settle(timeout=120.0)
+        wl = sorted(write_lats)
+        write_only_p99_ms = round(
+            1000 * wl[min(len(wl) - 1, int(len(wl) * 0.99))], 3) \
+            if wl else -1.0
+
+        # -- the mixed run ---------------------------------------------
+        eng.phases.reset_reservoirs()
+        write_lats.clear()
+        rc0 = dict(plane.read_counters)
+        wrote0 = plane.counters["accepted"]
+        t_mix = time.perf_counter()
+        while time.perf_counter() - t_mix < seconds:
+            _wave(do_reads=True)
+        plane.settle(timeout=120.0)
+        elapsed = time.perf_counter() - t_mix
+        obs.snapshot()
+        verdicts = {name: o["verdict"] for name, o in
+                    slo.evaluate()["objectives"].items()}
+
+        rc = plane.read_counters
+        served = rc["served"] - rc0["served"]
+        submitted = max(1, rc["submitted"] - rc0["submitted"])
+        blocks = max(1, rc["blocks_built"] - rc0["blocks_built"])
+        block_rows = rc["block_rows"] - rc0["block_rows"]
+        wrote = plane.counters["accepted"] - wrote0
+        read_cmds_per_s = served / max(elapsed, 1e-9)
+        percall_reads_per_s = 1.0 / max(percall_s, 1e-9)
+        rl = sorted(read_lats)
+        wl = sorted(write_lats)
+        read_p99_ms = round(
+            1000 * rl[min(len(rl) - 1, int(len(rl) * 0.99))], 3) \
+            if rl else -1.0
+        write_p99_ms = round(
+            1000 * wl[min(len(wl) - 1, int(len(wl) * 0.99))], 3) \
+            if wl else -1.0
+        dw = devicewatch.WATCH.counters
+        ov = plane.read_overview()
+        print(json.dumps({
+            "metric": "read_cmds_per_sec_mixed",
+            "value": round(read_cmds_per_s, 1),
+            "unit": "reads/s",
+            "read_cmds_per_s": round(read_cmds_per_s, 1),
+            "read_p99_ms": read_p99_ms,
+            "read_e2e_phase_p99_ms":
+                eng.phases.overview()["read_e2e"]["p99_ms"],
+            "read_share": read_share,
+            "reads_per_dispatch": round(block_rows / blocks, 1),
+            "read_served": int(served),
+            "read_shed_rate": round(
+                (rc["shed"] - rc0["shed"]) / submitted, 6),
+            "read_stale_refused": int(
+                rc["stale_refused"] - rc0["stale_refused"]),
+            "lease_coverage_pct": ov.get("lease_coverage_pct", -1.0),
+            "write_cmds_per_s": round(wrote / max(elapsed, 1e-9), 1),
+            "write_p99_ms": write_p99_ms,
+            "write_only_p99_ms": write_only_p99_ms,
+            "write_p99_vs_write_only": round(
+                write_p99_ms / write_only_p99_ms, 3)
+                if write_only_p99_ms > 0 and write_p99_ms > 0 else -1.0,
+            "percall_read_ms": round(1000 * percall_s, 3),
+            "percall_reads_per_s": round(percall_reads_per_s, 1),
+            "read_plane_speedup_vs_percall": round(
+                read_cmds_per_s / percall_reads_per_s, 1),
+            "slo": verdicts,
+            "slo_read_verdict": verdicts.get("read_p99_ms", "no_data"),
+            "slo_write_verdict": verdicts.get("commit_p99_ms", "no_data"),
+            "lanes": lanes, "members": members,
+            "cmds_per_step": cmds, "read_window": kr,
+            "superstep_k": superstep_k, "durable": True,
+            "wave_rows": wave_rows,
+            "steady_state_compiles": dw["compiles"] - dw0["compiles"],
+            "steady_state_recompiles":
+                dw["recompiles"] - dw0["recompiles"],
+            "platform": jax.devices()[0].platform,
+            "host": _host_meta(),
+            **devicewatch.bench_tail_keys(int(wrote + served)),
+        }))
+
+
+# ---------------------------------------------------------------------------
 # frontier mode: the latency/throughput frontier (one child, four points)
 # ---------------------------------------------------------------------------
 
@@ -1241,6 +1484,14 @@ def _parse_flags(argv) -> None:
         os.environ["RA_TPU_BENCH_MODE"] = "multichip"
     if "--wire" in argv:
         os.environ["RA_TPU_BENCH_MODE"] = "wire"
+    if "--reads" in argv:
+        # the mixed read/write frontier (ISSUE 20); --read-share tunes
+        # the read fraction of every wave (default 0.9 — the 90/10 mix)
+        os.environ["RA_TPU_BENCH_MODE"] = "reads"
+    if "--read-share" in argv:
+        i = argv.index("--read-share")
+        if i + 1 < len(argv):
+            os.environ["RA_TPU_BENCH_READ_SHARE"] = argv[i + 1]
 
 
 MULTICHIP_TIMEOUT_S = 1200
@@ -1256,6 +1507,8 @@ def main() -> None:
             _multichip_main()
         elif mode == "wire":
             _wire_bench_main()
+        elif mode == "reads":
+            _reads_bench_main()
         else:
             _child_main()
         return
@@ -1273,6 +1526,26 @@ def main() -> None:
         else:
             print(json.dumps({
                 "value": 0.0, "error": "wire_children_failed",
+                "detail": {"child_errors": _CHILD_ERRORS[-2:]}}))
+        return
+
+    if os.environ.get("RA_TPU_BENCH_MODE") == "reads":
+        # the read-plane frontier (ISSUE 20): host ingress + durable
+        # engine — CPU-safe everywhere, one child (retry once)
+        env = {"RA_TPU_BENCH_MODE": "reads"}
+        for k in ("RA_TPU_BENCH_READ_SHARE", "RA_TPU_BENCH_LANES",
+                  "RA_TPU_BENCH_SECONDS"):
+            if os.environ.get(k):
+                env[k] = os.environ[k]
+        if _probe_platform() in (None, "cpu"):
+            env.update({"PYTHONPATH": "", "JAX_PLATFORMS": "cpu"})
+        res = _run_child(env, CHILD_TIMEOUT_S) or \
+            _run_child(env, CHILD_TIMEOUT_S)
+        if res is not None:
+            print(json.dumps(res))
+        else:
+            print(json.dumps({
+                "value": 0.0, "error": "reads_children_failed",
                 "detail": {"child_errors": _CHILD_ERRORS[-2:]}}))
         return
 

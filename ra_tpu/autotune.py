@@ -292,8 +292,11 @@ class AutoTuner:
 
         k = self.knobs["superstep_k"]
         interval = self.knobs["wal_max_batch_interval_ms"]
+        # read_p99_ms is handled by its own trade rule below: a read
+        # breach must never read as a WRITE latency signal (it would
+        # back off the WAL or deepen fusion — both wrong for reads)
         lat_hot = [n for n, o in objs.items()
-                   if o["op"] == "<=" and hot(n)]
+                   if o["op"] == "<=" and hot(n) and n != "read_p99_ms"]
         # fsync-bound: the fsync objective itself burns, or a latency
         # breach whose window budget the fsync phases own
         phase, share = self._dominant_phase()
@@ -315,6 +318,21 @@ class AutoTuner:
                 # interval at floor: shrink the per-dispatch WAL burst
                 return self._set("superstep_k", max(1, k // 2),
                                  phase=tphase, objective=trigger)
+            return None
+        # read/write trade (ISSUE 20): a read-latency breach with the
+        # write plane green means each fused dispatch is too LONG for
+        # the read confirm schedule — a pending read batch waits O(K)
+        # inner rounds for its commit-watermark confirmation before the
+        # next window boundary observes it.  Halve the fusion depth so
+        # reads settle sooner; if the throughput floor then burns, the
+        # headroom rule below wins the fusion back — the two rules
+        # walking K against each other IS the read/write trade, and
+        # hysteresis + cooldown keep the walk damped.
+        if hot("read_p99_ms") and not lat_hot:
+            if k > self.bounds["superstep_k"][0]:
+                return self._set("superstep_k", max(1, k // 2),
+                                 phase="read_e2e",
+                                 objective="read_p99_ms")
             return None
         if lat_hot and phase in DISPATCH_BOUND_PHASES:
             # dispatch-bound latency: fuse more rounds per dispatch

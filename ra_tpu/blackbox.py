@@ -131,6 +131,14 @@ EVENT_REGISTRY = {
                      "(SLO-verdict-driven; open/tight/fair)",
     "ingress.shed": "coalescer ring overflow began shedding rows "
                     "(transition into a shed episode, not per row)",
+    # -- read lane (ra_tpu/ingress/, ISSUE 20) -------------------------
+    "read.shed": "ladder bias began shedding read waves at admission "
+                 "(any tightened level refuses reads BEFORE writes "
+                 "are delayed; transition, not per row)",
+    "read.stale": "the device refused pending reads rather than serve "
+                  "past lease/quorum cover (stale-refusal episode "
+                  "transition — the linearizable-read oracle pins "
+                  "stale SERVES, refusals are the safe outcome)",
     # -- wire plane (ra_tpu/wire/, ISSUE 12) ---------------------------
     "wire.conn": "connection lifecycle: accept/close/bulk-connect/"
                  "reconnect-storm (loopback fleets emit ONE event, "

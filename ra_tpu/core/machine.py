@@ -24,7 +24,7 @@ Two flavours exist:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 from .types import Effects
 
@@ -176,6 +176,18 @@ class JitMachine(Machine):
     #: shape/dtype spec of one reply
     reply_spec: tuple = ("int32", ())
 
+    #: OPTIONAL vectorized read path (ISSUE 20): shape/dtype spec of one
+    #: encoded query, or None when the machine has no jittable query
+    #: kernel (the engine's lease/read-index plane then refuses reads
+    #: for it).  Unlike commands, queries NEVER mutate state and never
+    #: enter the log — the lane engine evaluates them against the
+    #: leader replica once lease/read-index authority certifies the
+    #: watermark (the consistent_query contract, ra_server.erl:3032+,
+    #: with zero log appends).
+    query_spec: Optional[tuple] = None
+    #: shape/dtype spec of one query reply
+    query_reply_spec: tuple = ("int32", ())
+
     #: set True when jit_apply_batch folds a whole committed window in
     #: one shot FASTER than the engine's representative lax.scan.  The
     #: fold must be IN ORDER-equivalent to applying the masked commands
@@ -191,6 +203,28 @@ class JitMachine(Machine):
     def jit_apply(self, meta, command, state):
         """Pure JAX apply: (meta arrays, encoded cmd, state) -> (state, reply)."""
         raise NotImplementedError
+
+    def jit_query(self, queries, state):
+        """Pure vectorized read kernel (ISSUE 20): evaluate a window of
+        encoded queries against ONE replica's machine state.
+
+        ``queries``: [..., Kr, Cq] with Cq from :attr:`query_spec` and
+        arbitrary leading (lane) dims; ``state``: the machine pytree
+        with the SAME leading dims (the engine hands it the leader
+        replica, member axis already gathered away).  Returns replies
+        [..., Kr, Wq] per :attr:`query_reply_spec`.  Must be pure and
+        traceable (called inside the jitted step) and must NOT mutate
+        state — reads never enter the log.  Only called when
+        :attr:`query_spec` is not None."""
+        raise NotImplementedError
+
+    def encode_query(self, query: Any):
+        """Host query -> encoded int row (the read twin of
+        :meth:`encode_command`)."""
+        raise NotImplementedError
+
+    def decode_query_reply(self, reply_array) -> Any:
+        return reply_array
 
     def jit_apply_batch(self, meta, commands, mask, state):
         """Fold a window of commands at once, order-equivalently to a

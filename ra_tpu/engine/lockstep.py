@@ -170,6 +170,18 @@ class LaneState(NamedTuple):
     peer_query: Array     # int32[N,P] per-member confirmed query index
                           #            (#heartbeat_reply, :3101-3170)
     query_agreed: Array   # int32[N]   majority-confirmed query index
+    # -- vectorized read plane (ISSUE 20): leases + read-index state ------
+    read_clock: Array     # int32[N]   monotone step clock (lease base)
+    lease_until: Array    # int32[N]   leader lease expiry, read_clock units
+    read_buf: Array       # [N,Kr,Cq]  pending read-query batch (device)
+    read_n: Array         # int32[N]   pending read count (0 = slot free)
+    read_ix: Array        # int32[N]   captured read index (commit at reg.)
+    read_tok: Array       # int32[N]   captured heartbeat token (reg. round)
+    read_reg: Array       # int32[N]   registration clock (timeout base)
+    read_served: Array    # int32[N]   cumulative reads served
+    read_shed: Array      # int32[N]   cumulative reads shed at arrival
+    read_stale: Array     # int32[N]   cumulative stale-refusals (timeouts)
+    read_leased: Array    # int32[N]   served-under-lease subset
     telem: Any            # LaneTelemetry pytree, int32[N] per field
     mac: Any              # machine state pytree, leading dims [N,P]
 
@@ -214,6 +226,22 @@ CHECKPOINT_FIELD_DEFAULTS = {
     "query_index": "require",
     "peer_query": "require",
     "query_agreed": "require",
+    # read plane (ISSUE 20): ALL "zeros" — a lease must never survive a
+    # restart (the restarting process has no idea how long it was down,
+    # so an archived lease could outlive the wall-clock grant), and a
+    # pending read batch's client is gone; cumulative read counters are
+    # health state like telem
+    "read_clock": "zeros",
+    "lease_until": "zeros",
+    "read_buf": "zeros",
+    "read_n": "zeros",
+    "read_ix": "zeros",
+    "read_tok": "zeros",
+    "read_reg": "zeros",
+    "read_served": "zeros",
+    "read_shed": "zeros",
+    "read_stale": "zeros",
+    "read_leased": "zeros",
     "telem": "zeros",       # health counters: restart from zero
     "mac": "require",
 }
@@ -221,7 +249,9 @@ CHECKPOINT_FIELD_DEFAULTS = {
 
 def _init_state(n_lanes: int, n_members: int, ring_capacity: int,
                 payload_width: int, mac_state: Any,
-                payload_dtype=jnp.int32) -> LaneState:
+                payload_dtype=jnp.int32, read_window: int = 1,
+                query_width: int = 1,
+                query_dtype=jnp.int32) -> LaneState:
     N, P, R, C = n_lanes, n_members, ring_capacity, payload_width
     z = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
     return LaneState(
@@ -242,6 +272,17 @@ def _init_state(n_lanes: int, n_members: int, ring_capacity: int,
         query_index=z(N),
         peer_query=z(N, P),
         query_agreed=z(N),
+        read_clock=z(N),
+        lease_until=z(N),
+        read_buf=jnp.zeros((N, read_window, query_width), query_dtype),
+        read_n=z(N),
+        read_ix=z(N),
+        read_tok=z(N),
+        read_reg=z(N),
+        read_served=z(N),
+        read_shed=z(N),
+        read_stale=z(N),
+        read_leased=z(N),
         telem=_init_telemetry(N),
         mac=mac_state,
     )
@@ -249,10 +290,11 @@ def _init_state(n_lanes: int, n_members: int, ring_capacity: int,
 
 def _step(state: LaneState, n_new: Array, payloads: Array,
           fail_mask: Array, elect_mask: Array, confirm_upto: Array,
-          query_mask: Array, *,
+          query_mask: Array, n_read: Array, read_q: Array, *,
           machine: JitMachine, ring_capacity: int, apply_window: int,
           pipeline_window: int, max_append_batch: int, write_delay: int,
           durable: bool = False, ring_io: str = "gather",
+          lease_ttl: int = 8, read_timeout: int = 64,
           quorum_fn=evaluate_quorum):
     """One lockstep round for every lane.  Pure; jitted by the engine.
 
@@ -428,6 +470,49 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
              - leader_commit0)
     total_committed = state.total_committed + delta
 
+    # -- 4a. lease grant/expiry + read-batch registration (ISSUE 20) ------
+    # The leader lease is PURE per-lane arithmetic on the heartbeat
+    # round the lockstep step already is: a leader whose lane holds a
+    # counted quorum of active voters this round (the same grant
+    # arithmetic the vote round uses) extends its lease to
+    # read_clock + lease_ttl; a leader cut from its majority stops
+    # extending and the lease expires lease_ttl rounds later; a won
+    # election revokes it outright (the new leader earns its own).
+    # Note the SoA model admits no split-brain within a lane —
+    # leader_slot is lane-global, so a deposed leader cannot serve
+    # anything; the lease here bounds serving under LOST quorum (the
+    # partitioned-leader window before the host triggers an election),
+    # which is exactly what the read oracle pins.
+    read_clock = state.read_clock + 1
+    lease_q = election_quorum(active & state.voter, state.voter)
+    lease_until = jnp.where(elect_ok, 0, state.lease_until)
+    lease_until = jnp.where(
+        lease_q & leader_up,
+        jnp.maximum(lease_until, read_clock + lease_ttl), lease_until)
+    lease_ok = read_clock < lease_until
+
+    # read registration: reads NEVER touch the ring (zero log appends).
+    # A lane accepts an arriving batch only when its pending slot is
+    # free (one in-flight batch per lane — the device-side backpressure
+    # the ingress read lane leans on), its leader is up, and the
+    # machine has a query kernel; everything else is shed at arrival
+    # (counted, refused — never served stale).  The captured read index
+    # is the leader commit AT registration: the linearization point
+    # every write committed before the batch must be visible at
+    # (consistent_query's registration, ra_server.erl:3035-3071).
+    supports_read = machine.query_spec is not None
+    Kr = state.read_buf.shape[1]
+    if supports_read:
+        acc_lane = (n_read > 0) & leader_up & (state.read_n == 0)
+    else:
+        acc_lane = jnp.zeros((N,), jnp.bool_)
+    r_acc = jnp.where(acc_lane, jnp.minimum(n_read, Kr), 0)
+    r_shed_now = n_read - r_acc
+    read_buf = jnp.where(acc_lane[:, None, None], read_q, state.read_buf)
+    read_ix = jnp.where(acc_lane, leader_commit0, state.read_ix)
+    read_reg = jnp.where(acc_lane, read_clock, state.read_reg)
+    read_n1 = jnp.where(acc_lane, r_acc, state.read_n)
+
     # -- 4b. consistent-query heartbeat quorum -----------------------------
     # The host registers reads by bumping the lane's query counter
     # (query_mask); every active member confirms the current counter in
@@ -438,8 +523,13 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
     # the confirmations of members that are NOT reachable this round
     # (active members re-ack immediately below): stale acks collected by
     # a deposed leader can never certify a read under the new one (the
-    # new-leader pending_consistent_queries gate, :3174-3190).
-    query_index = state.query_index + jnp.where(query_mask, 1, 0)
+    # new-leader pending_consistent_queries gate, :3174-3190).  A lane
+    # accepting a read batch rides the same machinery: its registration
+    # bumps the counter, and the batch's token is confirmed by the same
+    # quorum fold (the read-index path when the lease is cold).
+    query_index = state.query_index + \
+        jnp.where(query_mask | acc_lane, 1, 0)
+    read_tok = jnp.where(acc_lane, query_index, state.read_tok)
     peer_q0 = jnp.where(elect_ok[:, None], 0, state.peer_query)
     peer_query = jnp.where(active, query_index[:, None], peer_q0)
     query_agreed = query_quorum(peer_query, state.voter)
@@ -586,6 +676,48 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
                               tel.stall_steps + 1),
         steps=tel.steps + 1)
 
+    # -- 5c. read serve/refuse (the read-index confirm schedule) ----------
+    # A pending batch serves the moment its lane can certify BOTH
+    # authority and freshness, all as masked vector ops: authority is
+    # the live lease OR the heartbeat quorum having confirmed the
+    # batch's token (the read-index path — note it needs no fsync:
+    # unlike the commit quorum, read certification gates on the apply
+    # frontier, not last_written, so reads are never held back by the
+    # fsync hold-back the write plane pays); freshness is the leader's
+    # apply frontier having reached the captured read index.  Queries
+    # evaluate against the leader replica via the machine's vectorized
+    # query kernel — zero log appends, zero host syncs; the answers
+    # ride the step aux and drain off the existing async readbacks.
+    # A batch that cannot certify within read_timeout rounds is REFUSED
+    # (stale-refusal counter) — a partitioned leader's lease reads can
+    # never outlive the lease: once lease_until passes and the quorum
+    # is gone, can_serve stays False until the batch expires.
+    lead_applied = jnp.take_along_axis(applied, leader_slot[:, None],
+                                       axis=-1)[:, 0]
+    authority = lease_ok | (query_agreed >= read_tok)
+    can_serve = (read_n1 > 0) & leader_up & authority & \
+        (lead_applied >= read_ix)
+    expired = (read_n1 > 0) & ~can_serve & \
+        (read_clock - read_reg >= read_timeout)
+    if supports_read:
+        def _pick_lead(x):
+            sidx = leader_slot[:, None].reshape(
+                (N, 1) + (1,) * (x.ndim - 2))
+            sidx = jnp.broadcast_to(sidx, (N, 1) + x.shape[2:])
+            return jnp.take_along_axis(x, sidx, axis=1)[:, 0]
+        replies = machine.jit_query(read_buf,
+                                    jax.tree.map(_pick_lead, mac))
+        replies = jnp.where(can_serve[:, None, None], replies, 0)
+    else:
+        replies = jnp.zeros((N, Kr, 1), jnp.int32)
+    read_done = jnp.where(can_serve, read_n1, 0)
+    stale_now = jnp.where(expired, read_n1, 0)
+    read_served = state.read_served + read_done
+    read_shed_tot = state.read_shed + r_shed_now
+    read_stale_tot = state.read_stale + stale_now
+    read_leased = state.read_leased + \
+        jnp.where(can_serve & lease_ok, read_n1, 0)
+
     new_state = LaneState(term=term, leader_slot=leader_slot,
                           term_start=term_start, last_index=last_index,
                           last_written=last_written, match=match,
@@ -594,9 +726,28 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
                           ring=ring, ring_base=ring_base,
                           total_committed=total_committed,
                           query_index=query_index, peer_query=peer_query,
-                          query_agreed=query_agreed, telem=telem, mac=mac)
+                          query_agreed=query_agreed,
+                          read_clock=read_clock, lease_until=lease_until,
+                          read_buf=read_buf,
+                          read_n=jnp.where(can_serve | expired, 0,
+                                           read_n1),
+                          read_ix=read_ix, read_tok=read_tok,
+                          read_reg=read_reg, read_served=read_served,
+                          read_shed=read_shed_tot,
+                          read_stale=read_stale_tot,
+                          read_leased=read_leased, telem=telem, mac=mac)
     aux = {"appended_hi": new_leader_last, "n_acc": n_acc,
-           "n_app": total_app}
+           "n_app": total_app,
+           # read-plane aux: per-step serve/refuse outcomes plus the
+           # cumulative per-lane watermarks the driver's async
+           # readbacks drain (the read twin of committed_lanes)
+           "read_done": read_done, "read_shed": r_shed_now,
+           "read_stale": stale_now,
+           "read_watermark": jnp.where(can_serve, lead_applied, -1),
+           "read_replies": replies,
+           "read_served_lanes": read_served,
+           "read_shed_lanes": read_shed_tot,
+           "read_stale_lanes": read_stale_tot}
     if durable:
         # -- 6. on-device payload compaction for the WAL readback ---------
         # The WAL record stores only the ACCEPTED host rows (lane-major,
@@ -629,7 +780,8 @@ def _step(state: LaneState, n_new: Array, payloads: Array,
 
 def _superstep(state: LaneState, n_new_blk: Array, payloads_blk: Array,
                fail_mask: Array, elect_blk: Array, confirm_upto: Array,
-               query_blk: Array, **step_kwargs):
+               query_blk: Array, n_read_blk: Array, read_q_blk: Array,
+               **step_kwargs):
     """K lockstep rounds fused into ONE XLA dispatch via ``lax.scan``
     (the tentpole of ISSUE 5).  The scan consumes a device-staged
     ``[K, ...]`` schedule — per-inner-step command counts, payload
@@ -652,9 +804,10 @@ def _superstep(state: LaneState, n_new_blk: Array, payloads_blk: Array,
     big = jnp.int32(2 ** 30)
 
     def body(st, xs):
-        n_new, payloads, elect, query = xs
+        n_new, payloads, elect, query, n_read, read_q = xs
         new_st, aux = _step(st, n_new, payloads, fail_mask, elect,
-                            confirm_upto, query, **step_kwargs)
+                            confirm_upto, query, n_read, read_q,
+                            **step_kwargs)
         aux["committed_lanes"] = new_st.total_committed
         applied = jnp.min(jnp.where(new_st.active, new_st.applied, big),
                           axis=-1)
@@ -663,10 +816,12 @@ def _superstep(state: LaneState, n_new_blk: Array, payloads_blk: Array,
         return new_st, aux
 
     return jax.lax.scan(body, state,
-                        (n_new_blk, payloads_blk, elect_blk, query_blk))
+                        (n_new_blk, payloads_blk, elect_blk, query_blk,
+                         n_read_blk, read_q_blk))
 
 
-def _telemetry_summary(telem: LaneTelemetry, total_committed: Array, *,
+def _telemetry_summary(telem: LaneTelemetry, total_committed: Array,
+                       reads: tuple, *,
                        top_k: int, hist_buckets: int,
                        stall_threshold: int) -> dict:
     """Aggregate the per-lane telemetry pytree ON DEVICE into a
@@ -712,6 +867,13 @@ def _telemetry_summary(telem: LaneTelemetry, total_committed: Array, *,
         # float32: the node-wide sum can exceed int32; the Observatory
         # ring differentiates this into per-window commit rates
         "committed_total": jnp.sum(total_committed.astype(f32)),
+        # read-plane rollups (ISSUE 20): cumulative like committed_total
+        # — the ring differentiates them into reads/s and refusal rates,
+        # and leased/served is the lease-coverage ratio ra_top renders
+        "read_served_total": jnp.sum(reads[0].astype(f32)),
+        "read_shed_total": jnp.sum(reads[1].astype(f32)),
+        "read_stale_total": jnp.sum(reads[2].astype(f32)),
+        "read_leased_total": jnp.sum(reads[3].astype(f32)),
     }
 
 
@@ -749,7 +911,9 @@ class LockstepEngine:
                  pipeline_window: int = 4096, max_append_batch: int = 128,
                  write_delay: int = 0, ring_io: str = "auto",
                  donate: bool = False, quorum_impl: str = "xla",
-                 superstep_donate: Optional[bool] = None) -> None:
+                 superstep_donate: Optional[bool] = None,
+                 max_step_reads: int = 16, lease_ttl: int = 8,
+                 read_timeout: int = 0) -> None:
         # donate=False by default ON THE SINGLE-STEP PATH: buffer
         # donation costs ~35ms/step on tunneled PJRT backends (a
         # per-step sync), vs ~0.05ms/step without — XLA's allocator
@@ -778,6 +942,26 @@ class LockstepEngine:
         dtype, shape = machine.command_spec
         self.payload_width = int(np.prod(shape)) if shape else 1
         self.payload_dtype = jnp.dtype(dtype)
+        # read-plane geometry (ISSUE 20): Kr pending-read slots per lane
+        # ride LaneState; a machine without a query kernel still carries
+        # the (minimal [N,1,1]) read fields so the step signature and
+        # checkpoint schema stay uniform, but every read is refused
+        self.reads_enabled = machine.query_spec is not None
+        self.read_window = max(1, int(max_step_reads)) \
+            if self.reads_enabled else 1
+        if self.reads_enabled:
+            qdtype, qshape = machine.query_spec
+            self.query_width = int(np.prod(qshape)) if qshape else 1
+            self.query_dtype = jnp.dtype(qdtype)
+            _rd, rshape = machine.query_reply_spec
+            self.query_reply_width = int(np.prod(rshape)) if rshape else 1
+        else:
+            self.query_width = 1
+            self.query_dtype = jnp.int32
+            self.query_reply_width = 1
+        self.lease_ttl = int(lease_ttl)
+        self.read_timeout = int(read_timeout) if read_timeout \
+            else 8 * self.lease_ttl
         mac = machine.jit_init(n_lanes)
         # broadcast machine state over member slots: [N,...] -> [N,P,...]
         mac = jax.tree.map(
@@ -787,7 +971,8 @@ class LockstepEngine:
             mac)
         self.state = _init_state(n_lanes, n_members, ring_capacity,
                                  self.payload_width, mac,
-                                 self.payload_dtype)
+                                 self.payload_dtype, self.read_window,
+                                 self.query_width, self.query_dtype)
         from ..ops.pallas_quorum import make_evaluate_quorum
         if ring_io == "auto":
             # MXU one-hot IO on TPU backends; along-axis gather (fast and
@@ -801,6 +986,8 @@ class LockstepEngine:
                                  pipeline_window=pipeline_window,
                                  max_append_batch=max_append_batch,
                                  write_delay=write_delay, ring_io=ring_io,
+                                 lease_ttl=self.lease_ttl,
+                                 read_timeout=self.read_timeout,
                                  quorum_fn=make_evaluate_quorum(quorum_impl))
         self._quorum_impl = quorum_impl
         self._donate = donate
@@ -826,6 +1013,10 @@ class LockstepEngine:
         self._zero_fail = jnp.zeros((n_lanes, n_members), bool)
         self._zero_elect = jnp.zeros((n_lanes,), bool)
         self._zero_confirm = jnp.zeros((n_lanes,), jnp.int32)
+        self._zero_nread = jnp.zeros((n_lanes,), jnp.int32)
+        self._zero_readq = jnp.zeros(
+            (n_lanes, self.read_window, self.query_width),
+            self.query_dtype)
         self._fail_host = np.zeros((n_lanes, n_members), bool)
 
     def _build_jit(self, fn, durable: bool, donate: bool, tag: str):
@@ -896,13 +1087,16 @@ class LockstepEngine:
         return jnp.asarray(arr), bool(arr.any())
 
     def step(self, n_new, payloads, elect_mask=None,
-             query_mask=None) -> None:
+             query_mask=None, n_read=None, read_q=None):
         """Advance every lane one round.  n_new: int32[N]; payloads:
         [N, K, C] with K <= max_step_cmds.  In durable mode the step's
         accepted entries are compacted on device, read back off-thread
         by the WAL shards, and commits gate on the fsync confirm — host
         or device payloads both work (no host-side copy is taken).
-        Masks are host data (see _host_mask)."""
+        Masks are host data (see _host_mask).  ``n_read``/``read_q``
+        (int32[N], [N, Kr, Cq]) register consistent-read batches on the
+        lease/read-index plane (ISSUE 20).  Returns the step aux (device
+        arrays) so read callers can drain serve outcomes."""
         fail = (jnp.asarray(self._fail_host)
                 if self._fail_host.any() else self._zero_fail)
         elect_any = False
@@ -912,23 +1106,27 @@ class LockstepEngine:
             elect, elect_any = self._host_mask(elect_mask)
         query = self._zero_elect if query_mask is None \
             else jnp.asarray(query_mask)
+        nr = self._zero_nread if n_read is None else jnp.asarray(n_read)
+        rq = self._zero_readq if read_q is None else jnp.asarray(read_q)
         self.pipeline_counters["dispatches"] += 1
         self.pipeline_counters["inner_steps"] += 1
         if self._dur is None:
             with trace.span("engine.step", "engine"):
-                self.state, _ = self._step(self.state, jnp.asarray(n_new),
-                                           jnp.asarray(payloads), fail,
-                                           elect, self._zero_confirm, query)
+                self.state, aux = self._step(self.state,
+                                             jnp.asarray(n_new),
+                                             jnp.asarray(payloads), fail,
+                                             elect, self._zero_confirm,
+                                             query, nr, rq)
             if self._telemetry is not None:
                 self._telemetry.tick(1)
-            return
+            return aux
         with trace.span("engine.backpressure", "engine"):
             self._dur.backpressure()
         confirm = jnp.asarray(self._dur.confirm_upto)
         with trace.span("engine.step", "engine", durable=True):
             self.state, aux = self._step(self.state, jnp.asarray(n_new),
                                          jnp.asarray(payloads), fail, elect,
-                                         confirm, query)
+                                         confirm, query, nr, rq)
         with trace.span("engine.wal_submit", "engine"):
             # no host payload copy here: the WAL shards read back the
             # device-compacted flat rows off-thread (see durable.py)
@@ -942,9 +1140,11 @@ class LockstepEngine:
             # after dispatch, never blocking: the sampler only starts
             # async device work/readbacks on this path (rule RA04)
             self._telemetry.tick(1)
+        return aux
 
     def superstep(self, n_new_blk, payloads_blk, elect_blk=None,
-                  query_blk=None) -> dict:
+                  query_blk=None, n_read_blk=None,
+                  read_q_blk=None) -> dict:
         """Advance every lane K rounds in ONE XLA dispatch (the fused
         `lax.scan` path, ISSUE 5).  Inputs carry a leading inner-step
         axis: ``n_new_blk`` int32[K, N]; ``payloads_blk`` [K, N, Kc, C];
@@ -971,6 +1171,11 @@ class LockstepEngine:
             elect, elect_any = self._host_mask(elect_blk)
         query = jnp.broadcast_to(self._zero_elect, (k, self.n_lanes)) \
             if query_blk is None else jnp.asarray(query_blk)
+        nr = jnp.broadcast_to(self._zero_nread, (k, self.n_lanes)) \
+            if n_read_blk is None else jnp.asarray(n_read_blk)
+        rq = jnp.broadcast_to(self._zero_readq,
+                              (k,) + self._zero_readq.shape) \
+            if read_q_blk is None else jnp.asarray(read_q_blk)
         self.pipeline_counters["dispatches"] += 1
         self.pipeline_counters["superstep_dispatches"] += 1
         self.pipeline_counters["inner_steps"] += k
@@ -980,7 +1185,7 @@ class LockstepEngine:
                 self.state, aux = self._sstep(
                     self.state, jnp.asarray(n_new_blk),
                     jnp.asarray(payloads_blk), fail, elect,
-                    self._zero_confirm, query)
+                    self._zero_confirm, query, nr, rq)
             if self._telemetry is not None:
                 self._telemetry.tick(k)
             return aux
@@ -993,7 +1198,8 @@ class LockstepEngine:
         with trace.span("engine.superstep", "engine", durable=True, k=k):
             self.state, aux = self._sstep(
                 self.state, jnp.asarray(n_new_blk),
-                jnp.asarray(payloads_blk), fail, elect, confirm, query)
+                jnp.asarray(payloads_blk), fail, elect, confirm, query,
+                nr, rq)
         with trace.span("engine.wal_submit", "engine", k=k):
             self._dur.submit_block(aux, k)
         if elect_any:
@@ -1032,6 +1238,21 @@ class LockstepEngine:
         payloads = jnp.full((k, N, K, C), payload_value,
                             self.payload_dtype)
         return self.superstep(n_new, payloads)
+
+    def uniform_read_block(self, k: int, reads_per_lane: int,
+                           query_value=0):
+        """Bench/soak helper: build a ``(n_read_blk, read_q_blk)``
+        superstep read schedule registering one uniform batch of
+        ``reads_per_lane`` queries per lane at inner step 0 (one batch
+        per lane is in flight at a time — see step 4a — so scheduling
+        at later inner steps would only shed)."""
+        N, Kr, Cq = self.n_lanes, self.read_window, self.query_width
+        r = min(int(reads_per_lane), Kr)
+        n_read = jnp.zeros((k, N), jnp.int32).at[0].set(r)
+        read_q = jnp.broadcast_to(
+            jnp.full((N, Kr, Cq), query_value, self.query_dtype),
+            (k, N, Kr, Cq))
+        return n_read, read_q
 
     # -- failure injection / elections ------------------------------------
 
@@ -1231,6 +1452,62 @@ class LockstepEngine:
         raise TimeoutError(
             "consistent_read: no heartbeat quorum within "
             f"{timeout_steps} rounds (leader lost its majority?)")
+
+    def read_lanes(self, lanes, queries, timeout_steps: int = 256):
+        """Consistent reads through the VECTORIZED read plane (ISSUE 20)
+        — the lease/read-index twin of :meth:`consistent_read`, serving
+        from the jitted step with zero log appends.
+
+        Registers ONE encoded query per given lane in a single
+        zero-command step, then drives empty rounds until every batch
+        settles.  ``queries``: [len(lanes), Cq] encoded rows (see the
+        machine's ``encode_query``).  Returns ``(replies, watermark,
+        ok)`` — np arrays aligned with ``lanes``: per-lane decoded-width
+        reply rows, the apply watermark each read was served at, and
+        ``ok`` False where the lane REFUSED the read (stale-refusal:
+        lease expired / quorum lost / timeout) rather than serve it
+        stale.  Raises TimeoutError if any batch neither serves nor
+        refuses within ``timeout_steps`` rounds."""
+        if not self.reads_enabled:
+            raise ValueError("machine has no query kernel "
+                             "(query_spec is None)")
+        lanes = np.atleast_1d(np.asarray(lanes))
+        n = len(lanes)
+        q = np.asarray(queries).reshape(n, -1)
+        nr = np.zeros((self.n_lanes,), np.int32)
+        nr[lanes] = 1
+        rq = np.zeros((self.n_lanes, self.read_window, self.query_width),
+                      self.query_dtype)
+        rq[lanes, 0] = q
+        zero_n = np.zeros((self.n_lanes,), np.int32)
+        zero_p = np.zeros((self.n_lanes, self.max_step_cmds,
+                           self.payload_width), self.payload_dtype)
+        Wq = self.query_reply_width
+        replies = np.zeros((n, Wq), np.int32)
+        wm = np.full((n,), -1, np.int32)
+        ok = np.zeros((n,), bool)
+        settled = np.zeros((n,), bool)
+        aux = self.step(zero_n, zero_p, n_read=nr, read_q=rq)
+        for _ in range(timeout_steps):
+            done = np.asarray(aux["read_done"])[lanes] > 0
+            # refused at arrival (leader down / slot busy) or by
+            # timeout — either way the batch settles with ok=False
+            stale = (np.asarray(aux["read_stale"])[lanes] > 0) | \
+                (np.asarray(aux["read_shed"])[lanes] > 0)
+            fresh = done & ~settled
+            if fresh.any():
+                rep = np.asarray(aux["read_replies"])[lanes[fresh], 0]
+                replies[fresh] = rep.reshape(fresh.sum(), -1)
+                wm[fresh] = np.asarray(
+                    aux["read_watermark"])[lanes[fresh]]
+                ok[fresh] = True
+            settled |= done | stale
+            if settled.all():
+                return replies, wm, ok
+            aux = self.step(zero_n, zero_p)
+        raise TimeoutError(
+            f"read_lanes: {int((~settled).sum())} batches neither "
+            f"served nor refused within {timeout_steps} rounds")
 
     # -- checkpoint / resume (device-state snapshot, SURVEY §5) ------------
 
@@ -1440,6 +1717,26 @@ class LockstepEngine:
                                      if self._driver is not None else 0),
             **self.pipeline_counters,
         }
+        if self.reads_enabled:
+            # read-plane health (ISSUE 20): cumulative serve/refuse
+            # ledger + lease coverage (the ra_top read panel's source)
+            i64 = np.int64
+            served = int(np.asarray(s.read_served).astype(i64).sum())
+            leased = int(np.asarray(s.read_leased).astype(i64).sum())
+            out["reads"] = {
+                "served_total": served,
+                "shed_total": int(
+                    np.asarray(s.read_shed).astype(i64).sum()),
+                "stale_refusals": int(
+                    np.asarray(s.read_stale).astype(i64).sum()),
+                "leased_total": leased,
+                "lease_coverage_pct": (100.0 * leased / served)
+                if served else 0.0,
+                "pending_lanes": int((np.asarray(s.read_n) > 0).sum()),
+                "lease_ttl": self.lease_ttl,
+                "read_timeout": self.read_timeout,
+                "read_window": self.read_window,
+            }
         if self._dur is not None:
             # durability-plane health (ENGINE_WAL_FIELDS + per-shard
             # WAL_FIELDS/stats), the key_metrics merge of PR 2's
@@ -1487,41 +1784,68 @@ class DispatchAheadDriver:
         self._staged = None
         self._handles: collections.deque = collections.deque()
         self.last_committed: Optional[np.ndarray] = None
+        #: newest OBSERVED cumulative read watermarks (np.int32[N]) —
+        #: the read twin of last_committed, advanced at the same
+        #: window-boundary pops; the ingress read lane settles its
+        #: in-flight blocks against these (ISSUE 20)
+        self.last_read_served: Optional[np.ndarray] = None
+        self.last_read_shed: Optional[np.ndarray] = None
+        self.last_read_stale: Optional[np.ndarray] = None
+        #: observed read aux (served replies + watermarks, np arrays)
+        #: in dispatch order, drained by IngressPlane read harvest —
+        #: bounded so a driver with no read consumer (bench loops that
+        #: only need the served counters) cannot grow host memory
+        self.read_obs: collections.deque = collections.deque(maxlen=64)
         engine._driver = self
 
     def in_flight(self) -> int:
         return len(self._handles)
 
-    def _stage(self, n_new_blk, payloads_blk, elect_blk=None) -> None:
+    def _stage(self, n_new_blk, payloads_blk, elect_blk=None,
+               read_blk=None) -> None:
         put = jax.device_put
         t0 = time.monotonic()
         n = put(np.asarray(n_new_blk, np.int32),  # ra02-ok: host block -> staging encode (async H2D; no device readback)
                 self.shardings.get("n_new"))
         p = put(np.asarray(payloads_blk), self.shardings.get("payloads"))  # ra02-ok: host block -> staging encode (async H2D; no device readback)
+        nbytes, nev = n.nbytes + p.nbytes, 2
+        if read_blk is not None:
+            rn = put(np.asarray(read_blk[0], np.int32),  # ra02-ok: host read block -> staging encode (async H2D; no device readback)
+                     self.shardings.get("n_read"))
+            rq = put(np.asarray(read_blk[1]), self.shardings.get("read_q"))  # ra02-ok: host read block -> staging encode (async H2D; no device readback)
+            nbytes += rn.nbytes + rq.nbytes
+            nev += 2
+            read_blk = (rn, rq)
         # host_staging phase stamp: the host-side encode + H2D submit
         # cost of this block (device_put is async, so this is the edge
         # the host pays, not the wire time — rule RA04: no sync here)
         self.engine.phases.note("host_staging", time.monotonic() - t0)
         self.engine.pipeline_counters["blocks_staged"] += 1
         # transfer ledger (ISSUE 16): the steady-state loop's h2d
-        # budget is exactly these two staged blocks per submit —
+        # budget is exactly these staged blocks per submit —
         # measured here so the "fixed per-window transfer budget" is a
         # number, not an RA04 lint promise (.nbytes = host metadata)
-        devicewatch.record_h2d("driver_stage", n.nbytes + p.nbytes,
-                               events=2)
-        self._staged = (n, p, elect_blk)
+        devicewatch.record_h2d("driver_stage", nbytes, events=nev)
+        self._staged = (n, p, elect_blk, read_blk)
 
-    def submit(self, n_new_blk, payloads_blk, elect_blk=None):
+    def submit(self, n_new_blk, payloads_blk, elect_blk=None,
+               read_blk=None):
         """Stage this block (async H2D), dispatch the previous one.
+        ``read_blk``: optional ``(n_read_blk [K,N], read_q_blk
+        [K,N,Kr,Cq])`` read schedule riding the same dispatch.
         Returns the previous dispatch's async committed-watermark
         handle, or None on the first call (nothing dispatched yet)."""
         prev = self._staged
-        self._stage(n_new_blk, payloads_blk, elect_blk)
+        self._stage(n_new_blk, payloads_blk, elect_blk, read_blk)
         return self._dispatch(prev) if prev is not None else None
 
     def _dispatch(self, blk):
         t_sub = time.monotonic()
-        aux = self.engine.superstep(blk[0], blk[1], elect_blk=blk[2])
+        read_blk = blk[3]
+        aux = self.engine.superstep(
+            blk[0], blk[1], elect_blk=blk[2],
+            n_read_blk=None if read_blk is None else read_blk[0],
+            read_q_blk=None if read_blk is None else read_blk[1])
         # the `+ 0` copy decouples the readback from buffer donation by
         # the next dispatch (same contract as committed_lanes_async)
         h = aux["committed_lanes"][-1] + 0
@@ -1533,7 +1857,32 @@ class DispatchAheadDriver:
         # dispatch, counted at copy start (the window-boundary pop
         # below observes the SAME copy — never double-counted)
         devicewatch.record_d2h("driver_watermark", h.nbytes)
-        self._handles.append((t_sub, h))
+        robs = None
+        if self.engine.reads_enabled:
+            # read answers drain off the same async-readback rhythm as
+            # the committed watermark: copies START here (no sync), and
+            # are OBSERVED at the window-boundary pops below (ISSUE 20
+            # — no new host sync points for the read plane).  The
+            # cumulative [N] outcome counters ride EVERY dispatch (a
+            # batch registered in dispatch i may serve or expire during
+            # a read-less dispatch i+k — settlement must still see it);
+            # the full reply tensors ride only read-carrying dispatches
+            robs = {"read_served_lanes": aux["read_served_lanes"][-1] + 0,
+                    "read_shed_lanes": aux["read_shed_lanes"][-1] + 0,
+                    "read_stale_lanes": aux["read_stale_lanes"][-1] + 0}
+            if read_blk is not None:
+                robs.update({k: aux[k] + 0 for k in
+                             ("read_done", "read_replies",
+                              "read_watermark")})
+            rb = 0
+            for v in robs.values():
+                try:
+                    v.copy_to_host_async()
+                except AttributeError:  # pragma: no cover
+                    pass
+                rb += v.nbytes
+            devicewatch.record_d2h("driver_read", rb, events=len(robs))
+        self._handles.append((t_sub, h, robs))
         while len(self._handles) > self.max_in_flight:
             # window boundary: await the OLDEST dispatch's watermark.
             # Only a harvest that actually had to WAIT counts as a
@@ -1541,7 +1890,7 @@ class DispatchAheadDriver:
             # pipeline working, not blocking (the counter backs the
             # "window_syncs << dispatches" health rule, so it must
             # distinguish the two)
-            t0, oldest = self._handles.popleft()
+            t0, oldest, orobs = self._handles.popleft()
             try:
                 waited = not oldest.is_ready()
             except AttributeError:  # pragma: no cover — older jax arrays
@@ -1555,7 +1904,29 @@ class DispatchAheadDriver:
             # watermark readbacks — no NEW sync point is introduced)
             self.engine.phases.note("device_dispatch",
                                     time.monotonic() - t0)
+            self._observe_reads(t0, orobs)
         return h
+
+    def _observe_reads(self, t_sub, robs) -> None:
+        """Convert a popped dispatch's read-aux copies to host data —
+        called only at the pops the in-flight cap already performs (the
+        copies were started at dispatch; observing them here adds no
+        new sync point beyond the committed-watermark one)."""
+        if robs is None:
+            return
+        obs = {k: np.asarray(v) for k, v in robs.items()}  # ra02-ok: window-boundary read observation — same pop as last_committed, copies started async at dispatch
+        self.last_read_served = obs["read_served_lanes"]
+        self.last_read_shed = obs["read_shed_lanes"]
+        self.last_read_stale = obs["read_stale_lanes"]
+        self.read_obs.append(obs)
+        # read_e2e phase stamp: read-block submit -> serve outcome
+        # observed on the host (the continuous signal behind the
+        # read_p99_ms SLO objective) — stamped only for dispatches
+        # that actually served reads, so write-only dispatches on a
+        # reads-enabled engine don't dilute the read latency signal
+        if "read_done" in obs and obs["read_done"].any():
+            self.engine.phases.note("read_e2e",
+                                    time.monotonic() - t_sub)
 
     def drain(self) -> Optional[np.ndarray]:
         """Dispatch any staged block and await every in-flight
@@ -1565,8 +1936,9 @@ class DispatchAheadDriver:
             blk, self._staged = self._staged, None
             self._dispatch(blk)
         while self._handles:
-            t0, h = self._handles.popleft()
+            t0, h, robs = self._handles.popleft()
             self.last_committed = np.asarray(h)
             self.engine.phases.note("device_dispatch",
                                     time.monotonic() - t0)
+            self._observe_reads(t0, robs)
         return self.last_committed

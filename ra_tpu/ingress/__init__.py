@@ -39,7 +39,7 @@ import numpy as np
 
 from ..blackbox import record
 from ..engine.lockstep import DispatchAheadDriver
-from ..metrics import INGRESS_FIELDS
+from ..metrics import INGRESS_FIELDS, READ_FIELDS
 from .backpressure import (DEFER, DUP, LEVEL_NAMES, OK, REJECT, SHED, SLOW,
                            STATUS_NAMES, CreditLadder)
 from .coalesce import CoalesceWindow, batch_rank
@@ -105,6 +105,61 @@ class IngressPlane:
         self._base_committed = \
             np.asarray(engine.state.total_committed).astype(np.int64)
         self._shedding = False
+        # -- vectorized read lane (ISSUE 20) ---------------------------
+        # A second, read-side CoalesceWindow stages consistent reads
+        # into ``(n_read [K,N], read_q [K,N,Kr,Cq])`` blocks that RIDE
+        # the write dispatches (superstep_k=1: the engine holds at most
+        # ONE in-flight read batch per lane, so a block is exactly one
+        # window of Kr rows per lane, registered at inner step 0 to
+        # maximize confirm rounds within the dispatch).  Reads consume
+        # the same session credit as writes but shed FIRST: any
+        # tightened ladder level refuses whole read waves at admission
+        # (overload sheds reads before it delays writes).
+        self.reads_enabled = bool(getattr(engine, "reads_enabled", False))
+        self.read_counters = {f: 0 for f in READ_FIELDS}
+        #: reply fan-out hook (the wire plane's READ_REPLY path):
+        #: called with (handles, seqnos, statuses, watermarks, payloads)
+        #: row vectors as read batches settle — off the driver's
+        #: EXISTING async read-aux readbacks, never a new host sync
+        self.on_reads_done = None
+        #: the single in-flight read block awaiting settlement:
+        #: (handles [N,Kr], seqnos [N,Kr], take [N], pend bool[N])
+        self._read_pending = None
+        self._read_shedding = False
+        self._read_stale_flag = False
+        n = engine.n_lanes
+        self._zero_wn = np.zeros((superstep_k, n), np.int32)
+        self._zero_wp = np.zeros(
+            (superstep_k, n, engine.max_step_cmds, engine.payload_width),
+            np.dtype(engine.payload_dtype))
+        if self.reads_enabled:
+            kr, cq = engine.read_window, engine.query_width
+            qdt = np.dtype(engine.query_dtype)
+            self.read_window = CoalesceWindow(
+                n, kr, cq, superstep_k=1, capacity=4 * kr,
+                window_s=window_s, fill_frac=fill_frac,
+                payload_dtype=qdt, track_seqnos=True)
+            #: zero read block attached while a block is PENDING so the
+            #: reply tensors (read_done/read_replies/read_watermark)
+            #: keep riding every dispatch until the batch serves or
+            #: expires — settlement never waits on a new read arriving
+            self._zero_read_blk = (
+                np.zeros((superstep_k, n), np.int32),
+                np.zeros((superstep_k, n, kr, cq), qdt))
+            # settlement joins on the engine's CUMULATIVE per-lane
+            # outcome counters (served/shed/stale deltas per observed
+            # dispatch) — baselines from current state, like
+            # _base_committed above
+            s = engine.state
+            self._read_served_base = \
+                np.asarray(s.read_served).astype(np.int64)
+            self._read_shed_base = \
+                np.asarray(s.read_shed).astype(np.int64)
+            self._read_stale_base = \
+                np.asarray(s.read_stale).astype(np.int64)
+        else:
+            self.read_window = None
+            self._zero_read_blk = None
         engine._ingress = self
 
     # -- sessions ----------------------------------------------------------
@@ -211,6 +266,54 @@ class IngressPlane:
         return self.submit(handles, self.directory.next_seqnos(handles),
                            payloads)
 
+    def submit_reads(self, handles, seqnos, queries) -> np.ndarray:
+        """One consistent-read wave: per-row status (OK/SLOW/REJECT/
+        SHED, np.int8), vectorized end to end (rule RA08 gates this
+        path like the write coalescer's).
+
+        Reads are idempotent, so there is NO dedup watermark: ``seqnos``
+        are pure reply-correlation ids, and a shed read's resend is
+        always fresh.  Credit bias (the ISSUE 20 overload story): any
+        tightened ladder level sheds the whole read wave at admission —
+        reads shed BEFORE writes are delayed, and a shed read costs no
+        credit."""
+        handles = np.asarray(handles, np.int64)
+        seqnos = np.asarray(seqnos, np.int64)
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[:, None]
+        n = len(handles)
+        rc = self.read_counters
+        rc["submitted"] += n
+        status = np.full(n, SHED, np.int8)
+        if not self.reads_enabled or n == 0:
+            rc["shed"] += n
+            return status
+        if self.ladder.level > 0:
+            rc["shed"] += n
+            if not self._read_shedding:
+                self._read_shedding = True
+                record("read.shed", rows=int(n),
+                       level=LEVEL_NAMES[self.ladder.level])
+            return status
+        self._read_shedding = False
+        adm = self.ladder.admit(handles)
+        status[:] = adm
+        ok = adm <= SLOW
+        idx_ok = np.flatnonzero(ok)
+        rc["rejected"] += int(n - len(idx_ok))
+        if len(idx_ok):
+            placed = self.read_window.offer(
+                self.directory.lane[handles[idx_ok]], queries[idx_ok],
+                handles[idx_ok], seqnos=seqnos[idx_ok])
+            if not placed.all():
+                idx_shed = idx_ok[~placed]
+                status[idx_shed] = SHED
+                self.ladder.release(handles[idx_shed])
+                rc["shed"] += len(idx_shed)
+            rc["accepted"] += int(placed.sum())
+        return status
+
     # -- dispatch ----------------------------------------------------------
 
     def pump(self, now: Optional[float] = None,
@@ -218,22 +321,39 @@ class IngressPlane:
         """Harvest committed blocks (credit release), poll the SLO
         ladder, and dispatch one superstep block if the window
         triggered (or ``force``).  Host dict/numpy work only — the
-        dispatch itself is the driver's async staged submit."""
+        dispatch itself is the driver's async staged submit.
+
+        Reads ride the same dispatch (ISSUE 20): a staged read block —
+        or the zero block that keeps a PENDING batch's reply tensors
+        flowing — is attached to whatever write block goes out.  With
+        no write work at all, read work still dispatches against a
+        cached zero write block (same geometry, same compiled
+        executable — no retrace)."""
         self._harvest()
         if self.slo is not None:
             # memoized with evaluate(): a per-pump poll is a dict hit
             self.ladder.on_verdict(self.slo.verdict("commit_p99_ms"))
-        if not force and not self.window.ready(now):
+        write_ready = (force or self.window.ready(now)) and \
+            self.window.queue_rows() > 0
+        read_ready = self.reads_enabled and (
+            self._read_pending is not None
+            or self.read_window.queue_rows() > 0)
+        if not write_ready and not read_ready:
             return False
-        if self.window.queue_rows() <= 0:
-            return False
-        n_new, payloads, handles, take = self.window.pop_block()
-        self.driver.submit(n_new, payloads)
-        self._dispatched_rows += take
-        self._inflight.append((self._dispatched_rows.copy(), handles,
-                               take))
-        self.counters["blocks_built"] += 1
-        self.counters["block_rows"] += int(take.sum())
+        read_blk = self._pop_read_block()
+        if write_ready:
+            n_new, payloads, handles, take = self.window.pop_block()
+            self.driver.submit(n_new, payloads, read_blk=read_blk)
+            self._dispatched_rows += take
+            self._inflight.append((self._dispatched_rows.copy(), handles,
+                                   take))
+            self.counters["blocks_built"] += 1
+            self.counters["block_rows"] += int(take.sum())
+        else:
+            # reads-only dispatch: zero write rows, no write
+            # bookkeeping — the read plane serves with zero log appends
+            self.driver.submit(self._zero_wn, self._zero_wp,
+                               read_blk=read_blk)
         self._harvest()
         return True
 
@@ -243,11 +363,126 @@ class IngressPlane:
             return None
         return np.asarray(lc, np.int64) - self._base_committed
 
+    def _pop_read_block(self):
+        """The read half of a dispatch: ``None`` (reads off / nothing
+        to do), the cached ZERO block (a batch is pending — keeps the
+        reply tensors riding every dispatch until it settles), or one
+        popped read window (at most Kr rows per lane, registered at
+        inner step 0)."""
+        if not self.reads_enabled:
+            return None
+        if self._read_pending is not None:
+            return self._zero_read_blk
+        if self.read_window.queue_rows() <= 0:
+            return None
+        n_r, read_q, handles, take = self.read_window.pop_block()
+        seqnos = self.read_window.last_pop_seqnos
+        nr_blk, rq_blk = (np.zeros_like(self._zero_read_blk[0]),
+                          np.zeros_like(self._zero_read_blk[1]))
+        nr_blk[0] = n_r[0]
+        rq_blk[0] = read_q[0]
+        self._read_pending = (handles, seqnos.copy(), take.copy(),
+                              take > 0)
+        self.read_counters["blocks_built"] += 1
+        self.read_counters["block_rows"] += int(take.sum())
+        return (nr_blk, rq_blk)
+
+    def _harvest_reads(self) -> None:
+        """Settle the in-flight read block against the driver's
+        observed read aux (drained in dispatch order).  Because the
+        engine accepts a lane's batch whole-or-nothing and registers at
+        most one batch per lane, each pending lane settles as exactly
+        one of served (OK + replies at a certified watermark), arrival-
+        shed (SHED: leader down / slot busy at registration), or
+        stale-expired (REJECT: the device refused rather than serve
+        past lease/quorum cover) — joined on the cumulative per-lane
+        outcome deltas, replies from the per-dispatch tensors."""
+        robs = self.driver.read_obs
+        while robs:  # ra08-ok: per-OBSERVED-DISPATCH drain (<= in-flight cap entries), not per-session work
+            obs = robs.popleft()
+            served_c = np.asarray(obs["read_served_lanes"], np.int64)
+            shed_c = np.asarray(obs["read_shed_lanes"], np.int64)
+            stale_c = np.asarray(obs["read_stale_lanes"], np.int64)
+            blk = self._read_pending
+            if blk is not None:
+                handles, seqnos, take, pend = blk
+                done = obs.get("read_done")
+                if done is not None:
+                    done = np.asarray(done)
+                    served = (done.sum(axis=0) > 0) & pend
+                    if served.any():
+                        k_idx = np.argmax(done > 0, axis=0)
+                        lane_ix = np.arange(done.shape[1])
+                        replies = np.asarray(
+                            obs["read_replies"])[k_idx, lane_ix]
+                        wms = np.asarray(
+                            obs["read_watermark"])[k_idx, lane_ix]
+                        self._emit_read_replies(blk, served, OK, wms,
+                                                replies)
+                        pend = pend & ~served
+                shed = ((shed_c - self._read_shed_base) > 0) & pend
+                if shed.any():
+                    self._emit_read_replies(blk, shed, SHED, None, None)
+                    pend = pend & ~shed
+                stale = ((stale_c - self._read_stale_base) > 0) & pend
+                if stale.any():
+                    self._emit_read_replies(blk, stale, REJECT, None,
+                                            None)
+                    pend = pend & ~stale
+                self._read_pending = None if not pend.any() else \
+                    (handles, seqnos, take, pend)
+            self._read_served_base = served_c
+            self._read_shed_base = shed_c
+            self._read_stale_base = stale_c
+
+    def _emit_read_replies(self, blk, mask, status, wms, replies) -> None:
+        """Fan one settlement outcome out to reply rows: release read
+        credit, bump counters, and fire ``on_reads_done`` (the wire
+        plane's READ_REPLY path) — one vectorized gather per outcome,
+        rule RA08-gated like the coalescer."""
+        handles, seqnos, take, _pend = blk
+        kr = handles.shape[1]
+        valid = (np.arange(kr)[None, :] < take[:, None]) & mask[:, None]
+        h = handles[valid]
+        nrows = len(h)
+        if not nrows:
+            return
+        s = seqnos[valid]
+        st = np.full(nrows, status, np.int8)
+        if wms is None:
+            wm_rows = np.full(nrows, -1, np.int32)
+        else:
+            wm_rows = np.broadcast_to(
+                np.asarray(wms, np.int32)[:, None],
+                valid.shape)[valid]
+        if replies is None:
+            pay = np.zeros((nrows, self.engine.query_reply_width),
+                           np.int32)
+        else:
+            pay = np.asarray(replies, np.int32)[valid]
+        self.ladder.release(h)
+        rc = self.read_counters
+        if status == OK:
+            rc["served"] += nrows
+            self._read_stale_flag = False
+        elif status == SHED:
+            rc["shed"] += nrows
+        else:
+            rc["stale_refused"] += nrows
+            if not self._read_stale_flag:
+                self._read_stale_flag = True
+                record("read.stale", rows=nrows)
+        if self.on_reads_done is not None:
+            self.on_reads_done(h, s, st, wm_rows, pay)
+            rc["replies_sent"] += nrows
+
     def _harvest(self) -> None:
         """Release credit for blocks the engine's committed watermark
         now covers (block granularity: one vectorized release per
         retired block, driven by the driver's EXISTING async watermark
         readbacks — no new host syncs)."""
+        if self.reads_enabled:
+            self._harvest_reads()
         done = self._committed_rows()
         if done is None:
             return
@@ -272,17 +507,17 @@ class IngressPlane:
         while self.window.queue_rows() > 0:
             self.pump(force=True)
         self.driver.drain()
-        k = self.window.superstep_k
-        n, kc, c = (self.engine.n_lanes, self.engine.max_step_cmds,
-                    self.engine.payload_width)
-        zero_n = np.zeros((k, n), np.int32)
-        zero_p = np.zeros((k, n, kc, c),
-                          np.dtype(self.engine.payload_dtype))
+        self._harvest()
         deadline = time.monotonic() + timeout
-        while self._inflight:
+        while self._inflight or (self.reads_enabled and (
+                self._read_pending is not None
+                or self.read_window.queue_rows() > 0)):
             # same block shapes as the pump path: reuses the compiled
-            # fused executable rather than retracing a new geometry
-            self.driver.submit(zero_n, zero_p)
+            # fused executable rather than retracing a new geometry.
+            # Pending reads ride along until they serve or the device
+            # read_timeout expires them — settlement always terminates
+            self.driver.submit(self._zero_wn, self._zero_wp,
+                               read_blk=self._pop_read_block())
             self.driver.drain()
             self._harvest()
             if time.monotonic() > deadline:
@@ -321,11 +556,35 @@ class IngressPlane:
                 "ladder": lad,
                 "window": self.window.overview()}
 
+    def read_overview(self) -> dict:
+        """The Observatory ``read`` source: READ_FIELDS counters + read
+        flow gauges (flat ring keys ``read_<field>``).  ``lease_served``
+        is filled from the device's cumulative served-under-lease
+        counter at snapshot time (the observability pull path — the hot
+        path never syncs for it); ``lease_coverage_pct`` is the
+        served-under-lease share, the ra_top read panel's headline."""
+        out = dict(self.read_counters)
+        if self.reads_enabled:
+            leased = int(np.asarray(
+                self.engine.state.read_leased).astype(np.int64).sum())
+            out["lease_served"] = leased
+            served_dev = int(np.asarray(
+                self.engine.state.read_served).astype(np.int64).sum())
+            out["lease_coverage_pct"] = \
+                100.0 * leased / max(1, served_dev)
+            out["queue_rows"] = self.read_window.queue_rows()
+            out["pending_lanes"] = 0 if self._read_pending is None \
+                else int(self._read_pending[3].sum())
+        return out
+
     def attach(self, observatory) -> "IngressPlane":
-        """Register this plane as the Observatory's ``ingress`` source
-        (``Observatory.for_engine`` wires it automatically when the
-        engine carries an attached plane)."""
+        """Register this plane as the Observatory's ``ingress`` (and,
+        reads enabled, ``read``) source (``Observatory.for_engine``
+        wires it automatically when the engine carries an attached
+        plane)."""
         observatory.add_source("ingress", self.overview)
+        if self.reads_enabled:
+            observatory.add_source("read", self.read_overview)
         return self
 
     def bench_row(self, elapsed_s: float) -> dict:
@@ -340,7 +599,7 @@ class IngressPlane:
         c = self.counters
         accepted = c["accepted"]
         submitted = max(1, c["submitted"])
-        return {
+        row = {
             "value": accepted / max(elapsed_s, 1e-9),
             "ingress_cmds_per_s": accepted / max(elapsed_s, 1e-9),
             "ingress_shed_rate": c["shed_rows"] / submitted,
@@ -350,3 +609,13 @@ class IngressPlane:
             "elapsed_s": elapsed_s,
             **devicewatch.bench_tail_keys(commands=accepted),
         }
+        if self.reads_enabled:
+            # read-frontier regression keys (ISSUE 20, higher-better
+            # read_cmds_per_s joined by the read_p99_ms phase key the
+            # SLO engine stamps)
+            rc = self.read_counters
+            row["read_cmds_per_s"] = rc["served"] / max(elapsed_s, 1e-9)
+            row["read_served"] = rc["served"]
+            row["read_shed_rate"] = rc["shed"] / max(1, rc["submitted"])
+            row["read_stale_refused"] = rc["stale_refused"]
+        return row

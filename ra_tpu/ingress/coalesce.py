@@ -73,7 +73,8 @@ class CoalesceWindow:
                  payload_width: int, *, superstep_k: int = 8,
                  capacity: Optional[int] = None, window_s: float = 0.002,
                  fill_frac: float = 0.5,
-                 payload_dtype=np.int32) -> None:
+                 payload_dtype=np.int32,
+                 track_seqnos: bool = False) -> None:
         self.n_lanes = int(n_lanes)
         self.cmds_per_step = int(cmds_per_step)
         self.payload_width = int(payload_width)
@@ -91,6 +92,15 @@ class CoalesceWindow:
                              self.payload_width), payload_dtype)
         #: session handle per staged row (credit release + audit joins)
         self.hbuf = np.full((self.n_lanes, self.capacity), -1, np.int64)
+        #: optional per-row seqno ring (the READ lane's reply
+        #: correlation ids, ISSUE 20) — opt-in: the write lane's seqno
+        #: bookkeeping lives in the dedup directory, and an
+        #: unconditional int64 ring would double this class's memory
+        self.sbuf = np.zeros((self.n_lanes, self.capacity), np.int64) \
+            if track_seqnos else None
+        #: seqno matrix [N, K*Kc] of the LAST pop_block (None when
+        #: seqno tracking is off) — read immediately after the pop
+        self.last_pop_seqnos: Optional[np.ndarray] = None
         self.head = np.zeros(self.n_lanes, np.int64)
         self.fill = np.zeros(self.n_lanes, np.int64)
         self._staged_rows = 0
@@ -99,7 +109,7 @@ class CoalesceWindow:
     # -- hot path (rule RA08: no per-session loops, no dict allocation) ----
 
     def offer(self, lanes: np.ndarray, payloads: np.ndarray,
-              handles: np.ndarray) -> np.ndarray:
+              handles: np.ndarray, seqnos=None) -> np.ndarray:
         """Scatter an admitted batch into the per-lane rings.  Returns
         the PLACED mask; unplaced rows overflowed their lane's bounded
         ring and must be shed/deferred by the caller (their seqnos are
@@ -112,6 +122,8 @@ class CoalesceWindow:
         slot = (self.head[lp] + rel[placed]) % self.capacity
         self.buf[lp, slot] = payloads[placed]
         self.hbuf[lp, slot] = np.asarray(handles, np.int64)[placed]
+        if self.sbuf is not None and seqnos is not None:
+            self.sbuf[lp, slot] = np.asarray(seqnos, np.int64)[placed]
         np.add.at(self.fill, lp, 1)
         self._staged_rows += int(len(lp))
         return placed
@@ -130,6 +142,8 @@ class CoalesceWindow:
             % self.capacity
         payloads = np.take_along_axis(self.buf, idx[..., None], axis=1)
         handles = np.take_along_axis(self.hbuf, idx, axis=1)
+        if self.sbuf is not None:
+            self.last_pop_seqnos = np.take_along_axis(self.sbuf, idx, axis=1)
         n_new = np.clip(take[None, :] - (np.arange(k) * kc)[:, None],
                         0, kc).astype(np.int32)
         payloads = payloads.reshape(self.n_lanes, k, kc,

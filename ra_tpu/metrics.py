@@ -175,6 +175,11 @@ TELEMETRY_FIELDS = (
 PHASE_FIELDS = (
     "host_staging", "device_dispatch", "queue_wait", "wal_encode",
     "fsync_wait", "confirm_publish", "commit_e2e", "encode",
+    # ``read_e2e`` (ISSUE 20): read-block submit -> serve outcome
+    # observed at the driver's existing window-boundary pops — the
+    # continuous read-latency signal the `read_p99_ms` SLO objective
+    # evaluates (flat ring key engine_phases_read_e2e_p99_ms)
+    "read_e2e",
 )
 
 #: ingress-plane counter fields (ra_tpu/ingress/, ISSUE 10): one dict
@@ -220,6 +225,33 @@ WIRE_FIELDS = (
     "sweeps", "swept_rows", "protocol_errors", "credit_rows",
     "ack_rows", "credit_ok", "credit_slow", "credit_defer",
     "credit_reject", "credit_dup", "credit_shed",
+    # read plane (ISSUE 20): ``read_rows`` READ records decoded and
+    # submitted by the vectorized sweep (the read twin of swept_rows),
+    # ``read_reply_rows`` READ_REPLY records fanned back with their
+    # certified watermark
+    "read_rows", "read_reply_rows",
+)
+
+#: ingress read-lane counter fields (ra_tpu/ingress/, ISSUE 20): one
+#: dict per IngressPlane read lane, the Observatory ``read`` source
+#: (flat ring keys ``read_<field>``).  Admission: ``submitted`` read
+#: rows offered, ``accepted`` the subset placed into the read
+#: coalescer, ``shed`` rows shed by overload (the CreditLadder sheds
+#: reads BEFORE it delays writes — any ladder level above green sheds),
+#: ``rejected`` rows refused by coalescer ring overflow.  Dispatch:
+#: ``blocks_built`` read superstep blocks dispatched and
+#: ``block_rows`` the rows they carried.  Settlement (from the
+#: device's cumulative serve/refuse watermarks): ``served`` reads
+#: answered at a certified watermark, ``stale_refused`` reads the
+#: device refused rather than serve stale (lease expired / quorum
+#: lost / timeout — the oracle pins consistent reads to 0 stale
+#: SERVES; refusals are the safe outcome), ``lease_served`` the
+#: served-under-lease subset (lease coverage), ``replies_sent``
+#: READ_REPLY rows fanned back to clients.
+READ_FIELDS = (
+    "submitted", "accepted", "shed", "rejected", "blocks_built",
+    "block_rows", "served", "stale_refused", "lease_served",
+    "replies_sent",
 )
 
 #: the on-device aggregation of TELEMETRY_FIELDS (lockstep's jitted
@@ -237,6 +269,8 @@ TELEMETRY_SUMMARY_FIELDS = (
     "apply_lag_max", "apply_lag_mean", "leader_age_min",
     "commit_lag_hist", "top_lanes", "top_commit_lag", "top_apply_lag",
     "top_stall_steps", "committed_total",
+    "read_served_total", "read_shed_total", "read_stale_total",
+    "read_leased_total",
 )
 
 #: classic replication-batching health (ISSUE 13): the shape of
@@ -331,6 +365,7 @@ FIELD_REGISTRY = {
     "telemetry_summary": TELEMETRY_SUMMARY_FIELDS,
     "phase": PHASE_FIELDS,
     "ingress": INGRESS_FIELDS,
+    "read": READ_FIELDS,
     "wire": WIRE_FIELDS,
     "classic": CLASSIC_FIELDS,
     "device": DEVICE_FIELDS,

@@ -6,7 +6,10 @@ from .jit_kv import JitKvMachine
 from .kv import KvMachine
 from .registers import RegisterMachine
 from .queue import QueueMachine
+from .stream import StreamMachine
+from .ttl_kv import TtlKvMachine
 
 __all__ = ["CounterMachine", "FifoMachine", "FifoClient", "JitFifoMachine",
            "JitKvMachine", "KvMachine", "Mailbox", "QueueMachine",
-           "RegisterMachine", "StopSending"]
+           "RegisterMachine", "StopSending", "StreamMachine",
+           "TtlKvMachine"]

@@ -40,3 +40,21 @@ class CounterMachine(JitMachine):
 
     def decode_reply(self, reply):
         return int(reply)
+
+    # -- vectorized read path (ISSUE 20) -----------------------------------
+
+    query_spec = ("int32", (1,))
+    query_reply_spec = ("int32", (1,))
+
+    def jit_query(self, queries, state):
+        # queries: [..., Kr, 1] (payload ignored); state: [...] int32 —
+        # every query answers the counter value at the serve watermark
+        Kr = queries.shape[-2]
+        return jnp.broadcast_to(state[..., None, None],
+                                state.shape + (Kr, 1))
+
+    def encode_query(self, query):
+        return jnp.zeros((1,), jnp.int32)
+
+    def decode_query_reply(self, reply):
+        return int(reply[..., 0])

@@ -157,6 +157,40 @@ class JitKvMachine(JitMachine):
         code, val = int(reply[..., 0]), int(reply[..., 1])
         return (code, None if val < 0 else val)
 
+    # -- vectorized read path (ISSUE 20) -----------------------------------
+    # Query encoding (query_spec int32[2]): ``[op, key]``
+    #   op 0 size()    reply [n_present, 0]
+    #   op 1 get(key)  reply [present, value]   (absent/bad key -> [0,-1])
+
+    query_spec = ("int32", (2,))
+    query_reply_spec = ("int32", (2,))
+
+    def jit_query(self, queries, state):
+        # queries: [..., Kr, 2]; state: [..., S] — pure gathers, no
+        # state mutation (reads never enter the log)
+        S = self.n_keys
+        op = queries[..., 0]
+        raw_key = queries[..., 1]
+        key_ok = (raw_key >= 0) & (raw_key < S)
+        key = jnp.clip(raw_key, 0, S - 1)
+        val = jnp.take_along_axis(state[..., None, :],
+                                  key[..., None], axis=-1)[..., 0]
+        present = key_ok & (val >= 0)
+        size = jnp.sum((state >= 0).astype(_I32),
+                       axis=-1)[..., None]                    # [..., 1]
+        code = jnp.where(op == 0, size, present.astype(_I32))
+        value = jnp.where(op == 0, 0, jnp.where(present, val, -1))
+        return jnp.stack([code, value], axis=-1)
+
+    def encode_query(self, query):
+        if isinstance(query, tuple) and query and query[0] == "get":
+            return jnp.asarray([1, int(query[1])], _I32)
+        return jnp.zeros((2,), _I32)  # size()
+
+    def decode_query_reply(self, reply):
+        code, val = int(reply[..., 0]), int(reply[..., 1])
+        return (code, None if val < 0 else val)
+
 
 def query_kv(state) -> dict:
     """Query fun: present keys as a plain dict (host path)."""

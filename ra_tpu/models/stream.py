@@ -1,0 +1,228 @@
+"""StreamMachine — an offset-addressed log with consumer cursors.
+
+The RabbitMQ-streams shape: an append-only log addressed by absolute
+offset, a retention window (oldest ``capacity`` entries survive; older
+offsets fall off the tail), and named consumer GROUPS whose committed
+cursors advance monotonically through the log — stream consumers track
+their own position, the machine only stores the committed cursor.  This
+is the second machine of the ISSUE 20 read library: the interesting
+workload is read-dominated (consumers replaying offsets), which is
+exactly what the engine's lease/read-index plane serves with zero log
+appends.
+
+State per lane: ``buf int32[capacity]`` ring (slot = offset % capacity),
+``tail`` (next offset to write), ``base`` (oldest retained offset —
+``base <= offset < tail`` is readable), ``cursors int32[groups]``.
+
+Command encoding (command_spec int32[3]): ``[op, a, b]``
+
+  op 0 noop                   (term-opening entry)
+  op 1 append(value)          reply [1, offset]        (value >= 0)
+  op 2 commit_cursor(g, off)  reply [1, cursor]   (max-merge, clamped
+                               to tail — a cursor never outruns the log)
+  op 3 truncate(upto)         reply [1, base]     (advance retention)
+
+Reply is int32[2].  Bad group / negative value degrade to a no-op with
+reply [-2, -1].
+
+Query encoding (query_spec int32[2]): ``[op, a]`` — the ISSUE 20
+vectorized read path:
+
+  op 0 bounds()        reply [tail, base]
+  op 1 read(offset)    reply [1, value] if base <= offset < tail
+                              else [0, -1]
+  op 2 cursor(g)       reply [1, cursor]         (bad g -> [0, -1])
+
+Batch apply: a window of only noop/append — the firehose steady state —
+folds in one vectorized pass (append positions are an exclusive cumsum
+of the admit flags; values land via the exact one-hot matmul, and when
+the window is wider than the ring only the LAST append aliasing each
+slot survives, as in jit_fifo's fold).  Windows containing cursor/
+truncate ops fall back to the in-order masked sequential fold.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.machine import JitMachine
+from ..ops.exact import place16
+
+_I32 = jnp.int32
+
+
+class StreamMachine(JitMachine):
+    command_spec = ("int32", (3,))
+    reply_spec = ("int32", (2,))
+    query_spec = ("int32", (2,))
+    query_reply_spec = ("int32", (2,))
+    version = 0
+    #: append order IS offset order — batch apply stays sound because
+    #: jit_apply_batch folds the window IN ORDER (vectorized fast path
+    #: for append-only windows, masked sequential fold else)
+    supports_batch_apply = True
+
+    def __init__(self, capacity: int = 64, groups: int = 4) -> None:
+        self.capacity = capacity
+        self.groups = groups
+
+    def jit_init(self, n_lanes: int):
+        N, Q, G = n_lanes, self.capacity, self.groups
+        return {
+            "buf": jnp.zeros((N, Q), _I32),
+            "tail": jnp.zeros((N,), _I32),
+            "base": jnp.zeros((N,), _I32),
+            "cursors": jnp.zeros((N, G), _I32),
+        }
+
+    def jit_apply(self, meta, command, state):
+        Q, G = self.capacity, self.groups
+        op = command[..., 0]
+        a = command[..., 1]
+        b = command[..., 2]
+        buf, tail, base = state["buf"], state["tail"], state["base"]
+        cursors = state["cursors"]
+
+        app = (op == 1) & (a >= 0)
+        slot = jnp.mod(tail, Q)
+        hot = (jnp.arange(Q) == slot[..., None]) & app[..., None]
+        buf = jnp.where(hot, a[..., None], buf)
+        new_tail = tail + app.astype(_I32)
+
+        g_ok = (a >= 0) & (a < G)
+        commit = (op == 2) & g_ok
+        g = jnp.clip(a, 0, G - 1)
+        cur = jnp.take_along_axis(cursors, g[..., None], axis=-1)[..., 0]
+        # max-merge clamped to tail: replayed/duplicate commits are
+        # no-ops and a cursor can never point past the log end
+        new_cur = jnp.clip(jnp.maximum(cur, b), 0, new_tail)
+        chot = (jnp.arange(G) == g[..., None]) & commit[..., None]
+        cursors = jnp.where(chot, new_cur[..., None], cursors)
+
+        trunc = op == 3
+        new_base = jnp.where(trunc,
+                             jnp.clip(jnp.maximum(base, a), 0, new_tail),
+                             base)
+        # retention: an append that laps the ring evicts the oldest offset
+        new_base = jnp.maximum(new_base, new_tail - Q)
+
+        reply_v = jnp.where(op == 1, tail,
+                            jnp.where(commit, new_cur,
+                                      jnp.where(trunc, new_base, 0)))
+        ok = (op == 0) | app | commit | trunc
+        code = jnp.where(ok, jnp.where(op == 0, 0, 1), -2)
+        reply = jnp.stack([code, jnp.where(ok, reply_v, -1)], axis=-1)
+        new_state = {"buf": buf, "tail": new_tail, "base": new_base,
+                     "cursors": cursors}
+        return new_state, reply
+
+    # -- one-shot window fold (engine batch path) --------------------------
+
+    def jit_apply_batch(self, meta, commands, mask, state):
+        # fast only for noop/append windows (the firehose steady state);
+        # cursor commits and truncates read evolving state in order
+        fast_ok = ~jnp.any(mask & (commands[..., 0] >= 2))
+        return self.window_fold_dispatch(meta, commands, mask, state,
+                                         fast_ok)
+
+    def _batch_fast(self, commands, mask, state):
+        """Vectorized append-only window fold."""
+        Q = self.capacity
+        op = jnp.where(mask, commands[..., 0], 0)           # [..., A]
+        val = commands[..., 1]
+        app = (op == 1) & (val >= 0)
+        rank = jnp.cumsum(app.astype(_I32), axis=-1) \
+            - app.astype(_I32)                               # exclusive
+        n_app = jnp.sum(app.astype(_I32), axis=-1)
+        tail = state["tail"]
+
+        # scatter-free ring write (see jit_fifo._batch_fast): written
+        # slots are offsets tail0..tail0+n_app-1; when A > Q several
+        # appends alias one slot mod Q and only the LAST survives, so
+        # each slot selects the maximal aliasing rank
+        qr = jnp.arange(Q)
+        jd = jnp.mod(qr - tail[..., None], Q)                # [..., Q]
+        written = jd < n_app[..., None]
+        rank_win = jd + Q * ((n_app[..., None] - 1 - jd) // Q)
+        onehot = (app[..., None, :] &
+                  (rank[..., None, :] == rank_win[..., None])
+                  ).astype(jnp.float32)                      # [..., Q, A]
+        placed = place16(onehot, val)
+
+        new_tail = tail + n_app
+        new_state = dict(state)
+        new_state["buf"] = jnp.where(written, placed, state["buf"])
+        new_state["tail"] = new_tail
+        new_state["base"] = jnp.maximum(state["base"], new_tail - Q)
+        return new_state
+
+    # -- vectorized read path (ISSUE 20) -----------------------------------
+
+    def jit_query(self, queries, state):
+        # queries: [..., Kr, 2]; state buf [..., Q], tail/base [...],
+        # cursors [..., G] — pure gathers, no state mutation (consumer
+        # replay reads never enter the log)
+        Q, G = self.capacity, self.groups
+        op = queries[..., 0]
+        a = queries[..., 1]
+        tail = state["tail"][..., None]                      # [..., 1]
+        base = state["base"][..., None]
+
+        off_ok = (a >= base) & (a < tail)
+        slot = jnp.mod(jnp.clip(a, 0, None), Q)
+        val = jnp.take_along_axis(state["buf"][..., None, :],
+                                  slot[..., None], axis=-1)[..., 0]
+        g_ok = (a >= 0) & (a < G)
+        g = jnp.clip(a, 0, G - 1)
+        cur = jnp.take_along_axis(state["cursors"][..., None, :],
+                                  g[..., None], axis=-1)[..., 0]
+
+        code = jnp.where(op == 0, tail,
+                         jnp.where(op == 1, off_ok.astype(_I32),
+                                   g_ok.astype(_I32)))
+        value = jnp.where(op == 0, base,
+                          jnp.where(op == 1,
+                                    jnp.where(off_ok, val, -1),
+                                    jnp.where(g_ok, cur, -1)))
+        return jnp.stack([code, value], axis=-1)
+
+    # -- host protocol -----------------------------------------------------
+
+    def encode_command(self, command):
+        try:
+            if isinstance(command, tuple) and command:
+                kind = command[0]
+                if kind == "append" and len(command) == 2:
+                    return jnp.asarray([1, int(command[1]), 0], _I32)
+                if kind == "commit" and len(command) == 3:
+                    return jnp.asarray([2, int(command[1]),
+                                        int(command[2])], _I32)
+                if kind == "truncate" and len(command) == 2:
+                    return jnp.asarray([3, int(command[1]), 0], _I32)
+        except (TypeError, ValueError, OverflowError):
+            pass
+        return jnp.zeros((3,), _I32)
+
+    def decode_reply(self, reply):
+        code, val = int(reply[..., 0]), int(reply[..., 1])
+        return (code, None if val < 0 else val)
+
+    def encode_query(self, query):
+        try:
+            if isinstance(query, tuple) and query:
+                kind = query[0]
+                if kind == "read" and len(query) == 2:
+                    return jnp.asarray([1, int(query[1])], _I32)
+                if kind == "cursor" and len(query) == 2:
+                    return jnp.asarray([2, int(query[1])], _I32)
+        except (TypeError, ValueError, OverflowError):
+            pass
+        return jnp.zeros((2,), _I32)  # bounds()
+
+    def decode_query_reply(self, reply):
+        code, val = int(reply[..., 0]), int(reply[..., 1])
+        return (code, None if val < 0 else val)
+
+
+def query_bounds(state) -> tuple:
+    """(base, tail) readable-offset window (host-path query fun)."""
+    return (int(state["base"]), int(state["tail"]))

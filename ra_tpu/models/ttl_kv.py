@@ -1,0 +1,204 @@
+"""TtlKvMachine — a Khepri-shaped tree/TTL store on the device apply path.
+
+Khepri (the RabbitMQ metadata store built on ra) layers two things on a
+plain KV machine: entries that EXPIRE and clients that WATCH keys.  This
+machine is the lane-engine counterpart serving the ISSUE 20 read plane:
+a fixed key space of ``n_keys`` cells per lane where every cell carries a
+value, an absolute expiry deadline, and a watcher count — all dense
+int32 arrays, so both the apply fold and the vectorized query kernel
+stay shape-stable.
+
+Time is LOGICAL: the machine's clock is the raft index of the last
+applied command (``meta["index"]``), the one monotone counter every
+replica already agrees on.  A ``put`` with ``ttl > 0`` stamps
+``exp = clock + ttl``; expiry is LAZY — nothing sweeps the table, a
+cell is simply absent once ``clock >= exp`` (reads and subsequent
+writes observe the expiry; deterministic across replicas because the
+clock is the log position, not wall time).  ``ttl <= 0`` means no
+expiry (``exp = 0`` sentinel).
+
+Absence is ``val == -1`` (stored values must be >= 0, as in jit_kv).
+
+Command encoding (command_spec int32[4]): ``[op, key, value, ttl]``
+
+  op 0 noop                 (term-opening entry)
+  op 1 put(key, value, ttl) reply [1, old]       (old -1 if absent/expired)
+  op 2 get(key)             reply [present, value]
+  op 3 delete(key)          reply [present, old]
+  op 4 watch(key)           reply [1, watchers]  (registration count)
+
+Reply is int32[2].  A key outside [0, n_keys) degrades the command to a
+no-op with reply [-2, -1].
+
+Query encoding (query_spec int32[2]): ``[op, key]`` — the ISSUE 20
+vectorized read path, evaluated at the serve watermark with NO log
+append:
+
+  op 0 size()         reply [n_live, clock]
+  op 1 get(key)       reply [present, value]    (expired -> [0, -1])
+  op 2 watchers(key)  reply [1, count]
+
+Batch apply takes the universal in-order sequential fold (puts with
+TTLs are index-dependent — each command's expiry stamps its OWN raft
+index, so a last-writer-wins collapse would mis-stamp deadlines).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.machine import JitMachine
+
+_I32 = jnp.int32
+
+
+class TtlKvMachine(JitMachine):
+    command_spec = ("int32", (4,))
+    reply_spec = ("int32", (2,))
+    query_spec = ("int32", (2,))
+    query_reply_spec = ("int32", (2,))
+    version = 0
+    #: sound because the default jit_apply_batch IS the in-order masked
+    #: sequential fold — no vectorized fast path (see module docstring)
+    supports_batch_apply = True
+
+    def __init__(self, n_keys: int = 64) -> None:
+        self.n_keys = n_keys
+
+    def jit_init(self, n_lanes: int):
+        N, S = n_lanes, self.n_keys
+        return {
+            "vals": jnp.full((N, S), -1, _I32),   # -1 = absent
+            "exp": jnp.zeros((N, S), _I32),       # 0 = never expires
+            "watch": jnp.zeros((N, S), _I32),
+            "clock": jnp.zeros((N,), _I32),       # last applied raft index
+        }
+
+    @staticmethod
+    def _live(vals, exp, clock):
+        # lazy expiry: a cell is live while unexpired (exp 0 = forever)
+        return (vals >= 0) & ((exp == 0) | (exp > clock[..., None]))
+
+    def jit_apply(self, meta, command, state):
+        S = self.n_keys
+        op = command[..., 0]
+        raw_key = command[..., 1]
+        value = command[..., 2]
+        ttl = command[..., 3]
+        key_ok = (raw_key >= 0) & (raw_key < S)
+        key = jnp.clip(raw_key, 0, S - 1)
+
+        clock = jnp.maximum(state["clock"], meta["index"].astype(_I32))
+        vals, exp, watch = state["vals"], state["exp"], state["watch"]
+        live = self._live(vals, exp, clock)
+
+        cur = jnp.take_along_axis(vals, key[..., None], axis=-1)[..., 0]
+        cur_live = jnp.take_along_axis(live, key[..., None],
+                                       axis=-1)[..., 0]
+        cur = jnp.where(cur_live, cur, -1)
+        present = cur_live.astype(_I32)
+        n_watch = jnp.take_along_axis(watch, key[..., None],
+                                      axis=-1)[..., 0]
+
+        val_bad = (op == 1) & (value < 0)
+        put = (op == 1) & key_ok & ~val_bad
+        dele = (op == 3) & key_ok
+        wreg = (op == 4) & key_ok
+
+        new_exp = jnp.where(ttl > 0, clock + ttl, 0)
+        onehot = jnp.arange(S) == key[..., None]
+        vals = jnp.where(onehot & put[..., None], value[..., None],
+                         jnp.where(onehot & dele[..., None], -1, vals))
+        exp = jnp.where(onehot & put[..., None], new_exp[..., None], exp)
+        watch = watch + (onehot & wreg[..., None]).astype(_I32)
+
+        code = jnp.where(put | wreg, 1,
+                         jnp.where((op == 2) | dele, present, 0))
+        val_out = jnp.where(wreg, n_watch + 1, cur)
+        bad = ((op > 0) & ~key_ok) | val_bad
+        code = jnp.where(bad, -2, code)
+        reply = jnp.stack([code, jnp.where(bad, -1, val_out)], axis=-1)
+        new_state = {"vals": vals, "exp": exp, "watch": watch,
+                     "clock": clock}
+        return new_state, reply
+
+    # -- vectorized read path (ISSUE 20) -----------------------------------
+
+    def jit_query(self, queries, state):
+        # queries: [..., Kr, 2]; state arrays: vals/exp/watch [..., S],
+        # clock [...] — pure gathers against the logical clock, no
+        # state mutation (reads never enter the log, expiry stays lazy)
+        S = self.n_keys
+        op = queries[..., 0]
+        raw_key = queries[..., 1]
+        key_ok = (raw_key >= 0) & (raw_key < S)
+        key = jnp.clip(raw_key, 0, S - 1)
+        live = self._live(state["vals"], state["exp"],
+                          state["clock"])                    # [..., S]
+        val = jnp.take_along_axis(state["vals"][..., None, :],
+                                  key[..., None], axis=-1)[..., 0]
+        is_live = jnp.take_along_axis(live[..., None, :],
+                                      key[..., None], axis=-1)[..., 0]
+        n_w = jnp.take_along_axis(state["watch"][..., None, :],
+                                  key[..., None], axis=-1)[..., 0]
+        present = key_ok & is_live
+        n_live = jnp.sum(live.astype(_I32), axis=-1)[..., None]
+        code = jnp.where(op == 0, n_live,
+                         jnp.where(op == 2, key_ok.astype(_I32),
+                                   present.astype(_I32)))
+        value = jnp.where(op == 0, state["clock"][..., None],
+                          jnp.where(op == 2, jnp.where(key_ok, n_w, -1),
+                                    jnp.where(present, val, -1)))
+        return jnp.stack([code, value], axis=-1)
+
+    # -- host protocol -----------------------------------------------------
+
+    def encode_command(self, command):
+        try:
+            if isinstance(command, tuple) and command:
+                kind = command[0]
+                if kind == "put" and len(command) in (3, 4):
+                    ttl = int(command[3]) if len(command) == 4 else 0
+                    return jnp.asarray(
+                        [1, int(command[1]), int(command[2]), ttl], _I32)
+                if kind == "get" and len(command) == 2:
+                    return jnp.asarray([2, int(command[1]), 0, 0], _I32)
+                if kind == "delete" and len(command) == 2:
+                    return jnp.asarray([3, int(command[1]), 0, 0], _I32)
+                if kind == "watch" and len(command) == 2:
+                    return jnp.asarray([4, int(command[1]), 0, 0], _I32)
+        except (TypeError, ValueError, OverflowError):
+            pass
+        return jnp.zeros((4,), _I32)
+
+    def decode_reply(self, reply):
+        code, val = int(reply[..., 0]), int(reply[..., 1])
+        return (code, None if val < 0 else val)
+
+    def encode_query(self, query):
+        try:
+            if isinstance(query, tuple) and query:
+                kind = query[0]
+                if kind == "get" and len(query) == 2:
+                    return jnp.asarray([1, int(query[1])], _I32)
+                if kind == "watchers" and len(query) == 2:
+                    return jnp.asarray([2, int(query[1])], _I32)
+        except (TypeError, ValueError, OverflowError):
+            pass
+        return jnp.zeros((2,), _I32)  # size()
+
+    def decode_query_reply(self, reply):
+        code, val = int(reply[..., 0]), int(reply[..., 1])
+        return (code, None if val < 0 else val)
+
+
+def query_live(state) -> dict:
+    """Query fun: live (unexpired) keys as a plain dict (host path)."""
+    import numpy as np
+    vals = np.asarray(state["vals"])
+    exp = np.asarray(state["exp"])
+    clock = int(np.asarray(state["clock"]))
+    out = {}
+    for k, (v, e) in enumerate(zip(vals, exp)):
+        if v >= 0 and (e == 0 or e > clock):
+            out[int(k)] = int(v)
+    return out

@@ -83,7 +83,9 @@ def state_shardings(mesh: Mesh, state: LaneState) -> LaneState:
             specs[name] = jax.tree.map(
                 lambda l: by_shape(l, member_axis=False), leaf)
             continue
-        member_axis = name != "ring"
+        # ring [N,R,C] and read_buf [N,Kr,Cq] are LANE-local planes:
+        # axis 1 is ring depth / pending-read slots, never members
+        member_axis = name not in ("ring", "read_buf")
         specs[name] = by_shape(leaf, member_axis=member_axis)
     return LaneState(mac=mac_specs, **specs)
 
@@ -141,6 +143,8 @@ def superstep_block_shardings(mesh: Mesh) -> dict:
       n_new    int32[K, N]        -> P(None, 'lanes')
       payloads [K, N, Kc, C]      -> P(None, 'lanes', None, None)
       query    bool[K, N]         -> P(None, 'lanes')
+      n_read   int32[K, N]        -> P(None, 'lanes')
+      read_q   [K, N, Kr, Cq]     -> P(None, 'lanes', None, None)
 
     No ``elect`` entry on purpose: elect schedules are HOST data —
     the engine keeps any-election bookkeeping on the host
@@ -156,6 +160,8 @@ def superstep_block_shardings(mesh: Mesh) -> dict:
         "n_new": vec,
         "payloads": NamedSharding(mesh, P(None, "lanes", None, None)),
         "query": vec,
+        "n_read": vec,
+        "read_q": NamedSharding(mesh, P(None, "lanes", None, None)),
     }
 
 

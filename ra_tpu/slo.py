@@ -75,7 +75,8 @@ class Objective:
 
 def default_objectives(*, commit_p99_ms: float = 25.0,
                        fsync_p99_ms: float = 50.0,
-                       min_cmds_per_s: float = 1000.0) -> tuple:
+                       min_cmds_per_s: float = 1000.0,
+                       read_p99_ms: float = 10.0) -> tuple:
     """The standard lane-engine objective set (docs/OBSERVABILITY.md
     "SLOs"): commit latency from the always-on phase attribution,
     fsync latency from the per-shard WAL stats, a throughput floor
@@ -85,7 +86,14 @@ def default_objectives(*, commit_p99_ms: float = 25.0,
     over any window must stay 0 — the runtime twin of static gate
     RA13.  Absent devicewatch wiring the key never appears and the
     objective reads ``no_data`` (which is ok), so classic-plane
-    deployments are unaffected."""
+    deployments are unaffected.
+
+    ``read_p99_ms`` (ISSUE 20) ceilings the read plane's submit→serve
+    latency from the ``read_e2e`` phase (stamped only for dispatches
+    that served reads); on a write-only engine the key never appears
+    and the objective reads ``no_data``.  Its verdict is the read half
+    of the ladder bias: ingress sheds reads outright at any tightened
+    level, so a read_p99 breach never delays the write plane."""
     return (
         Objective("commit_p99_ms",
                   "engine_phases_commit_e2e_p99_ms", "<=", commit_p99_ms),
@@ -96,6 +104,8 @@ def default_objectives(*, commit_p99_ms: float = 25.0,
                   min_cmds_per_s, kind="rate", agg="sum"),
         Objective("steady_state_recompiles",
                   "device_recompiles", "<=", 0.0, kind="rate"),
+        Objective("read_p99_ms",
+                  "engine_phases_read_e2e_p99_ms", "<=", read_p99_ms),
     )
 
 

@@ -136,6 +136,18 @@ class PhaseStats:
         out["dropped"] = self.dropped
         return out
 
+    def reset_reservoirs(self) -> None:
+        """Clear the percentile reservoirs (p50/p99/max) while keeping
+        the MONOTONE fields (count, total_ms, hist) monotone — a
+        measurement-phase boundary for bench harnesses (ISSUE 20):
+        warmup/compile samples must not sit in a measured phase's p99
+        tail, but the Observatory ring's rate differentiation over
+        ``total_ms``/``count`` must never see a counter reset.  A
+        barrier-side call, never the hot path."""
+        with self._lock:
+            for p in self._fields:
+                self._res[p].clear()
+
     def encode_share_pct(self) -> float:
         """Codec encode time as a percentage of ALL phase time this
         accumulator has seen (ISSUE 18) — the lower-better bench-tail
@@ -220,7 +232,9 @@ class TelemetrySampler:
 
     def _start_sample(self) -> None:
         st = self.engine.state
-        out = self._fn(st.telem, st.total_committed)
+        out = self._fn(st.telem, st.total_committed,
+                       (st.read_served, st.read_shed, st.read_stale,
+                        st.read_leased))
         for v in out.values():
             try:
                 v.copy_to_host_async()
@@ -426,6 +440,11 @@ class Observatory:
             # flow gauges as their own source, so ring keys read
             # ``ingress_<field>`` (the SLO/bench_diff namespace)
             obs.add_source("ingress", ing.overview)
+            if getattr(ing, "reads_enabled", False):
+                # the read lane (ISSUE 20): READ_FIELDS counters +
+                # lease coverage as ring keys ``read_<field>`` (the
+                # ra_top read panel's namespace)
+                obs.add_source("read", ing.read_overview)
         # the device plane (ISSUE 16): recompile sentinel + transfer
         # ledger + memory watermarks as their own source — ring keys
         # read ``device_<field>`` (DEVICE_FIELDS; the namespace the

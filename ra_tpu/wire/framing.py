@@ -83,6 +83,8 @@ __all__ = [
     "encode_data", "decode_data", "encode_credit",
     "decode_credit", "encode_ack", "decode_ack",
     "encode_rehome", "decode_rehome", "read_frame",
+    "T_READ", "T_READ_REPLY", "read_reply_dtype",
+    "encode_read", "encode_read_reply", "decode_read_reply",
     "OK", "SLOW", "DEFER", "REJECT", "DUP", "SHED", "STATUS_NAMES",
 ]
 
@@ -99,6 +101,14 @@ T_CREDIT = 4
 T_ACK = 5
 T_ERR = 6
 T_REHOME = 7
+#: consistent read (ISSUE 20).  A READ record shares the DATA stride
+#: and dtype — the type column distinguishes it — so the server's ONE
+#: frombuffer sweep still holds for a mixed read/write stream; the
+#: encoded query rides the leading ``pay`` columns (zero-padded to the
+#: connection's C).  Reads never enter the log: they answer with a
+#: READ_REPLY at a certified watermark instead of an ACK.
+T_READ = 8
+T_READ_REPLY = 9
 
 #: ERR frame codes
 E_VERSION = 1        # HELLO version byte != WIRE_VERSION
@@ -280,6 +290,68 @@ def decode_ack(body: bytes) -> np.ndarray:
     if t != T_ACK:
         raise ValueError(f"not an ACK frame (type {t})")
     return np.frombuffer(body, ack_dtype, count, _ACK_HDR.size)
+
+
+# -- consistent reads (ISSUE 20) --------------------------------------------
+
+def read_reply_dtype(reply_width: int) -> np.dtype:
+    """Packed READ_REPLY record: one served/refused read outcome.
+    ``wm`` is the commit watermark the read was served at (-1 when the
+    read was refused — ``status`` then carries the ladder verdict or
+    the stale-refusal marker)."""
+    return np.dtype([("sess", "<u2"), ("seqno", "<u8"), ("status", "u1"),
+                     ("wm", "<i4"), ("pay", "<i4", (int(reply_width),))])
+
+
+_READ_REPLY_HDR = struct.Struct("<BBHH")  # type, width, pad, count
+
+
+def encode_read(sess, seqnos, queries, *, payload_width: int) -> bytes:
+    """Encode a batch of consistent-read queries at the connection's
+    DATA stride (type=T_READ; query columns zero-padded to C) — one
+    structured-array fill, no per-record Python, and the server's
+    single fixed-stride sweep stays intact."""
+    queries = np.asarray(queries)
+    if queries.ndim == 1:
+        queries = queries[:, None]
+    n, cq = queries.shape
+    if cq > payload_width:
+        raise ValueError(
+            f"query width {cq} exceeds negotiated payload width "
+            f"{payload_width}")
+    rec = np.zeros(n, data_dtype(payload_width))
+    rec["len"] = rec.dtype.itemsize - 4
+    rec["type"] = T_READ
+    rec["sess"] = np.asarray(sess)
+    rec["seqno"] = np.asarray(seqnos)
+    rec["pay"][:, :cq] = queries
+    return rec.tobytes()
+
+
+def encode_read_reply(sess, seqnos, statuses, wms, payloads) -> bytes:
+    """Serialize served/refused read outcomes as one READ_REPLY frame
+    (vectorized records under a small header, like CREDIT/ACK)."""
+    payloads = np.asarray(payloads)
+    if payloads.ndim == 1:
+        payloads = payloads[:, None]
+    n, w = payloads.shape
+    rec = np.zeros(n, read_reply_dtype(w))
+    rec["sess"] = np.asarray(sess)
+    rec["seqno"] = np.asarray(seqnos)
+    rec["status"] = np.asarray(statuses)
+    rec["wm"] = np.asarray(wms)
+    rec["pay"] = payloads
+    body = _READ_REPLY_HDR.pack(T_READ_REPLY, w, 0, n) + rec.tobytes()
+    return _LEN.pack(len(body)) + body
+
+
+def decode_read_reply(body: bytes) -> np.ndarray:
+    """READ_REPLY body -> records (vectorized client-side decode)."""
+    t, width, _p, count = _READ_REPLY_HDR.unpack_from(body)
+    if t != T_READ_REPLY:
+        raise ValueError(f"not a READ_REPLY frame (type {t})")
+    return np.frombuffer(body, read_reply_dtype(width), count,
+                         _READ_REPLY_HDR.size)
 
 
 def read_frame(buf: bytes, offset: int = 0):

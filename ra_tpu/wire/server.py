@@ -48,10 +48,11 @@ import numpy as np
 
 from ..blackbox import record
 from ..metrics import WIRE_FIELDS
-from .framing import (E_PAYLOAD_WIDTH, E_VERSION, SHED, T_DATA,
-                      WIRE_VERSION, ack_dtype, credit_dtype,
-                      data_stride, decode_hello, encode_error,
-                      encode_hello_ack, encode_rehome)
+from .framing import (E_PAYLOAD_WIDTH, E_VERSION, SHED, T_DATA, T_READ,
+                      T_READ_REPLY, WIRE_VERSION, ack_dtype,
+                      credit_dtype, data_stride, decode_hello,
+                      encode_error, encode_hello_ack, encode_rehome,
+                      read_reply_dtype)
 
 _LEN = struct.Struct("<I")
 
@@ -142,6 +143,10 @@ class WireListener:
         #: tuples drained via collect_rehome_hints() — the in-process
         #: twin of the TCP T_REHOME frame (ISSUE 19)
         self._lb_rehome: list = []
+        #: loopback READ_REPLY outbox (ISSUE 20): (conn ids, per-conn
+        #: row counts, records) drained via collect_read_replies() —
+        #: the in-process twin of the TCP T_READ_REPLY frame
+        self._lb_read: list = []
         #: serving-path placement view (ISSUE 19): a revision-monotone
         #: PlacementCache + the engine ids served HERE; None = every
         #: lane is local (the single-host default)
@@ -167,6 +172,19 @@ class WireListener:
         self._base_sorted = np.zeros(0, np.int64)
         self._base_slot = np.zeros(0, np.int64)
         plane.on_block_committed = self._on_block_committed
+        # the read plane (ISSUE 20): READ records ride the DATA stride,
+        # so the encoded query must fit the negotiated payload columns
+        self._query_width = int(getattr(eng, "query_width", 1))
+        self._reply_width = int(getattr(eng, "query_reply_width", 1))
+        self._reads_enabled = bool(getattr(plane, "reads_enabled",
+                                           False))
+        if self._reads_enabled:
+            if self._query_width > self.payload_width:
+                raise ValueError(
+                    f"query width {self._query_width} exceeds the "
+                    f"wire payload width {self.payload_width}: READ "
+                    "records cannot carry this machine's queries")
+            plane.on_reads_done = self._on_reads_served
         self._sock = None
         self._thread = None
         self._stop = False
@@ -886,8 +904,13 @@ class WireListener:
         flat = recs[valid]
         rec = flat.view(self._rec_dtype())[:, 0]
         conn_of = np.repeat(active, counts)
-        ok = (rec["len"] == r - 4) & (rec["type"] == T_DATA) \
+        # READ records share the DATA stride — ONE frombuffer sweep
+        # covers the mixed stream, the type column splits it (ISSUE 20)
+        is_read = rec["type"] == T_READ
+        wf = (rec["len"] == r - 4) \
+            & ((rec["type"] == T_DATA) | is_read) \
             & (rec["sess"].astype(np.int64) < self.nsess[conn_of])
+        ok = wf & ~is_read
         with self._lock:
             # a conn closed/killed between the snapshot and here has
             # had its ring RESET — advancing it would drive rfill
@@ -898,35 +921,47 @@ class WireListener:
             self.rhead[a] = (head[live] + counts[live] * r) % b
             self.rfill[a] = np.maximum(
                 self.rfill[a] - counts[live] * r, 0)
-        if not ok.all():
+        if not wf.all():
             # AFTER the ring advance: closing resets the slot's ring
-            self._protocol_errors(np.unique(conn_of[~ok]),
-                                  int((~ok).sum()))
+            self._protocol_errors(np.unique(conn_of[~wf]),
+                                  int((~wf).sum()))
         sess = rec["sess"].astype(np.int64)
         handles = self.hbase[conn_of] + sess
         seqnos = rec["seqno"].astype(np.int64)
-        if self._placement is not None and ok.any():
+        if self._placement is not None and wf.any():
             # placement staleness gate (ISSUE 19): rows whose lane
             # moved to a foreign engine get a typed REHOME hint, not a
             # submit — they earn neither credit nor a shed verdict
             # (the client re-sends them at the new home after
-            # following the hint)
-            stale = self._stale_rows(handles, ok)
+            # following the hint).  Reads rehome too: a consistent
+            # read served by a stale home would read a frozen lane
+            stale = self._stale_rows(handles, wf)
             if stale is not None and stale.any():
                 self._send_rehome(conn_of, handles, stale)
+                wf &= ~stale
                 ok &= ~stale
+        rd = wf & is_read
         status = np.full(len(rec), SHED, np.int8)
         if ok.any():
             status[ok] = self.plane.submit(handles[ok], seqnos[ok],
                                            rec["pay"][ok])
+        if rd.any():
+            # the verdict here is ADMISSION only (ladder bias: reads
+            # shed first under load); served/refused outcomes fan back
+            # later as READ_REPLY records off the settlement hook
+            status[rd] = self.plane.submit_reads(
+                handles[rd], seqnos[rd],
+                rec["pay"][rd][:, :self._query_width])
+            self.counters["read_rows"] += int(rd.sum())
         self.counters["sweeps"] += 1
         self.counters["swept_rows"] += int(ok.sum())
         # malformed rows are protocol errors, NOT shed verdicts: only
-        # real rows feed the credit histogram and the credit frames
-        self._note_statuses(status[ok])
-        self._send_credit(conn_of[ok], sess[ok], seqnos[ok],
-                          status[ok])
-        return int(ok.sum())
+        # real rows feed the credit histogram and the credit frames —
+        # reads join the SAME credit fan-out (one verdict stream)
+        self._note_statuses(status[wf])
+        self._send_credit(conn_of[wf], sess[wf], seqnos[wf],
+                          status[wf])
+        return int(wf.sum())
 
     def _rec_dtype(self):
         from .framing import data_dtype
@@ -1072,6 +1107,75 @@ class WireListener:
     def _ack_frame(rec: np.ndarray) -> bytes:
         body = struct.pack("<BBHH", 5, 0, 0, len(rec)) + rec.tobytes()
         return _LEN.pack(len(body)) + body
+
+    # ------------------------------------------------------------------
+    # read replies — served/refused reads off the plane's settlement
+    # ------------------------------------------------------------------
+
+    def _on_reads_served(self, handles, seqnos, statuses, wms,
+                         payloads) -> None:
+        """IngressPlane read-settlement hook (ISSUE 20): fan READ_REPLY
+        records out per connection — the same searchsorted handle-base
+        lookup as the ack path, driven by the driver's EXISTING async
+        read-aux readbacks (no new host syncs).  ``wm`` carries the
+        certified commit watermark each read was served at (-1 on a
+        shed/stale refusal)."""
+        if self._base_dirty:
+            live = np.flatnonzero(self.cstate == _S_DATA)
+            order = np.argsort(self.hbase[live], kind="stable")
+            self._base_slot = live[order]
+            self._base_sorted = self.hbase[self._base_slot]
+            self._base_dirty = False
+        if not len(self._base_slot) or not len(handles):
+            return
+        handles = np.asarray(handles, np.int64)
+        pos = np.searchsorted(self._base_sorted, handles,
+                              side="right") - 1
+        pos = np.clip(pos, 0, len(self._base_sorted) - 1)
+        conns = self._base_slot[pos]
+        in_range = (handles >= self._base_sorted[pos]) & \
+            (handles < self._base_sorted[pos] + self.nsess[conns])
+        if not in_range.any():
+            return
+        conns = conns[in_range]
+        order = np.argsort(conns, kind="stable")
+        conns = conns[order]
+        keep_ix = np.flatnonzero(in_range)[order]
+        w = self._reply_width
+        rec = np.zeros(len(conns), read_reply_dtype(w))
+        rec["sess"] = handles[keep_ix] - self.hbase[conns]
+        rec["seqno"] = np.asarray(seqnos)[keep_ix]
+        rec["status"] = np.asarray(statuses)[keep_ix]
+        rec["wm"] = np.asarray(wms)[keep_ix]
+        pay = np.asarray(payloads)[keep_ix]
+        rec["pay"][:, :pay.shape[1]] = pay[:, :w]
+        self.counters["read_reply_rows"] += len(rec)
+        runs, counts = self._runs(conns)
+        lb = self._is_lb[runs]
+        if lb.any():
+            keep = np.repeat(lb, counts)
+            self._lb_read.append((runs[lb], counts[lb], rec[keep]))
+        if (~lb).any():
+            bounds = np.cumsum(counts)
+            starts = bounds - counts
+            for i in np.flatnonzero(~lb):  # ra09-ok: per-CONNECTION socket write (one READ_REPLY frame/syscall per conn, never per read)
+                self._send_frame_to(
+                    int(runs[i]),
+                    self._read_reply_frame(rec[starts[i]:bounds[i]]))
+
+    def _read_reply_frame(self, rec: np.ndarray) -> bytes:
+        body = struct.pack("<BBHH", T_READ_REPLY, self._reply_width, 0,
+                           len(rec)) + rec.tobytes()
+        return _LEN.pack(len(body)) + body
+
+    def collect_read_replies(self) -> list:
+        """Drain the loopback READ_REPLY outbox: a list of (conn ids,
+        per-conn row counts, records) tuples, records typed
+        ``read_reply_dtype(reply_width)`` (the in-process twin of the
+        TCP frame — the fleet/bench harvests replies here)."""
+        with self._lock:
+            out, self._lb_read = self._lb_read, []
+        return out
 
     # ------------------------------------------------------------------
     # observability

@@ -602,3 +602,44 @@ def test_bench_diff_compares_ingress_keys(tmp_path):
     r = subprocess.run([sys.executable, diff_tool, str(a), str(b)],
                        capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_bench_diff_compares_read_keys(tmp_path):
+    """ISSUE 20 satellite: when both tails carry the read-frontier keys
+    (the `bench.py --reads` capture format, pinned here), bench_diff
+    flags read-throughput drops, read_p99 rises, shed-rate rises AND
+    stale refusals appearing from a healthy 0; the -1 "no reads ran"
+    latency sentinel is skipped; tails without the keys keep comparing
+    exactly as before."""
+    diff_tool = os.path.join(REPO, "tools", "bench_diff.py")
+    base = {"value": 25_000.0, "read_cmds_per_s": 25_000.0,
+            "read_p99_ms": 4.0, "read_shed_rate": 0.0,
+            "read_stale_refused": 0.0}
+    a = tmp_path / "old.json"
+    b = tmp_path / "new.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(base))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b),
+                        "--json"], capture_output=True, text=True,
+                       timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    res = json.loads(r.stdout)
+    metrics = [f["metric"] for f in res["rows"]["headline"]]
+    assert "read_cmds_per_s" in metrics
+    assert "read_p99_ms" in metrics
+    assert "read_shed_rate" in metrics
+    assert "read_stale_refused" in metrics
+    worse = {"value": 25_000.0, "read_cmds_per_s": 15_000.0,
+             "read_p99_ms": 9.0, "read_shed_rate": 0.3,
+             "read_stale_refused": 12.0}
+    b.write_text(json.dumps(worse))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1, r.stdout
+    assert r.stdout.count("REGRESSION") == 4, r.stdout
+    # a write-only tail (read_p99_ms -1 sentinel, no read keys) still
+    # compares on what it has
+    b.write_text(json.dumps({"value": 25_000.0, "read_p99_ms": -1.0}))
+    r = subprocess.run([sys.executable, diff_tool, str(a), str(b)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr

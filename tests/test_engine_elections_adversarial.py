@@ -214,16 +214,27 @@ def test_mesh_sharded_election_fuzz():
                           quorum_fn=evaluate_quorum),
         in_shardings=(shardings, lane_sh, lane_sh,
                       NamedSharding(mesh, Pspec("lanes", "members")),
-                      lane_sh, lane_sh, lane_sh),
+                      lane_sh, lane_sh, lane_sh, lane_sh, lane_sh),
         out_shardings=(shardings,
                        {"appended_hi": lane_sh, "n_acc": lane_sh,
-                        "n_app": lane_sh}))
+                        "n_app": lane_sh,
+                        # the read-plane aux block (ISSUE 20) is
+                        # lane-major like everything else
+                        "read_done": lane_sh, "read_shed": lane_sh,
+                        "read_stale": lane_sh, "read_replies": lane_sh,
+                        "read_watermark": lane_sh,
+                        "read_served_lanes": lane_sh,
+                        "read_shed_lanes": lane_sh,
+                        "read_stale_lanes": lane_sh}))
 
     rng = np.random.default_rng(3)
     n_new = jnp.full((n_lanes,), k, jnp.int32)
     payloads = jnp.ones((n_lanes, k, 1), jnp.int32)
     confirm = jnp.zeros((n_lanes,), jnp.int32)
     query = jnp.zeros((n_lanes,), bool)
+    n_read = jnp.zeros((n_lanes,), jnp.int32)
+    read_q = jnp.zeros((n_lanes, eng.read_window, eng.query_width),
+                       eng.query_dtype)
     fail_host = np.zeros((n_lanes, n_members), bool)
 
     prev = jax.device_get(
@@ -247,7 +258,7 @@ def test_mesh_sharded_election_fuzz():
         # governed by the mask itself
         state, _aux = step(state, n_new, payloads,
                            jnp.asarray(fail_host), jnp.asarray(elect),
-                           confirm, query)
+                           confirm, query, n_read, read_q)
         cur = jax.device_get(
             {"term": state.term, "commit": state.commit,
              "total": state.total_committed})

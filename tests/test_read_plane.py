@@ -1,0 +1,522 @@
+"""Read-plane tests + the linearizable-read oracle (ISSUE 20).
+
+Two layers:
+
+* unit pins — lease grant/expiry, stale refusal under a partitioned
+  leader, ``read_lanes`` round trips over the query machine library,
+  checkpoint restore of the read counters;
+* :func:`run_read_oracle` — the chaos family ``tools/soak.py --reads``
+  drives: a host-side model machine folds the SAME committed command
+  history the engine applies, and every consistent read served across
+  election churn, leader kills, majority partitions and (optionally)
+  disk faults must equal the model's answer over the FULL committed
+  prefix — "a read at watermark W reflects every write committed
+  <= W".  A reply matching only an OLDER prefix is a stale serve and
+  the oracle's stale counter is pinned 0 (the device refusing a read
+  is always safe; serving stale never is).  Runs single-device and on
+  the sharded 8-way lane mesh.
+"""
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ra_tpu.engine import LockstepEngine
+from ra_tpu.models import (CounterMachine, JitKvMachine, StreamMachine,
+                           TtlKvMachine)
+
+N = 8      # lanes
+P = 3      # members
+K = 4      # cmds per traffic round
+
+
+def _zeros_step(eng, **kw):
+    zn = np.zeros((eng.n_lanes,), np.int32)
+    zp = np.zeros((eng.n_lanes, eng.max_step_cmds, eng.payload_width),
+                  np.dtype(eng.payload_dtype))
+    return eng.step(zn, zp, **kw)
+
+
+def _drain(eng, limit=64):
+    """Empty rounds until every lane's leader log is committed and
+    applied on every ACTIVE member (the drain_committed pattern)."""
+    lane = np.arange(eng.n_lanes)
+    for _ in range(limit):
+        st = eng.state
+        leads = np.asarray(st.leader_slot)
+        tail = np.asarray(st.last_index)[lane, leads]
+        com = np.asarray(st.commit)[lane, leads]
+        act = np.asarray(st.active)
+        app = np.where(act, np.asarray(st.applied),
+                       np.iinfo(np.int32).max).min(axis=1)
+        if (com >= tail).all() and (app >= com).all():
+            return
+        _zeros_step(eng)
+    raise AssertionError("read-plane drain did not converge")
+
+
+# ---------------------------------------------------------------------------
+# host model machines: exact folds of the committed command history
+# ---------------------------------------------------------------------------
+
+class _TtlModel:
+    """TtlKvMachine fold for ttl=0 command streams (put/delete/watch are
+    raft-index-independent then, so the model needs no logical clock)."""
+
+    def __init__(self, n_keys=8):
+        self.n_keys = n_keys
+        self.vals: dict = {}
+        self.watch: dict = {}
+
+    def apply(self, cmd) -> None:
+        op, key, val, _ttl = (int(x) for x in cmd)
+        if not (0 <= key < self.n_keys):
+            return
+        if op == 1 and val >= 0:
+            self.vals[key] = val
+        elif op == 3:
+            self.vals.pop(key, None)
+        elif op == 4:
+            self.watch[key] = self.watch.get(key, 0) + 1
+
+    def query(self, q) -> tuple:
+        op, key = int(q[0]), int(q[1])
+        ok = 0 <= key < self.n_keys
+        if op == 2:  # watchers(key)
+            return ((1, self.watch.get(key, 0)) if ok else (0, -1))
+        # get(key)
+        if ok and key in self.vals:
+            return (1, self.vals[key])
+        return (0, -1)
+
+
+class _StreamModel:
+    """StreamMachine fold: ring retention + monotone group cursors."""
+
+    def __init__(self, capacity=16, groups=4):
+        self.q, self.g = capacity, groups
+        self.buf: dict = {}
+        self.tail = 0
+        self.base = 0
+        self.cursors = [0] * groups
+
+    def apply(self, cmd) -> None:
+        op, a, b = (int(x) for x in cmd)
+        if op == 1 and a >= 0:
+            self.buf[self.tail] = a
+            self.tail += 1
+        elif op == 2 and 0 <= a < self.g:
+            self.cursors[a] = min(max(self.cursors[a], b, 0), self.tail)
+        elif op == 3:
+            self.base = min(max(self.base, a, 0), self.tail)
+        self.base = max(self.base, self.tail - self.q)
+
+    def query(self, q) -> tuple:
+        op, a = int(q[0]), int(q[1])
+        if op == 0:  # bounds()
+            return (self.tail, self.base)
+        if op == 1:  # read(offset)
+            if self.base <= a < self.tail:
+                return (1, self.buf[a])
+            return (0, -1)
+        if 0 <= a < self.g:  # cursor(g)
+            return (1, self.cursors[a])
+        return (0, -1)
+
+
+def _ttl_cmds(rng):
+    out = []
+    for _ in range(K):
+        r = rng.random()
+        key = rng.randrange(8)
+        if r < 0.5:
+            out.append((1, key, rng.randrange(100), 0))      # put, no ttl
+        elif r < 0.7:
+            out.append((3, key, 0, 0))                       # delete
+        else:
+            out.append((4, key, 0, 0))                       # watch
+    return out
+
+
+def _ttl_query(rng):
+    return (rng.choice([1, 2]), rng.randrange(-1, 9))
+
+
+def _stream_cmds(rng, tail):
+    out = []
+    for _ in range(K):
+        r = rng.random()
+        if r < 0.7:
+            out.append((1, rng.randrange(1, 100), 0))        # append
+        elif r < 0.9:
+            out.append((2, rng.randrange(4), rng.randrange(tail + 2)))
+        else:
+            out.append((3, rng.randrange(tail + 2), 0))      # truncate
+    return out
+
+
+def _stream_query(rng, tail):
+    r = rng.random()
+    if r < 0.3:
+        return (0, 0)                                        # bounds
+    if r < 0.8:
+        return (1, rng.randrange(-1, tail + 2))              # read(off)
+    return (2, rng.randrange(-1, 5))                         # cursor(g)
+
+
+_KINDS = {
+    "ttl_kv": (lambda: TtlKvMachine(n_keys=8), lambda: _TtlModel(8),
+               _ttl_cmds, _ttl_query, 4),
+    "stream": (lambda: StreamMachine(capacity=16, groups=4),
+               lambda: _StreamModel(16, 4),
+               _stream_cmds, _stream_query, 3),
+}
+
+
+# ---------------------------------------------------------------------------
+# the oracle
+# ---------------------------------------------------------------------------
+
+def run_read_oracle(seed, machine_kind="ttl_kv", *, mesh=False,
+                    durable_dir=None, disk_faults=False,
+                    rounds=16) -> dict:
+    """Chaos schedule with a consistent read wave every round.  Traffic
+    rounds fully drain before any nemesis fires, so the committed state
+    at every read is EXACTLY the model fold of the whole history — a
+    served reply must match it; matching only an older prefix counts as
+    a stale serve (pinned 0); refusing is always legal.  The final
+    healed wave must SERVE on every lane (liveness)."""
+    import random
+
+    rng = random.Random(seed)
+    make_machine, make_model, make_cmds, make_query, width = \
+        _KINDS[machine_kind]
+
+    if durable_dir is not None:
+        from ra_tpu.engine.durable import open_engine
+        eng = open_engine(make_machine(), durable_dir, N, P,
+                          wal_shards=2, ring_capacity=64,
+                          max_step_cmds=K, max_step_reads=4,
+                          lease_ttl=4, donate=False)
+    else:
+        eng = LockstepEngine(make_machine(), N, P, ring_capacity=64,
+                             max_step_cmds=K, max_step_reads=4,
+                             lease_ttl=4, donate=False)
+    if mesh:
+        import jax
+
+        from ra_tpu.parallel.mesh import lane_mesh, shard_engine_state
+        shard_engine_state(eng, lane_mesh(jax.devices(), member_axis=1))
+    plan = None
+    if disk_faults:
+        from ra_tpu.log import faults
+        plan = faults.DiskFaultPlan(
+            seed=seed, by_class={"wal": faults.DiskFaultSpec(
+                fsync_eio=0.05, short_write=0.02, limit=3)})
+        faults.install_plan(plan)
+
+    model = make_model()
+    snaps: list = [model]           # model state after each prefix
+    history: list = []
+    down: dict = {lane: set() for lane in range(N)}
+    last_wm = np.full((N,), -1, np.int32)
+    stats = {"served": 0, "refused": 0, "stale_serves": 0}
+
+    def snapshot_model():
+        import copy
+        return copy.deepcopy(snaps[-1])
+
+    def submit(cmds) -> None:
+        pay = np.zeros((N, K, width), np.int32)
+        for k, c in enumerate(cmds):
+            pay[:, k] = c
+        eng.step(np.full((N,), K, np.int32), jnp.asarray(pay))
+        _drain(eng)
+        for c in cmds:
+            history.append(c)
+            m = snapshot_model()
+            m.apply(c)
+            snaps.append(m)
+
+    def read_wave(must_refuse=None) -> None:
+        tail = snaps[-1].tail if machine_kind == "stream" else 0
+        qs = [(make_query(rng, tail) if machine_kind == "stream"
+               else make_query(rng)) for _ in range(N)]
+        replies, wm, ok = eng.read_lanes(
+            np.arange(N), np.asarray(qs, np.int32))
+        if must_refuse is not None:
+            assert not ok[must_refuse], (
+                f"lane {must_refuse} served a read past its lease while "
+                f"partitioned from quorum (seed={seed})")
+        for lane in range(N):
+            if not ok[lane]:
+                stats["refused"] += 1
+                continue
+            stats["served"] += 1
+            want = snaps[-1].query(qs[lane])
+            got = (int(replies[lane][0]), int(replies[lane][1]))
+            if got != want:
+                # distinguish stale serve from corruption for the
+                # failure message, then fail either way
+                if any(s.query(qs[lane]) == got for s in snaps[:-1]):
+                    stats["stale_serves"] += 1
+                assert got == want, (
+                    f"lane {lane} read {qs[lane]} -> {got}, model says "
+                    f"{want} (stale_serves={stats['stale_serves']}, "
+                    f"seed={seed}, kind={machine_kind})")
+            assert wm[lane] >= last_wm[lane], \
+                f"lane {lane} served watermark regressed"
+            last_wm[lane] = wm[lane]
+
+    try:
+        for _ in range(rounds):
+            roll = rng.random()
+            if roll < 0.45:
+                tail = snaps[-1].tail if machine_kind == "stream" else 0
+                submit(make_cmds(rng, tail) if machine_kind == "stream"
+                       else make_cmds(rng))
+            elif roll < 0.6:
+                # quorum-preserving member kill (leader kill included)
+                leads = np.asarray(eng.state.leader_slot)
+                for lane in range(N):
+                    if len(down[lane]) >= (P - 1) // 2:
+                        continue
+                    victim = rng.choice(
+                        [s for s in range(P) if s not in down[lane]])
+                    eng.fail_member(lane, victim)
+                    down[lane].add(victim)
+                    if victim == int(leads[lane]):
+                        eng.trigger_election([lane])
+            elif roll < 0.75:
+                # majority partition on ONE lane: its leader loses
+                # quorum entirely.  Burn past the lease horizon, then a
+                # read on that lane must REFUSE (a lease read never
+                # outlives lease expiry) while healthy lanes still
+                # serve.  Heal before the round ends so the next
+                # traffic round can commit everywhere.
+                lane = rng.randrange(N)
+                lead = int(np.asarray(eng.state.leader_slot)[lane])
+                cut = [s for s in range(P)
+                       if s != lead and s not in down[lane]]
+                for s in cut:
+                    eng.fail_member(lane, s)
+                for _ in range(3 * eng.lease_ttl):
+                    _zeros_step(eng)
+                read_wave(must_refuse=lane)
+                for s in cut:
+                    eng.recover_member(lane, s)
+                st = eng.state
+                if not np.asarray(st.active)[
+                        lane, int(np.asarray(st.leader_slot)[lane])]:
+                    eng.trigger_election([lane])
+                _drain(eng, limit=96)
+                continue
+            elif roll < 0.9:
+                leads = np.asarray(eng.state.leader_slot)
+                for lane in range(N):
+                    if down[lane]:
+                        slot = rng.choice(sorted(down[lane]))
+                        if slot != int(leads[lane]):
+                            eng.recover_member(lane, slot)
+                            down[lane].discard(slot)
+                _drain(eng, limit=96)
+            else:
+                healthy = [lane for lane in range(N) if not down[lane]]
+                if healthy:
+                    eng.trigger_election(healthy)
+            read_wave()
+
+        # heal everything, converge, and require liveness: every lane
+        # serves the final wave at the full model state
+        for _ in range(3):
+            leads = np.asarray(eng.state.leader_slot)
+            for lane in range(N):
+                for slot in sorted(down[lane]):
+                    if slot != int(leads[lane]):
+                        eng.recover_member(lane, slot)
+                        down[lane].discard(slot)
+            broken = [lane for lane in range(N) if down[lane]]
+            if broken:
+                eng.trigger_election(broken)
+        assert not any(down.values()), down
+        _drain(eng, limit=128)
+        qs = [(make_query(rng, snaps[-1].tail)
+               if machine_kind == "stream" else make_query(rng))
+              for _ in range(N)]
+        replies, _wm, ok = eng.read_lanes(
+            np.arange(N), np.asarray(qs, np.int32))
+        assert ok.all(), f"healed lanes refused reads: {np.where(~ok)[0]}"
+        for lane in range(N):
+            want = snaps[-1].query(qs[lane])
+            got = (int(replies[lane][0]), int(replies[lane][1]))
+            assert got == want, (lane, qs[lane], got, want)
+    finally:
+        if plan is not None:
+            from ra_tpu.log import faults
+            faults.clear_plan()
+    assert stats["stale_serves"] == 0, stats
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# unit pins
+# ---------------------------------------------------------------------------
+
+def test_read_lanes_round_trip_query_machines():
+    """Every query machine serves exact consistent reads post-commit."""
+    cases = [
+        (CounterMachine(), [(1,)] * 3, np.zeros((N, 1), np.int32),
+         lambda rep: (rep[:, 0] == 3).all()),
+        (JitKvMachine(n_keys=8),
+         [(1, 3, 77, 0)],                       # put(3, 77)
+         np.tile(np.asarray([[1, 3]], np.int32), (N, 1)),
+         lambda rep: (rep[:, 0] == 1).all() and (rep[:, 1] == 77).all()),
+        (TtlKvMachine(n_keys=8),
+         [(1, 2, 55, 0), (4, 2, 0, 0)],          # put + watch
+         np.tile(np.asarray([[2, 2]], np.int32), (N, 1)),
+         lambda rep: (rep[:, 0] == 1).all() and (rep[:, 1] == 1).all()),
+        (StreamMachine(capacity=8, groups=2),
+         [(1, 42, 0), (1, 43, 0)],               # append x2
+         np.tile(np.asarray([[1, 1]], np.int32), (N, 1)),
+         lambda rep: (rep[:, 0] == 1).all() and (rep[:, 1] == 43).all()),
+    ]
+    for machine, cmds, queries, check in cases:
+        eng = LockstepEngine(machine, N, P, ring_capacity=32,
+                             max_step_cmds=4, max_step_reads=4,
+                             lease_ttl=4, donate=False)
+        w = eng.payload_width
+        pay = np.zeros((N, 4, w), np.int32)
+        for k, c in enumerate(cmds):
+            pay[:, k, :len(c)] = c
+        eng.step(np.full((N,), len(cmds), np.int32), jnp.asarray(pay))
+        _drain(eng)
+        replies, wm, ok = eng.read_lanes(np.arange(N), queries)
+        assert ok.all(), type(machine).__name__
+        assert (wm >= 0).all()
+        assert check(replies), (type(machine).__name__, replies[:2])
+
+
+def test_partitioned_leader_refuses_after_lease_expiry():
+    """A leader cut from its majority must stop serving once the lease
+    horizon passes: the pending read settles as a STALE REFUSAL (the
+    device's read_stale counter advances), never a stale serve."""
+    eng = LockstepEngine(TtlKvMachine(n_keys=8), N, P, ring_capacity=32,
+                         max_step_cmds=4, max_step_reads=4,
+                         lease_ttl=4, donate=False)
+    pay = np.zeros((N, 4, 4), np.int32)
+    pay[:, 0] = (1, 1, 9, 0)
+    eng.step(np.full((N,), 1, np.int32), jnp.asarray(pay))
+    _drain(eng)
+    # partition lane 0's leader from both followers
+    lead = int(np.asarray(eng.state.leader_slot)[0])
+    for s in range(P):
+        if s != lead:
+            eng.fail_member(0, s)
+    # burn well past the lease so no grant survives registration
+    for _ in range(3 * eng.lease_ttl):
+        _zeros_step(eng)
+    stale0 = int(np.asarray(eng.state.read_stale)[0])
+    shed0 = int(np.asarray(eng.state.read_shed)[0])
+    replies, wm, ok = eng.read_lanes(
+        [0], np.asarray([[1, 1]], np.int32))
+    assert not ok[0], "partitioned leader served past its lease"
+    assert wm[0] == -1
+    stale1 = int(np.asarray(eng.state.read_stale)[0])
+    shed1 = int(np.asarray(eng.state.read_shed)[0])
+    assert stale1 + shed1 > stale0 + shed0
+    # heal: recover followers, re-elect, and the lane serves again
+    for s in range(P):
+        if s != lead:
+            eng.recover_member(0, s)
+    _drain(eng, limit=96)
+    replies, wm, ok = eng.read_lanes([0], np.asarray([[1, 1]], np.int32))
+    assert ok[0] and replies[0][0] == 1 and replies[0][1] == 9
+
+
+def test_checkpoint_roundtrip_preserves_read_counters():
+    """save/restore carries the read-plane counters (CHECKPOINT
+    defaults are "zeros" — an old archive restores cleanly, pinned by
+    the schema tests; here: a NEW archive round-trips exactly)."""
+    import os
+    eng = LockstepEngine(JitKvMachine(n_keys=8), N, P, ring_capacity=32,
+                         max_step_cmds=4, max_step_reads=4,
+                         lease_ttl=4, donate=False)
+    pay = np.zeros((N, 4, 4), np.int32)
+    pay[:, 0] = (1, 2, 5, 0)
+    eng.step(np.full((N,), 1, np.int32), jnp.asarray(pay))
+    _drain(eng)
+    eng.read_lanes(np.arange(N), np.tile(
+        np.asarray([[1, 2]], np.int32), (N, 1)))
+    served = np.asarray(eng.state.read_served).copy()
+    assert served.sum() > 0
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ck.npz")
+        eng.save(path)
+        eng2 = LockstepEngine(JitKvMachine(n_keys=8), N, P,
+                              ring_capacity=32, max_step_cmds=4,
+                              max_step_reads=4, lease_ttl=4,
+                              donate=False)
+        eng2.restore(path)
+        np.testing.assert_array_equal(
+            np.asarray(eng2.state.read_served), served)
+        replies, _wm, ok = eng2.read_lanes(np.arange(N), np.tile(
+            np.asarray([[1, 2]], np.int32), (N, 1)))
+        assert ok.all() and (replies[:, 1] == 5).all()
+
+
+def test_read_oracle_ttl_kv():
+    run_read_oracle(0, "ttl_kv", rounds=12)
+
+
+def test_read_oracle_stream():
+    run_read_oracle(1, "stream", rounds=12)
+
+
+def test_read_oracle_sharded_mesh():
+    run_read_oracle(2, "ttl_kv", mesh=True, rounds=8)
+
+
+@pytest.mark.slow
+def test_read_oracle_durable_disk_faults():
+    with tempfile.TemporaryDirectory() as d:
+        run_read_oracle(3, "stream", durable_dir=d, disk_faults=True,
+                        rounds=10)
+
+
+def test_ra_top_renders_read_panel(tmp_path):
+    """ra_top shows the read plane: serve rate over the snapshot
+    window, read_e2e p99 from the phase attribution, lease coverage,
+    shed/stale counters, and the REFUSING flag when stale_refused grew
+    between frames."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_rd = {"served": 10_000, "shed": 12, "stale_refused": 3,
+               "queue_rows": 64, "lease_coverage_pct": 96.5}
+    eng = {"lanes": 16, "members": 3,
+           "phases": {"read_e2e": {"count": 9, "p99_ms": 4.2}}}
+    t0 = time.time()
+    snap0 = {"seq": 1, "ts": t0 - 1.0, "engine": eng, "read": base_rd}
+    snap1 = {"seq": 2, "ts": t0, "engine": eng,
+             "read": {**base_rd, "served": 60_000, "stale_refused": 7}}
+    path = str(tmp_path / "obs.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(snap0) + "\n")
+        f.write(json.dumps(snap1) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "ra_top.py"),
+         path, "--once"], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "reads" in out and "srv/s" in out
+    assert "p99=4.2ms" in out
+    assert "lease=96%" in out or "lease=97%" in out
+    assert "q=64" in out and "shed=12" in out
+    assert "stale_refused=7" in out
+    assert "REFUSING" in out

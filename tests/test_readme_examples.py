@@ -21,8 +21,8 @@ def _blocks():
         text = f.read()
     return re.findall(r"```python\n(.*?)```", text, re.DOTALL)
 
-def test_readme_has_eight_python_blocks():
-    assert len(_blocks()) == 8
+def test_readme_has_nine_python_blocks():
+    assert len(_blocks()) == 9
 
 def test_classic_quickstart_block(tmp_path):
     src = _blocks()[0]
@@ -55,7 +55,7 @@ def test_engine_quickstart_block():
     assert ns["eng"].committed_total() > 0
 
 def test_trace_quickstart_block():
-    src = _blocks()[2]
+    src = _blocks()[3]
     lines = [ln for ln in src.splitlines()
              if not ln.strip().startswith("...")]
     src = "\n".join(lines)
@@ -72,7 +72,7 @@ def test_trace_quickstart_block():
 def test_slo_autotune_quickstart_block(tmp_path):
     """The ISSUE 9 closed-loop block: SLO verdicts + phase attribution
     + an autotuner ticking a real durable engine, as documented."""
-    src = _blocks()[4]
+    src = _blocks()[5]
     assert "SloEngine" in src and "AutoTuner" in src
     # patch only path + size; the loop runs exactly as documented
     src = _patch(src, '"/tmp/ra_slo_demo", 1024', "demo_dir, 64")
@@ -81,7 +81,7 @@ def test_slo_autotune_quickstart_block(tmp_path):
         exec(compile(src, "README.md[slo]", "exec"), ns)  # noqa: S102
         verdicts = ns["slo"].evaluate()["objectives"]
         assert set(verdicts) == {"commit_p99_ms", "fsync_p99_ms",
-                                 "cmds_per_s",
+                                 "cmds_per_s", "read_p99_ms",
                                  "steady_state_recompiles"}
         ns["eng"]._dur.flush_all()  # settle async confirms -> e2e samples
         snap = ns["obs"].snapshot()
@@ -97,7 +97,7 @@ def test_slo_autotune_quickstart_block(tmp_path):
 def test_ingress_quickstart_block():
     """The ISSUE 10 session-tier block: connect a bulk fleet, submit
     with auto-minted seqnos, pump, settle — exactly once."""
-    src = _blocks()[5]
+    src = _blocks()[6]
     assert "IngressPlane" in src and "connect_bulk" in src
     # shrink lanes + fleet for suite runtime; structure runs as written
     src = _patch(src, "10_000", "128")
@@ -119,7 +119,7 @@ def test_wire_quickstart_block():
     client + machine-level dedup — exactly-once-observable through a
     reconnect."""
     import time as _time
-    src = _blocks()[6]
+    src = _blocks()[7]
     assert "WireListener" in src and "WireClient" in src
     assert "DedupCounterMachine" in src
     # shrink lanes for suite runtime; structure runs as written
@@ -161,7 +161,7 @@ def test_failover_quickstart_block(tmp_path):
     """The ISSUE 17 failover block: one small-geometry failover soak
     runs as written and the exactly-once oracle closes (the kill-9
     dies loudly in the victim's WAL thread by design)."""
-    src = _blocks()[7]
+    src = _blocks()[8]
     assert "run_failover_soak" in src
     # route the soak's durable dirs into the test sandbox
     src = _patch(src, "kill_wave=2)",
@@ -176,7 +176,7 @@ def test_failover_quickstart_block(tmp_path):
 
 
 def test_telemetry_quickstart_block(tmp_path):
-    src = _blocks()[3]
+    src = _blocks()[4]
     assert "TelemetrySampler" in src and "Observatory" in src
     ring = str(tmp_path / "obs.jsonl")
     src = _patch(src, '"obs.jsonl"', "ring")
@@ -194,3 +194,14 @@ def test_telemetry_quickstart_block(tmp_path):
     assert snap["engine"]["telemetry"]["steps"] == 4
     import os
     assert os.path.exists(ring)
+
+def test_read_quickstart_block():
+    src = _blocks()[2]
+    assert "read_lanes" in src and "TtlKvMachine" in src
+    # shrink the documented 1024-lane config for suite runtime; the
+    # structure (shapes, calls, assertions) runs exactly as written
+    src = _patch(src, "1024", "64")
+    ns: dict = {}
+    exec(compile(src, "README.md[reads]", "exec"), ns)  # noqa: S102
+    assert ns["ok"].all() and (ns["replies"][:, 1] == 42).all()
+    assert (ns["watermark"] >= 0).all()

@@ -2234,3 +2234,132 @@ def test_placement_package_is_ra16_clean():
             if f.endswith(".py")]
     r = run_lint(*mods)
     assert "RA16" not in r.stdout, r.stdout
+
+
+# -- ISSUE 20: read-plane closure gates ------------------------------------
+
+def test_checker_gates_read_admission_lane(tmp_path):
+    """RA08 (read extension, ISSUE 20): per-session Python loops and
+    dict allocation in the ingress read lane (submit_reads /
+    _pop_read_block / _harvest_reads / _emit_read_replies + their
+    same-module closure) are flagged; scoped to ingress/__init__.py
+    only; `# ra08-ok:` allowlists survive."""
+    pkg = tmp_path / "ingress"
+    pkg.mkdir()
+    bad = pkg / "__init__.py"
+    body = textwrap.dedent("""\
+        import numpy as np
+
+        class Plane:
+            def submit_reads(self, handles, seqnos, queries):
+                for h in handles:                     # RA08: loop
+                    self.pending[h] = 1
+                return np.asarray(handles)
+
+            def _emit_read_replies(self, blk, mask, status, wms, reps):
+                out = {"rows": len(blk)}              # RA08: dict
+                return out
+
+            def read_overview(self):
+                # NOT hot: overview is control-plane reporting
+                return {k: 1 for k in ["a", "b"]}
+    """)
+    bad.write_text(body)
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA08") == 2, r.stdout
+    assert "submit_reads()" in r.stdout
+    assert "_emit_read_replies()" in r.stdout
+    assert "read_overview()" not in r.stdout
+    # allowlisted lines pass
+    bad.write_text(body
+                   .replace("for h in handles:",
+                            "for h in handles:  # ra08-ok: tiny")
+                   .replace('out = {"rows": len(blk)}',
+                            'out = {"rows": len(blk)}  # ra08-ok: once'))
+    r = run_lint(str(bad))
+    assert "RA08" not in r.stdout, r.stdout
+    # same content outside an ingress/ package: out of scope
+    other = tmp_path / "plane.py"
+    other.write_text(body)
+    r = run_lint(str(other))
+    assert "RA08" not in r.stdout, r.stdout
+
+
+def test_checker_gates_read_reply_egress(tmp_path):
+    """RA09 (read extension, ISSUE 20): per-read Python in the wire
+    server's READ_REPLY egress (_on_reads_served /
+    collect_read_replies + closure) is flagged; scoped to
+    wire/server.py only."""
+    pkg = tmp_path / "wire"
+    pkg.mkdir()
+    bad = pkg / "server.py"
+    body = textwrap.dedent("""\
+        import numpy as np
+
+        class Server:
+            def _on_reads_served(self, handles, seqnos, sts, wms, reps):
+                frames = [bytes(r) for r in reps]     # RA09: per-read
+                meta = {"n": len(handles)}            # RA09: dict
+                return frames, meta
+
+            def overview(self):
+                # NOT hot
+                return [i for i in range(3)]
+    """)
+    bad.write_text(body)
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA09") == 2, r.stdout
+    assert "_on_reads_served()" in r.stdout
+    assert "overview()" not in r.stdout
+    # same content outside a wire/ dir: out of scope
+    other = tmp_path / "server.py"
+    other.write_text(body)
+    r = run_lint(str(other))
+    assert "RA09" not in r.stdout, r.stdout
+
+
+def test_checker_gates_driver_read_observer(tmp_path):
+    """RA04 (read extension, ISSUE 20): a blocking device sync inside
+    the driver's read observer (_observe_reads + closure in
+    lockstep.py) is flagged — the observer may only touch COMPLETED
+    async read-aux copies."""
+    bad = tmp_path / "lockstep.py"
+    body = textwrap.dedent("""\
+        import numpy as np
+
+        class Driver:
+            def _observe_reads(self, t_sub, robs):
+                robs["read_done"].block_until_ready()  # RA04: sync
+                return self._decode(robs)
+
+            def _decode(self, robs):
+                return np.asarray(robs["read_replies"])  # RA04: sync
+
+            def read_overview(self):
+                # not on the observer path
+                return np.asarray([1, 2]).item()
+    """)
+    bad.write_text(body)
+    r = run_lint(str(bad))
+    assert r.returncode == 1
+    assert r.stdout.count("RA04") == 2, r.stdout
+    assert "_observe_reads" in r.stdout or "_decode" in r.stdout
+    # other module names are not gated by this scope
+    other = tmp_path / "driver.py"
+    other.write_text(body)
+    r = run_lint(str(other))
+    assert "RA04" not in r.stdout, r.stdout
+
+
+def test_read_plane_modules_are_read_gate_clean():
+    """Live pins: the real read lane satisfies its own gates — the
+    ingress admission/reply lane (RA08), the wire READ_REPLY egress
+    (RA09), and the driver read observer (RA04)."""
+    r = run_lint(os.path.join(REPO, "ra_tpu", "ingress", "__init__.py"))
+    assert "RA08" not in r.stdout, r.stdout
+    r = run_lint(os.path.join(REPO, "ra_tpu", "wire", "server.py"))
+    assert "RA09" not in r.stdout, r.stdout
+    r = run_lint(os.path.join(REPO, "ra_tpu", "engine", "lockstep.py"))
+    assert "RA04" not in r.stdout, r.stdout

@@ -168,7 +168,9 @@ def test_driver_stages_blocks_under_mesh_shardings():
     b = _mk("counter")
     mesh = shard_engine_state(b)
     sh = superstep_block_shardings(mesh)
-    assert set(sh) == {"n_new", "payloads", "query"}  # elect is host data
+    # elect is host data; the read block shards with the write block
+    # (ISSUE 20)
+    assert set(sh) == {"n_new", "payloads", "query", "n_read", "read_q"}
     drv = DispatchAheadDriver(b, max_in_flight=2, shardings=sh)
     rng = np.random.default_rng(23)
     blocks = [(np.full((4, N), 2, np.int32),

@@ -137,15 +137,28 @@ CLOSURE_RULES = [
                 [Scope(_SAMPLER_HOT_FUNCS, basenames={"telemetry.py"}),
                  Scope({"record"}, basenames={"blackbox.py"}),
                  Scope({"tick"}, basenames={"autotune.py"}),
-                 Scope({"drive_uniform_window"}, basenames={"mesh.py"})],
+                 Scope({"drive_uniform_window"}, basenames={"mesh.py"}),
+                 # ISSUE 20: the driver's read observer is a sampler
+                 # tick — it must only touch COMPLETED async read-aux
+                 # copies, never force a device sync of its own
+                 Scope({"_observe_reads"}, basenames={"lockstep.py"})],
                 "sampler tick-path"),
     ClosureRule("RA08", "loops",
                 [Scope({"offer", "pop_block"},
                        basenames={"coalesce.py"}),
-                 Scope({"ingress_submit_wave"}, basenames={"mesh.py"})],
+                 Scope({"ingress_submit_wave"}, basenames={"mesh.py"}),
+                 # ISSUE 20: the read admission/reply lane — per-WAVE
+                 # vectorized, no per-session Python on the hot path
+                 Scope({"submit_reads", "_pop_read_block",
+                        "_harvest_reads", "_emit_read_replies"},
+                       basenames={"__init__.py"}, parent="ingress")],
                 "coalescer"),
     ClosureRule("RA09", "loops",
-                [Scope({"sweep"}, dirname="wire")],
+                [Scope({"sweep"}, dirname="wire"),
+                 # ISSUE 20: READ_REPLY egress — one frame per
+                 # connection per wave, never per read
+                 Scope({"_on_reads_served", "collect_read_replies"},
+                       basenames={"server.py"}, dirname="wire")],
                 "wire sweep"),
     ClosureRule("RA10", "per_entry",
                 [Scope({"_send_items", "_wire_form"},

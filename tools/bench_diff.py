@@ -53,9 +53,12 @@ from __future__ import annotations
 import json
 import sys
 
-#: lower-is-better latency fields compared when present in both rows
+#: lower-is-better latency fields compared when present in both rows.
+#: ``read_p99_ms`` (ISSUE 20) is the read plane's submit→serve p99 —
+#: it rides the same shape (-1 "no reads ran" sentinel skipped)
 LATENCY_FIELDS = ("p50_commit_latency_ms", "p99_commit_latency_ms",
-                  "p50_applied_latency_ms", "p99_applied_latency_ms")
+                  "p50_applied_latency_ms", "p99_applied_latency_ms",
+                  "read_p99_ms")
 
 #: ingress-plane keys (ISSUE 10), compared when BOTH tails carry them:
 #: throughput is higher-is-better like ``value``; shed rate is
@@ -72,7 +75,11 @@ LATENCY_FIELDS = ("p50_commit_latency_ms", "p99_commit_latency_ms",
 #: lower-is-better with a -1 "no failover ran" sentinel, and
 #: ``failover_lost_acked`` lower-is-better where 0 is THE healthy
 #: baseline — any acked-but-lost delta appearing from 0 must flag
-INGRESS_RATE_FIELDS = ("ingress_cmds_per_s", "wire_cmds_per_s")
+#: read-plane throughput (ISSUE 20): served consistent reads per
+#: second through the vectorized lease/read-index path — higher-better
+#: like the write rates it rides next to
+INGRESS_RATE_FIELDS = ("ingress_cmds_per_s", "wire_cmds_per_s",
+                       "read_cmds_per_s")
 #: ``encode_share_pct`` (ISSUE 18) rides the shed shape as well: the
 #: codec's encode phase share of total phase time — lower-better,
 #: 0 a meaningful healthy value (everything arrived pre-encoded), and
@@ -82,12 +89,16 @@ INGRESS_RATE_FIELDS = ("ingress_cmds_per_s", "wire_cmds_per_s")
 #: home, across real processes + the latency matrix) lower-is-better,
 #: and ``geo_false_migrations`` lower-is-better where 0 is THE healthy
 #: baseline — any migration during a delay-only episode must flag
+#: read-plane shed/stale keys (ISSUE 20) ride the shed shape: both
+#: lower-better with 0 THE healthy baseline — a stale-refusal count
+#: appearing from 0 under the same workload must flag
 INGRESS_SHED_FIELDS = ("ingress_shed_rate", "wire_shed_rate",
                        "wire_reconnect_recovery_s",
                        "failover_recovery_s", "failover_lost_acked",
                        "encode_share_pct",
                        "geo_failover_recovery_s",
-                       "geo_false_migrations")
+                       "geo_false_migrations",
+                       "read_shed_rate", "read_stale_refused")
 
 #: device-plane compile counts (ISSUE 16): absolute comparison, any
 #: growth is a regression — the workload is fixed across rounds, so an

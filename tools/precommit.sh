@@ -24,7 +24,13 @@
 #    delay-only episode that must migrate nothing, then a SIGKILL
 #    failover over the reliable RPC tier with the exactly-once oracle
 #    read back over RPC.
-# 5. `pytest tests/test_static_gates.py` runs the full gate suite
+# 5. `tools/soak.py --reads 0 1` runs ONE seed of the ISSUE 20
+#    linearizable-read oracle (~8s): both read machines, single-device
+#    and sharded mesh plus a durable disk-fault run, with every served
+#    consistent read checked against the host model fold across
+#    election churn / leader kills / majority partitions (stale serves
+#    pinned 0, lease reads never outlive expiry).
+# 6. `pytest tests/test_static_gates.py` runs the full gate suite
 #    (rule fixtures + clean pins + the analyzer runtime budget).
 #
 # Exit nonzero on any finding or test failure.  The full-tree lint
@@ -36,4 +42,5 @@ python tools/lint.py --changed
 python tools/soak.py --device-obs 0 1
 python tools/soak.py --failover 0
 python tools/soak.py --geo 0
+python tools/soak.py --reads 0 1
 exec python -m pytest tests/test_static_gates.py -q

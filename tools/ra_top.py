@@ -178,6 +178,30 @@ def render(snap: dict, prev: dict | None = None) -> str:
             f"paused={wire.get('paused_conns', 0)})  "
             f"credit[{_spark(hist)}] {hist_s or 'idle'}"
             + (f"  errs={errs}" if errs else ""))
+    # -- read plane (ISSUE 20) ---------------------------------------------
+    rd = snap.get("read") or {}
+    if rd:
+        p_rd = (prev.get("read") or {}) if prev is not None else {}
+        if prev is not None:
+            dt = max(ts - prev.get("ts", ts), 1e-9)
+            ds = rd.get("served", 0) - p_rd.get("served", 0)
+            rate = _fmt_rate(ds / dt)
+        else:
+            rate = "--"
+        # read_p99 from the phase attribution (the read_p99_ms SLO's
+        # own signal); -1.0 is the repo-wide "never measured" sentinel
+        p99 = ((eng.get("phases") or {}).get("read_e2e") or {}) \
+            .get("p99_ms", -1.0)
+        p99_s = f"{p99:.1f}ms" if p99 >= 0 else "--"
+        stale = rd.get("stale_refused", 0)
+        flag = " <<< REFUSING" if prev is not None and \
+            stale > p_rd.get("stale_refused", 0) else ""
+        shed = rd.get("shed", 0)
+        lines.append(
+            f"reads   {rate} srv/s  p99={p99_s}  "
+            f"lease={rd.get('lease_coverage_pct', 0.0):.0f}%  "
+            f"q={rd.get('queue_rows', 0)} shed={shed} "
+            f"stale_refused={stale}{flag}")
     # -- device plane (ISSUE 16) -------------------------------------------
     dev = snap.get("device") or {}
     if dev:

@@ -12,6 +12,7 @@ Usage:  python tools/soak.py [seeds_per_family] [offset]
         python tools/soak.py --device-obs SEED [n]
         python tools/soak.py --failover SEED [SEED...]
         python tools/soak.py --geo SEED [SEED...]
+        python tools/soak.py --reads SEED [n]
 
 ``--wire`` climbs the ISSUE 12 connection ladder (ra_tpu/wire/soak.py
 run_wire_soak): C10k (with a real-socket side-car) → C100k loopback
@@ -71,6 +72,17 @@ then that a deliberate mixed-shape probe (K=8 -> K=4) IS detected
 within one Observatory window and attributed to the drifting block
 shape.  Engine configs are seed-varied so every episode compiles
 fresh jit variants.
+
+``--reads`` runs the linearizable-read oracle family
+(tests/test_read_plane.run_read_oracle, ISSUE 20): ``n`` seeded
+episodes, each driving BOTH read machines (TtlKvMachine, StreamMachine)
+single-device AND on the sharded 8-way lane mesh — plus one durable run
+under a seeded WAL DiskFaultPlan — through election churn, leader
+kills and majority partitions while a host model machine folds the
+same committed history.  Every read the device SERVES must equal the
+model's answer over the full committed prefix (a reply matching only
+an older prefix is a stale serve, pinned 0); a leader cut from quorum
+must REFUSE once its lease expires; healed lanes must serve again.
 
 Prints one line per family with pass/fail counts; exits nonzero on the
 first failing seed (which should then be added to the in-suite list).
@@ -349,6 +361,45 @@ def _device_obs_main(argv: list) -> int:
     return 1 if failed else 0
 
 
+def _reads_main(argv: list) -> int:
+    """--reads SEED [n]: the linearizable-read oracle family (ISSUE 20).
+
+    Each seed drives every cell of {ttl_kv, stream} x {single-device,
+    sharded mesh} through the read oracle — consistent reads across
+    election churn, leader kills and majority partitions must reflect
+    every committed write (stale serves pinned 0, refusals legal,
+    healed lanes must serve) — plus one durable + disk-fault run."""
+    import test_read_plane as trp
+
+    seed = int(argv[0]) if argv else 0
+    n = int(argv[1]) if len(argv) > 1 else 4
+    t0 = time.time()
+    failed = []
+    served = refused = 0
+    for s in range(seed, seed + n):
+        try:
+            for kind in ("ttl_kv", "stream"):
+                for mesh in (False, True):
+                    st = trp.run_read_oracle(s, kind, mesh=mesh,
+                                             rounds=10 if mesh else 14)
+                    served += st["served"]
+                    refused += st["refused"]
+            with tempfile.TemporaryDirectory(prefix="soak_reads_") as d:
+                st = trp.run_read_oracle(s, "stream", durable_dir=d,
+                                         disk_faults=True, rounds=10)
+                served += st["served"]
+                refused += st["refused"]
+        except Exception:  # noqa: BLE001 — report seed + continue
+            failed.append(s)
+            if len(failed) == 1:
+                traceback.print_exc()
+    print(f"reads: {n - len(failed)}/{n} ok in {time.time() - t0:.1f}s  "
+          f"served={served} refused={refused} stale_serves=0"
+          + (f"  FAILED seeds: {failed[:10]}" if failed else ""),
+          flush=True)
+    return 1 if failed else 0
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--wire":
         return _wire_main(sys.argv[2:])
@@ -368,6 +419,8 @@ def main() -> int:
         return _failover_main(sys.argv[2:])
     if len(sys.argv) > 1 and sys.argv[1] == "--geo":
         return _geo_main(sys.argv[2:])
+    if len(sys.argv) > 1 and sys.argv[1] == "--reads":
+        return _reads_main(sys.argv[2:])
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 400
     off = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
     families = [
